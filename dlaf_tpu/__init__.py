@@ -1,0 +1,22 @@
+"""dlaf_tpu — TPU-native distributed dense linear algebra.
+
+A brand-new framework with the capabilities of DLA-Future (ETH-CSCS), rebuilt
+idiomatically for TPUs: JAX/XLA compute, a 2D ``jax.sharding.Mesh`` with ICI
+collectives in place of the MPI communicator grid, block-cyclic tile storage
+in HBM, and host-C++ components for the inherently sequential stages. See
+``SURVEY.md`` at the repo root for the layer-by-layer mapping to the reference.
+
+Layer map (reference → here):
+  L1 foundations      → :mod:`dlaf_tpu.types`, :mod:`dlaf_tpu.common`
+  L2 runtime glue     → :mod:`dlaf_tpu.config` (+ XLA program order)
+  L3 matrix model     → :mod:`dlaf_tpu.matrix`
+  L4 communication    → :mod:`dlaf_tpu.comm`
+  L5 tile kernels     → :mod:`dlaf_tpu.tile_ops`
+  L6 algorithms       → :mod:`dlaf_tpu.algorithms`, :mod:`dlaf_tpu.eigensolver`
+  L7 miniapps         → :mod:`dlaf_tpu.miniapp`
+"""
+
+from .config import Configuration, finalize, get_configuration, initialize
+from .types import Backend, Device, SizeType, total_ops
+
+__version__ = "0.1.0"

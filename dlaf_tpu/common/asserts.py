@@ -1,0 +1,62 @@
+"""Three-level assertion system.
+
+TPU-native counterpart of the reference's ``common/assert.h:105-121``
+(``DLAF_ASSERT`` / ``DLAF_ASSERT_MODERATE`` / ``DLAF_ASSERT_HEAVY``): three
+severity tiers, each independently switchable, that print the failing
+expression with a source location. The reference gates tiers at compile time
+via CMake options (``src/CMakeLists.txt:33-46``); here they are gated at import
+time by environment variables so test runs can enable the heavy tier:
+
+* ``DLAF_ASSERT_ENABLE``          (default: on)
+* ``DLAF_ASSERT_MODERATE_ENABLE`` (default: on  — reference default: debug only)
+* ``DLAF_ASSERT_HEAVY_ENABLE``    (default: off)
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "off", "false", "no", "")
+
+
+ASSERT_ENABLED = _env_flag("DLAF_ASSERT_ENABLE", True)
+ASSERT_MODERATE_ENABLED = _env_flag("DLAF_ASSERT_MODERATE_ENABLE", True)
+ASSERT_HEAVY_ENABLED = _env_flag("DLAF_ASSERT_HEAVY_ENABLE", False)
+
+
+class DlafAssertError(AssertionError):
+    """Raised on a failed DLAF assertion (reference aborts; we raise)."""
+
+
+def _fail(level: str, message: str, extras: tuple) -> None:
+    frame = inspect.stack()[2]
+    loc = f"{frame.filename}:{frame.lineno} in {frame.function}"
+    extra = ("\n  " + "\n  ".join(str(e) for e in extras)) if extras else ""
+    raise DlafAssertError(f"[{level}] {message}\n  at {loc}{extra}")
+
+
+def dlaf_assert(cond: bool, message: str = "", *extras) -> None:
+    """Tier-1 assertion: cheap invariants, on by default everywhere.
+
+    Mirrors ``DLAF_ASSERT`` (reference ``common/assert.h:105``).
+    """
+    if ASSERT_ENABLED and not cond:
+        _fail("DLAF_ASSERT", message, extras)
+
+
+def dlaf_assert_moderate(cond: bool, message: str = "", *extras) -> None:
+    """Tier-2 assertion: moderate-cost checks (reference ``assert.h:113``)."""
+    if ASSERT_MODERATE_ENABLED and not cond:
+        _fail("DLAF_ASSERT_MODERATE", message, extras)
+
+
+def dlaf_assert_heavy(cond: bool, message: str = "", *extras) -> None:
+    """Tier-3 assertion: expensive checks (reference ``assert.h:121``)."""
+    if ASSERT_HEAVY_ENABLED and not cond:
+        _fail("DLAF_ASSERT_HEAVY", message, extras)

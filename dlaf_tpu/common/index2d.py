@@ -1,0 +1,121 @@
+"""Strongly-tagged 2D index/size algebra.
+
+TPU-native counterpart of the reference's ``common/index2d.h`` plus the tag
+instantiations from ``matrix/index.h`` and ``communication/index.h``: a small
+family of (row, col) value types whose *tags* prevent mixing incompatible
+coordinate spaces (global-element vs global-tile vs local-tile vs
+tile-element vs process-grid coordinates). The reference enforces this with
+C++ template tags (``common/index2d.h:141-238``); here each tag is a distinct
+frozen dataclass sharing arithmetic through two mixins.
+
+Also provides RowMajor/ColMajor linearization (``index2d.h:288-410``) and the
+``iterate_range2d`` tile-loop helper (``common/range2d.h:15-269``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterator, Type
+
+from ..types import SizeType
+from .asserts import dlaf_assert
+
+
+class Ordering(enum.Enum):
+    """Linearization order (reference ``common/index2d.h:24-30``)."""
+
+    RowMajor = "row-major"
+    ColMajor = "col-major"
+
+
+@dataclasses.dataclass(frozen=True, order=False)
+class _Coords2D:
+    """Common (row, col) payload (reference ``basic_coords``)."""
+
+    row: SizeType
+    col: SizeType
+
+    def __iter__(self):
+        yield self.row
+        yield self.col
+
+    def transposed(self):
+        return type(self)(self.col, self.row)
+
+    def __str__(self) -> str:
+        return f"({self.row}, {self.col})"
+
+
+class _SizeMixin:
+    def is_valid(self) -> bool:
+        return self.row >= 0 and self.col >= 0
+
+    def is_empty(self) -> bool:
+        return self.row == 0 or self.col == 0
+
+    def linear_size(self) -> SizeType:
+        return self.row * self.col
+
+
+class _IndexMixin:
+    def is_valid(self) -> bool:
+        return self.row >= 0 and self.col >= 0
+
+    def is_in(self, size) -> bool:
+        """True iff this index addresses an element of ``size``
+        (reference ``index2d.h:198-208``; size must be the paired tag)."""
+        dlaf_assert(type(size) is self._size_tag,
+                    f"is_in: expected {self._size_tag.__name__}, got {type(size).__name__}")
+        return 0 <= self.row < size.row and 0 <= self.col < size.col
+
+
+def _make_pair(index_name: str, size_name: str) -> tuple[Type, Type]:
+    size_cls = type(size_name, (_Coords2D, _SizeMixin), {})
+    index_cls = type(index_name, (_Coords2D, _IndexMixin), {"_size_tag": size_cls})
+    return index_cls, size_cls
+
+
+# Tag zoo (reference matrix/index.h + communication/index.h)
+GlobalElementIndex, GlobalElementSize = _make_pair("GlobalElementIndex", "GlobalElementSize")
+GlobalTileIndex, GlobalTileSize = _make_pair("GlobalTileIndex", "GlobalTileSize")
+LocalTileIndex, LocalTileSize = _make_pair("LocalTileIndex", "LocalTileSize")
+LocalElementIndex, LocalElementSize = _make_pair("LocalElementIndex", "LocalElementSize")
+TileElementIndex, TileElementSize = _make_pair("TileElementIndex", "TileElementSize")
+# Process-grid coordinates (reference comm::Index2D / comm::Size2D)
+RankIndex2D, GridSize2D = _make_pair("RankIndex2D", "GridSize2D")
+
+
+def compute_linear_index(ordering: Ordering, index, dims) -> SizeType:
+    """Linearize ``index`` inside a box of extents ``dims``
+    (reference ``index2d.h:288-330``)."""
+    dlaf_assert(index.is_in(dims) if hasattr(index, "is_in") else True,
+                f"linear index out of bounds: {index} in {dims}")
+    if ordering is Ordering.RowMajor:
+        return index.row * dims.col + index.col
+    return index.col * dims.row + index.row
+
+
+def compute_coords(ordering: Ordering, linear: SizeType, dims, cls):
+    """Inverse of :func:`compute_linear_index` (reference ``index2d.h:340-380``)."""
+    if ordering is Ordering.RowMajor:
+        return cls(linear // dims.col, linear % dims.col)
+    return cls(linear % dims.row, linear // dims.row)
+
+
+def iterate_range2d(begin_or_end, end=None, *, cls=LocalTileIndex) -> Iterator:
+    """Iterate a 2D half-open range in col-major order, yielding ``cls`` indices.
+
+    ``iterate_range2d(end)`` iterates [(0,0), end); ``iterate_range2d(begin,
+    end)`` iterates [begin, end). Col-major order matches the reference's
+    ``common/range2d.h`` iteration used by all tile loops.
+    """
+    if end is None:
+        b_row, b_col = 0, 0
+        e_row, e_col = begin_or_end
+    else:
+        b_row, b_col = begin_or_end
+        e_row, e_col = end
+    for col in range(b_col, e_col):
+        for row in range(b_row, e_row):
+            yield cls(row, col)

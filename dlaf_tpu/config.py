@@ -1,0 +1,115 @@
+"""Layered runtime configuration: defaults < user struct < env < CLI.
+
+TPU-native counterpart of the reference's ``configuration`` struct
+(``init.h:28-34``) and its layering logic (``src/init.cpp:117-177``): every
+field has a built-in default, can be overridden by a user-supplied
+``Configuration``, then by a ``DLAF_<NAME>`` environment variable, then by a
+``--dlaf:<name>=<value>`` command-line option. ``dlaf:print-config`` mirrors
+``--dlaf:print-config`` (``src/init.cpp:190-194``).
+
+The reference's fields are CUDA-stream/umpire-pool counts; the TPU runtime has
+no user-managed streams or pools (PJRT owns both), so the fields here are the
+knobs this framework actually honors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class Configuration:
+    """Runtime knobs (analog of reference ``init.h:28-34``)."""
+
+    #: Print the final configuration at initialize() (``--dlaf:print-config``).
+    print_config: bool = False
+    #: Rank ordering when building a grid from a flat device list
+    #: ("row-major" | "col-major"), reference CommunicatorGrid ctor option.
+    grid_ordering: str = "row-major"
+    #: Implementation of the band->tridiag bulge chasing stage:
+    #: "native" (C++ via ctypes) with automatic fallback to "numpy".
+    band_to_tridiag_impl: str = "native"
+    #: Look-ahead depth for panel pipelining in distributed factorizations
+    #: (analog of the reference's round-robin workspace count,
+    #: ``factorization/cholesky/impl.h:187-189``).
+    lookahead: int = 2
+    #: Enable float64/complex128 support (sets jax_enable_x64).
+    enable_x64: bool = True
+
+    def _fields(self):
+        return {f.name: f for f in dataclasses.fields(self)}
+
+
+def _parse(value: str, typ):
+    if typ is bool:
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def update_configuration(
+    user: Optional[Configuration] = None,
+    argv: Optional[Sequence[str]] = None,
+) -> Configuration:
+    """Resolve the effective configuration.
+
+    Precedence (highest wins), mirroring ``src/init.cpp:117-156``:
+    CLI ``--dlaf:<name>=<v>`` > env ``DLAF_<NAME>`` > ``user`` struct > default.
+    """
+    cfg = dataclasses.replace(user) if user is not None else Configuration()
+    fields = cfg._fields()
+    for name, f in fields.items():
+        env = os.environ.get("DLAF_" + name.upper())
+        if env is not None:
+            setattr(cfg, name, _parse(env, f.type if isinstance(f.type, type) else type(f.default)))
+    if argv:
+        for arg in argv:
+            if not arg.startswith("--dlaf:"):
+                continue
+            body = arg[len("--dlaf:"):]
+            if "=" in body:
+                key, val = body.split("=", 1)
+            else:
+                key, val = body, "true"
+            key = key.replace("-", "_")
+            if key in fields:
+                f = fields[key]
+                setattr(cfg, key, _parse(val, f.type if isinstance(f.type, type) else type(f.default)))
+    return cfg
+
+
+_active: Optional[Configuration] = None
+
+
+def initialize(user: Optional[Configuration] = None,
+               argv: Optional[Sequence[str]] = None) -> Configuration:
+    """Bring up the runtime (analog of ``dlaf::initialize``, ``init.h:60-75``).
+
+    Resolves configuration and applies process-wide JAX settings (x64). Safe
+    to call more than once; later calls re-resolve configuration.
+    """
+    global _active
+    cfg = update_configuration(user, argv)
+    if cfg.enable_x64:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    if cfg.print_config:
+        print(cfg)
+    _active = cfg
+    return cfg
+
+
+def get_configuration() -> Configuration:
+    """Active configuration, initializing with defaults on first use."""
+    global _active
+    if _active is None:
+        _active = initialize()
+    return _active
+
+
+def finalize() -> None:
+    """Tear down (analog of ``dlaf::finalize``); PJRT owns real resources."""
+    global _active
+    _active = None

@@ -1,0 +1,150 @@
+"""2D block-cyclic distribution map.
+
+TPU-native counterpart of the reference's ``matrix/distribution.h:25-386`` (and
+its design note ``misc/matrix_distribution.md``): given a global matrix size, a
+block size, a process-grid size, this process's grid coordinates, and a
+*source rank offset*, answer every index question the algorithms ask —
+global-tile ↔ local-tile ↔ owning-rank ↔ tile-element conversions, local
+extents, and edge-tile sizes. Pure index math; per-axis work is delegated to
+:mod:`.util_distribution`.
+
+On TPU the "process grid" is the 2D device mesh (``comm.grid.Grid``); each mesh
+coordinate plays the role of an MPI rank in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..common.asserts import dlaf_assert
+from ..common.index2d import (GlobalElementIndex, GlobalElementSize, GlobalTileIndex,
+                              GlobalTileSize, GridSize2D, LocalElementSize, LocalTileIndex,
+                              LocalTileSize, RankIndex2D, TileElementIndex, TileElementSize)
+from ..types import SizeType, ceil_div
+from . import util_distribution as ud
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """Block-cyclic 2D distribution (reference ``matrix/distribution.h:25``)."""
+
+    size: GlobalElementSize
+    block_size: TileElementSize
+    grid_size: GridSize2D = GridSize2D(1, 1)
+    rank: RankIndex2D = RankIndex2D(0, 0)
+    source_rank: RankIndex2D = RankIndex2D(0, 0)
+
+    def __post_init__(self):
+        dlaf_assert(self.size.is_valid(), f"invalid size {self.size}")
+        dlaf_assert(self.block_size.row > 0 and self.block_size.col > 0,
+                    f"invalid block size {self.block_size}")
+        dlaf_assert(self.grid_size.row > 0 and self.grid_size.col > 0,
+                    f"invalid grid {self.grid_size}")
+        dlaf_assert(self.rank.is_in(self.grid_size), f"rank {self.rank} not in {self.grid_size}")
+        dlaf_assert(self.source_rank.is_in(self.grid_size),
+                    f"source rank {self.source_rank} not in {self.grid_size}")
+
+    # -- global extents -----------------------------------------------------
+
+    @property
+    def nr_tiles(self) -> GlobalTileSize:
+        """Global tile-grid extents (reference ``distribution.h:nrTiles``)."""
+        return GlobalTileSize(ceil_div(self.size.row, self.block_size.row) if self.size.row else 0,
+                              ceil_div(self.size.col, self.block_size.col) if self.size.col else 0)
+
+    # -- local extents ------------------------------------------------------
+
+    @property
+    def local_nr_tiles(self) -> LocalTileSize:
+        nt = self.nr_tiles
+        return LocalTileSize(
+            ud.local_nr_tiles(nt.row, self.grid_size.row, self.rank.row, self.source_rank.row),
+            ud.local_nr_tiles(nt.col, self.grid_size.col, self.rank.col, self.source_rank.col))
+
+    @property
+    def local_size(self) -> LocalElementSize:
+        return LocalElementSize(
+            ud.local_size(self.size.row, self.block_size.row, self.grid_size.row,
+                          self.rank.row, self.source_rank.row),
+            ud.local_size(self.size.col, self.block_size.col, self.grid_size.col,
+                          self.rank.col, self.source_rank.col))
+
+    # -- ownership ----------------------------------------------------------
+
+    def rank_global_tile(self, index: GlobalTileIndex) -> RankIndex2D:
+        """Rank owning a global tile (reference ``distribution.h:rankGlobalTile``)."""
+        dlaf_assert(index.is_in(self.nr_tiles), f"{index} not in {self.nr_tiles}")
+        return RankIndex2D(
+            ud.rank_global_tile(index.row, self.grid_size.row, self.source_rank.row),
+            ud.rank_global_tile(index.col, self.grid_size.col, self.source_rank.col))
+
+    def rank_global_element(self, index: GlobalElementIndex) -> RankIndex2D:
+        return self.rank_global_tile(self.global_tile_index(index))
+
+    # -- tile index conversions --------------------------------------------
+
+    def local_tile_index(self, index: GlobalTileIndex) -> LocalTileIndex:
+        """Local tile index of a tile owned by this rank
+        (reference ``distribution.h:localTileIndex``)."""
+        dlaf_assert(self.rank_global_tile(index) == self.rank,
+                    f"tile {index} not owned by rank {self.rank}")
+        return LocalTileIndex(ud.local_tile_from_global_tile(index.row, self.grid_size.row),
+                              ud.local_tile_from_global_tile(index.col, self.grid_size.col))
+
+    def global_tile_index(self, index) -> GlobalTileIndex:
+        """From a GlobalElementIndex or LocalTileIndex
+        (reference ``distribution.h:globalTileIndex`` overloads)."""
+        if isinstance(index, GlobalElementIndex):
+            return GlobalTileIndex(
+                ud.tile_from_element(index.row, self.block_size.row),
+                ud.tile_from_element(index.col, self.block_size.col))
+        dlaf_assert(isinstance(index, LocalTileIndex), f"bad index type {type(index)}")
+        return GlobalTileIndex(
+            ud.global_tile_from_local_tile(index.row, self.grid_size.row,
+                                           self.rank.row, self.source_rank.row),
+            ud.global_tile_from_local_tile(index.col, self.grid_size.col,
+                                           self.rank.col, self.source_rank.col))
+
+    def next_local_tile_from_global_tile(self, row: SizeType, col: SizeType) -> LocalTileIndex:
+        """Per-axis smallest local tile >= the given global tile indices
+        (reference ``distribution.h:nextLocalTileFromGlobalTile``)."""
+        return LocalTileIndex(
+            ud.next_local_tile_from_global_tile(row, self.grid_size.row,
+                                                self.rank.row, self.source_rank.row),
+            ud.next_local_tile_from_global_tile(col, self.grid_size.col,
+                                                self.rank.col, self.source_rank.col))
+
+    # -- element conversions ------------------------------------------------
+
+    def tile_element_index(self, index: GlobalElementIndex) -> TileElementIndex:
+        return TileElementIndex(
+            ud.tile_element_from_element(index.row, self.block_size.row),
+            ud.tile_element_from_element(index.col, self.block_size.col))
+
+    def global_element_index(self, tile: GlobalTileIndex,
+                             el: TileElementIndex) -> GlobalElementIndex:
+        return GlobalElementIndex(
+            ud.element_from_tile_and_tile_element(tile.row, el.row, self.block_size.row),
+            ud.element_from_tile_and_tile_element(tile.col, el.col, self.block_size.col))
+
+    # -- tile sizes ----------------------------------------------------------
+
+    def tile_size_of(self, index: GlobalTileIndex) -> TileElementSize:
+        """Actual extents of a global tile; edge tiles may be short
+        (reference ``distribution.h:tileSize``)."""
+        return TileElementSize(
+            ud.tile_size_of(index.row, self.size.row, self.block_size.row),
+            ud.tile_size_of(index.col, self.size.col, self.block_size.col))
+
+    def local_tile_linear_index(self, index: LocalTileIndex) -> SizeType:
+        """Col-major linearization over local tiles (reference ``MatrixBase``)."""
+        lnt = self.local_nr_tiles
+        dlaf_assert(index.is_in(lnt), f"{index} not in {lnt}")
+        return index.col * lnt.row + index.row
+
+    def single_rank(self) -> bool:
+        return self.grid_size == GridSize2D(1, 1)
+
+    def __str__(self) -> str:
+        return (f"Distribution(size={self.size}, block={self.block_size}, "
+                f"grid={self.grid_size}, rank={self.rank}, src={self.source_rank})")

@@ -1,0 +1,87 @@
+"""Pure 1D block-cyclic index conversions.
+
+TPU-native counterpart of the reference's ``matrix/util_distribution.h:28-140``:
+stateless per-axis functions mapping between global elements, global tiles,
+local tiles, tile-local elements, and owning ranks of a block-cyclic
+distribution with a source-rank offset. :class:`..matrix.distribution.Distribution`
+composes these per-axis functions into the 2D map.
+
+Conventions (identical to the reference / ScaLAPACK):
+* global tile ``i`` is owned by rank ``(src_rank + i) % grid_size``;
+* the local tile index of an owned global tile ``i`` is ``i // grid_size``;
+* the last global tile may be smaller than ``tile_size``.
+"""
+
+from __future__ import annotations
+
+from ..types import SizeType, ceil_div
+
+
+def tile_from_element(element: SizeType, tile_size: SizeType) -> SizeType:
+    """Global tile index containing global element (``util_distribution.h:34``)."""
+    return element // tile_size
+
+
+def tile_element_from_element(element: SizeType, tile_size: SizeType) -> SizeType:
+    """Index inside its tile of a global element (``util_distribution.h:41``)."""
+    return element % tile_size
+
+
+def element_from_tile_and_tile_element(tile: SizeType, tile_element: SizeType,
+                                       tile_size: SizeType) -> SizeType:
+    """Global element from (tile, in-tile) pair (``util_distribution.h:48``)."""
+    return tile * tile_size + tile_element
+
+
+def rank_global_tile(tile: SizeType, grid_size: SizeType, src_rank: SizeType) -> SizeType:
+    """Rank owning global tile ``tile`` (``util_distribution.h:56``)."""
+    return (src_rank + tile) % grid_size
+
+
+def local_tile_from_global_tile(tile: SizeType, grid_size: SizeType) -> SizeType:
+    """Local tile index of an *owned* global tile (``util_distribution.h:64``).
+
+    Only meaningful on the rank returned by :func:`rank_global_tile`.
+    """
+    return tile // grid_size
+
+
+def next_local_tile_from_global_tile(tile: SizeType, grid_size: SizeType,
+                                     rank: SizeType, src_rank: SizeType) -> SizeType:
+    """Smallest local tile index on ``rank`` whose global tile is >= ``tile``
+    (``util_distribution.h:73-88``). Equals ``local_nr_tiles`` when ``rank``
+    owns no tile at or past ``tile``.
+    """
+    r = (rank - src_rank) % grid_size
+    # smallest l >= 0 with l*grid_size + r >= tile, i.e. ceil((tile-r)/grid_size)
+    return max(0, -(-(tile - r) // grid_size))
+
+
+def global_tile_from_local_tile(local_tile: SizeType, grid_size: SizeType,
+                                rank: SizeType, src_rank: SizeType) -> SizeType:
+    """Global tile index of local tile ``local_tile`` on ``rank``
+    (``util_distribution.h:95``)."""
+    return local_tile * grid_size + (rank - src_rank) % grid_size
+
+
+def local_nr_tiles(nr_tiles: SizeType, grid_size: SizeType,
+                   rank: SizeType, src_rank: SizeType) -> SizeType:
+    """Number of local tiles on ``rank`` for ``nr_tiles`` global tiles."""
+    return next_local_tile_from_global_tile(nr_tiles, grid_size, rank, src_rank)
+
+
+def tile_size_of(tile: SizeType, size: SizeType, tile_size: SizeType) -> SizeType:
+    """Extent of global tile ``tile`` on an axis of ``size`` elements
+    (edge tiles may be short)."""
+    return min(tile_size, size - tile * tile_size)
+
+
+def local_size(size: SizeType, tile_size: SizeType, grid_size: SizeType,
+               rank: SizeType, src_rank: SizeType) -> SizeType:
+    """Number of local elements on ``rank`` along an axis."""
+    nt = ceil_div(size, tile_size) if size > 0 else 0
+    ln = local_nr_tiles(nt, grid_size, rank, src_rank)
+    if ln == 0:
+        return 0
+    last_global = global_tile_from_local_tile(ln - 1, grid_size, rank, src_rank)
+    return (ln - 1) * tile_size + tile_size_of(last_global, size, tile_size)

@@ -1,0 +1,136 @@
+"""Scalar/enum foundation types for the TPU-native DLA-Future rebuild.
+
+TPU-native counterpart of the reference's ``include/dlaf/types.h``:
+
+* ``Device`` / ``Backend`` enums (reference ``types.h:30-60``) — here the device
+  zoo is {CPU, TPU}: CPU is the host/XLA-CPU backend used for tests and the
+  host-resident stages of the eigensolver pipeline (band→tridiag bulge chasing,
+  secular-equation solves), TPU is the accelerator backend.
+* Default device/backend mappings (reference ``types.h:75-106``).
+* Element-type machinery and the *flop-weight model* used for GFLOPS reporting
+  (reference ``types.h:120-131,158-161``): a complex multiply counts 6 real ops
+  and a complex add counts 2.
+
+Everything here is pure Python with no JAX dependency at import time so that
+index math and configuration can be used host-side without touching a device.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Union
+
+import numpy as np
+
+#: Signed size type used for all element/tile indices (reference
+#: ``types.h:24-28`` uses ``std::ptrdiff_t``). Python ints are unbounded; the
+#: alias documents intent at API boundaries.
+SizeType = int
+
+
+class Device(enum.Enum):
+    """Where data lives (reference ``types.h:30-38``), extended with TPU."""
+
+    CPU = "cpu"
+    TPU = "tpu"
+
+    def __str__(self) -> str:  # matches reference operator<< spelling
+        return self.value
+
+
+class Backend(enum.Enum):
+    """Which execution backend runs kernels (reference ``types.h:40-60``).
+
+    ``MC`` (multicore host, via XLA-CPU) mirrors the reference's ``Backend::MC``;
+    ``TPU`` replaces ``Backend::GPU``.
+    """
+
+    MC = "mc"
+    TPU = "tpu"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+def default_device(backend: Backend) -> Device:
+    """``DefaultDevice_v`` mapping (reference ``types.h:75-90``)."""
+    return {Backend.MC: Device.CPU, Backend.TPU: Device.TPU}[backend]
+
+
+def default_backend(device: Device) -> Backend:
+    """``DefaultBackend_v`` mapping (reference ``types.h:92-106``)."""
+    return {Device.CPU: Backend.MC, Device.TPU: Backend.TPU}[device]
+
+
+# ---------------------------------------------------------------------------
+# Element types
+# ---------------------------------------------------------------------------
+
+#: The four scalar types every algorithm is instantiated over, keyed by the
+#: single-letter BLAS naming convention used by the miniapps (s/d/c/z).
+ELEMENT_TYPES = {
+    "s": np.float32,
+    "d": np.float64,
+    "c": np.complex64,
+    "z": np.complex128,
+}
+
+_LETTER = {np.dtype(v): k for k, v in ELEMENT_TYPES.items()}
+
+
+def type_letter(dtype) -> str:
+    """BLAS letter (s/d/c/z) for a dtype, used in benchmark output lines."""
+    return _LETTER[np.dtype(dtype)]
+
+
+def is_complex(dtype) -> bool:
+    return np.dtype(dtype).kind == "c"
+
+
+def base_float(dtype):
+    """Real scalar type underlying ``dtype`` (``BaseType`` in the reference)."""
+    return {np.dtype(np.float32): np.float32,
+            np.dtype(np.float64): np.float64,
+            np.dtype(np.complex64): np.float32,
+            np.dtype(np.complex128): np.float64}[np.dtype(dtype)]
+
+
+def complex_of(dtype):
+    """Complex scalar type with the same base precision."""
+    return {np.dtype(np.float32): np.complex64,
+            np.dtype(np.float64): np.complex128,
+            np.dtype(np.complex64): np.complex64,
+            np.dtype(np.complex128): np.complex128}[np.dtype(dtype)]
+
+
+# ---------------------------------------------------------------------------
+# Flop-weight model (reference types.h:120-131 ``TypeInfo::ops_add/ops_mul``
+# and types.h:158-161 ``total_ops``)
+# ---------------------------------------------------------------------------
+
+def ops_weights(dtype) -> tuple[int, int]:
+    """(add_weight, mul_weight) in real flops for one add/mul of ``dtype``."""
+    return (2, 6) if is_complex(dtype) else (1, 1)
+
+
+def total_ops(dtype, add: float, mul: float) -> float:
+    """Total real-op count for ``add`` additions and ``mul`` multiplications.
+
+    Mirrors ``dlaf::total_ops`` (reference ``types.h:158-161``): complex
+    weighting add=2, mul=6. The miniapps feed this with the canonical flop
+    models (e.g. Cholesky: add=mul=N^3/6).
+    """
+    wa, wm = ops_weights(dtype)
+    return wa * add + wm * mul
+
+
+def ceil_div(num: SizeType, den: SizeType) -> SizeType:
+    """Integer ceiling division (reference ``util_math.h::ceilDiv``)."""
+    if den <= 0:
+        raise ValueError(f"ceil_div: denominator must be positive, got {den}")
+    if num < 0:
+        raise ValueError(f"ceil_div: numerator must be non-negative, got {num}")
+    return -(-num // den)
+
+
+ScalarLike = Union[int, float, complex]

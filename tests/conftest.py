@@ -1,0 +1,30 @@
+"""Test harness configuration.
+
+Mirrors the reference's "6 oversubscribed MPI ranks" strategy
+(``test/include/dlaf_test/comm_grids/grids_6_ranks.h``) by forcing an
+8-device virtual CPU platform so distributed code paths (2D meshes, ICI
+collective verbs, shard_map algorithms) run on any host. Must run before the
+first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Run the full assertion ladder in tests (reference CI enables heavy asserts).
+os.environ.setdefault("DLAF_ASSERT_HEAVY_ENABLE", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

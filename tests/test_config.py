@@ -1,0 +1,41 @@
+"""Tests for layered configuration (reference: src/init.cpp:117-177 behavior)."""
+
+import dlaf_tpu.config as C
+
+
+def test_defaults():
+    cfg = C.update_configuration()
+    assert cfg.grid_ordering == "row-major"
+    assert cfg.lookahead == 2
+
+
+def test_user_struct_layer():
+    cfg = C.update_configuration(C.Configuration(lookahead=3))
+    assert cfg.lookahead == 3
+
+
+def test_env_overrides_user(monkeypatch):
+    monkeypatch.setenv("DLAF_LOOKAHEAD", "4")
+    cfg = C.update_configuration(C.Configuration(lookahead=3))
+    assert cfg.lookahead == 4
+
+
+def test_cli_overrides_env(monkeypatch):
+    monkeypatch.setenv("DLAF_LOOKAHEAD", "4")
+    cfg = C.update_configuration(C.Configuration(lookahead=3),
+                                 argv=["--dlaf:lookahead=5", "ignored", "--other"])
+    assert cfg.lookahead == 5
+
+
+def test_cli_bool_and_dashes(monkeypatch):
+    cfg = C.update_configuration(argv=["--dlaf:print-config"])
+    assert cfg.print_config is True
+    cfg = C.update_configuration(argv=["--dlaf:grid-ordering=col-major"])
+    assert cfg.grid_ordering == "col-major"
+
+
+def test_initialize_get_finalize():
+    cfg = C.initialize(C.Configuration(enable_x64=True))
+    assert C.get_configuration() is cfg
+    C.finalize()
+    assert C.get_configuration() is not cfg  # re-initialized with defaults

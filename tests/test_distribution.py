@@ -1,0 +1,116 @@
+"""Tests for block-cyclic index math.
+
+Mirrors the reference's ``test/unit/matrix/test_util_distribution.cpp`` and
+``test_distribution.cpp``: conversions are validated against a brute-force
+enumeration of the block-cyclic assignment over grid-shape and source-rank
+sweeps, including degenerate sizes.
+"""
+
+import pytest
+
+from dlaf_tpu.common.index2d import (GlobalElementIndex, GlobalElementSize, GlobalTileIndex,
+                                     GridSize2D, LocalTileIndex, RankIndex2D, TileElementSize)
+from dlaf_tpu.matrix import util_distribution as ud
+from dlaf_tpu.matrix.distribution import Distribution
+
+
+def brute_force_axis(size, tile_size, grid, src):
+    """Enumerate (global_tile -> (rank, local_tile)) the slow, obvious way."""
+    nt = -(-size // tile_size) if size else 0
+    owner = {}
+    counts = {r: 0 for r in range(grid)}
+    for t in range(nt):
+        r = (src + t) % grid
+        owner[t] = (r, counts[r])
+        counts[r] += 1
+    return nt, owner, counts
+
+
+AXIS_CASES = [
+    # (size, tile, grid, src)
+    (0, 4, 3, 0), (1, 4, 1, 0), (10, 4, 1, 0), (10, 4, 3, 0), (10, 4, 3, 2),
+    (12, 4, 3, 1), (16, 4, 4, 3), (17, 5, 2, 1), (4, 8, 3, 2), (100, 7, 5, 4),
+]
+
+
+@pytest.mark.parametrize("size,tile,grid,src", AXIS_CASES)
+def test_axis_conversions_vs_bruteforce(size, tile, grid, src):
+    nt, owner, counts = brute_force_axis(size, tile, grid, src)
+    for t in range(nt):
+        r, lt = owner[t]
+        assert ud.rank_global_tile(t, grid, src) == r
+        assert ud.local_tile_from_global_tile(t, grid) == lt
+        assert ud.global_tile_from_local_tile(lt, grid, r, src) == t
+    for r in range(grid):
+        assert ud.local_nr_tiles(nt, grid, r, src) == counts[r]
+        # local element count = sum of owned tile sizes
+        expect_elems = sum(min(tile, size - t * tile) for t in range(nt) if owner[t][0] == r)
+        assert ud.local_size(size, tile, grid, r, src) == expect_elems
+        # next_local_tile: first local tile with global index >= t, for every
+        # t in the valid domain [0, nt] (t == nt yields local_nr_tiles)
+        for t in range(nt + 1):
+            later = [owner[g][1] for g in range(t, nt) if owner[g][0] == r]
+            expect = later[0] if later else counts[r]
+            assert ud.next_local_tile_from_global_tile(t, grid, r, src) == expect
+
+
+def test_element_tile_conversions():
+    for el in range(23):
+        t = ud.tile_from_element(el, 5)
+        te = ud.tile_element_from_element(el, 5)
+        assert 0 <= te < 5
+        assert ud.element_from_tile_and_tile_element(t, te, 5) == el
+
+
+GRID_CASES = [
+    (GridSize2D(1, 1), RankIndex2D(0, 0), RankIndex2D(0, 0)),
+    (GridSize2D(3, 2), RankIndex2D(1, 1), RankIndex2D(0, 0)),
+    (GridSize2D(2, 3), RankIndex2D(0, 2), RankIndex2D(1, 2)),  # nonzero source rank
+    (GridSize2D(4, 4), RankIndex2D(3, 0), RankIndex2D(2, 3)),
+]
+
+
+@pytest.mark.parametrize("grid,rank,src", GRID_CASES)
+@pytest.mark.parametrize("m,n,mb,nb", [(0, 0, 4, 4), (10, 10, 4, 4), (13, 26, 5, 5),
+                                       (26, 13, 4, 8), (3, 3, 8, 8)])
+def test_distribution_2d(grid, rank, src, m, n, mb, nb):
+    d = Distribution(GlobalElementSize(m, n), TileElementSize(mb, nb), grid, rank, src)
+    ntr, owner_r, counts_r = brute_force_axis(m, mb, grid.row, src.row)
+    ntc, owner_c, counts_c = brute_force_axis(n, nb, grid.col, src.col)
+    assert (d.nr_tiles.row, d.nr_tiles.col) == (ntr, ntc)
+    assert (d.local_nr_tiles.row, d.local_nr_tiles.col) == (counts_r[rank.row], counts_c[rank.col])
+
+    for tr in range(ntr):
+        for tc in range(ntc):
+            gt = GlobalTileIndex(tr, tc)
+            own = d.rank_global_tile(gt)
+            assert (own.row, own.col) == (owner_r[tr][0], owner_c[tc][0])
+            if own == rank:
+                lt = d.local_tile_index(gt)
+                assert (lt.row, lt.col) == (owner_r[tr][1], owner_c[tc][1])
+                assert d.global_tile_index(lt) == gt
+            # edge tile sizes
+            ts = d.tile_size_of(gt)
+            assert ts.row == min(mb, m - tr * mb)
+            assert ts.col == min(nb, n - tc * nb)
+
+
+def test_distribution_element_queries():
+    d = Distribution(GlobalElementSize(13, 26), TileElementSize(5, 5),
+                     GridSize2D(2, 3), RankIndex2D(1, 2), RankIndex2D(1, 1))
+    for i in range(13):
+        for j in range(26):
+            ge = GlobalElementIndex(i, j)
+            gt = d.global_tile_index(ge)
+            te = d.tile_element_index(ge)
+            assert d.global_element_index(gt, te) == ge
+            assert d.rank_global_element(ge) == d.rank_global_tile(gt)
+
+
+def test_local_tile_linear_index_colmajor():
+    d = Distribution(GlobalElementSize(20, 20), TileElementSize(5, 5),
+                     GridSize2D(2, 2), RankIndex2D(0, 0), RankIndex2D(0, 0))
+    lnt = d.local_nr_tiles
+    seen = [d.local_tile_linear_index(LocalTileIndex(r, c))
+            for c in range(lnt.col) for r in range(lnt.row)]
+    assert seen == list(range(lnt.row * lnt.col))

@@ -1,0 +1,64 @@
+"""Tests for the tagged index algebra (reference: test/unit/common/test_index2d.cpp)."""
+
+import pytest
+
+from dlaf_tpu.common.asserts import DlafAssertError
+from dlaf_tpu.common.index2d import (GlobalElementIndex, GlobalElementSize, GlobalTileIndex,
+                                     GlobalTileSize, LocalTileIndex, LocalTileSize, Ordering,
+                                     compute_coords, compute_linear_index, iterate_range2d)
+
+
+def test_basic_coords():
+    i = GlobalElementIndex(3, 5)
+    assert (i.row, i.col) == (3, 5)
+    assert tuple(i) == (3, 5)
+    assert i.transposed() == GlobalElementIndex(5, 3)
+    assert str(i) == "(3, 5)"
+
+
+def test_tag_safety():
+    # indices of different tags never compare equal (dataclass eq checks type)
+    assert GlobalTileIndex(1, 2) != LocalTileIndex(1, 2)
+    assert GlobalTileIndex(1, 2) == GlobalTileIndex(1, 2)
+    # is_in only accepts the paired size tag (reference compile error -> assert)
+    with pytest.raises(DlafAssertError):
+        GlobalTileIndex(0, 0).is_in(LocalTileSize(2, 2))
+
+
+def test_is_in():
+    sz = GlobalElementSize(4, 6)
+    assert GlobalElementIndex(0, 0).is_in(sz)
+    assert GlobalElementIndex(3, 5).is_in(sz)
+    assert not GlobalElementIndex(4, 0).is_in(sz)
+    assert not GlobalElementIndex(0, 6).is_in(sz)
+
+
+def test_size_predicates():
+    assert GlobalElementSize(0, 3).is_empty()
+    assert not GlobalElementSize(2, 3).is_empty()
+    assert GlobalElementSize(2, 3).linear_size() == 6
+
+
+def test_linear_index_roundtrip():
+    dims = GlobalTileSize(3, 4)
+    seen_rm, seen_cm = set(), set()
+    for r in range(3):
+        for c in range(4):
+            idx = GlobalTileIndex(r, c)
+            lin_rm = compute_linear_index(Ordering.RowMajor, idx, dims)
+            lin_cm = compute_linear_index(Ordering.ColMajor, idx, dims)
+            assert compute_coords(Ordering.RowMajor, lin_rm, dims, GlobalTileIndex) == idx
+            assert compute_coords(Ordering.ColMajor, lin_cm, dims, GlobalTileIndex) == idx
+            seen_rm.add(lin_rm)
+            seen_cm.add(lin_cm)
+    assert seen_rm == set(range(12)) and seen_cm == set(range(12))
+
+
+def test_iterate_range2d():
+    # col-major order, matching reference common/range2d.h
+    pts = list(iterate_range2d(LocalTileSize(2, 3)))
+    assert pts[0] == LocalTileIndex(0, 0)
+    assert pts[1] == LocalTileIndex(1, 0)
+    assert len(pts) == 6
+    sub = list(iterate_range2d(LocalTileIndex(1, 1), LocalTileIndex(3, 2)))
+    assert sub == [LocalTileIndex(1, 1), LocalTileIndex(2, 1)]

@@ -1,0 +1,46 @@
+"""Tests for dlaf_tpu.types (reference test: implicit via types.h usage)."""
+
+import numpy as np
+import pytest
+
+from dlaf_tpu import types as T
+
+
+def test_device_backend_mappings():
+    assert T.default_device(T.Backend.MC) is T.Device.CPU
+    assert T.default_device(T.Backend.TPU) is T.Device.TPU
+    assert T.default_backend(T.Device.CPU) is T.Backend.MC
+    assert T.default_backend(T.Device.TPU) is T.Backend.TPU
+
+
+@pytest.mark.parametrize("letter,dtype", [("s", np.float32), ("d", np.float64),
+                                          ("c", np.complex64), ("z", np.complex128)])
+def test_type_letters(letter, dtype):
+    assert T.ELEMENT_TYPES[letter] == dtype
+    assert T.type_letter(dtype) == letter
+
+
+def test_flop_weights():
+    # reference types.h:120-131: real add=1 mul=1; complex add=2 mul=6
+    assert T.total_ops(np.float64, 10, 20) == 30
+    assert T.total_ops(np.complex128, 10, 20) == 2 * 10 + 6 * 20
+    # cholesky model: n^3/6 adds + n^3/6 muls -> n^3/3 real
+    n = 6.0
+    assert T.total_ops(np.float32, n**3 / 6, n**3 / 6) == pytest.approx(n**3 / 3)
+
+
+def test_base_and_complex_of():
+    assert T.base_float(np.complex64) == np.float32
+    assert T.base_float(np.complex128) == np.float64
+    assert T.complex_of(np.float32) == np.complex64
+    assert T.complex_of(np.float64) == np.complex128
+    assert T.is_complex(np.complex64) and not T.is_complex(np.float64)
+
+
+def test_ceil_div():
+    assert T.ceil_div(0, 4) == 0
+    assert T.ceil_div(1, 4) == 1
+    assert T.ceil_div(4, 4) == 1
+    assert T.ceil_div(5, 4) == 2
+    with pytest.raises(ValueError):
+        T.ceil_div(1, 0)
