@@ -1,0 +1,108 @@
+"""Collective verbs over mesh axes, usable inside ``shard_map``.
+
+TPU-native counterpart of the reference's L4 async tile collectives
+(``communication/kernels/{broadcast,all_reduce,reduce,p2p,p2p_allsum}.h``).
+The reference wraps nonblocking MPI calls in sender adaptors, serialized
+per-communicator by ``Pipeline`` and polled from a dedicated "mpi" thread pool
+(``sender/transform_mpi.h:56-98``). On TPU all of that machinery collapses
+into XLA collectives over ICI: ordering is XLA program order inside the traced
+step, overlap is XLA's latency hiding, and there is nothing to poll.
+
+Each verb takes an ``axis`` (``'row'`` or ``'col'`` — see
+:mod:`dlaf_tpu.comm.grid`). Broadcast *along* the row axis communicates among
+ranks of the same grid column (the reference's column communicator) and vice
+versa. Source/destination ranks must be trace-time constants, which they are
+in the per-``k`` factorization loops (the loop is unrolled at trace time).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .grid import COL_AXIS, ROW_AXIS  # re-export for convenience  # noqa: F401
+
+
+def this_rank(axis: str):
+    """This device's coordinate along ``axis`` (reference ``Communicator::rank``)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    """Number of ranks along ``axis`` (reference ``Communicator::size``)."""
+    return lax.axis_size(axis)
+
+
+def bcast(x, axis: str, src: int):
+    """Broadcast ``x`` from rank ``src`` along ``axis``
+    (reference ``scheduleSendBcast``/``scheduleRecvBcast``,
+    ``kernels/broadcast.h:62-115``).
+
+    Implemented as mask-then-psum: contributions from non-source ranks are
+    zeroed, so the all-reduce returns exactly the source value. On a TPU ring
+    this lowers to one all-reduce over ICI; XLA fuses the masking.
+    """
+    mask = (this_rank(axis) == src).astype(x.dtype)
+    return lax.psum(x * mask, axis)
+
+
+def all_reduce(x, axis: str, op: str = "sum"):
+    """All-reduce along ``axis`` (reference ``scheduleAllReduce``,
+    ``kernels/all_reduce.h:67-138``)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def reduce(x, axis: str, root: int, op: str = "sum"):
+    """Reduce to ``root`` (reference ``scheduleReduceRecvInPlace`` +
+    ``scheduleReduceSend``, ``kernels/reduce.h:36-124``).
+
+    SPMD note: every rank receives the reduced value; non-root ranks simply
+    ignore it (XLA DCEs unused results). This matches the reference's
+    semantics where only the root's output tile is defined.
+    """
+    del root
+    return all_reduce(x, axis, op)
+
+
+def send_recv(x, axis: str, src: int, dst: int):
+    """Point-to-point move of ``x`` from ``src`` to ``dst`` along ``axis``
+    (reference ``scheduleSend``/``scheduleRecv``, ``kernels/p2p.h:34-105``).
+
+    Returns the sent value on ``dst``; other ranks get zeros. Lowered to an
+    XLA collective-permute (one ICI hop for neighbours).
+    """
+    return lax.ppermute(x, axis, perm=[(src, dst)])
+
+
+def all_sum_p2p(x, axis: str):
+    """Sum over an axis intended for the 2-rank case (reference
+    ``scheduleAllSumP2P``, ``kernels/p2p_allsum.h:39-60``: a send/recv pair
+    plus local add). XLA's psum already specializes the 2-rank ring."""
+    return lax.psum(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = False, concat_axis: int = 0):
+    """Gather ``x`` from every rank along ``axis``; result has a new leading
+    axis of size ``axis_size``, or is concatenated along array axis
+    ``concat_axis`` when ``tiled``. Used by panel broadcast to give every rank
+    the full panel (reference ``broadcast_panel.h`` achieves the same with
+    per-tile bcasts)."""
+    return lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def barrier_value(x, axis: str):
+    """Order-enforcing no-op: returns ``x`` after a reduction over a token.
+
+    The reference fences benchmark timing with ``MPI_Barrier``
+    (``miniapp_cholesky.cpp:134-146``); inside one traced program XLA order
+    suffices, so this exists for cross-program fencing in miniapps.
+    """
+    token = lax.psum(jnp.zeros((), x.dtype), axis)
+    return x + token

@@ -1,0 +1,88 @@
+"""2D process grid over TPU devices.
+
+TPU-native counterpart of the reference's ``Communicator`` /
+``CommunicatorGrid`` (``communication/communicator.h:37-93``,
+``communicator_grid.h:42-109``). The reference builds row/col MPI
+sub-communicators from a parent communicator with row-major or col-major rank
+ordering; here the grid *is* a ``jax.sharding.Mesh`` with axes ``('row',
+'col')``, and the row/col "sub-communicators" are the mesh axes themselves —
+every collective verb in :mod:`.collectives` takes an axis name.
+
+JAX is single-controller SPMD: there is no per-process rank at the Python
+level. Code that needs "my grid coordinates" runs inside ``shard_map`` and
+asks :func:`dlaf_tpu.comm.collectives.this_rank`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..common.asserts import dlaf_assert
+from ..common.index2d import GridSize2D
+
+#: Mesh axis names: 'row' indexes grid rows (the reference's column
+#: communicator direction — ranks in the same grid *column* differ in 'row'),
+#: 'col' indexes grid columns.
+ROW_AXIS = "row"
+COL_AXIS = "col"
+
+
+class Grid:
+    """A rows x cols device grid (reference ``CommunicatorGrid``).
+
+    ``ordering`` controls how the flat device list fills the grid, mirroring
+    the reference's ``common::Ordering`` ctor argument: "row-major" assigns
+    device ``i`` to grid position ``(i // cols, i % cols)``, "col-major" to
+    ``(i % rows, i // rows)``.
+    """
+
+    def __init__(self, rows: int, cols: int, devices=None, ordering: str = "row-major"):
+        if devices is None:
+            devices = jax.devices()
+        dlaf_assert(rows * cols <= len(devices),
+                    f"grid {rows}x{cols} needs {rows * cols} devices, have {len(devices)}")
+        devices = list(devices)[: rows * cols]
+        if ordering == "row-major":
+            dev2d = np.array(devices, dtype=object).reshape(rows, cols)
+        elif ordering == "col-major":
+            dev2d = np.array(devices, dtype=object).reshape(cols, rows).T
+        else:
+            raise ValueError(f"unknown ordering {ordering!r}")
+        self._mesh = Mesh(dev2d, (ROW_AXIS, COL_AXIS))
+        self._ordering = ordering
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def size(self) -> GridSize2D:
+        """Grid extents (reference ``CommunicatorGrid::size``)."""
+        return GridSize2D(self._mesh.shape[ROW_AXIS], self._mesh.shape[COL_AXIS])
+
+    @property
+    def num_devices(self) -> int:
+        return self.size.row * self.size.col
+
+    @property
+    def ordering(self) -> str:
+        return self._ordering
+
+    def tile_sharding(self) -> NamedSharding:
+        """Sharding for block-cyclic tile storage arrays
+        (leading two dims = storage tile grid, sharded over row/col)."""
+        return NamedSharding(self._mesh, PartitionSpec(ROW_AXIS, COL_AXIS))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    def __str__(self) -> str:
+        return f"Grid({self.size.row}x{self.size.col}, {self._ordering})"
+
+
+def single_device_grid() -> Grid:
+    """1x1 grid on the default device (reference single-rank communicator)."""
+    return Grid(1, 1)

@@ -1,0 +1,136 @@
+"""Distributed matrix container.
+
+TPU-native counterpart of the reference's ``Matrix<T, Device>``
+(``matrix/matrix.h:56-211``). The reference's Matrix is a pool of per-tile
+allocations plus a future-chain dependency engine (``TileFutureManager``,
+``misc/synchronization.md``); here a matrix is ONE immutable 4D tile-storage
+``jax.Array`` (see :mod:`.tiling`) sharded block-cyclically over the grid's
+mesh, plus its :class:`Distribution`. The dependency semantics the reference
+implements with RW/RO future chains (``matrix.h:117-197``) map to XLA program
+order: algorithms are pure functions ``storage -> storage`` traced per step,
+and within a traced program XLA's dataflow *is* the tile DAG — read-after-
+write and write-after-read hazards cannot exist on immutable values.
+
+Host-side element access (``set``/``tile``/``to_numpy``) exists for test and
+miniapp convenience, mirroring the reference's analytic matrix setters
+(``test/include/dlaf_test/matrix/util_matrix.h``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..comm.grid import Grid
+from ..common.asserts import dlaf_assert
+from ..common.index2d import (GlobalElementSize, GlobalTileIndex, GridSize2D, RankIndex2D,
+                              TileElementSize)
+from .distribution import Distribution
+from . import tiling
+
+
+class Matrix:
+    """Block-cyclic distributed matrix over a device grid.
+
+    ``storage`` is the 4D cyclic-ordered tile array (possibly sharded over
+    ``grid.mesh``); ``dist`` carries the index map. Instances are cheap,
+    immutable views — algorithms return new Matrices sharing layout.
+    """
+
+    def __init__(self, dist: Distribution, storage, grid: Optional[Grid] = None):
+        self.dist = dist
+        self.grid = grid
+        Sr, Sc, _, _ = tiling.storage_tile_grid(dist)
+        expect = (Sr, Sc, dist.block_size.row, dist.block_size.col)
+        dlaf_assert(tuple(storage.shape) == expect,
+                    f"storage shape {storage.shape} != {expect}")
+        self.storage = storage
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def zeros(cls, size: GlobalElementSize, block_size: TileElementSize,
+              grid: Optional[Grid] = None, dtype=np.float64,
+              source_rank: RankIndex2D = RankIndex2D(0, 0)) -> "Matrix":
+        dist = _make_dist(size, block_size, grid, source_rank)
+        Sr, Sc, _, _ = tiling.storage_tile_grid(dist)
+        storage = jnp.zeros((Sr, Sc, block_size.row, block_size.col), dtype=dtype)
+        return cls(dist, _shard(storage, grid), grid)
+
+    @classmethod
+    def from_global(cls, a, block_size: TileElementSize, grid: Optional[Grid] = None,
+                    source_rank: RankIndex2D = RankIndex2D(0, 0)) -> "Matrix":
+        """Wrap a host/device global array (reference ``Matrix(layout, ptr)``)."""
+        a = np.asarray(a) if not isinstance(a, jax.Array) else a
+        size = GlobalElementSize(a.shape[0], a.shape[1])
+        dist = _make_dist(size, block_size, grid, source_rank)
+        storage = tiling.global_to_tiles(a, dist)
+        return cls(dist, _shard(storage, grid), grid)
+
+    @classmethod
+    def from_element_fn(cls, fn: Callable, size: GlobalElementSize,
+                        block_size: TileElementSize, grid: Optional[Grid] = None,
+                        dtype=np.float64,
+                        source_rank: RankIndex2D = RankIndex2D(0, 0)) -> "Matrix":
+        """Build from an analytic element function ``fn(i, j) -> value`` with
+        vectorized (broadcasting) ``i``/``j`` — the test-suite setter style of
+        the reference (``util_matrix.h:93-212`` ``set``)."""
+        i, j = np.meshgrid(np.arange(size.row), np.arange(size.col), indexing="ij")
+        a = np.asarray(fn(i, j), dtype=dtype)
+        return cls.from_global(a, block_size, grid, source_rank)
+
+    # -- properties ---------------------------------------------------------
+
+    @property
+    def size(self) -> GlobalElementSize:
+        return self.dist.size
+
+    @property
+    def block_size(self) -> TileElementSize:
+        return self.dist.block_size
+
+    @property
+    def nr_tiles(self):
+        return self.dist.nr_tiles
+
+    @property
+    def dtype(self):
+        return self.storage.dtype
+
+    # -- host access (tests / debugging) ------------------------------------
+
+    def to_numpy(self) -> np.ndarray:
+        """Gather the global matrix to host (reference test helper
+        ``matrix_local.h`` gather)."""
+        return np.asarray(tiling.tiles_to_global(jax.device_get(self.storage), self.dist))
+
+    def tile(self, index: GlobalTileIndex) -> np.ndarray:
+        """Read one global tile (its actual, possibly short, extent)."""
+        r, c = tiling.global_tile_to_storage_index(self.dist, index.row, index.col)
+        ts = self.dist.tile_size_of(index)
+        t = jax.device_get(self.storage[r, c])
+        return np.asarray(t[: ts.row, : ts.col])
+
+    def with_storage(self, storage) -> "Matrix":
+        """New Matrix sharing this layout (the functional 'write')."""
+        return Matrix(self.dist, storage, self.grid)
+
+    def __str__(self) -> str:
+        g = f", grid={self.grid}" if self.grid else ""
+        return f"Matrix(size={self.size}, block={self.block_size}, dtype={self.dtype}{g})"
+
+
+def _make_dist(size, block_size, grid: Optional[Grid], source_rank) -> Distribution:
+    gs = grid.size if grid is not None else GridSize2D(1, 1)
+    return Distribution(size=size, block_size=block_size, grid_size=gs,
+                        rank=RankIndex2D(0, 0), source_rank=source_rank)
+
+
+def _shard(storage, grid: Optional[Grid]):
+    if grid is None or grid.num_devices == 1:
+        return storage
+    return jax.device_put(storage, grid.tile_sharding())

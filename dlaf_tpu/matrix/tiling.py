@@ -1,0 +1,107 @@
+"""Layout transforms between global matrices and block-cyclic tile storage.
+
+This is the TPU-native replacement for the reference's per-tile memory model
+(``matrix/layout_info.h``, ``memory/``): instead of a pool of individually
+allocated tiles, a distributed matrix lives in ONE 4D "tile storage" array of
+shape ``(P*ltr, Q*ltc, mb, nb)`` whose leading two axes enumerate tiles in
+*rank-major cyclic-permuted* order:
+
+    storage[p*ltr + l_r, q*ltc + l_c] == global tile (l_r*P + (p - src_r)%P,
+                                                      l_c*Q + (q - src_c)%Q)
+
+so a plain ``NamedSharding(mesh, P('row','col'))`` over the leading axes gives
+each mesh coordinate exactly its block-cyclic local tiles — XLA's block
+sharding composed with this static tile permutation *is* the reference's 2D
+block-cyclic distribution (``misc/matrix_distribution.md``). Edge tiles are
+zero-padded to full ``(mb, nb)``; ranks owning fewer tiles than the max get
+all-zero padding tiles.
+
+All transforms are pure jnp functions (jit-able, run on device). The
+permutations are trace-time constants derived from :class:`Distribution`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..types import ceil_div
+from .distribution import Distribution
+from . import util_distribution as ud
+
+
+def storage_tile_grid(dist: Distribution) -> tuple[int, int, int, int]:
+    """(P*ltr, Q*ltc, ltr, ltc): storage tile-grid extents and the uniform
+    per-rank local tile counts (max over ranks, so short ranks are padded)."""
+    nt = dist.nr_tiles
+    P, Q = dist.grid_size.row, dist.grid_size.col
+    ltr = ceil_div(nt.row, P) if nt.row else 0
+    ltc = ceil_div(nt.col, Q) if nt.col else 0
+    return P * ltr, Q * ltc, ltr, ltc
+
+
+def _axis_perm(n_tiles: int, grid: int, src: int, lt: int) -> list[int]:
+    """storage index -> global tile index (or n_tiles for the zero-pad slot)."""
+    perm = []
+    for p in range(grid):
+        for l in range(lt):
+            g = ud.global_tile_from_local_tile(l, grid, p, src)
+            perm.append(g if g < n_tiles else n_tiles)
+    return perm
+
+
+def _axis_perm_inv(n_tiles: int, grid: int, src: int, lt: int) -> list[int]:
+    """global tile index -> storage index."""
+    inv = []
+    for g in range(n_tiles):
+        p = ud.rank_global_tile(g, grid, src)
+        l = ud.local_tile_from_global_tile(g, grid)
+        inv.append(p * lt + l)
+    return inv
+
+
+def global_to_tiles(a, dist: Distribution):
+    """Global ``(m, n)`` array -> tile storage ``(P*ltr, Q*ltc, mb, nb)``."""
+    m, n = dist.size.row, dist.size.col
+    mb, nb = dist.block_size.row, dist.block_size.col
+    nt = dist.nr_tiles
+    Sr, Sc, ltr, ltc = storage_tile_grid(dist)
+    a = jnp.asarray(a)
+    # pad to whole tiles, split into the (ntr, ntc, mb, nb) tile grid
+    a = jnp.pad(a, ((0, nt.row * mb - m), (0, nt.col * nb - n)))
+    t = a.reshape(nt.row, mb, nt.col, nb).transpose(0, 2, 1, 3)
+    # append one zero tile row/col as the target of padding slots, permute
+    t = jnp.pad(t, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    pr = _axis_perm(nt.row, dist.grid_size.row, dist.source_rank.row, ltr)
+    pc = _axis_perm(nt.col, dist.grid_size.col, dist.source_rank.col, ltc)
+    t = jnp.take(t, jnp.array(pr, dtype=jnp.int32), axis=0)
+    t = jnp.take(t, jnp.array(pc, dtype=jnp.int32), axis=1)
+    assert t.shape == (Sr, Sc, mb, nb)
+    return t
+
+
+def tiles_to_global(t, dist: Distribution):
+    """Tile storage -> global ``(m, n)`` array (inverse of global_to_tiles)."""
+    m, n = dist.size.row, dist.size.col
+    mb, nb = dist.block_size.row, dist.block_size.col
+    nt = dist.nr_tiles
+    _, _, ltr, ltc = storage_tile_grid(dist)
+    pr = _axis_perm_inv(nt.row, dist.grid_size.row, dist.source_rank.row, ltr)
+    pc = _axis_perm_inv(nt.col, dist.grid_size.col, dist.source_rank.col, ltc)
+    t = jnp.asarray(t)
+    t = jnp.take(t, jnp.array(pr, dtype=jnp.int32), axis=0)
+    t = jnp.take(t, jnp.array(pc, dtype=jnp.int32), axis=1)
+    a = t.transpose(0, 2, 1, 3).reshape(nt.row * mb, nt.col * nb)
+    return a[:m, :n]
+
+
+def global_tile_to_storage_index(dist: Distribution, row: int, col: int) -> tuple[int, int]:
+    """Storage coordinates of global tile (row, col) — trace-time helper used
+    by the per-k algorithm loops."""
+    _, _, ltr, ltc = storage_tile_grid(dist)
+    pr = ud.rank_global_tile(row, dist.grid_size.row, dist.source_rank.row)
+    pc = ud.rank_global_tile(col, dist.grid_size.col, dist.source_rank.col)
+    lr = ud.local_tile_from_global_tile(row, dist.grid_size.row)
+    lc = ud.local_tile_from_global_tile(col, dist.grid_size.col)
+    return pr * ltr + lr, pc * ltc + lc

@@ -18,6 +18,10 @@ os.environ.setdefault("DLAF_ASSERT_HEAVY_ENABLE", "1")
 
 import jax  # noqa: E402
 
+# A TPU plugin's register() may have force-set jax_platforms at interpreter
+# start (overriding the env var); the config-level update wins and keeps the
+# test session on the 8 virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402

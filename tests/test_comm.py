@@ -1,0 +1,131 @@
+"""Tests for the grid and collective verbs on the 8-device CPU mesh.
+
+Mirrors the reference's ``test/unit/communication/`` suite (bcast / reduce /
+all_reduce / p2p at several grid shapes and both rank orderings,
+``grids_6_ranks.h``) using shard_map over virtual devices.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dlaf_tpu.comm import collectives as cc
+from dlaf_tpu.comm.grid import Grid
+
+
+def _shmap(grid, f, in_specs, out_specs):
+    return shard_map(f, mesh=grid.mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+
+
+@pytest.mark.parametrize("rows,cols", [(2, 4), (4, 2), (2, 2), (1, 8), (8, 1)])
+def test_grid_shapes(rows, cols, devices8):
+    g = Grid(rows, cols)
+    assert (g.size.row, g.size.col) == (rows, cols)
+    assert g.num_devices == rows * cols
+
+
+def test_grid_orderings(devices8):
+    g_rm = Grid(2, 4, ordering="row-major")
+    g_cm = Grid(2, 4, ordering="col-major")
+    devs = jax.devices()
+    assert g_rm.mesh.devices[0, 1] == devs[1]
+    assert g_cm.mesh.devices[0, 1] == devs[2]
+    assert g_cm.mesh.devices[1, 0] == devs[1]
+
+
+@pytest.mark.parametrize("axis,src", [("row", 0), ("row", 1), ("col", 2)])
+def test_bcast(axis, src, devices8):
+    g = Grid(2, 4)
+    x = jnp.arange(8, dtype=jnp.float64).reshape(2, 4) + 1.0
+
+    def f(x):
+        blk = x.reshape(())  # local (1,1) block -> scalar
+        return cc.bcast(blk, axis, src).reshape(1, 1)
+
+    out = _shmap(g, f, P("row", "col"), P("row", "col"))(x)
+    out = np.asarray(out)
+    if axis == "row":
+        expect = np.tile(np.asarray(x)[src: src + 1, :], (2, 1))
+    else:
+        expect = np.tile(np.asarray(x)[:, src: src + 1], (1, 4))
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_bcast_complex(devices8):
+    g = Grid(2, 4)
+    x = (jnp.arange(8) + 1j * jnp.arange(8)).reshape(2, 4).astype(jnp.complex128)
+
+    def f(x):
+        return cc.bcast(x.reshape(()), "col", 1).reshape(1, 1)
+
+    out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    expect = np.tile(np.asarray(x)[:, 1:2], (1, 4))
+    np.testing.assert_array_equal(out, expect)
+
+
+@pytest.mark.parametrize("op,red", [("sum", np.sum), ("max", np.max), ("min", np.min)])
+def test_all_reduce(op, red, devices8):
+    g = Grid(2, 4)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4)))
+
+    def f(x):
+        return cc.all_reduce(x.reshape(()), "col", op).reshape(1, 1)
+
+    out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    expect = np.tile(red(np.asarray(x), axis=1, keepdims=True), (1, 4))
+    np.testing.assert_allclose(out, expect, rtol=1e-14)
+
+
+def test_reduce_matches_allreduce_on_root(devices8):
+    g = Grid(2, 4)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 4)))
+
+    def f(x):
+        return cc.reduce(x.reshape(()), "row", root=1).reshape(1, 1)
+
+    out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    np.testing.assert_allclose(out[1], np.asarray(x).sum(axis=0), rtol=1e-14)
+
+
+def test_send_recv(devices8):
+    g = Grid(2, 4)
+    x = jnp.arange(8, dtype=jnp.float64).reshape(2, 4)
+
+    def f(x):
+        return cc.send_recv(x.reshape(()), "col", src=0, dst=3).reshape(1, 1)
+
+    out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    # dst column 3 received column 0's values; others zero
+    np.testing.assert_array_equal(out[:, 3], np.asarray(x)[:, 0])
+    assert np.all(out[:, :3] == 0)
+
+
+def test_all_gather_panel(devices8):
+    g = Grid(2, 4)
+    x = jnp.arange(32, dtype=jnp.float64).reshape(8, 4)
+
+    def f(x):  # local (4, 1) column chunk; gather along 'col' -> full row block
+        return cc.all_gather(x, "col", tiled=True, concat_axis=1)
+
+    out = _shmap(g, f, P("row", "col"), P("row", None))(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_this_rank_axis_size(devices8):
+    g = Grid(2, 4)
+
+    def f():
+        r = cc.this_rank("row") * 10 + cc.this_rank("col")
+        n = cc.axis_size("row") * 100 + cc.axis_size("col")
+        return (r + n).reshape(1, 1)
+
+    out = np.asarray(_shmap(g, f, (), P("row", "col"))())
+    expect = np.array([[204, 205, 206, 207], [214, 215, 216, 217]])
+    np.testing.assert_array_equal(out, expect)

@@ -1,0 +1,130 @@
+"""Tests for tile storage transforms and the Matrix container.
+
+Mirrors the reference's ``test/unit/matrix/test_matrix.cpp`` /
+``test_layout_info.cpp`` scope: round-trips, edge tiles, non-trivial grids
+with source-rank offsets, and sharded placement over the 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import (GlobalElementSize, GlobalTileIndex, GridSize2D,
+                                     LocalElementSize, LocalTileIndex, RankIndex2D,
+                                     TileElementSize)
+from dlaf_tpu.matrix import layout_info as li
+from dlaf_tpu.matrix import tiling
+from dlaf_tpu.matrix.distribution import Distribution
+from dlaf_tpu.matrix.matrix import Matrix
+
+CASES = [
+    # (m, n, mb, nb, P, Q, src_r, src_c)
+    (10, 10, 4, 4, 1, 1, 0, 0),
+    (12, 12, 4, 4, 2, 2, 0, 0),
+    (13, 26, 5, 5, 2, 3, 1, 2),   # edge tiles + source-rank offset
+    (7, 7, 8, 8, 2, 2, 1, 1),     # single (short) tile, offset source
+    (26, 13, 4, 8, 4, 2, 3, 0),
+    (0, 0, 4, 4, 2, 2, 0, 0),
+]
+
+
+def _dist(m, n, mb, nb, P, Q, sr, sc):
+    return Distribution(GlobalElementSize(m, n), TileElementSize(mb, nb),
+                        GridSize2D(P, Q), RankIndex2D(0, 0), RankIndex2D(sr, sc))
+
+
+@pytest.mark.parametrize("m,n,mb,nb,P,Q,sr,sc", CASES)
+def test_tiling_roundtrip(m, n, mb, nb, P, Q, sr, sc):
+    d = _dist(m, n, mb, nb, P, Q, sr, sc)
+    rng = np.random.default_rng(42)
+    a = rng.standard_normal((m, n))
+    t = tiling.global_to_tiles(a, d)
+    Sr, Sc, ltr, ltc = tiling.storage_tile_grid(d)
+    assert t.shape == (Sr, Sc, mb, nb)
+    back = tiling.tiles_to_global(t, d)
+    np.testing.assert_array_equal(np.asarray(back), a)
+
+
+def test_tiling_places_tiles_correctly():
+    d = _dist(13, 26, 5, 5, 2, 3, 1, 2)
+    a = np.arange(13 * 26, dtype=np.float64).reshape(13, 26)
+    t = np.asarray(tiling.global_to_tiles(a, d))
+    nt = d.nr_tiles
+    for tr in range(nt.row):
+        for tc in range(nt.col):
+            r, c = tiling.global_tile_to_storage_index(d, tr, tc)
+            ts = d.tile_size_of(GlobalTileIndex(tr, tc))
+            expect = a[tr * 5: tr * 5 + ts.row, tc * 5: tc * 5 + ts.col]
+            np.testing.assert_array_equal(t[r, c, : ts.row, : ts.col], expect)
+            # padding region is zero
+            assert np.all(t[r, c, ts.row:, :] == 0)
+            assert np.all(t[r, c, :, ts.col:] == 0)
+
+
+@pytest.mark.parametrize("m,n,mb,nb,P,Q,sr,sc", CASES)
+def test_matrix_roundtrip_local(m, n, mb, nb, P, Q, sr, sc):
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((m, n))
+    # without a grid the distribution is 1x1 (source rank must be (0,0) then)
+    mat = Matrix.from_global(a, TileElementSize(mb, nb), grid=None)
+    np.testing.assert_array_equal(mat.to_numpy(), a)
+
+
+def test_matrix_sharded_over_mesh(devices8):
+    grid = Grid(2, 4)
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((24, 24))
+    mat = Matrix.from_global(a, TileElementSize(4, 4), grid=grid,
+                             source_rank=RankIndex2D(1, 2))
+    assert len(mat.storage.sharding.device_set) == 8
+    np.testing.assert_array_equal(mat.to_numpy(), a)
+    # per-tile reads see the right data
+    t = mat.tile(GlobalTileIndex(2, 3))
+    np.testing.assert_array_equal(t, a[8:12, 12:16])
+
+
+def test_matrix_from_element_fn():
+    fn = lambda i, j: 1.0 / (1 + i + j)  # noqa: E731
+    mat = Matrix.from_element_fn(fn, GlobalElementSize(9, 9), TileElementSize(4, 4))
+    a = mat.to_numpy()
+    i, j = np.meshgrid(np.arange(9), np.arange(9), indexing="ij")
+    np.testing.assert_allclose(a, 1.0 / (1 + i + j))
+
+
+def test_matrix_complex_dtype():
+    a = (np.arange(36).reshape(6, 6) + 1j * np.ones((6, 6))).astype(np.complex128)
+    mat = Matrix.from_global(a, TileElementSize(4, 4))
+    assert mat.dtype == np.complex128
+    np.testing.assert_array_equal(mat.to_numpy(), a)
+
+
+# -- layout info (reference test_layout_info.cpp) ---------------------------
+
+def test_col_major_layout():
+    sz = LocalElementSize(10, 7)
+    bl = TileElementSize(4, 3)
+    lay = li.col_major_layout(sz, bl, ld=12)
+    assert lay.tile_offset(LocalTileIndex(0, 0)) == 0
+    assert lay.tile_offset(LocalTileIndex(1, 0)) == 4
+    assert lay.tile_offset(LocalTileIndex(0, 1)) == 3 * 12
+    assert lay.tile_offset(LocalTileIndex(2, 2)) == 8 + 6 * 12
+    # min mem: last tile (2,2) has size (2,1); offset + (1-1)*ld + 2
+    assert lay.min_mem_size() == (8 + 6 * 12) + 2
+
+
+def test_tile_layout():
+    sz = LocalElementSize(10, 7)
+    bl = TileElementSize(4, 4)
+    lay = li.tile_layout(sz, bl)
+    # 3x2 tiles, tile area 16, column stride 16*3
+    assert lay.tile_offset(LocalTileIndex(1, 0)) == 16
+    assert lay.tile_offset(LocalTileIndex(0, 1)) == 48
+    last = lay.tile_offset(LocalTileIndex(2, 1))
+    assert lay.min_mem_size() == last + (3 - 1) * 4 + 2
+
+
+def test_layout_empty():
+    lay = li.tile_layout(LocalElementSize(0, 0), TileElementSize(4, 4))
+    assert lay.min_mem_size() == 0
