@@ -1,0 +1,170 @@
+"""tpu_blas — BLAS tile operations on (batched) 2D blocks.
+
+TPU-native counterpart of the reference's ``blas/tile.h:139-517`` (tile-level
+``gemm/hemm/her2k/herk/trmm/trsm`` dispatched to blaspp on CPU and cuBLAS on
+GPU) plus the ``add`` extension (``blas/tile_extensions.h``). Here every op is
+a pure jnp function on arrays whose last two axes are the tile; leading axes
+are batch dims, so one call expresses the reference's per-tile task fan-out as
+a single batched XLA op that tiles onto the MXU (the idiomatic TPU form of
+"many small gemms" is one big batched gemm).
+
+Conventions:
+* ``op``: 'N' (none), 'T' (transpose), 'C' (conjugate transpose) — the
+  reference's ``blas::Op``.
+* ``side``: 'L'/'R'; ``uplo``: 'L'/'U'/'G' (general); ``diag``: 'N'/'U' —
+  ``blas::{Side,Uplo,Diag}``.
+* Triangular inputs are *stored* triangles: the opposite triangle of the
+  argument may hold garbage and is never read (LAPACK storage semantics).
+* No in-place: ops return new values; XLA aliases buffers when it can.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _op(a, op: str):
+    if op == "N":
+        return a
+    if op == "T":
+        return jnp.swapaxes(a, -1, -2)
+    if op == "C":
+        return jnp.conj(jnp.swapaxes(a, -1, -2))
+    raise ValueError(f"bad op {op!r}")
+
+
+def tri_mask(a, uplo: str, *, k: int = 0):
+    """Keep the stored triangle of the last-two-dims block."""
+    if uplo == "G":
+        return a
+    if uplo == "L":
+        return jnp.tril(a, k=k)
+    if uplo == "U":
+        return jnp.triu(a, k=-k)
+    raise ValueError(f"bad uplo {uplo!r}")
+
+
+def hermitian_from(a, uplo: str):
+    """Full (conjugate-)symmetric block from its stored triangle, e.g. for
+    ``hemm``/``hegst`` inputs. Diagonal imaginary parts are dropped for
+    complex dtypes (Hermitian diagonal is real by definition)."""
+    if uplo == "G":
+        return a
+    tri = tri_mask(a, uplo, k=-1)
+    diag = jnp.real(_diag_of(a)) if jnp.iscomplexobj(a) else _diag_of(a)
+    d = _embed_diag(diag, a.shape, a.dtype)
+    return tri + jnp.conj(jnp.swapaxes(tri, -1, -2)) + d
+
+
+def _diag_of(a):
+    return jnp.diagonal(a, axis1=-2, axis2=-1)
+
+
+def _embed_diag(d, shape, dtype):
+    n = shape[-1]
+    eye = jnp.eye(n, dtype=dtype)
+    return d[..., None] * eye
+
+
+def _tri(a, uplo: str, diag: str):
+    """Triangle of ``a`` with optional implicit unit diagonal."""
+    t = tri_mask(a, uplo)
+    if diag == "U":
+        n = a.shape[-1]
+        t = t - _embed_diag(_diag_of(t), a.shape, a.dtype) + jnp.eye(n, dtype=a.dtype)
+    return t
+
+
+# ---------------------------------------------------------------------------
+# Level-3 ops (reference blas/tile.h:139-517)
+# ---------------------------------------------------------------------------
+
+def gemm(a, b, c=None, *, alpha=1.0, beta=0.0, op_a: str = "N", op_b: str = "N"):
+    """``c = alpha op_a(a) op_b(b) + beta c`` (reference ``tile::gemm``)."""
+    prod = _op(a, op_a) @ _op(b, op_b)
+    out = alpha * prod
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out.astype(a.dtype)
+
+
+def hemm(side: str, uplo: str, a, b, c=None, *, alpha=1.0, beta=0.0):
+    """``c = alpha A b + beta c`` (side='L') with Hermitian ``A`` stored in
+    ``uplo`` (reference ``tile::hemm``)."""
+    af = hermitian_from(a, uplo)
+    prod = af @ b if side == "L" else b @ af
+    out = alpha * prod
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out.astype(b.dtype)
+
+
+def herk(uplo: str, op_a: str, a, c, *, alpha=1.0, beta=1.0):
+    """``c = alpha op_a(a) op_a(a)^H + beta c`` on the ``uplo`` triangle
+    (reference ``tile::herk``; alpha/beta real).
+
+    The full Hermitian product is formed (one MXU gemm); only the requested
+    triangle of ``c`` is updated, the other triangle passes through — matching
+    LAPACK update semantics so garbage triangles stay untouched.
+    """
+    oa = _op(a, op_a)
+    prod = oa @ jnp.conj(jnp.swapaxes(oa, -1, -2))
+    upd = alpha * prod + beta * c
+    if jnp.iscomplexobj(c):  # herk guarantees a real diagonal
+        d = _embed_diag(jnp.real(_diag_of(upd)) - _diag_of(upd), upd.shape, upd.dtype)
+        upd = upd + d
+    return _merge_triangle(upd, c, uplo)
+
+
+def her2k(uplo: str, op: str, a, b, c, *, alpha=1.0, beta=1.0):
+    """``c = alpha op(a) op(b)^H + conj(alpha) op(b) op(a)^H + beta c`` on the
+    ``uplo`` triangle (reference ``tile::her2k``; beta real)."""
+    oa, ob = _op(a, op), _op(b, op)
+    prod = alpha * (oa @ jnp.conj(jnp.swapaxes(ob, -1, -2)))
+    prod = prod + jnp.conj(jnp.swapaxes(prod, -1, -2))
+    upd = prod + beta * c
+    return _merge_triangle(upd, c, uplo)
+
+
+def _merge_triangle(update, orig, uplo: str):
+    if uplo == "G":
+        return update
+    return tri_mask(update, uplo) + tri_mask(orig, "U" if uplo == "L" else "L", k=-1)
+
+
+def trmm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
+    """``b = alpha op_a(A) b`` (side='L') with triangular ``A``
+    (reference ``tile::trmm``)."""
+    t = _op(_tri(a, uplo, diag), op_a)
+    prod = t @ b if side == "L" else b @ t
+    return (alpha * prod).astype(b.dtype)
+
+
+def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
+    """Solve ``op_a(A) x = alpha b`` (side='L') / ``x op_a(A) = alpha b``
+    (side='R') with triangular ``A`` (reference ``tile::trsm``).
+
+    Lowers to XLA ``TriangularSolve`` (blocked forward substitution on TPU).
+    """
+    out = lax.linalg.triangular_solve(
+        a, alpha * b,
+        left_side=(side == "L"),
+        lower=(uplo == "L"),
+        transpose_a=(op_a in ("T", "C")),
+        conjugate_a=(op_a == "C"),
+        unit_diagonal=(diag == "U"))
+    return out.astype(b.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Extensions / small helpers used by algorithms
+# ---------------------------------------------------------------------------
+
+def add(a, b, *, alpha=1.0):
+    """``b = b + alpha a`` (reference ``tile_extensions.h`` ``tile::add``)."""
+    return b + alpha * a
+
+
+def scal(a, *, alpha):
+    return alpha * a

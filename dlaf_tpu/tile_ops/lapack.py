@@ -1,0 +1,150 @@
+"""tpu_lapack — LAPACK tile operations.
+
+TPU-native counterpart of the reference's ``lapack/tile.h:66-645`` (tile-level
+``potrf/hegst/laset/lacpy/lange/lantr/larft/stedc/laed4`` dispatched to
+lapackpp on CPU, cuSOLVER + custom CUDA kernels on GPU — the custom-kernel
+table in SURVEY.md §2/L5). Device ops are pure jnp functions (XLA has native
+Cholesky and TriangularSolve; ``lacpy``/``laset`` are trivial masked ops — the
+reference needed hand-written CUDA for those, ``src/lapack/gpu/{lacpy,laset}.cu``);
+the symmetric-tridiagonal eigensolver leaf (``stedc``) stays a host kernel
+exactly as the reference keeps it on CPU (``eigensolver/impl.h:46-72``).
+
+``larft`` replaces the reference's gemv-loop T-factor accumulation
+(``factorization/qr/t_factor_impl.h``) with a closed form: for forward
+columnwise reflectors, ``T^{-1} = diag(1/tau) + strict_upper(V^H V)``, so T
+comes from ONE gemm (MXU) plus one small triangular solve — the TPU-idiomatic
+formulation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from .blas import _diag_of, _embed_diag, hermitian_from, tri_mask, trsm
+
+
+def laset(uplo: str, alpha, beta, shape, dtype):
+    """Fresh block: off-diagonal ``alpha``, diagonal ``beta``, over the
+    ``uplo`` region (reference ``tile::laset``; custom CUDA kernel
+    ``src/lapack/gpu/laset.cu``)."""
+    m, n = shape[-2], shape[-1]
+    a = jnp.full(shape, alpha, dtype=dtype)
+    a = a + (beta - alpha) * jnp.eye(m, n, dtype=dtype)
+    return tri_mask(a, uplo)
+
+
+def lacpy(uplo: str, a, b):
+    """Copy the ``uplo`` region of ``a`` onto ``b`` (reference ``tile::lacpy``;
+    custom CUDA kernel ``src/lapack/gpu/lacpy.cu``)."""
+    if uplo == "G":
+        return a
+    keep = "U" if uplo == "L" else "L"
+    return tri_mask(a, uplo) + tri_mask(b, keep, k=-1)
+
+
+def lange(norm: str, a):
+    """General-block norm: 'M' (max abs), '1', 'I', 'F'
+    (reference ``tile::lange``)."""
+    aa = jnp.abs(a)
+    if norm == "M":
+        return jnp.max(aa, axis=(-2, -1)) if a.size else jnp.zeros(a.shape[:-2], a.dtype)
+    if norm == "1":
+        return jnp.max(jnp.sum(aa, axis=-2), axis=-1)
+    if norm == "I":
+        return jnp.max(jnp.sum(aa, axis=-1), axis=-1)
+    if norm == "F":
+        return jnp.sqrt(jnp.sum(aa * aa, axis=(-2, -1)))
+    raise ValueError(f"bad norm {norm!r}")
+
+
+def lantr(norm: str, uplo: str, diag: str, a):
+    """Triangular-block norm (reference ``tile::lantr``)."""
+    t = tri_mask(a, uplo)
+    if diag == "U":
+        t = t - _embed_diag(_diag_of(t), t.shape, t.dtype) + jnp.eye(a.shape[-1], dtype=a.dtype)
+    return lange(norm, t)
+
+
+def potrf(uplo: str, a):
+    """Cholesky factor of an SPD/HPD block stored in ``uplo``
+    (reference ``tile::potrf``). The factor lands in the ``uplo`` triangle;
+    the opposite triangle of ``a`` passes through unchanged (LAPACK in-place
+    semantics). Lowers to XLA's native blocked Cholesky on TPU."""
+    af = hermitian_from(a, uplo)
+    if uplo == "L":
+        f = lax.linalg.cholesky(af)
+        return tri_mask(f, "L") + tri_mask(a, "U", k=-1)
+    f = jnp.conj(jnp.swapaxes(lax.linalg.cholesky(af), -1, -2))
+    return tri_mask(f, "U") + tri_mask(a, "L", k=-1)
+
+
+def hegst(itype: int, uplo: str, a, b):
+    """Tile-level generalized-to-standard transform (reference
+    ``tile::hegst`` / custom GPU port ``gpu/cusolver/hegst.h``):
+
+    itype=1: ``A := inv(L) A inv(L)^H`` (uplo='L', B = L) or
+             ``A := inv(U^H) A inv(U)`` (uplo='U').
+
+    Composed from two XLA triangular solves on the Hermitianized block —
+    no custom kernel needed on TPU.
+    """
+    if itype != 1:
+        raise NotImplementedError("hegst itype=2,3 not used by the pipeline")
+    af = hermitian_from(a, uplo)
+    if uplo == "L":
+        t = trsm("L", "L", "N", "N", b, af)         # inv(L) A
+        out = trsm("R", "L", "C", "N", b, t)        # ... inv(L)^H
+    else:
+        t = trsm("L", "U", "C", "N", b, af)         # inv(U)^H A
+        out = trsm("R", "U", "N", "N", b, t)        # ... inv(U)
+    return _restore_other_triangle(out, a, uplo)
+
+
+def _restore_other_triangle(update, orig, uplo: str):
+    if uplo == "G":
+        return update
+    other = "U" if uplo == "L" else "L"
+    return tri_mask(update, uplo) + tri_mask(orig, other, k=-1)
+
+
+def larft(v, tau):
+    """T factor of a block of forward, columnwise Householder reflectors
+    (reference ``tile::larft`` and the distributed T-factor algorithm
+    ``factorization/qr/t_factor_impl.h:42-347``).
+
+    ``v``: (m, k) reflectors (unit lower trapezoidal, implicit ones NOT
+    required — v's upper triangle is ignored); ``tau``: (k,).
+    Uses ``T^{-1} = diag(1/tau) + strict_upper(V^H V)``; zero taus produce
+    zero rows/cols in T (null reflectors), as LAPACK does.
+    """
+    k = tau.shape[-1]
+    vv = tri_mask(v, "L", k=-1) + jnp.eye(v.shape[-2], k, dtype=v.dtype)
+    s = jnp.conj(jnp.swapaxes(vv, -1, -2)) @ vv            # V^H V, one gemm
+    tau_safe = jnp.where(tau == 0, jnp.ones_like(tau), tau)
+    tinv = tri_mask(s, "U", k=-1) + _embed_diag(1.0 / tau_safe, s.shape, s.dtype)
+    eye = jnp.broadcast_to(jnp.eye(k, dtype=v.dtype), s.shape)
+    t = lax.linalg.triangular_solve(tinv, eye, left_side=True, lower=False)
+    nz = (tau != 0)
+    mask = nz[..., :, None] & nz[..., None, :]
+    return jnp.where(mask, t, jnp.zeros_like(t))
+
+
+# ---------------------------------------------------------------------------
+# Host kernels (reference keeps these on CPU too)
+# ---------------------------------------------------------------------------
+
+def stedc(d: np.ndarray, e: np.ndarray):
+    """Host symmetric-tridiagonal eigensolver used for D&C leaf solves
+    (reference ``tile::stedc`` -> LAPACK stedc / cusolver syevd wrapper
+    ``src/cusolver/stedc.cu``). Returns (eigenvalues, eigenvectors)."""
+    import scipy.linalg as sla
+
+    d = np.asarray(d, dtype=np.float64)
+    e = np.asarray(e, dtype=np.float64)
+    if d.size == 1:
+        return d.copy(), np.ones((1, 1))
+    w, v = sla.eigh_tridiagonal(d, e)
+    return w, v

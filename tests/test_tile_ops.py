@@ -1,0 +1,277 @@
+"""Tile-kernel correctness vs numpy/scipy.
+
+Mirrors the reference's ``test/unit/test_blas_tile/`` and
+``test_lapack_tile/`` suites: every op, all four scalar types, square and
+rectangular blocks, batched forms.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import jax.numpy as jnp
+
+from dlaf_tpu.tile_ops import blas as tb
+from dlaf_tpu.tile_ops import lapack as tl
+
+DTYPES = [np.float32, np.float64, np.complex64, np.complex128]
+
+
+def rand(rng, shape, dtype):
+    a = rng.standard_normal(shape)
+    if np.dtype(dtype).kind == "c":
+        a = a + 1j * rng.standard_normal(shape)
+    return a.astype(dtype)
+
+
+def _tol(dtype):
+    eps = np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+    return dict(rtol=200 * eps, atol=200 * eps)
+
+
+def np_op(a, op):
+    return {"N": a, "T": a.T, "C": a.conj().T}[op]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("opa,opb", [("N", "N"), ("T", "N"), ("N", "C"), ("C", "T")])
+def test_gemm(dtype, opa, opb):
+    rng = np.random.default_rng(0)
+    m, n, k = 7, 5, 6
+    a = rand(rng, (k, m) if opa != "N" else (m, k), dtype)
+    b = rand(rng, (n, k) if opb != "N" else (k, n), dtype)
+    c = rand(rng, (m, n), dtype)
+    out = tb.gemm(jnp.asarray(a), jnp.asarray(b), jnp.asarray(c),
+                  alpha=2.0, beta=0.5, op_a=opa, op_b=opb)
+    expect = 2.0 * np_op(a, opa) @ np_op(b, opb) + 0.5 * c
+    np.testing.assert_allclose(np.asarray(out), expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_gemm_batched(dtype):
+    rng = np.random.default_rng(1)
+    a = rand(rng, (4, 3, 6, 5), dtype)
+    b = rand(rng, (4, 3, 5, 7), dtype)
+    out = np.asarray(tb.gemm(jnp.asarray(a), jnp.asarray(b)))
+    for i in range(4):
+        for j in range(3):
+            np.testing.assert_allclose(out[i, j], a[i, j] @ b[i, j], **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("side,uplo", [("L", "L"), ("L", "U"), ("R", "L")])
+def test_hemm(dtype, side, uplo):
+    rng = np.random.default_rng(2)
+    n, m = 6, 6
+    a = rand(rng, (n, n), dtype)
+    b = rand(rng, (n, m), dtype)
+    c = rand(rng, (n, m), dtype)
+    # reference semantics: only the uplo triangle of a is read
+    afull = np.tril(a, -1) + np.tril(a, -1).conj().T + np.diag(np.real(np.diag(a))) \
+        if uplo == "L" else np.triu(a, 1) + np.triu(a, 1).conj().T + np.diag(np.real(np.diag(a)))
+    expect = 1.5 * (afull @ b if side == "L" else b @ afull) + 0.5 * c
+    out = tb.hemm(side, uplo, jnp.asarray(a), jnp.asarray(b), jnp.asarray(c),
+                  alpha=1.5, beta=0.5)
+    np.testing.assert_allclose(np.asarray(out), expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("uplo,op", [("L", "N"), ("U", "N"), ("L", "C")])
+def test_herk(dtype, uplo, op):
+    rng = np.random.default_rng(3)
+    n, k = 6, 4
+    a = rand(rng, (n, k) if op == "N" else (k, n), dtype)
+    c = rand(rng, (n, n), dtype)
+    if np.dtype(dtype).kind == "c":
+        # zherk assumes the imaginary part of C's diagonal is zero
+        np.fill_diagonal(c, np.real(np.diag(c)))
+    out = np.asarray(tb.herk(uplo, op, jnp.asarray(a), jnp.asarray(c),
+                             alpha=0.5, beta=2.0))
+    oa = a if op == "N" else a.conj().T
+    expect_full = 0.5 * (oa @ oa.conj().T) + 2.0 * c
+    if uplo == "L":
+        np.testing.assert_allclose(np.tril(out), np.tril(expect_full), **_tol(dtype))
+        np.testing.assert_allclose(np.triu(out, 1), np.triu(c, 1), **_tol(dtype))
+    else:
+        np.testing.assert_allclose(np.triu(out), np.triu(expect_full), **_tol(dtype))
+        np.testing.assert_allclose(np.tril(out, -1), np.tril(c, -1), **_tol(dtype))
+    if np.dtype(dtype).kind == "c":
+        assert np.allclose(np.imag(np.diag(out)), 0)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_her2k(dtype, uplo):
+    rng = np.random.default_rng(4)
+    n, k = 5, 3
+    a = rand(rng, (n, k), dtype)
+    b = rand(rng, (n, k), dtype)
+    c = rand(rng, (n, n), dtype)
+    alpha = 1.5 - 0.5j if np.dtype(dtype).kind == "c" else 1.5
+    out = np.asarray(tb.her2k(uplo, "N", jnp.asarray(a), jnp.asarray(b),
+                              jnp.asarray(c), alpha=alpha, beta=0.5))
+    expect = alpha * a @ b.conj().T + np.conj(alpha) * b @ a.conj().T + 0.5 * c
+    if uplo == "L":
+        np.testing.assert_allclose(np.tril(out), np.tril(expect), **_tol(dtype))
+    else:
+        np.testing.assert_allclose(np.triu(out), np.triu(expect), **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("side,uplo,op,diag",
+                         [("L", "L", "N", "N"), ("L", "U", "T", "N"),
+                          ("R", "L", "C", "N"), ("L", "L", "N", "U")])
+def test_trmm(dtype, side, uplo, op, diag):
+    rng = np.random.default_rng(5)
+    n, m = 6, 4
+    adim = n if side == "L" else m
+    a = rand(rng, (adim, adim), dtype)
+    b = rand(rng, (n, m), dtype)
+    t = np.tril(a) if uplo == "L" else np.triu(a)
+    if diag == "U":
+        np.fill_diagonal(t, 1.0)
+    expect = 2.0 * (np_op(t, op) @ b if side == "L" else b @ np_op(t, op))
+    out = tb.trmm(side, uplo, op, diag, jnp.asarray(a), jnp.asarray(b), alpha=2.0)
+    np.testing.assert_allclose(np.asarray(out), expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("side,uplo,op,diag",
+                         [("L", "L", "N", "N"), ("L", "L", "C", "N"),
+                          ("L", "U", "T", "N"), ("R", "L", "C", "N"),
+                          ("R", "U", "N", "U")])
+def test_trsm(dtype, side, uplo, op, diag):
+    rng = np.random.default_rng(6)
+    n, m = 6, 4
+    adim = n if side == "L" else m
+    a = rand(rng, (adim, adim), dtype)
+    a = a + adim * np.eye(adim, dtype=dtype)  # well-conditioned
+    b = rand(rng, (n, m), dtype)
+    out = np.asarray(tb.trsm(side, uplo, op, diag, jnp.asarray(a), jnp.asarray(b),
+                             alpha=2.0))
+    t = np.tril(a) if uplo == "L" else np.triu(a)
+    if diag == "U":
+        np.fill_diagonal(t, 1.0)
+    ot = np_op(t, op)
+    residual = (ot @ out if side == "L" else out @ ot) - 2.0 * b
+    np.testing.assert_allclose(residual, np.zeros_like(b), **_tol(dtype))
+
+
+# -- lapack tile ops --------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("uplo", ["L", "U", "G"])
+def test_laset_lacpy(dtype, uplo):
+    rng = np.random.default_rng(7)
+    a = np.asarray(tl.laset(uplo, 2.0, 5.0, (4, 6), dtype))
+    full = np.full((4, 6), 2.0) + 3.0 * np.eye(4, 6)
+    expect = {"G": full, "L": np.tril(full), "U": np.triu(full)}[uplo]
+    np.testing.assert_allclose(a, expect.astype(dtype))
+
+    src = rand(rng, (5, 5), dtype)
+    dst = rand(rng, (5, 5), dtype)
+    out = np.asarray(tl.lacpy(uplo, jnp.asarray(src), jnp.asarray(dst)))
+    if uplo == "G":
+        np.testing.assert_allclose(out, src)
+    elif uplo == "L":
+        np.testing.assert_allclose(np.tril(out), np.tril(src))
+        np.testing.assert_allclose(np.triu(out, 1), np.triu(dst, 1))
+    else:
+        np.testing.assert_allclose(np.triu(out), np.triu(src))
+        np.testing.assert_allclose(np.tril(out, -1), np.tril(dst, -1))
+
+
+@pytest.mark.parametrize("norm", ["M", "1", "I", "F"])
+def test_lange(norm):
+    rng = np.random.default_rng(8)
+    a = rng.standard_normal((5, 7))
+    expect = {"M": np.max(np.abs(a)), "1": np.max(np.abs(a).sum(0)),
+              "I": np.max(np.abs(a).sum(1)), "F": np.linalg.norm(a)}[norm]
+    np.testing.assert_allclose(float(tl.lange(norm, jnp.asarray(a))), expect, rtol=1e-14)
+
+
+def test_lantr():
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((5, 5))
+    t = np.tril(a)
+    np.testing.assert_allclose(float(tl.lantr("M", "L", "N", jnp.asarray(a))),
+                               np.max(np.abs(t)), rtol=1e-14)
+    tu = np.tril(a, -1) + np.eye(5)
+    np.testing.assert_allclose(float(tl.lantr("F", "L", "U", jnp.asarray(a))),
+                               np.linalg.norm(tu), rtol=1e-14)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_potrf(dtype, uplo):
+    rng = np.random.default_rng(10)
+    n = 6
+    x = rand(rng, (n, n), dtype)
+    spd = x @ x.conj().T + n * np.eye(n, dtype=dtype)
+    out = np.asarray(tl.potrf(uplo, jnp.asarray(spd)))
+    if uplo == "L":
+        f = np.tril(out)
+        np.testing.assert_allclose(f @ f.conj().T, spd, **_tol(dtype))
+        np.testing.assert_allclose(np.triu(out, 1), np.triu(spd, 1), **_tol(dtype))
+    else:
+        f = np.triu(out)
+        np.testing.assert_allclose(f.conj().T @ f, spd, **_tol(dtype))
+        np.testing.assert_allclose(np.tril(out, -1), np.tril(spd, -1), **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_hegst(dtype, uplo):
+    rng = np.random.default_rng(11)
+    n = 6
+    x = rand(rng, (n, n), dtype)
+    a = x @ x.conj().T + n * np.eye(n, dtype=dtype)  # Hermitian PD
+    y = rand(rng, (n, n), dtype)
+    bfull = y @ y.conj().T + n * np.eye(n, dtype=dtype)
+    bf = np.linalg.cholesky(bfull) if uplo == "L" else np.linalg.cholesky(bfull).conj().T
+    out = np.asarray(tl.hegst(1, uplo, jnp.asarray(a), jnp.asarray(bf)))
+    if uplo == "L":
+        expect = np.linalg.solve(bf, a) @ np.linalg.inv(bf).conj().T
+        np.testing.assert_allclose(np.tril(out), np.tril(expect), **_tol(dtype))
+    else:
+        expect = np.linalg.solve(bf.conj().T, a) @ np.linalg.inv(bf)
+        np.testing.assert_allclose(np.triu(out), np.triu(expect), **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_larft_matches_reflector_product(dtype):
+    rng = np.random.default_rng(12)
+    m, k = 8, 4
+    v = rand(rng, (m, k), dtype)
+    v = np.tril(v, -1) + np.eye(m, k, dtype=dtype)
+    # proper Householder taus: tau = 2 / (v^H v) makes each I - tau v v^H unitary
+    taus = np.array([2.0 / np.real(np.vdot(v[:, i], v[:, i])) for i in range(k)],
+                    dtype=dtype)
+    t = np.asarray(tl.larft(jnp.asarray(v), jnp.asarray(taus)))
+    q_block = np.eye(m, dtype=dtype) - v @ t @ v.conj().T
+    q_prod = np.eye(m, dtype=dtype)
+    for i in range(k):
+        q_prod = q_prod @ (np.eye(m, dtype=dtype)
+                           - taus[i] * np.outer(v[:, i], v[:, i].conj()))
+    np.testing.assert_allclose(q_block, q_prod, **_tol(dtype))
+    assert np.allclose(np.tril(t, -1), 0)
+
+
+def test_larft_zero_tau():
+    rng = np.random.default_rng(13)
+    v = np.tril(rng.standard_normal((6, 3)), -1) + np.eye(6, 3)
+    taus = np.array([0.5, 0.0, 0.25])
+    t = np.asarray(tl.larft(jnp.asarray(v), jnp.asarray(taus)))
+    assert np.allclose(t[1, :], 0) and np.allclose(t[:, 1], 0)
+    assert np.isfinite(t).all()
+
+
+def test_stedc_vs_scipy():
+    rng = np.random.default_rng(14)
+    n = 12
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    w, v = tl.stedc(d, e)
+    tri = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    np.testing.assert_allclose(v @ np.diag(w) @ v.T, tri, atol=1e-12)
+    assert np.all(np.diff(w) >= 0)
