@@ -1,0 +1,94 @@
+"""Cholesky tests (reference: test/unit/factorization/test_cholesky.cpp).
+
+Verification style follows the reference: residual-based checks
+|A - L L^H| / |A| <= c * n * eps plus direct comparison against
+numpy.linalg.cholesky, over a size sweep including degenerate cases (m=0,
+m<=mb, non-divisible m/mb), both uplos, several grid shapes, and non-zero
+source-rank offsets.
+"""
+
+import numpy as np
+import pytest
+
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import RankIndex2D, TileElementSize
+
+SIZES = [(0, 4), (3, 4), (4, 4), (13, 4), (16, 4), (29, 8)]
+DTYPES = [np.float32, np.float64, np.complex64, np.complex128]
+
+
+def hpd_matrix(n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    a = x @ x.conj().T + n * np.eye(n)
+    return a.astype(dtype)
+
+
+def _eps(dtype):
+    return np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+
+
+def check_factor(uplo, a, out, dtype):
+    n = a.shape[0]
+    if n == 0:
+        return
+    tol = 60 * max(n, 1) * _eps(dtype)
+    if uplo == "L":
+        f = np.tril(out)
+        resid = np.linalg.norm(f @ f.conj().T - a) / np.linalg.norm(a)
+        # untouched triangle passes through
+        np.testing.assert_array_equal(np.triu(out, 1), np.triu(a, 1))
+    else:
+        f = np.triu(out)
+        resid = np.linalg.norm(f.conj().T @ f - a) / np.linalg.norm(a)
+        np.testing.assert_array_equal(np.tril(out, -1), np.tril(a, -1))
+    assert resid < tol, f"residual {resid} >= {tol}"
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("n,nb", SIZES)
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_cholesky_local(uplo, n, nb, dtype):
+    a = hpd_matrix(n, dtype)
+    mat = Matrix_from(a, nb)
+    out = cholesky(uplo, mat).to_numpy()
+    check_factor(uplo, a, out, dtype)
+
+
+def Matrix_from(a, nb, grid=None, src=RankIndex2D(0, 0)):
+    from dlaf_tpu.matrix.matrix import Matrix
+    return Matrix.from_global(a, TileElementSize(nb, nb), grid=grid, source_rank=src)
+
+
+GRIDS = [(1, 1, 0, 0), (2, 2, 0, 0), (2, 4, 1, 2), (4, 2, 3, 1), (1, 8, 0, 5),
+         (8, 1, 2, 0)]
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
+@pytest.mark.parametrize("rows,cols,sr,sc", GRIDS)
+@pytest.mark.parametrize("n,nb", [(16, 4), (13, 4), (29, 8), (8, 8), (3, 4)])
+def test_cholesky_distributed(rows, cols, sr, sc, n, nb, dtype, devices8):
+    grid = Grid(rows, cols)
+    a = hpd_matrix(n, dtype, seed=n + rows)
+    mat = Matrix_from(a, nb, grid=grid, src=RankIndex2D(sr % rows, sc % cols))
+    out = cholesky("L", mat).to_numpy()
+    check_factor("L", a, out, dtype)
+
+
+def test_cholesky_distributed_matches_local(devices8):
+    n, nb = 24, 4
+    a = hpd_matrix(n, np.float64, seed=9)
+    local = cholesky("L", Matrix_from(a, nb)).to_numpy()
+    dist = cholesky("L", Matrix_from(a, nb, grid=Grid(2, 4))).to_numpy()
+    np.testing.assert_allclose(dist, local, rtol=1e-12, atol=1e-12)
+
+
+def test_cholesky_vs_numpy():
+    n = 32
+    a = hpd_matrix(n, np.float64, seed=1)
+    out = cholesky("L", Matrix_from(a, 8)).to_numpy()
+    np.testing.assert_allclose(np.tril(out), np.linalg.cholesky(a),
+                               rtol=1e-10, atol=1e-10)
