@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Headline config (BASELINE.md #1): miniapp_cholesky, double, N=4096, nb=256,
+1x1 local grid, using the reference's fenced-timing protocol and flop model
+(``miniapp/miniapp_cholesky.cpp:123-164``): GFLOPS = total_ops(n^3/6, n^3/6)/t.
+
+No absolute baseline exists (the reference publishes no numbers —
+BASELINE.md), so ``vs_baseline`` is reported as the ratio against this
+framework's first recorded round (1.0 until BENCH_r1.json exists).
+
+All progress goes to stderr; stdout carries exactly one JSON line.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    t_start = time.time()
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    devs = jax.devices()
+    log(f"devices: {devs} ({time.time() - t_start:.1f}s)")
+
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.types import total_ops
+
+    n, nb = 4096, 256
+    dtype = np.float64
+    try:
+        jax.jit(lambda x: x * 2)(jax.numpy.ones((2,), dtype=dtype)).block_until_ready()
+    except Exception as e:  # platform without f64 support
+        log(f"float64 unavailable ({e}); falling back to float32")
+        dtype = np.float32
+
+    size = GlobalElementSize(n, n)
+    block = TileElementSize(nb, nb)
+    ref = Matrix.from_element_fn(hpd_element_fn(n, dtype), size, block, dtype=dtype)
+
+    best = 0.0
+    times = []
+    for i in range(4):  # 1 warmup (compile) + 3 timed
+        mat = ref.with_storage(ref.storage + 0)
+        mat.storage.block_until_ready()
+        t0 = time.perf_counter()
+        out = cholesky("L", mat)
+        out.storage.block_until_ready()
+        t = time.perf_counter() - t0
+        gflops = total_ops(dtype, n**3 / 6, n**3 / 6) / t / 1e9
+        log(f"run {i}: {t:.4f}s {gflops:.1f} GFlop/s")
+        if i > 0:
+            times.append(t)
+            best = max(best, gflops)
+
+    result = {
+        "metric": f"miniapp_cholesky {np.dtype(dtype).name} N={n} nb={nb} local GFlop/s",
+        "value": round(best, 2),
+        "unit": "GFlop/s",
+        "vs_baseline": 1.0,
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Analytic matrix generators for miniapps and tests.
+
+Mirrors the reference test-support style (``util_generic_lapack.h``
+``getCholeskySetters``, ``util_matrix.h`` ``set_random_hermitian_*``):
+closed-form element functions, cheap to evaluate at any (i, j), deterministic,
+with well-conditioned factorizations — so benchmark inputs at N=65536 never
+require an O(n^3) host-side setup.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import is_complex
+
+
+def hpd_element_fn(n: int, dtype):
+    """Hermitian positive-definite element function.
+
+    ``a(i,j) = 1/(1+|i-j|) + n·[i==j]`` (+ a small skew-Hermitian imaginary
+    part for complex types): strictly diagonally dominant, hence HPD, with
+    condition number O(n) — comparable to the reference's analytic setters.
+    """
+    def fn(i, j):
+        base = 1.0 / (1.0 + np.abs(i - j)) + n * (i == j)
+        if is_complex(dtype):
+            im = np.sign(j - i) / (1.0 + np.abs(i - j)) / 2.0
+            return base + 1j * im
+        return base
+    return fn
+
+
+def random_hermitian(n: int, dtype, seed: int = 0, diag_boost: float | None = None):
+    """Dense random Hermitian (optionally PD-shifted) host matrix; O(n^2)."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if is_complex(dtype):
+        x = x + 1j * rng.standard_normal((n, n))
+    a = (x + x.conj().T) / 2
+    if diag_boost:
+        a = a + diag_boost * np.eye(n)
+    return a.astype(dtype)

@@ -1,0 +1,105 @@
+"""Cholesky benchmark driver.
+
+TPU-native counterpart of the reference's ``miniapp/miniapp_cholesky.cpp``:
+same fenced-timing protocol (device-sync before and after the factorization —
+the analog of ``waitLocalTiles()`` + ``MPI_Barrier``, ``:134-146``), same flop
+model (``total_ops(n^3/6, n^3/6)``, ``:149-154``), and the same schema for the
+per-run output line (``:157-164``):
+
+    [i] <t>s <gflops>GFlop/s <type><uplo> (m,m) (mb,mb) (gr,gc) <threads> <backend>
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_cholesky -m 4096 -b 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from .. import config
+from ..algorithms.cholesky import cholesky
+from ..comm.grid import Grid
+from ..common.index2d import GlobalElementSize, TileElementSize
+from ..matrix.matrix import Matrix
+from ..types import total_ops, type_letter
+from .generators import hpd_element_fn
+from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--matrix-size", type=int, default=4096,
+                   help="matrix size (reference default 4096)")
+    p.add_argument("-b", "--block-size", type=int, default=256,
+                   help="tile size (reference default 256)")
+    p.add_argument("--uplo", choices=["L", "U"], default="L")
+    add_miniapp_arguments(p)
+    return p
+
+
+def run(argv=None) -> list[dict]:
+    args, extra = build_parser().parse_known_args(argv)
+    config.initialize(argv=extra)
+    opts = parse_miniapp_options(args)
+    devices = select_devices(opts)
+
+    n, nb = args.matrix_size, args.block_size
+    grid = Grid(opts.grid_rows, opts.grid_cols, devices=devices,
+                ordering=config.get_configuration().grid_ordering)
+    use_grid = None if grid.num_devices == 1 else grid
+
+    size = GlobalElementSize(n, n)
+    block = TileElementSize(nb, nb)
+    ref = Matrix.from_element_fn(hpd_element_fn(n, opts.dtype), size, block,
+                                 grid=use_grid, dtype=opts.dtype)
+    backend = devices[0].platform
+    threads = os.cpu_count()
+    results = []
+    for run_i in range(-opts.nwarmups, opts.nruns):
+        mat = ref.with_storage(ref.storage + 0)   # fresh copy per run (:127-128)
+        mat.storage.block_until_ready()           # start fence (:134-136)
+        t0 = time.perf_counter()
+        out = cholesky(args.uplo, mat)
+        out.storage.block_until_ready()           # end fence (:142-144)
+        t = time.perf_counter() - t0
+        gflops = total_ops(opts.dtype, n**3 / 6, n**3 / 6) / t / 1e9
+        if run_i < 0:
+            continue
+        line = (f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
+                f"{type_letter(opts.dtype)}{args.uplo} ({n}, {n}) ({nb}, {nb}) "
+                f"({opts.grid_rows}, {opts.grid_cols}) {threads} {backend}")
+        print(line, flush=True)
+        results.append({"run": run_i, "time_s": t, "gflops": gflops})
+        last = run_i == opts.nruns - 1
+        if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
+            check_cholesky(args.uplo, ref, out)
+    return results
+
+
+def check_cholesky(uplo: str, ref: Matrix, out: Matrix) -> None:
+    """Residual check |A - L L^H| / |A| <= c*n*eps (reference ``:379-417``;
+    gathers to host — intended for moderate sizes, like the reference's
+    ``--check-result`` which is off by default)."""
+    a = ref.to_numpy()
+    f = out.to_numpy()
+    n = a.shape[0]
+    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    if uplo == "L":
+        l = np.tril(f)
+        resid = np.linalg.norm(l @ l.conj().T - a) / np.linalg.norm(a)
+    else:
+        u = np.triu(f)
+        resid = np.linalg.norm(u.conj().T @ u - a) / np.linalg.norm(a)
+    tol = 60 * n * eps
+    status = "PASSED" if resid < tol else "FAILED"
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    if resid >= tol:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    run()
