@@ -1,0 +1,145 @@
+"""Panel workspaces: tile-row/column exchange over the mesh.
+
+TPU-native counterpart of the reference's ``Panel`` workspace
+(``matrix/panel.h:35-485``) and ``broadcast_panel`` (``broadcast_panel.h:
+53-193``). The reference materializes per-rank panel workspaces whose tiles
+either alias matrix tiles (external link) or are freshly allocated, then
+broadcasts them along the orthogonal communicator; transposed panels get a
+second broadcast. In the SPMD/shard_map world a panel is just a value: these
+helpers produce, inside a traced step, the per-rank slice of a global tile
+row/column (aliasing is free — values are immutable), with the broadcast
+collapsing to one mask+psum along a mesh axis and the transposed-panel
+exchange to an all_gather + static-index select.
+
+All functions are called INSIDE shard_map with the conventions of
+:mod:`dlaf_tpu.algorithms` (storage (ltr, ltc, mb, nb) local blocks, trace-
+time static ``k``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..comm import collectives as cc
+from ..comm.grid import COL_AXIS, ROW_AXIS
+from . import util_distribution as ud
+
+
+class DistContext:
+    """Trace-time constants + traced rank coordinates for one distribution.
+
+    Bundles what every distributed algorithm needs: grid extents, source
+    ranks, per-axis cycle positions (traced), and global-index vectors for
+    local tile slots.
+    """
+
+    def __init__(self, dist):
+        self.nt = dist.nr_tiles
+        self.mb = dist.block_size.row
+        self.nb = dist.block_size.col
+        self.P = dist.grid_size.row
+        self.Q = dist.grid_size.col
+        self.sr = dist.source_rank.row
+        self.sc = dist.source_rank.col
+        from .tiling import storage_tile_grid
+
+        _, _, self.ltr, self.ltc = storage_tile_grid(dist)
+        # traced per-rank values
+        self.rank_r = cc.this_rank(ROW_AXIS)
+        self.rank_c = cc.this_rank(COL_AXIS)
+        self.rr = (self.rank_r - self.sr) % self.P  # cycle position (rows)
+        self.rc = (self.rank_c - self.sc) % self.Q
+
+    # trace-time owner/local-index math (static k)
+    def owner_r(self, k: int) -> int:
+        return ud.rank_global_tile(k, self.P, self.sr)
+
+    def owner_c(self, k: int) -> int:
+        return ud.rank_global_tile(k, self.Q, self.sc)
+
+    def kr(self, k: int) -> int:
+        return ud.local_tile_from_global_tile(k, self.P)
+
+    def kc(self, k: int) -> int:
+        return ud.local_tile_from_global_tile(k, self.Q)
+
+    def row_start(self, k: int) -> int:
+        """Uniform local row slot covering every rank's tiles >= k (off by at
+        most one slot from the per-rank optimum; see cholesky design note)."""
+        return max(0, -(-(k + 1 - self.P) // self.P))
+
+    def col_start(self, k: int) -> int:
+        return max(0, -(-(k + 1 - self.Q) // self.Q))
+
+    def g_rows(self, lu: int, count: int):
+        """Traced global tile rows of local slots lu..lu+count-1."""
+        return (lu + jnp.arange(count)) * self.P + self.rr
+
+    def g_cols(self, lu: int, count: int):
+        return (lu + jnp.arange(count)) * self.Q + self.rc
+
+    def tile_size_r(self, k: int, n_rows: int) -> int:
+        return min(self.mb, n_rows - k * self.mb)
+
+
+def bcast_diag(ctx: DistContext, lt, k: int):
+    """The (k,k) tile to every rank: two mask+psum hops (reference: diag-tile
+    column broadcast, ``cholesky/impl.h:215-219``)."""
+    cand = lt[ctx.kr(k), ctx.kc(k)]
+    return cc.bcast(cc.bcast(cand, ROW_AXIS, ctx.owner_r(k)), COL_AXIS, ctx.owner_c(k))
+
+
+def pad_diag_identity(tile, real_size: int):
+    """Replace the zero-padded trailing block of a short edge diagonal tile
+    with the identity, keeping factorizations/solves nonsingular. No-op when
+    the tile is full (trace-time check)."""
+    mb = tile.shape[-1]
+    if real_size >= mb:
+        return tile
+    pad = jnp.arange(mb) >= real_size
+    cleared = jnp.where(pad[:, None] | pad[None, :], 0, tile)
+    return cleared + jnp.diag(pad.astype(tile.dtype))
+
+
+def col_panel(ctx: DistContext, lt, k: int, lu: int):
+    """Local-row tiles of global tile column ``k`` (rows from slot ``lu``),
+    delivered to every rank of each grid row (reference: panel col->row
+    broadcast). Returns (tiles (ltr-lu, mb, nb), valid-row mask source)."""
+    mine = lt[lu:, ctx.kc(k)]
+    return cc.bcast(mine, COL_AXIS, ctx.owner_c(k))
+
+
+def row_panel(ctx: DistContext, lt, k: int, lu: int):
+    """Local-col tiles of global tile row ``k`` (cols from slot ``lu``),
+    delivered to every rank of each grid column."""
+    mine = lt[ctx.kr(k), lu:]
+    return cc.bcast(mine, ROW_AXIS, ctx.owner_r(k))
+
+
+def transpose_col_to_rows(ctx: DistContext, col_tiles, lu_r: int, g_cols):
+    """Transposed-panel exchange (reference ``panelT`` + transposed
+    ``broadcast_panel``, ``broadcast_panel.h:101-193``): given each rank's
+    row-slice of a tile *column* (slots >= lu_r, already col_panel-broadcast),
+    return for each of my local *column* slots the panel tile of that global
+    index — i.e. the panel seen transposed.
+
+    ``g_cols``: traced global tile indices (my local column slots).
+    """
+    nrows = col_tiles.shape[0]
+    full = cc.all_gather(col_tiles, ROW_AXIS)            # (P, nrows, mb, nb)
+    full = full.reshape(ctx.P * nrows, *col_tiles.shape[1:])
+    pj = (ctx.sr + g_cols) % ctx.P
+    lj = g_cols // ctx.P
+    flat = pj * nrows + jnp.clip(lj - lu_r, 0, max(nrows - 1, 0))
+    return full[flat]
+
+
+def transpose_row_to_cols(ctx: DistContext, row_tiles, lu_c: int, g_rows):
+    """Mirror of :func:`transpose_col_to_rows` for a tile *row* panel."""
+    ncols = row_tiles.shape[0]
+    full = cc.all_gather(row_tiles, COL_AXIS)            # (Q, ncols, mb, nb)
+    full = full.reshape(ctx.Q * ncols, *row_tiles.shape[1:])
+    pj = (ctx.sc + g_rows) % ctx.Q
+    lj = g_rows // ctx.Q
+    flat = pj * ncols + jnp.clip(lj - lu_c, 0, max(ncols - 1, 0))
+    return full[flat]

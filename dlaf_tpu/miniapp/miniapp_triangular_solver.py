@@ -1,0 +1,116 @@
+"""Triangular-solve benchmark driver.
+
+TPU-native counterpart of the reference's
+``miniapp/miniapp_triangular_solver.cpp`` (285 LoC): fenced timing, TRSM flop
+model (side-dependent m*m*n adds + muls), schema-stable output line.
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_triangular_solver -m 8192 -n 512 \
+          -b 256 --grid-rows 2 --grid-cols 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from .. import config
+from ..algorithms.triangular import triangular_solve
+from ..comm.grid import Grid
+from ..common.index2d import GlobalElementSize, TileElementSize
+from ..matrix.matrix import Matrix
+from ..types import total_ops, type_letter
+from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--m", type=int, default=4096, help="rows of B")
+    p.add_argument("-n", "--n", type=int, default=512, help="cols of B")
+    p.add_argument("-b", "--block-size", type=int, default=256)
+    p.add_argument("--side", choices=["L", "R"], default="L")
+    p.add_argument("--uplo", choices=["L", "U"], default="L")
+    p.add_argument("--op", choices=["N", "T", "C"], default="N")
+    p.add_argument("--diag", choices=["N", "U"], default="N")
+    add_miniapp_arguments(p)
+    return p
+
+
+def trsm_flops(dtype, side, m, n):
+    """m^2 n (side L) / m n^2 (side R) muls + same adds (reference
+    ``miniapp_triangular_solver.cpp`` flop model)."""
+    mul = m * m * n / 2 if side == "L" else m * n * n / 2
+    return total_ops(dtype, mul, mul)
+
+
+def run(argv=None) -> list[dict]:
+    args, extra = build_parser().parse_known_args(argv)
+    config.initialize(argv=extra)
+    opts = parse_miniapp_options(args)
+    devices = select_devices(opts)
+
+    m, n, nb = args.m, args.n, args.block_size
+    adim = m if args.side == "L" else n
+    grid = Grid(opts.grid_rows, opts.grid_cols, devices=devices,
+                ordering=config.get_configuration().grid_ordering)
+    use_grid = None if grid.num_devices == 1 else grid
+
+    def a_fn(i, j):  # well-conditioned triangular analytic setter
+        return (1.0 / (1.0 + np.abs(i - j))) + 2.0 * adim * (i == j)
+
+    def b_fn(i, j):
+        return np.cos(0.001 * (i + 1)) + np.sin(0.002 * (j + 1))
+
+    am = Matrix.from_element_fn(a_fn, GlobalElementSize(adim, adim),
+                                TileElementSize(nb, nb), grid=use_grid,
+                                dtype=opts.dtype)
+    bm = Matrix.from_element_fn(b_fn, GlobalElementSize(m, n),
+                                TileElementSize(nb, nb), grid=use_grid,
+                                dtype=opts.dtype)
+    backend = devices[0].platform
+    results = []
+    for run_i in range(-opts.nwarmups, opts.nruns):
+        b_in = bm.with_storage(bm.storage + 0)
+        b_in.storage.block_until_ready()
+        t0 = time.perf_counter()
+        out = triangular_solve(args.side, args.uplo, args.op, args.diag, 1.0,
+                               am, b_in)
+        out.storage.block_until_ready()
+        t = time.perf_counter() - t0
+        gflops = trsm_flops(opts.dtype, args.side, m, n) / t / 1e9
+        if run_i < 0:
+            continue
+        print(f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
+              f"{type_letter(opts.dtype)}{args.side}{args.uplo}{args.op}{args.diag} "
+              f"({m}, {n}) ({nb}, {nb}) ({opts.grid_rows}, {opts.grid_cols}) "
+              f"{os.cpu_count()} {backend}", flush=True)
+        results.append({"run": run_i, "time_s": t, "gflops": gflops})
+        last = run_i == opts.nruns - 1
+        if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
+            check(args, am, bm, out)
+    return results
+
+
+def check(args, am: Matrix, bm: Matrix, out: Matrix) -> None:
+    a = am.to_numpy()
+    t = np.tril(a) if args.uplo == "L" else np.triu(a)
+    if args.diag == "U":
+        np.fill_diagonal(t, 1.0)
+    t = {"N": t, "T": t.T, "C": t.conj().T}[args.op]
+    x = out.to_numpy()
+    b = bm.to_numpy()
+    resid = np.linalg.norm((t @ x if args.side == "L" else x @ t) - b) \
+        / max(np.linalg.norm(b), 1e-30)
+    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    tol = 60 * max(args.m, args.n) * eps
+    status = "PASSED" if resid < tol else "FAILED"
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    if resid >= tol:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,142 @@
+"""Triangular solve/multiply tests
+(reference: test/unit/solver/test_triangular.cpp,
+test/unit/multiplication/test_triangular.cpp): all combos, local +
+distributed, several grids, rectangular B, edge tiles, all scalar types.
+"""
+
+import numpy as np
+import pytest
+
+from dlaf_tpu.algorithms.triangular import triangular_multiply, triangular_solve
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import RankIndex2D, TileElementSize
+
+COMBOS = [(s, u, o, d)
+          for s in "LR" for u in "LU" for o in "NTC" for d in "NU"]
+SOLVE_COMBOS_SMALL = [("L", "L", "N", "N"), ("L", "U", "T", "N"), ("L", "U", "N", "U"),
+                      ("L", "L", "C", "N"), ("R", "L", "N", "N"), ("R", "U", "C", "N"),
+                      ("R", "L", "T", "U"), ("R", "U", "N", "N")]
+
+
+def make_ab(n, m, dtype, side, seed=0):
+    rng = np.random.default_rng(seed)
+    adim = n if side == "L" else m
+    a = rng.standard_normal((adim, adim))
+    b = rng.standard_normal((n, m))
+    if np.dtype(dtype).kind == "c":
+        a = a + 1j * rng.standard_normal(a.shape)
+        b = b + 1j * rng.standard_normal(b.shape)
+    a = a + 2 * adim * np.eye(adim)   # well-conditioned triangles
+    return a.astype(dtype), b.astype(dtype)
+
+
+def np_tri(a, uplo, diag):
+    t = np.tril(a) if uplo == "L" else np.triu(a)
+    if diag == "U":
+        np.fill_diagonal(t, 1.0)
+    return t
+
+
+def np_op(a, op):
+    return {"N": a, "T": a.T, "C": a.conj().T}[op]
+
+
+def mats(a, b, nb, nbb, grid=None, src=RankIndex2D(0, 0)):
+    from dlaf_tpu.matrix.matrix import Matrix
+    am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid, source_rank=src)
+    bm = Matrix.from_global(b, TileElementSize(nb, nbb), grid=grid, source_rank=src)
+    return am, bm
+
+
+def _tol(dtype):
+    eps = np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+    return dict(rtol=500 * eps, atol=500 * eps)
+
+
+@pytest.mark.parametrize("side,uplo,op,diag", COMBOS)
+def test_solve_local_all_combos(side, uplo, op, diag):
+    dtype = np.float64
+    a, b = make_ab(12, 8, dtype, side)
+    am, bm = mats(a, b, 4, 4)
+    out = triangular_solve(side, uplo, op, diag, 1.5, am, bm).to_numpy()
+    t = np_op(np_tri(a, uplo, diag), op)
+    expect = np.linalg.solve(t, 1.5 * b) if side == "L" else (1.5 * b) @ np.linalg.inv(t)
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.complex128])
+@pytest.mark.parametrize("side,uplo,op,diag", SOLVE_COMBOS_SMALL[:4])
+def test_solve_local_dtypes(side, uplo, op, diag, dtype):
+    a, b = make_ab(12, 8, dtype, side)
+    am, bm = mats(a, b, 4, 4)
+    out = triangular_solve(side, uplo, op, diag, 1.0, am, bm).to_numpy()
+    t = np_op(np_tri(a, uplo, diag), op)
+    expect = np.linalg.solve(t, b) if side == "L" else b @ np.linalg.inv(t)
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("grid_shape", [(2, 2), (2, 4), (4, 2)])
+@pytest.mark.parametrize("side,uplo,op,diag", SOLVE_COMBOS_SMALL)
+def test_solve_distributed(side, uplo, op, diag, grid_shape, devices8):
+    dtype = np.float64
+    n, m, nb = 16, 12, 4
+    a, b = make_ab(n, m, dtype, side, seed=3)
+    grid = Grid(*grid_shape)
+    am, bm = mats(a, b, nb, nb, grid=grid, src=RankIndex2D(1 % grid_shape[0],
+                                                           1 % grid_shape[1]))
+    out = triangular_solve(side, uplo, op, diag, 2.0, am, bm).to_numpy()
+    t = np_op(np_tri(a, uplo, diag), op)
+    expect = np.linalg.solve(t, 2.0 * b) if side == "L" else (2.0 * b) @ np.linalg.inv(t)
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
+
+
+def test_solve_distributed_edge_tiles(devices8):
+    # non-divisible sizes: short edge tiles on both A and B
+    dtype = np.float64
+    n, m, nb = 13, 9, 4
+    a, b = make_ab(n, m, dtype, "L", seed=5)
+    grid = Grid(2, 4)
+    am, bm = mats(a, b, nb, nb, grid=grid)
+    out = triangular_solve("L", "L", "N", "N", 1.0, am, bm).to_numpy()
+    expect = np.linalg.solve(np_tri(a, "L", "N"), b)
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
+    assert np.isfinite(out).all()
+
+
+@pytest.mark.parametrize("side,uplo,op,diag", COMBOS)
+def test_multiply_local_all_combos(side, uplo, op, diag):
+    dtype = np.float64
+    a, b = make_ab(12, 8, dtype, side, seed=7)
+    am, bm = mats(a, b, 4, 4)
+    out = triangular_multiply(side, uplo, op, diag, 0.5, am, bm).to_numpy()
+    t = np_op(np_tri(a, uplo, diag), op)
+    expect = 0.5 * (t @ b if side == "L" else b @ t)
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("grid_shape", [(2, 2), (2, 4)])
+@pytest.mark.parametrize("side,uplo,op,diag",
+                         [("L", "L", "N", "N"), ("L", "U", "N", "N"),
+                          ("R", "L", "N", "N"), ("R", "U", "N", "U"),
+                          ("L", "L", "C", "N"), ("R", "U", "T", "N")])
+def test_multiply_distributed(side, uplo, op, diag, grid_shape, devices8):
+    dtype = np.float64
+    n, m, nb = 16, 12, 4
+    a, b = make_ab(n, m, dtype, side, seed=11)
+    grid = Grid(*grid_shape)
+    am, bm = mats(a, b, nb, nb, grid=grid, src=RankIndex2D(0, 1))
+    out = triangular_multiply(side, uplo, op, diag, 1.0, am, bm).to_numpy()
+    t = np_op(np_tri(a, uplo, diag), op)
+    expect = t @ b if side == "L" else b @ t
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", [np.complex128])
+def test_solve_distributed_complex(dtype, devices8):
+    n, m, nb = 16, 8, 4
+    a, b = make_ab(n, m, dtype, "L", seed=13)
+    grid = Grid(2, 2)
+    am, bm = mats(a, b, nb, nb, grid=grid)
+    out = triangular_solve("L", "L", "C", "N", 1.0, am, bm).to_numpy()
+    expect = np.linalg.solve(np_tri(a, "L", "N").conj().T, b)
+    np.testing.assert_allclose(out, expect, **_tol(dtype))
