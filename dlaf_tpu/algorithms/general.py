@@ -1,0 +1,58 @@
+"""General (sub-)matrix multiplication.
+
+TPU-native counterpart of the reference's ``multiplication/general``
+(``multiplication/general/api.h:23`` ``GeneralSub::callNN``: local NN gemm
+over the tile range [a, b] — the reference's naive triple tile loop,
+``impl.h:25-43``, used by the D&C eigenvector multiply). Here the tile range
+is an element-range slice and the product is ONE XLA dot on the slice.
+
+Also provides the full distributed gemm (an extension over the reference's
+local-only scope) via the GSPMD global view: annotate shardings, let XLA
+pick the SUMMA-style collective schedule.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..common.asserts import dlaf_assert
+from ..matrix.matrix import Matrix
+from ..matrix.tiling import global_to_tiles, tiles_to_global
+
+
+@functools.lru_cache(maxsize=128)
+def _gemm_cached(dist_a, dist_b, dist_c, sharding, a0, a1, alpha_beta_static=None):
+    def prog(sa, sb, sc, alpha, beta):
+        ga = tiles_to_global(sa, dist_a)
+        gb = tiles_to_global(sb, dist_b)
+        gc = tiles_to_global(sc, dist_c)
+        sl = slice(a0, a1)
+        prod = ga[sl, sl] @ gb[sl, sl]
+        gc = gc.at[sl, sl].set(alpha * prod + beta * gc[sl, sl])
+        return global_to_tiles(gc, dist_c)
+
+    kw = {}
+    if sharding is not None:
+        kw = dict(in_shardings=(sharding, sharding, sharding, None, None),
+                  out_shardings=sharding)
+    return jax.jit(prog, **kw)
+
+
+def general_sub_multiply(alpha, a: Matrix, b: Matrix, beta, c: Matrix,
+                         tile_begin: int, tile_end: int) -> Matrix:
+    """``C[r,r] = alpha A[r,r] B[r,r] + beta C[r,r]`` with ``r`` the element
+    range covered by tiles [tile_begin, tile_end) (reference
+    ``GeneralSub::callNN``)."""
+    dlaf_assert(a.block_size == b.block_size == c.block_size,
+                "general_sub_multiply: block sizes must agree")
+    nb = a.block_size.row
+    a0 = tile_begin * nb
+    a1 = min(tile_end * nb, a.size.row)
+    sh = None if (a.grid is None or a.grid.num_devices == 1) else a.grid.tile_sharding()
+    fn = _gemm_cached(a.dist, b.dist, c.dist, sh, a0, a1)
+    alpha = jnp.asarray(alpha, c.dtype)
+    beta = jnp.asarray(beta, c.dtype)
+    return c.with_storage(fn(a.storage, b.storage, c.storage, alpha, beta))

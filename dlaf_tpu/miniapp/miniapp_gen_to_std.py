@@ -1,0 +1,111 @@
+"""HEGST (gen_to_std) benchmark driver.
+
+TPU-native counterpart of the reference's ``miniapp/miniapp_gen_to_std.cpp``
+(202 LoC): fenced timing, hegst flop model (n^3/2 muls + n^3/2 adds), schema
+output line. BASELINE config #3: z, N=8192, nb=256, 2x2 grid.
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_gen_to_std -m 8192 -b 256 \
+          --type z --grid-rows 2 --grid-cols 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from .. import config
+from ..algorithms.cholesky import cholesky
+from ..algorithms.gen_to_std import gen_to_std
+from ..comm.grid import Grid
+from ..common.index2d import GlobalElementSize, TileElementSize
+from ..matrix.matrix import Matrix
+from ..types import total_ops, type_letter
+from .generators import hpd_element_fn
+from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--matrix-size", type=int, default=4096)
+    p.add_argument("-b", "--block-size", type=int, default=256)
+    p.add_argument("--uplo", choices=["L", "U"], default="L")
+    add_miniapp_arguments(p)
+    return p
+
+
+def run(argv=None) -> list[dict]:
+    args, extra = build_parser().parse_known_args(argv)
+    config.initialize(argv=extra)
+    opts = parse_miniapp_options(args)
+    devices = select_devices(opts)
+
+    n, nb = args.matrix_size, args.block_size
+    grid = Grid(opts.grid_rows, opts.grid_cols, devices=devices,
+                ordering=config.get_configuration().grid_ordering)
+    use_grid = None if grid.num_devices == 1 else grid
+    size = GlobalElementSize(n, n)
+    block = TileElementSize(nb, nb)
+
+    am = Matrix.from_element_fn(hpd_element_fn(n, opts.dtype), size, block,
+                                grid=use_grid, dtype=opts.dtype)
+    bm = Matrix.from_element_fn(hpd_element_fn(n, opts.dtype), size, block,
+                                grid=use_grid, dtype=opts.dtype)
+    bf = cholesky(args.uplo, bm)
+    bf.storage.block_until_ready()
+
+    backend = devices[0].platform
+    results = []
+    for run_i in range(-opts.nwarmups, opts.nruns):
+        a_in = am.with_storage(am.storage + 0)
+        a_in.storage.block_until_ready()
+        t0 = time.perf_counter()
+        out = gen_to_std(args.uplo, a_in, bf)
+        out.storage.block_until_ready()
+        t = time.perf_counter() - t0
+        gflops = total_ops(opts.dtype, n**3 / 2, n**3 / 2) / t / 1e9
+        if run_i < 0:
+            continue
+        print(f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
+              f"{type_letter(opts.dtype)}{args.uplo} ({n}, {n}) ({nb}, {nb}) "
+              f"({opts.grid_rows}, {opts.grid_cols}) {os.cpu_count()} {backend}",
+              flush=True)
+        results.append({"run": run_i, "time_s": t, "gflops": gflops})
+        last = run_i == opts.nruns - 1
+        if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
+            check(args.uplo, am, bf, out)
+    return results
+
+
+def check(uplo, am, bf, out) -> None:
+    a = am.to_numpy()
+    f = bf.to_numpy()
+    c = out.to_numpy()
+    n = a.shape[0]
+    if uplo == "L":
+        l = np.tril(f)
+        cf = np.tril(c) + np.tril(c, -1).conj().T
+        resid = np.linalg.norm(l @ cf @ l.conj().T - _hermfull(a, "L"))
+    else:
+        u = np.triu(f)
+        cf = np.triu(c) + np.triu(c, 1).conj().T
+        resid = np.linalg.norm(u.conj().T @ cf @ u - _hermfull(a, "U"))
+    resid /= max(np.linalg.norm(a), 1e-30)
+    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    tol = 100 * n * eps
+    status = "PASSED" if resid < tol else "FAILED"
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    if resid >= tol:
+        sys.exit(1)
+
+
+def _hermfull(a, uplo):
+    tri = np.tril(a, -1) if uplo == "L" else np.triu(a, 1)
+    return tri + tri.conj().T + np.diag(np.real(np.diag(a)))
+
+
+if __name__ == "__main__":
+    run()

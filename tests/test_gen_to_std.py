@@ -1,0 +1,138 @@
+"""gen_to_std (HEGST), matrix ops, and general multiply tests
+(reference: test/unit/eigensolver/test_gen_to_std.cpp,
+test/unit/multiplication/test_multiplication_general.cpp)."""
+
+import numpy as np
+import pytest
+
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.algorithms.gen_to_std import gen_to_std
+from dlaf_tpu.algorithms.general import general_sub_multiply
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import RankIndex2D, TileElementSize
+from dlaf_tpu.matrix import ops as mops
+from dlaf_tpu.matrix.matrix import Matrix
+
+
+def _tol(dtype):
+    eps = np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+    return dict(rtol=2000 * eps, atol=2000 * eps)
+
+
+def herm(n, dtype, seed, pd=False):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    a = (x + x.conj().T) / 2
+    if pd:
+        a = x @ x.conj().T + n * np.eye(n)
+    return a.astype(dtype)
+
+
+def M(a, nb, grid=None, src=RankIndex2D(0, 0)):
+    return Matrix.from_global(a, TileElementSize(nb, nb), grid=grid, source_rank=src)
+
+
+# -- matrix ops -------------------------------------------------------------
+
+@pytest.mark.parametrize("grid_shape", [None, (2, 2), (2, 4)])
+def test_transpose_hermitianize(grid_shape, devices8):
+    grid = Grid(*grid_shape) if grid_shape else None
+    a = herm(12, np.complex128, 1) + np.triu(np.ones((12, 12)), 1) * 0.3
+    m = M(a, 4, grid)
+    t = mops.transpose(m).to_numpy()
+    np.testing.assert_allclose(t, a.conj().T, rtol=1e-14)
+    h = mops.hermitianize(m, "L").to_numpy()
+    tri = np.tril(a, -1)
+    expect = tri + tri.conj().T + np.diag(np.real(np.diag(a)))
+    np.testing.assert_allclose(h, expect, rtol=1e-14)
+    assert np.allclose(h, h.conj().T)
+
+
+def test_merge_triangle(devices8):
+    a = np.arange(64, dtype=np.float64).reshape(8, 8)
+    b = -np.ones((8, 8))
+    out = mops.merge_triangle(M(a, 4, Grid(2, 2)), M(b, 4, Grid(2, 2)), "L").to_numpy()
+    np.testing.assert_array_equal(np.tril(out), np.tril(a))
+    np.testing.assert_array_equal(np.triu(out, 1), np.triu(b, 1))
+
+
+# -- gen_to_std -------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("n,nb", [(12, 4), (13, 4), (8, 8)])
+def test_gen_to_std_local(uplo, n, nb, dtype):
+    a = herm(n, dtype, 2)
+    b = herm(n, dtype, 3, pd=True)
+    bf = cholesky(uplo, M(b, nb))
+    out = gen_to_std(uplo, M(a, nb), bf).to_numpy()
+    if uplo == "L":
+        l = np.tril(bf.to_numpy())
+        expect = np.linalg.solve(l, a) @ np.linalg.inv(l).conj().T
+        np.testing.assert_allclose(np.tril(out), np.tril(expect), **_tol(dtype))
+        np.testing.assert_array_equal(np.triu(out, 1), np.triu(a, 1))
+    else:
+        u = np.triu(bf.to_numpy())
+        expect = np.linalg.solve(u.conj().T, a) @ np.linalg.inv(u)
+        np.testing.assert_allclose(np.triu(out), np.triu(expect), **_tol(dtype))
+        np.testing.assert_array_equal(np.tril(out, -1), np.tril(a, -1))
+
+
+@pytest.mark.parametrize("grid_shape", [(2, 2), (2, 4), (4, 2)])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_gen_to_std_distributed(uplo, grid_shape, devices8):
+    dtype = np.float64
+    n, nb = 16, 4
+    a = herm(n, dtype, 4)
+    b = herm(n, dtype, 5, pd=True)
+    grid = Grid(*grid_shape)
+    src = RankIndex2D(1 % grid_shape[0], 1 % grid_shape[1])
+    bf = cholesky("L", M(b, nb, grid, src)) if uplo == "L" else None
+    if uplo == "U":
+        # build U factor locally, distribute it
+        u = np.linalg.cholesky(b).conj().T
+        bfm = M(np.triu(u) + np.tril(b, -1), nb, grid, src)
+    else:
+        bfm = bf
+    out = gen_to_std(uplo, M(a, nb, grid, src), bfm).to_numpy()
+    if uplo == "L":
+        l = np.tril(bfm.to_numpy())
+        expect = np.linalg.solve(l, a) @ np.linalg.inv(l).conj().T
+        np.testing.assert_allclose(np.tril(out), np.tril(expect), **_tol(dtype))
+    else:
+        u = np.triu(bfm.to_numpy())
+        expect = np.linalg.solve(u.conj().T, a) @ np.linalg.inv(u)
+        np.testing.assert_allclose(np.triu(out), np.triu(expect), **_tol(dtype))
+
+
+def test_gen_to_std_matches_scipy_eigvals():
+    # end check: eig(A, B) == eig(transformed standard problem)
+    import scipy.linalg as sla
+
+    n, nb = 12, 4
+    a = herm(n, np.float64, 6)
+    b = herm(n, np.float64, 7, pd=True)
+    bf = cholesky("L", M(b, nb))
+    c = gen_to_std("L", M(a, nb), bf).to_numpy()
+    cfull = np.tril(c) + np.tril(c, -1).T
+    w1 = np.linalg.eigvalsh(cfull)
+    w2 = sla.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(w1, w2, atol=1e-10)
+
+
+# -- general sub multiply ---------------------------------------------------
+
+@pytest.mark.parametrize("grid_shape", [None, (2, 2)])
+def test_general_sub_multiply(grid_shape, devices8):
+    grid = Grid(*grid_shape) if grid_shape else None
+    n, nb = 16, 4
+    rng = np.random.default_rng(8)
+    a, b, c = (rng.standard_normal((n, n)) for _ in range(3))
+    am, bm, cm = M(a, nb, grid), M(b, nb, grid), M(c, nb, grid)
+    out = general_sub_multiply(2.0, am, bm, 0.5, cm, 1, 3).to_numpy()
+    expect = c.copy()
+    sl = slice(4, 12)
+    expect[sl, sl] = 2.0 * a[sl, sl] @ b[sl, sl] + 0.5 * c[sl, sl]
+    np.testing.assert_allclose(out, expect, rtol=1e-13, atol=1e-13)
