@@ -1,0 +1,129 @@
+"""reduction_to_band tests
+(reference: test/unit/eigensolver/test_reduction_to_band.cpp): band
+structure, eigenvalue preservation (orthogonal similarity), explicit Q
+reconstruction from the stored V/taus, local + distributed.
+"""
+
+import numpy as np
+import pytest
+
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import RankIndex2D, TileElementSize
+from dlaf_tpu.eigensolver.reduction_to_band import (BandReduction, extract_band,
+                                                    reduction_to_band)
+from dlaf_tpu.matrix.matrix import Matrix
+
+
+def herm(n, dtype, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    return ((x + x.conj().T) / 2).astype(dtype)
+
+
+def band_dense(red: BandReduction, n):
+    """Dense band matrix from the reduced result."""
+    a = red.matrix.to_numpy()
+    b = red.band
+    out = np.zeros_like(a)
+    for r in range(b + 1):
+        d = np.diagonal(a, -r)
+        out += np.diag(d, -r)
+        if r:
+            out += np.diag(d.conj(), r)
+    return out
+
+
+def q_from_vt(red: BandReduction, n):
+    """Accumulate Q = prod_k (I - V_k T_k V_k^H) embedded at offset (k+1)nb."""
+    from dlaf_tpu.tile_ops.lapack import larft
+    import jax.numpy as jnp
+
+    a = red.matrix.to_numpy()
+    nb = red.band
+    taus = np.asarray(red.taus)
+    q = np.eye(n, dtype=a.dtype)
+    nt = (n + nb - 1) // nb
+    for k in range(nt - 1):
+        k1 = (k + 1) * nb
+        m_p = n - k1
+        pw = min(nb, a.shape[1] - k * nb)
+        vf = a[k1:, k * nb: k * nb + nb]
+        v = np.tril(vf, -1) + np.eye(m_p, nb)
+        t = np.asarray(larft(jnp.asarray(v), jnp.asarray(taus[k].astype(a.dtype))))
+        qk = np.eye(n, dtype=a.dtype)
+        qk[k1:, k1:] = np.eye(m_p, dtype=a.dtype) - v @ t @ v.conj().T
+        q = q @ qk
+    return q
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb", [(16, 4), (24, 8), (13, 4), (8, 8)])
+def test_red2band_local(n, nb, dtype):
+    a = herm(n, dtype, n)
+    mat = Matrix.from_global(a, TileElementSize(nb, nb))
+    red = reduction_to_band(mat)
+    bd = band_dense(red, n)
+    # 1) band structure: nothing outside the band
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > nb
+    assert np.allclose(bd[mask], 0)
+    # 2) similarity: B == Q^H A Q with the accumulated Q
+    q = q_from_vt(red, n)
+    np.testing.assert_allclose(q @ q.conj().T, np.eye(n), atol=1e-12)
+    np.testing.assert_allclose(q.conj().T @ a @ q, bd, atol=1e-10)
+    # 3) eigenvalues preserved
+    np.testing.assert_allclose(np.linalg.eigvalsh(bd), np.linalg.eigvalsh(a),
+                               atol=1e-10)
+
+
+def test_extract_band_layout():
+    n, nb = 16, 4
+    a = herm(n, np.float64, 3)
+    red = reduction_to_band(Matrix.from_global(a, TileElementSize(nb, nb)))
+    band = extract_band(red)
+    assert band.shape == (nb + 1, n)
+    full = red.matrix.to_numpy()
+    for r in range(nb + 1):
+        np.testing.assert_array_equal(band[r, : n - r], np.diagonal(full, -r))
+
+
+@pytest.mark.parametrize("grid_shape,src", [((2, 2), (0, 0)), ((2, 4), (1, 2)),
+                                            ((4, 2), (3, 0))])
+@pytest.mark.parametrize("n,nb", [(16, 4), (24, 4), (13, 4)])
+def test_red2band_distributed(n, nb, grid_shape, src, devices8):
+    dtype = np.float64
+    a = herm(n, dtype, n + grid_shape[0])
+    grid = Grid(*grid_shape)
+    mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
+                             source_rank=RankIndex2D(src[0] % grid_shape[0],
+                                                     src[1] % grid_shape[1]))
+    red = reduction_to_band(mat)
+    bd = band_dense(red, n)
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > nb
+    assert np.allclose(bd[mask], 0, atol=1e-12)
+    np.testing.assert_allclose(np.linalg.eigvalsh(bd), np.linalg.eigvalsh(a),
+                               atol=1e-9)
+
+
+def test_red2band_distributed_matches_local(devices8):
+    n, nb = 24, 4
+    a = herm(n, np.float64, 77)
+    local = reduction_to_band(Matrix.from_global(a, TileElementSize(nb, nb)))
+    dist = reduction_to_band(Matrix.from_global(a, TileElementSize(nb, nb),
+                                                grid=Grid(2, 4)))
+    np.testing.assert_allclose(dist.matrix.to_numpy(), local.matrix.to_numpy(),
+                               atol=1e-11)
+    np.testing.assert_allclose(np.asarray(dist.taus), np.asarray(local.taus),
+                               atol=1e-11)
+
+
+@pytest.mark.parametrize("dtype", [np.complex128])
+def test_red2band_distributed_complex(dtype, devices8):
+    n, nb = 16, 4
+    a = herm(n, dtype, 5)
+    red = reduction_to_band(Matrix.from_global(a, TileElementSize(nb, nb),
+                                               grid=Grid(2, 2)))
+    bd = band_dense(red, n)
+    np.testing.assert_allclose(np.linalg.eigvalsh(bd), np.linalg.eigvalsh(a),
+                               atol=1e-9)
