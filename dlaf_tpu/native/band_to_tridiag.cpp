@@ -1,0 +1,210 @@
+// Native bulge-chasing kernel: Hermitian band -> tridiagonal.
+//
+// C++ twin of dlaf_tpu/eigensolver/band_to_tridiag.py (the numpy reference
+// implementation); see that module for the algorithm notes and the uniform
+// reflector layout contract. This is the performance path for the host stage
+// the reference also keeps CPU-only (its pika SweepWorker pipeline,
+// eigensolver/band_to_tridiag/mc.h) — here a single tight loop; sweep-level
+// pipelining across cores can come later without changing the interface.
+//
+// Build: g++ -O3 -shared -fPIC band_to_tridiag.cpp -o libdlaf_native.so
+// Interface: C ABI consumed via ctypes (dlaf_tpu/native/bindings.py).
+
+#include <cmath>
+#include <complex>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+template <typename T>
+struct Traits;
+
+template <>
+struct Traits<double> {
+  static double conj(double x) { return x; }
+  static double abs(double x) { return std::fabs(x); }
+  static double real(double x) { return x; }
+};
+
+template <>
+struct Traits<std::complex<double>> {
+  static std::complex<double> conj(std::complex<double> x) { return std::conj(x); }
+  static double abs(std::complex<double> x) { return std::abs(x); }
+  static double real(std::complex<double> x) { return x.real(); }
+};
+
+// Householder generator: (I - tau v v^H) x = beta e1, v[0]=1, beta real.
+template <typename T>
+void larfg(long m, T* x, T* v, T* tau, double* beta_out) {
+  T alpha = x[0];
+  double xnorm = 0.0;
+  for (long i = 1; i < m; ++i) {
+    double a = Traits<T>::abs(x[i]);
+    xnorm = std::hypot(xnorm, a);
+  }
+  double alpha_im = Traits<T>::abs(alpha - T(Traits<T>::real(alpha)));
+  if (xnorm == 0.0 && alpha_im == 0.0) {
+    for (long i = 0; i < m; ++i) v[i] = T(0);
+    *tau = T(0);
+    *beta_out = Traits<T>::real(alpha);
+    return;
+  }
+  double r = std::hypot(Traits<T>::abs(alpha), xnorm);
+  double ar = Traits<T>::real(alpha);
+  double beta = (ar != 0.0) ? -std::copysign(r, ar) : -r;
+  // our convention: tau = conj((beta - alpha)/beta)
+  T t = Traits<T>::conj((T(beta) - alpha) / T(beta));
+  T scale = T(1.0) / (alpha - T(beta));
+  v[0] = T(1);
+  for (long i = 1; i < m; ++i) v[i] = x[i] * scale;
+  *tau = t;
+  *beta_out = beta;
+}
+
+template <typename T>
+struct BandChase {
+  long n, b, ld;  // ld = 2b+1 rows of working band
+  std::vector<T> wb;          // wb[r*n + j] = A[j+r, j]
+  std::vector<T> win, blk, u, w, tmp;
+
+  BandChase(const T* band, long n_, long b_) : n(n_), b(b_), ld(2 * b_ + 1) {
+    wb.assign(static_cast<size_t>(ld) * n, T(0));
+    for (long r = 0; r <= b; ++r)
+      std::memcpy(&wb[r * n], &band[r * n], sizeof(T) * n);
+    win.resize(b * b);
+    blk.resize(b * b);
+    u.resize(b);
+    w.resize(b);
+  }
+
+  T& at(long i, long j) { return wb[(i - j) * n + j]; }  // i >= j, i-j <= 2b
+
+  // S <- H S H^H on the Hermitian window A[j0:j0+m, j0:j0+m]
+  void two_sided(long j0, long m, const T* v, T tau) {
+    // dense Hermitian window
+    for (long c = 0; c < m; ++c)
+      for (long r = 0; r < m; ++r)
+        win[r * m + c] = (r >= c) ? at(j0 + r, j0 + c)
+                                  : Traits<T>::conj(at(j0 + c, j0 + r));
+    for (long r = 0; r < m; ++r) win[r * m + r] = T(Traits<T>::real(win[r * m + r]));
+    // u = S v ; vhu = v^H u (real)
+    for (long r = 0; r < m; ++r) {
+      T acc = T(0);
+      for (long c = 0; c < m; ++c) acc += win[r * m + c] * v[c];
+      u[r] = acc;
+    }
+    T vhu = T(0);
+    for (long r = 0; r < m; ++r) vhu += Traits<T>::conj(v[r]) * u[r];
+    double a2 = Traits<T>::abs(tau);
+    T half = T(a2 * a2 / 2.0) * vhu;
+    for (long r = 0; r < m; ++r) w[r] = Traits<T>::conj(tau) * u[r] - half * v[r];
+    // S -= w v^H + v w^H  (write back lower triangle only)
+    for (long c = 0; c < m; ++c)
+      for (long r = c; r < m; ++r)
+        at(j0 + r, j0 + c) = win[r * m + c] - w[r] * Traits<T>::conj(v[c]) -
+                             v[r] * Traits<T>::conj(w[c]);
+  }
+
+  void run(T* v_out, T* tau_out, long n_steps, double* d_out, T* e_out) {
+    // n-2 sweeps like the numpy reference; complex off-diagonal phases are
+    // normalized by the caller (python side), not by an extra sweep.
+    for (long s = 0; s < n - 2; ++s) {
+      long l = std::min(b, n - 1 - s);
+      if (l < 1) continue;
+      // column s below diag
+      std::vector<T> x(l);
+      for (long i = 0; i < l; ++i) x[i] = wb[(1 + i) * n + s];
+      std::vector<T> v(l);
+      T tau;
+      double beta;
+      larfg<T>(l, x.data(), v.data(), &tau, &beta);
+      wb[1 * n + s] = T(beta);
+      for (long i = 1; i < l; ++i) wb[(1 + i) * n + s] = T(0);
+      T* vrow = &v_out[(s * n_steps + 0) * b];
+      for (long i = 0; i < l; ++i) vrow[i] = v[i];
+      tau_out[s * n_steps + 0] = tau;
+
+      long j0 = s + 1, t = 0;
+      std::vector<T> v2(b), xcol(b);
+      while (true) {
+        if (Traits<T>::abs(tau) != 0.0) two_sided(j0, l, v.data(), tau);
+        long l2 = std::min(b, n - (j0 + l));
+        if (l2 == 0) break;
+        // B = A[j0+l : j0+l+l2, j0 : j0+l];  B <- B H^H
+        // column c of B is at band offsets (j0+l - (j0+c)) .. in col j0+c
+        for (long r = 0; r < l2; ++r)
+          for (long c = 0; c < l; ++c)
+            blk[r * l + c] = at(j0 + l + r, j0 + c);
+        if (Traits<T>::abs(tau) != 0.0) {
+          for (long r = 0; r < l2; ++r) {
+            T acc = T(0);
+            for (long c = 0; c < l; ++c) acc += blk[r * l + c] * v[c];
+            acc *= Traits<T>::conj(tau);
+            for (long c = 0; c < l; ++c)
+              blk[r * l + c] -= acc * Traits<T>::conj(v[c]);
+          }
+        }
+        // eliminate first column of B
+        for (long r = 0; r < l2; ++r) xcol[r] = blk[r * l + 0];
+        T tau2;
+        double beta2;
+        larfg<T>(l2, xcol.data(), v2.data(), &tau2, &beta2);
+        for (long r = 0; r < l2; ++r) blk[r * l + 0] = T(0);
+        blk[0] = T(beta2);
+        // left-apply H2 to remaining columns
+        if (Traits<T>::abs(tau2) != 0.0 && l > 1) {
+          for (long c = 1; c < l; ++c) {
+            T acc = T(0);
+            for (long r = 0; r < l2; ++r)
+              acc += Traits<T>::conj(v2[r]) * blk[r * l + c];
+            acc *= tau2;
+            for (long r = 0; r < l2; ++r) blk[r * l + c] -= v2[r] * acc;
+          }
+        }
+        for (long r = 0; r < l2; ++r)
+          for (long c = 0; c < l; ++c)
+            at(j0 + l + r, j0 + c) = blk[r * l + c];
+        ++t;
+        T* vr2 = &v_out[(s * n_steps + t) * b];
+        for (long r = 0; r < l2; ++r) vr2[r] = v2[r];
+        tau_out[s * n_steps + t] = tau2;
+        j0 += l;
+        l = l2;
+        v.assign(v2.begin(), v2.begin() + l2);
+        tau = tau2;
+      }
+    }
+    for (long j = 0; j < n; ++j) d_out[j] = Traits<T>::real(wb[0 * n + j]);
+    for (long j = 0; j + 1 < n; ++j) e_out[j] = wb[1 * n + j];
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// band: (b+1) x n row-major; v_out: n_sweeps*n_steps*b; tau_out:
+// n_sweeps*n_steps; d_out: n; e_out: n-1 (raw, complex for _z).
+int dlaf_band_to_tridiag_d(const double* band, long n, long b, long n_steps,
+                           double* v_out, double* tau_out, double* d_out,
+                           double* e_out) {
+  if (n <= 0 || b <= 0) return 1;
+  BandChase<double> chase(band, n, b);
+  chase.run(v_out, tau_out, n_steps, d_out, e_out);
+  return 0;
+}
+
+int dlaf_band_to_tridiag_z(const void* band, long n, long b, long n_steps,
+                           void* v_out, void* tau_out, double* d_out,
+                           void* e_out) {
+  if (n <= 0 || b <= 0) return 1;
+  using C = std::complex<double>;
+  BandChase<C> chase(reinterpret_cast<const C*>(band), n, b);
+  chase.run(reinterpret_cast<C*>(v_out), reinterpret_cast<C*>(tau_out),
+            n_steps, d_out, reinterpret_cast<C*>(e_out));
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+"""ctypes bindings for the native (C++) host kernels.
+
+The reference's native layer is its entire C++ codebase; here the native
+surface is the host-side stages that XLA cannot own: currently the
+bulge-chasing band->tridiag kernel (``band_to_tridiag.cpp``). The library is
+compiled on first use with g++ (no pybind11 in the image — plain C ABI via
+ctypes); failures fall back to the numpy implementation transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from ..types import ceil_div
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "band_to_tridiag.cpp")
+_LIB = os.path.join(_HERE, "libdlaf_native.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> str:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB
+
+
+def get_lib():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        for name in ("dlaf_band_to_tridiag_d", "dlaf_band_to_tridiag_z"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def band_to_tridiag(band: np.ndarray, b: int):
+    """Native chase; same result contract as
+    :func:`dlaf_tpu.eigensolver.band_to_tridiag.band_to_tridiag_numpy`."""
+    from ..eigensolver.band_to_tridiag import TridiagResult
+
+    n = band.shape[1]
+    cplx = np.issubdtype(band.dtype, np.complexfloating)
+    work_dtype = np.complex128 if cplx else np.float64
+    band_w = np.ascontiguousarray(band, dtype=work_dtype)
+    n_sweeps = max(n - 2, 0)
+    n_steps = ceil_div(max(n - 1, 1), b) if n > 1 else 0
+    v = np.zeros((n_sweeps, max(n_steps, 1), b), dtype=work_dtype)
+    tau = np.zeros((n_sweeps, max(n_steps, 1)), dtype=work_dtype)
+    d = np.zeros(n, dtype=np.float64)
+    e_raw = np.zeros(max(n - 1, 0), dtype=work_dtype)
+    if n_sweeps > 0 or n > 0:
+        lib = get_lib()
+        fn = lib.dlaf_band_to_tridiag_z if cplx else lib.dlaf_band_to_tridiag_d
+        rc = fn(band_w.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_long(n), ctypes.c_long(b), ctypes.c_long(max(n_steps, 1)),
+                v.ctypes.data_as(ctypes.c_void_p),
+                tau.ctypes.data_as(ctypes.c_void_p),
+                d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                e_raw.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise RuntimeError(f"native band_to_tridiag failed rc={rc}")
+    phase = np.ones(n, dtype=work_dtype)
+    if cplx:
+        e = np.zeros(max(n - 1, 0), dtype=np.float64)
+        for j in range(n - 1):
+            mag = np.abs(e_raw[j])
+            ph = e_raw[j] / mag if mag > 0 else 1.0
+            phase[j + 1] = phase[j] * ph
+            e[j] = mag
+    else:
+        e = np.real(e_raw)
+    return TridiagResult(d=d, e=e, v=v[:, :n_steps if n_steps else 0],
+                         tau=tau[:, :n_steps if n_steps else 0],
+                         phase=phase, band=b)

@@ -1,0 +1,91 @@
+"""band_to_tridiag tests
+(reference: test/unit/eigensolver/test_band_to_tridiag.cpp): eigenvalue
+preservation vs scipy, reflector-storage reconstruction, complex phases.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+from dlaf_tpu.eigensolver.band_to_tridiag import band_to_tridiag_numpy
+
+
+def random_band(n, b, dtype, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    a = (x + x.conj().T) / 2
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) <= b
+    a = np.where(mask, a, 0).astype(dtype)
+    np.fill_diagonal(a, np.real(np.diag(a)))
+    band = np.zeros((b + 1, n), dtype=dtype)
+    for r in range(b + 1):
+        band[r, : n - r] = np.diagonal(a, -r)
+    return a, band
+
+
+def reconstruct_q(res, n):
+    """Q = H_1^H H_2^H ... (apply in reverse order to I)."""
+    b = res.band
+    q = np.eye(n, dtype=res.v.dtype)
+    n_sweeps, n_steps, _ = res.v.shape
+    for s in range(n_sweeps - 1, -1, -1):
+        for t in range(n_steps - 1, -1, -1):
+            tau = res.tau[s, t]
+            if tau == 0:
+                continue
+            r0 = s + 1 + t * b
+            seg = min(b, n - r0)
+            v = res.v[s, t, :seg]
+            # Q <- H^H Q on rows r0:r0+seg
+            q[r0: r0 + seg] -= np.conj(tau) * np.outer(v, v.conj() @ q[r0: r0 + seg])
+    return q
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,b", [(12, 2), (16, 4), (13, 4), (17, 3), (8, 8), (5, 1)])
+def test_band_to_tridiag(n, b, dtype):
+    a, band = random_band(n, b, dtype, n + b)
+    res = band_to_tridiag_numpy(band, b)
+    w_ref = np.linalg.eigvalsh(a)
+    w_tri = sla.eigvalsh_tridiagonal(res.d, res.e) if n > 1 else res.d
+    np.testing.assert_allclose(w_tri, w_ref, atol=1e-10)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,b", [(12, 3), (10, 2)])
+def test_band_to_tridiag_reflectors(n, b, dtype):
+    """Q^H A Q must equal the (phase-restored) tridiagonal."""
+    a, band = random_band(n, b, dtype, 3)
+    res = band_to_tridiag_numpy(band, b)
+    q = reconstruct_q(res, n)
+    np.testing.assert_allclose(q @ q.conj().T, np.eye(n), atol=1e-12)
+    t_real = np.diag(res.d) + np.diag(res.e, 1) + np.diag(res.e, -1)
+    t_complex = np.diag(res.phase) @ t_real.astype(res.v.dtype) @ np.diag(res.phase.conj())
+    np.testing.assert_allclose(q.conj().T @ a @ q, t_complex, atol=1e-10)
+
+
+def test_band_one_is_noop_tridiag():
+    n = 9
+    a, band = random_band(n, 1, np.float64, 5)
+    res = band_to_tridiag_numpy(band, 1)
+    np.testing.assert_allclose(res.d, np.diagonal(a), atol=1e-14)
+    np.testing.assert_allclose(np.abs(res.e), np.abs(np.diagonal(a, -1)), atol=1e-14)
+
+
+# -- native C++ twin --------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,b", [(16, 4), (13, 3), (30, 5)])
+def test_native_matches_numpy(n, b, dtype):
+    from dlaf_tpu.native import bindings
+
+    a, band = random_band(n, b, dtype, n * b)
+    ref = band_to_tridiag_numpy(band, b)
+    nat = bindings.band_to_tridiag(band, b)
+    np.testing.assert_allclose(nat.d, ref.d, atol=1e-12)
+    np.testing.assert_allclose(nat.e, ref.e, atol=1e-12)
+    np.testing.assert_allclose(nat.v, ref.v, atol=1e-12)
+    np.testing.assert_allclose(nat.tau, ref.tau, atol=1e-12)
+    np.testing.assert_allclose(nat.phase, ref.phase, atol=1e-12)
