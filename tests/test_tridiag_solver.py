@@ -1,0 +1,80 @@
+"""D&C tridiagonal eigensolver tests
+(reference: test/unit/eigensolver/test_tridiag_solver.cpp): residual +
+orthogonality checks against scipy over sizes, leaf sizes, and pathological
+inputs (clustered eigenvalues, zero couplings, constant diagonal).
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+
+
+def check(d, e, lam, q, tol=5e-13):
+    n = d.shape[0]
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    scale = max(np.abs(d).max(initial=1.0), np.abs(e).max(initial=1.0), 1.0)
+    # eigenvalues vs scipy
+    w = sla.eigvalsh_tridiagonal(d, e) if n > 1 else d
+    np.testing.assert_allclose(lam, w, atol=tol * scale * n, rtol=1e-12)
+    # residual and orthogonality
+    assert np.linalg.norm(t @ q - q * lam[None, :]) < tol * scale * n * 10
+    assert np.linalg.norm(q.T @ q - np.eye(n)) < tol * n * 10
+
+
+@pytest.mark.parametrize("n,nb", [(4, 2), (16, 4), (33, 8), (64, 8), (100, 16),
+                                  (65, 64), (7, 2)])
+def test_random(n, nb):
+    rng = np.random.default_rng(n)
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    lam, q = tridiag_solver(d, e, nb, use_device=False)
+    check(d, e, lam, q)
+
+
+def test_zero_coupling():
+    rng = np.random.default_rng(1)
+    d = rng.standard_normal(32)
+    e = rng.standard_normal(31)
+    e[15] = 0.0  # exact decoupling at the split point
+    lam, q = tridiag_solver(d, e, 16, use_device=False)
+    check(d, e, lam, q)
+
+
+def test_constant_diagonal_heavy_deflation():
+    n = 48
+    d = np.full(n, 2.0)
+    e = np.full(n - 1, 1.0)  # Toeplitz: known eigenvalues, many near-equal poles
+    lam, q = tridiag_solver(d, e, 8, use_device=False)
+    expect = 2.0 + 2.0 * np.cos(np.pi * np.arange(n, 0, -1) / (n + 1))
+    np.testing.assert_allclose(lam, np.sort(expect), atol=1e-12)
+    check(d, e, lam, q)
+
+
+def test_clustered_eigenvalues():
+    rng = np.random.default_rng(3)
+    n = 40
+    d = np.ones(n) + 1e-14 * rng.standard_normal(n)
+    e = 1e-13 * np.abs(rng.standard_normal(n - 1))
+    lam, q = tridiag_solver(d, e, 8, use_device=False)
+    check(d, e, lam, q)
+
+
+def test_wilkinson():
+    # Wilkinson W21+: famously paired close eigenvalues
+    m = 10
+    d = np.abs(np.arange(-m, m + 1)).astype(np.float64)
+    e = np.ones(2 * m)
+    lam, q = tridiag_solver(d, e, 4, use_device=False)
+    check(d, e, lam, q)
+
+
+def test_device_path_matches():
+    rng = np.random.default_rng(9)
+    d = rng.standard_normal(24)
+    e = rng.standard_normal(23)
+    l1, q1 = tridiag_solver(d, e, 8, use_device=False)
+    l2, q2 = tridiag_solver(d, e, 8, use_device=True)
+    np.testing.assert_allclose(l1, l2, atol=1e-12)
+    np.testing.assert_allclose(np.abs(q1), np.abs(q2), atol=1e-10)
