@@ -1,0 +1,36 @@
+"""Row/column permutations over an index range.
+
+TPU-native counterpart of the reference's ``permutations::permute``
+(``permutations/general/api.h:22``, ``impl.h:40-155`` + CUDA gather kernel
+``perms.cu:58-120``): out-of-place ``out[i] = in[perm[i]]`` along rows or
+columns restricted to a tile range, used by the D&C merge. On TPU this is a
+single XLA gather (``jnp.take``) — the custom CUDA kernel disappears.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..common.asserts import dlaf_assert
+from ..matrix.matrix import Matrix
+from ..matrix.tiling import global_to_tiles, tiles_to_global
+
+
+def permute(coord: str, perm, mat: Matrix, tile_begin: int = 0,
+            tile_end: int | None = None) -> Matrix:
+    """Permute rows (coord='Row') or columns ('Col') of the element range
+    covered by tiles [tile_begin, tile_end); identity elsewhere."""
+    dlaf_assert(coord in ("Row", "Col"), f"bad coord {coord!r}")
+    nb = mat.block_size.row if coord == "Row" else mat.block_size.col
+    ext = mat.size.row if coord == "Row" else mat.size.col
+    a0 = tile_begin * nb
+    a1 = ext if tile_end is None else min(tile_end * nb, ext)
+    g = tiles_to_global(mat.storage, mat.dist)
+    idx = jnp.asarray(perm) + a0
+    if coord == "Row":
+        sub = jnp.take(g, idx, axis=0)
+        g = g.at[a0:a1, :].set(sub)
+    else:
+        sub = jnp.take(g, idx, axis=1)
+        g = g.at[:, a0:a1].set(sub)
+    return mat.with_storage(global_to_tiles(g, mat.dist))

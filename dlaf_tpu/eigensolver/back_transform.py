@@ -1,0 +1,102 @@
+"""Back-transformations: tridiag -> band -> full eigenvectors.
+
+TPU-native counterpart of the reference's two back-transformation stages:
+
+* ``bt_band_to_tridiag`` (``impl.h:1-938``): apply the bulge-chasing
+  Householder vectors to the eigenvector matrix. The reference re-tiles the
+  HH storage into cache-friendly b x b groups; here the uniform
+  (n_sweeps, n_steps, b) layout produced by the chase makes one sweep = ONE
+  batched segment update, and the whole stage is a ``lax.scan`` over sweeps
+  (reverse order) — static shapes, device-resident, no host round trips.
+
+* ``bt_reduction_to_band`` (``impl.h:82-373``): apply the panel reflector
+  blocks in reverse order, C <- (I - V T V^H) C per panel — two gemms + one
+  small T solve per panel, trace-time unrolled.
+
+Both consume the storage contracts of :mod:`.band_to_tridiag` and
+:mod:`.reduction_to_band` directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tile_ops.lapack import larft
+from ..types import ceil_div
+from .band_to_tridiag import TridiagResult
+from .reduction_to_band import BandReduction
+
+
+@functools.partial(jax.jit, static_argnames=("b", "n"))
+def _bt_b2t_scan(v_all, tau_all, e, *, b: int, n: int):
+    """E <- Q E with Q = prod over reflectors H^H in reverse sweep order."""
+    n_sweeps, n_steps, _ = v_all.shape
+    m = e.shape[1]
+    seg_len = n_steps * b
+    pad = seg_len + 1
+    e_pad = jnp.pad(e, ((0, pad), (0, 0)))
+
+    def body(e_pad, xs):
+        s, v_s, tau_s = xs
+        start = s + 1
+        seg = lax.dynamic_slice(e_pad, (start, 0), (seg_len, m))
+        seg = seg.reshape(n_steps, b, m)
+        w = jnp.einsum("tb,tbm->tm", jnp.conj(v_s), seg)
+        seg = seg - jnp.conj(tau_s)[:, None, None] * v_s[..., None] * w[:, None, :]
+        e_pad = lax.dynamic_update_slice(e_pad, seg.reshape(seg_len, m), (start, 0))
+        return e_pad, None
+
+    xs = (jnp.arange(n_sweeps - 1, -1, -1),
+          v_all[::-1], tau_all[::-1])
+    e_pad, _ = lax.scan(body, e_pad, xs)
+    return e_pad[:n]
+
+
+def bt_band_to_tridiag(tri: TridiagResult, evecs) -> jax.Array:
+    """Eigenvectors of the BAND matrix from eigenvectors of the tridiagonal:
+    apply the complex phases (see band_to_tridiag), then the chase reflectors
+    in reverse sweep order."""
+    n = tri.d.shape[0]
+    cplx = np.issubdtype(tri.v.dtype, np.complexfloating)
+    e = jnp.asarray(evecs)
+    if cplx:
+        e = e.astype(tri.v.dtype) * jnp.asarray(tri.phase)[:, None]
+    if tri.v.shape[0] == 0:
+        return e
+    return _bt_b2t_scan(jnp.asarray(tri.v), jnp.asarray(tri.tau), e,
+                        b=tri.band, n=n)
+
+
+@functools.partial(jax.jit, static_argnames=("nb",))
+def _bt_r2b_local(a_v, taus, e, *, nb: int):
+    n = a_v.shape[0]
+    nt = ceil_div(n, nb) if n else 0
+    for k in range(nt - 2, -1, -1):
+        k1 = (k + 1) * nb
+        m_p = n - k1
+        if m_p <= 0:
+            continue
+        vf = a_v[k1:, k * nb: k * nb + nb]
+        v = jnp.tril(vf, -1) + jnp.eye(m_p, nb, dtype=a_v.dtype)
+        t = larft(v, taus[k])
+        w = t @ (jnp.conj(v).T @ e[k1:])
+        e = e.at[k1:].add(-v @ w)
+    return e
+
+
+def bt_reduction_to_band(red: BandReduction, evecs) -> jax.Array:
+    """Eigenvectors of the ORIGINAL matrix from eigenvectors of the band
+    matrix: apply the panel reflector blocks in reverse order (local;
+    the reference's distributed variant lands with the distributed
+    eigensolver driver)."""
+    from ..matrix.tiling import tiles_to_global
+
+    a_v = tiles_to_global(red.matrix.storage, red.matrix.dist)
+    e = jnp.asarray(evecs, dtype=a_v.dtype)
+    return _bt_r2b_local(a_v, jnp.asarray(red.taus), e, nb=red.band)

@@ -1,0 +1,80 @@
+"""Standard and generalized Hermitian eigensolver drivers.
+
+TPU-native counterpart of the reference's ``eigensolver/eigensolver``
+(``api.h:28-31``, ``impl.h:33-78``) and ``gen_eigensolver``
+(``api.h:17-21``, ``impl.h:24-35``) — LOCAL only, matching the reference at
+this snapshot (its distributed eigensolver does not exist either; SURVEY §2).
+
+Pipeline (reference ``impl.h:33-78``):
+  reduction_to_band  ->  band_to_tridiag (host chase)  ->  D&C tridiag solve
+  ->  bt_band_to_tridiag  ->  bt_reduction_to_band
+
+Generalized problem ``A x = lambda B x`` (``gen_eigensolver/impl.h:24-35``):
+  cholesky(B)  ->  gen_to_std  ->  eigensolver  ->  triangular back-
+  substitution of the eigenvectors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..algorithms.cholesky import cholesky
+from ..algorithms.gen_to_std import gen_to_std
+from ..algorithms.triangular import triangular_solve
+from ..common.asserts import dlaf_assert
+from ..matrix import ops as mops
+from ..matrix.matrix import Matrix
+from .back_transform import bt_band_to_tridiag, bt_reduction_to_band
+from .band_to_tridiag import band_to_tridiag
+from .reduction_to_band import extract_band, reduction_to_band
+from .tridiag_solver import tridiag_solver
+
+
+@dataclasses.dataclass
+class EigensolverResult:
+    """Reference ``EigensolverResult{eigenvalues, eigenvectors}``
+    (``api.h:21-24``)."""
+
+    eigenvalues: np.ndarray   # (n,) real, ascending
+    eigenvectors: Matrix      # columns are eigenvectors
+
+
+def eigensolver(uplo: str, a: Matrix) -> EigensolverResult:
+    """Eigendecomposition of Hermitian ``a`` stored in ``uplo``
+    (reference ``eigensolver::eigensolver``; local)."""
+    dlaf_assert(a.grid is None or a.grid.num_devices == 1,
+                "eigensolver is local-only (reference parity, api.h:28-31)")
+    dlaf_assert(a.size.row == a.size.col, "eigensolver: square only")
+    n = a.size.row
+    nb = a.block_size.row
+    if n == 0:
+        return EigensolverResult(np.zeros(0), a)
+    ah = mops.hermitianize(a, uplo)
+    red = reduction_to_band(ah)
+    band = extract_band(red)
+    tri = band_to_tridiag(band, red.band)
+    lam, z = tridiag_solver(tri.d, tri.e, nb)
+    zb = bt_band_to_tridiag(tri, z)
+    zf = bt_reduction_to_band(red, zb)
+    vecs = Matrix.from_global(np.asarray(zf), a.block_size, grid=a.grid,
+                              source_rank=a.dist.source_rank)
+    return EigensolverResult(lam, vecs)
+
+
+def gen_eigensolver(uplo: str, a: Matrix, b: Matrix) -> EigensolverResult:
+    """Generalized problem ``A x = lambda B x`` with Hermitian ``a`` and
+    HPD ``b`` (reference ``eigensolver::genEigensolver``; local)."""
+    dlaf_assert(a.size == b.size, "gen_eigensolver: A/B size mismatch")
+    bf = cholesky(uplo, b)
+    astd = gen_to_std(uplo, a, bf)
+    res = eigensolver(uplo, astd)
+    # back-substitute eigenvectors (reference gen_eigensolver/impl.h:24-35):
+    # uplo=L: B = L L^H, standard vec y -> x = L^-H y
+    # uplo=U: B = U^H U,                x = U^-1 y
+    if uplo == "L":
+        vecs = triangular_solve("L", "L", "C", "N", 1.0, bf, res.eigenvectors)
+    else:
+        vecs = triangular_solve("L", "U", "N", "N", 1.0, bf, res.eigenvectors)
+    return EigensolverResult(res.eigenvalues, vecs)

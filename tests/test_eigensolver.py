@@ -1,0 +1,122 @@
+"""End-to-end eigensolver tests
+(reference: test/unit/eigensolver/test_eigensolver.cpp,
+test_gen_eigensolver.cpp): |A Q - Q Lambda| residuals, orthogonality,
+scipy cross-checks, both uplos, real + complex, odd sizes.
+"""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+from dlaf_tpu.algorithms.permutations import permute
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.eigensolver.back_transform import bt_band_to_tridiag, bt_reduction_to_band
+from dlaf_tpu.eigensolver.band_to_tridiag import band_to_tridiag_numpy
+from dlaf_tpu.eigensolver.eigensolver import eigensolver, gen_eigensolver
+from dlaf_tpu.eigensolver.reduction_to_band import extract_band, reduction_to_band
+from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+from dlaf_tpu.matrix.matrix import Matrix
+
+
+def herm(n, dtype, seed, pd=False):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    if pd:
+        return (x @ x.conj().T + n * np.eye(n)).astype(dtype)
+    return ((x + x.conj().T) / 2).astype(dtype)
+
+
+def M(a, nb):
+    return Matrix.from_global(a, TileElementSize(nb, nb))
+
+
+# -- back-transform building blocks ----------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,b", [(16, 4), (13, 3)])
+def test_bt_band_to_tridiag(n, b, dtype):
+    """Eigenvectors of the band matrix via chase + bt must diagonalize it."""
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    a = ((x + x.conj().T) / 2)
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) <= b
+    a = np.where(mask, a, 0).astype(dtype)
+    np.fill_diagonal(a, np.real(np.diag(a)))
+    band = np.zeros((b + 1, n), dtype=dtype)
+    for r in range(b + 1):
+        band[r, : n - r] = np.diagonal(a, -r)
+    tri = band_to_tridiag_numpy(band, b)
+    lam, z = tridiag_solver(tri.d, tri.e, b, use_device=False)
+    q = np.asarray(bt_band_to_tridiag(tri, z))
+    assert np.linalg.norm(a @ q - q * lam[None, :]) < 1e-10 * n
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_bt_reduction_to_band(dtype):
+    """Band eigenvectors lifted through the reduction must diagonalize A."""
+    n, nb = 16, 4
+    a = herm(n, dtype, 3)
+    red = reduction_to_band(M(a, nb))
+    band = extract_band(red)
+    tri = band_to_tridiag_numpy(band, nb)
+    lam, z = tridiag_solver(tri.d, tri.e, nb, use_device=False)
+    zb = bt_band_to_tridiag(tri, z)
+    q = np.asarray(bt_reduction_to_band(red, zb))
+    assert np.linalg.norm(a @ q - q * lam[None, :]) < 1e-10 * n
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
+
+
+# -- full pipeline ----------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("n,nb", [(16, 4), (24, 8), (13, 4), (4, 4), (33, 8)])
+def test_eigensolver(n, nb, uplo, dtype):
+    a = herm(n, dtype, n + nb)
+    res = eigensolver(uplo, M(a, nb))
+    lam, q = res.eigenvalues, res.eigenvectors.to_numpy()
+    afull = np.tril(a) + np.tril(a, -1).conj().T if uplo == "L" \
+        else np.triu(a) + np.triu(a, 1).conj().T
+    np.fill_diagonal(afull, np.real(np.diag(afull)))
+    eps = np.finfo(np.dtype(dtype).type(0).real.dtype).eps
+    tol = 100 * n * eps * max(np.abs(lam).max(initial=1.0), 1.0)
+    np.testing.assert_allclose(lam, np.linalg.eigvalsh(afull), atol=tol)
+    assert np.linalg.norm(afull @ q - q * lam[None, :]) < tol * 10
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 100 * n * eps
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_gen_eigensolver(uplo, dtype):
+    n, nb = 16, 4
+    a = herm(n, dtype, 11)
+    b = herm(n, dtype, 12, pd=True)
+    res = gen_eigensolver(uplo, M(a, nb), M(b, nb))
+    lam, q = res.eigenvalues, res.eigenvectors.to_numpy()
+    w = sla.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(lam, w, atol=1e-9)
+    # generalized residual |A q - lam B q|
+    resid = np.linalg.norm(a @ q - (b @ q) * lam[None, :])
+    assert resid < 1e-9 * n
+    # B-orthogonality
+    assert np.linalg.norm(q.conj().T @ b @ q - np.eye(n)) < 1e-10 * n
+
+
+def test_permutations():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((16, 16))
+    mat = M(a, 4)
+    perm = rng.permutation(8)
+    out = permute("Row", perm, mat, 1, 3).to_numpy()
+    expect = a.copy()
+    expect[4:12] = a[4:12][perm]
+    np.testing.assert_array_equal(out, expect)
+    out = permute("Col", perm, mat, 1, 3).to_numpy()
+    expect = a.copy()
+    expect[:, 4:12] = a[:, 4:12][:, perm]
+    np.testing.assert_array_equal(out, expect)
