@@ -1,0 +1,113 @@
+"""Eigensolver benchmark drivers (standard + generalized).
+
+TPU-native counterpart of the reference's ``miniapp/miniapp_eigensolver.cpp``
+(177 LoC) and ``miniapp_gen_eigensolver.cpp`` (190 LoC). Flop model: the
+canonical full Hermitian eigensolver cost ~(4/3 + 4/3 + 2) n^3 -> reported as
+the reference does via time + derived GFLOPS with the 4n^3/3 reduction term
+dominant; we report 10n^3/3 total (reduction + tridiag D&C + two back
+transforms), muls = adds. BASELINE config #5: gen_eigensolver d N=32768
+nb=512 8x8 (the eigensolver itself is local at this snapshot — grid options
+accepted for forward-compatibility).
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_eigensolver -m 4096 -b 256
+      python -m dlaf_tpu.miniapp.miniapp_eigensolver -m 4096 -b 256 --generalized
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from .. import config
+from ..common.index2d import GlobalElementSize, TileElementSize
+from ..eigensolver.eigensolver import eigensolver, gen_eigensolver
+from ..matrix.matrix import Matrix
+from ..types import total_ops, type_letter
+from .generators import hpd_element_fn
+from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--matrix-size", type=int, default=1024)
+    p.add_argument("-b", "--block-size", type=int, default=256)
+    p.add_argument("--uplo", choices=["L", "U"], default="L")
+    p.add_argument("--generalized", action="store_true",
+                   help="solve A x = lambda B x (miniapp_gen_eigensolver)")
+    add_miniapp_arguments(p)
+    return p
+
+
+def run(argv=None) -> list[dict]:
+    args, extra = build_parser().parse_known_args(argv)
+    config.initialize(argv=extra)
+    opts = parse_miniapp_options(args)
+    devices = select_devices(opts)
+
+    n, nb = args.matrix_size, args.block_size
+    size = GlobalElementSize(n, n)
+    block = TileElementSize(nb, nb)
+
+    def herm_fn(i, j):
+        return np.cos(0.001 * (i * 31 + j * 17)) + np.cos(0.001 * (j * 31 + i * 17))
+
+    am = Matrix.from_element_fn(herm_fn, size, block, dtype=opts.dtype)
+    bm = Matrix.from_element_fn(hpd_element_fn(n, opts.dtype), size, block,
+                                dtype=opts.dtype) if args.generalized else None
+
+    backend = devices[0].platform
+    results = []
+    for run_i in range(-opts.nwarmups, opts.nruns):
+        a_in = am.with_storage(am.storage + 0)
+        a_in.storage.block_until_ready()
+        t0 = time.perf_counter()
+        if args.generalized:
+            res = gen_eigensolver(args.uplo, a_in, bm)
+        else:
+            res = eigensolver(args.uplo, a_in)
+        res.eigenvectors.storage.block_until_ready()
+        t = time.perf_counter() - t0
+        gflops = total_ops(opts.dtype, 5 * n**3 / 3, 5 * n**3 / 3) / t / 1e9
+        if run_i < 0:
+            continue
+        name = "gen_evp" if args.generalized else "evp"
+        print(f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
+              f"{type_letter(opts.dtype)}{args.uplo} {name} ({n}, {n}) "
+              f"({nb}, {nb}) ({opts.grid_rows}, {opts.grid_cols}) "
+              f"{os.cpu_count()} {backend}", flush=True)
+        results.append({"run": run_i, "time_s": t, "gflops": gflops})
+        last = run_i == opts.nruns - 1
+        if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
+            check(args, am, bm, res)
+    return results
+
+
+def check(args, am, bm, res) -> None:
+    a = am.to_numpy()
+    afull = np.tril(a) + np.tril(a, -1).conj().T if args.uplo == "L" \
+        else np.triu(a) + np.triu(a, 1).conj().T
+    np.fill_diagonal(afull, np.real(np.diag(afull)))
+    q = res.eigenvectors.to_numpy()
+    lam = res.eigenvalues
+    n = a.shape[0]
+    if args.generalized:
+        b = bm.to_numpy()
+        resid = np.linalg.norm(afull @ q - (b @ q) * lam[None, :])
+        resid /= max(np.linalg.norm(afull), 1e-30)
+    else:
+        resid = np.linalg.norm(afull @ q - q * lam[None, :])
+        resid /= max(np.linalg.norm(afull), 1e-30)
+    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    tol = 200 * n * eps
+    status = "PASSED" if resid < tol else "FAILED"
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    if resid >= tol:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    run()
