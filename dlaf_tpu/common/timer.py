@@ -1,0 +1,67 @@
+"""Wall-clock timing and phase profiling.
+
+TPU-native counterpart of the reference's ``common::Timer``
+(``common/timer.h``) plus the green-field profiling hook SURVEY §5 calls for:
+the reference delegates profiling to pika's runtime; here phase timers can
+additionally emit XLA/PJRT execution profiles via ``jax.profiler`` when a
+trace directory is configured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+class Timer:
+    """Elapsed-seconds timer (reference ``common::Timer``)."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+
+class PhaseTimer:
+    """Named phase timings for multi-stage algorithms (eigensolver pipeline).
+
+    Use ``with phases.phase("reduction_to_band"): ...``; ``report()`` returns
+    {name: seconds}. When ``profile_dir`` is set, each phase is additionally
+    wrapped in a ``jax.profiler.TraceAnnotation`` so device timelines carry
+    the phase names.
+    """
+
+    def __init__(self, profile_dir: Optional[str] = None):
+        self.times: dict[str, float] = {}
+        self.profile_dir = profile_dir
+        self._tracing = False
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        ctx = contextlib.nullcontext()
+        if self.profile_dir is not None:
+            import jax
+
+            if not self._tracing:
+                jax.profiler.start_trace(self.profile_dir)
+                self._tracing = True
+            ctx = jax.profiler.TraceAnnotation(name)
+        t0 = time.perf_counter()
+        with ctx:
+            yield
+        self.times[name] = self.times.get(name, 0.0) + time.perf_counter() - t0
+
+    def stop(self) -> None:
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def report(self) -> dict[str, float]:
+        return dict(self.times)

@@ -1,0 +1,39 @@
+"""Device-memory placement helpers.
+
+TPU-native counterpart of the reference's ``memory/`` layer
+(``MemoryChunk``/``MemoryView`` over umpire host/device pools,
+``memory/memory_chunk.h:38-165``): PJRT owns allocation, pooling and
+pinning, so what remains is placement (host→HBM with a sharding), donation
+(the in-place story for functional updates), and wrapping user-provided
+buffers without copies where possible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+
+def place(array, sharding=None):
+    """Move a host array into device memory (reference: MemoryChunk alloc +
+    H2D); with a NamedSharding this is the distributed placement."""
+    if sharding is None:
+        return jax.device_put(array)
+    return jax.device_put(array, sharding)
+
+
+def donate_wrapper(fn):
+    """jit with first-argument donation: the functional-update analog of the
+    reference's in-place tile writes — XLA reuses the input buffer."""
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def wrap_host(array: np.ndarray) -> np.ndarray:
+    """Non-owning host wrap (reference MemoryChunk user-pointer ctor): numpy
+    views are already non-owning; returned as-is, documented for parity."""
+    return np.asarray(array)
+
+
+def nbytes(x) -> int:
+    return int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize

@@ -1,0 +1,40 @@
+"""Matrix dumps for debugging.
+
+TPU-native counterpart of the reference's ``matrix/print_numpy.h`` (112),
+``print_csv.h`` (73), ``print_gpu.h``: ``print(format, matrix)`` emitting a
+numpy-expression or CSV rendering of the (gathered) matrix.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+
+import numpy as np
+
+from .matrix import Matrix
+
+
+def print_numpy(mat: Matrix, name: str = "a", file=None) -> str:
+    """Emit ``name = np.array([...])`` (reference format::numpy)."""
+    a = mat.to_numpy()
+    buf = io.StringIO()
+    buf.write(f"{name} = np.array(")
+    buf.write(np.array2string(a, separator=", ", threshold=np.inf,
+                              floatmode="unique"))
+    buf.write(f", dtype=np.{a.dtype})\n")
+    s = buf.getvalue()
+    print(s, file=file or sys.stdout, end="")
+    return s
+
+
+def print_csv(mat: Matrix, file=None) -> str:
+    """Comma-separated rows (reference format::csv)."""
+    a = mat.to_numpy()
+    buf = io.StringIO()
+    for row in np.atleast_2d(a):
+        buf.write(",".join(repr(x) for x in row.tolist()))
+        buf.write("\n")
+    s = buf.getvalue()
+    print(s, file=file or sys.stdout, end="")
+    return s

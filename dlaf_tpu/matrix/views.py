@@ -1,0 +1,66 @@
+"""Sub-matrix / sub-panel views.
+
+TPU-native counterpart of the reference's ``SubMatrixView``/``SubPanelView``
+(``matrix/views.h:29-184``) and ``MatrixView`` (``matrix/matrix_view.h``):
+offset-limited views handing per-tile ``SubTileSpec``s to algorithms working
+on a sub-block (the reference uses them in reduction_to_band). The reference's
+MatrixView additionally manages concurrent scheduling epochs with
+``done()/doneWrite()`` handoff — with immutable values and jit-step
+boundaries there is no epoch state to hand off, so the view here is pure
+index bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..common.asserts import dlaf_assert
+from ..common.index2d import GlobalElementIndex, GlobalTileIndex, TileElementSize
+from ..types import SizeType
+from .distribution import Distribution
+
+
+@dataclasses.dataclass(frozen=True)
+class SubTileSpec:
+    """Origin + extent inside one tile (reference ``SubTileSpec``)."""
+
+    origin_row: SizeType
+    origin_col: SizeType
+    rows: SizeType
+    cols: SizeType
+
+
+@dataclasses.dataclass(frozen=True)
+class SubMatrixView:
+    """View of the sub-matrix starting at a global element offset
+    (reference ``matrix/views.h:85``)."""
+
+    dist: Distribution
+    offset: GlobalElementIndex
+
+    def __post_init__(self):
+        dlaf_assert(self.offset.row >= 0 and self.offset.col >= 0,
+                    f"bad offset {self.offset}")
+
+    @property
+    def begin_tile(self) -> GlobalTileIndex:
+        return self.dist.global_tile_index(self.offset)
+
+    def tile_spec(self, index: GlobalTileIndex) -> SubTileSpec:
+        """Portion of global tile ``index`` inside the view."""
+        ts = self.dist.tile_size_of(index)
+        first = self.begin_tile
+        orow = self.dist.tile_element_index(self.offset).row if index.row == first.row else 0
+        ocol = self.dist.tile_element_index(self.offset).col if index.col == first.col else 0
+        return SubTileSpec(orow, ocol, ts.row - orow, ts.col - ocol)
+
+
+@dataclasses.dataclass(frozen=True)
+class SubPanelView(SubMatrixView):
+    """Single-tile-wide view (reference ``matrix/views.h:129``)."""
+
+    width: SizeType = 0
+
+    def cols(self) -> SizeType:
+        return min(self.width or self.dist.block_size.col,
+                   self.dist.size.col - self.offset.col)

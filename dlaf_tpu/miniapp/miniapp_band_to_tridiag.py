@@ -1,0 +1,91 @@
+"""Band-to-tridiagonal benchmark driver.
+
+TPU-native counterpart of the reference's
+``miniapp/miniapp_band_to_tridiag.cpp`` (195 LoC): times the host bulge-chase
+stage (native C++ or numpy impl per ``--dlaf:band_to_tridiag_impl``). Flop
+model: ~6 n^2 b real ops for the chase (muls=adds=3 n^2 b).
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_band_to_tridiag -m 4096 -b 128
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+from .. import config
+from ..eigensolver.band_to_tridiag import band_to_tridiag
+from ..types import total_ops, type_letter
+from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--matrix-size", type=int, default=4096)
+    p.add_argument("-b", "--band-size", type=int, default=128)
+    add_miniapp_arguments(p)
+    return p
+
+
+def make_band(n, b, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    band = rng.standard_normal((b + 1, n))
+    if np.dtype(dtype).kind == "c":
+        band = band + 1j * rng.standard_normal((b + 1, n))
+        band[0] = np.real(band[0])
+    for r in range(1, b + 1):
+        band[r, n - r:] = 0
+    return band.astype(dtype)
+
+
+def run(argv=None) -> list[dict]:
+    args, extra = build_parser().parse_known_args(argv)
+    config.initialize(argv=extra)
+    opts = parse_miniapp_options(args)
+    n, b = args.matrix_size, args.band_size
+    band = make_band(n, b, opts.dtype)
+    results = []
+    for run_i in range(-opts.nwarmups, opts.nruns):
+        t0 = time.perf_counter()
+        res = band_to_tridiag(band, b)
+        t = time.perf_counter() - t0
+        gflops = total_ops(opts.dtype, 3.0 * n * n * b, 3.0 * n * n * b) / t / 1e9
+        if run_i < 0:
+            continue
+        print(f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
+              f"{type_letter(opts.dtype)} ({n}, {n}) band={b} "
+              f"({opts.grid_rows}, {opts.grid_cols}) {os.cpu_count()} host",
+              flush=True)
+        results.append({"run": run_i, "time_s": t, "gflops": gflops})
+        last = run_i == opts.nruns - 1
+        if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
+            check(band, b, res, n)
+    return results
+
+
+def check(band, b, res, n) -> None:
+    import scipy.linalg as sla
+
+    a = np.zeros((n, n), dtype=band.dtype)
+    for r in range(b + 1):
+        d = band[r, : n - r]
+        a += np.diag(d, -r)
+        if r:
+            a += np.diag(d.conj(), r)
+    w_ref = np.linalg.eigvalsh(a)
+    w_tri = sla.eigvalsh_tridiagonal(res.d, res.e)
+    resid = np.abs(w_ref - w_tri).max() / max(np.abs(w_ref).max(), 1e-30)
+    eps = np.finfo(np.float64).eps
+    tol = 100 * n * eps
+    status = "PASSED" if resid < tol else "FAILED"
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    if resid >= tol:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,76 @@
+"""Tile-kernel microbenchmark.
+
+TPU-native counterpart of the reference's kernel runner
+(``miniapp/kernel/miniapp_laset.cpp`` + ``kernel_runner.h``/``work_tiles.h``):
+times one tile op over a batch of work tiles. Supports the ops whose
+throughput matters for the algorithm mix: laset, lacpy, gemm, trsm, potrf.
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_kernel --kernel gemm -m 256 --batch 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import config
+from ..tile_ops import blas as tb
+from ..tile_ops import lapack as tl
+from ..types import total_ops, type_letter
+from .options import add_miniapp_arguments, parse_miniapp_options
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kernel", choices=["laset", "lacpy", "gemm", "trsm", "potrf"],
+                   default="laset")
+    p.add_argument("-m", "--tile-size", type=int, default=256)
+    p.add_argument("--batch", type=int, default=64)
+    add_miniapp_arguments(p)
+    return p
+
+
+def run(argv=None):
+    args, extra = build_parser().parse_known_args(argv)
+    config.initialize(argv=extra)
+    opts = parse_miniapp_options(args)
+    m, batch = args.tile_size, args.batch
+    dtype = opts.dtype
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((batch, m, m)).astype(dtype))
+    spd = jnp.asarray((rng.standard_normal((batch, m, m)) / m
+                       + 2 * np.eye(m)).astype(dtype))
+
+    kernels = {
+        "laset": (lambda: tl.laset("G", 1.0, 2.0, (batch, m, m), dtype), 0),
+        "lacpy": (lambda: tl.lacpy("L", a, jnp.zeros_like(a)), 0),
+        "gemm": (lambda: tb.gemm(a, a), batch * 2.0 * m**3 / 2),
+        "trsm": (lambda: tb.trsm("L", "L", "N", "N", spd, a), batch * m**3 / 2 / 2),
+        "potrf": (lambda: tl.potrf("L", spd), batch * m**3 / 6),
+    }
+    fn, half_flops = kernels[args.kernel]
+    jfn = jax.jit(fn)
+    results = []
+    for run_i in range(-opts.nwarmups, opts.nruns):
+        t0 = time.perf_counter()
+        out = jfn()
+        out.block_until_ready()
+        t = time.perf_counter() - t0
+        gflops = total_ops(dtype, half_flops, half_flops) / t / 1e9
+        if run_i < 0:
+            continue
+        print(f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s {args.kernel} "
+              f"{type_letter(dtype)} ({m}, {m}) x{batch} {os.cpu_count()} "
+              f"{jax.devices()[0].platform}", flush=True)
+        results.append({"run": run_i, "time_s": t, "gflops": gflops})
+    return results
+
+
+if __name__ == "__main__":
+    run()

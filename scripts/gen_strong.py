@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Strong-scaling campaign generator.
+
+TPU-native counterpart of the reference's ``scripts/gen_strong.py`` (+
+``miniapps.py``/``systems.py``): emits the command lines for a strong-scaling
+sweep (fixed problem size, growing device grid) of a chosen miniapp. On a
+single-host TPU slice the grid is over local devices; multi-host runs use the
+same commands under your launcher.
+
+Usage: python scripts/gen_strong.py --miniapp cholesky -m 32768 -b 512 \
+           --grids 1x1 2x2 4x4 8x8 > strong.sh
+"""
+
+import argparse
+
+MINIAPPS = {
+    "cholesky": "dlaf_tpu.miniapp.miniapp_cholesky",
+    "trsm": "dlaf_tpu.miniapp.miniapp_triangular_solver",
+    "gen_to_std": "dlaf_tpu.miniapp.miniapp_gen_to_std",
+    "reduction_to_band": "dlaf_tpu.miniapp.miniapp_reduction_to_band",
+    "eigensolver": "dlaf_tpu.miniapp.miniapp_eigensolver",
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--miniapp", choices=MINIAPPS, default="cholesky")
+    p.add_argument("-m", type=int, default=32768)
+    p.add_argument("-b", type=int, default=512)
+    p.add_argument("--grids", nargs="+", default=["1x1", "2x2", "4x4", "8x8"])
+    p.add_argument("--nruns", type=int, default=5)
+    p.add_argument("--nwarmups", type=int, default=1)
+    p.add_argument("--type", default="d")
+    args = p.parse_args()
+    mod = MINIAPPS[args.miniapp]
+    print("#!/bin/sh")
+    print(f"# strong scaling: {args.miniapp} N={args.m} nb={args.b}")
+    for g in args.grids:
+        r, c = g.split("x")
+        print(f"python -m {mod} -m {args.m} -b {args.b} --grid-rows {r} "
+              f"--grid-cols {c} --nruns {args.nruns} --nwarmups {args.nwarmups} "
+              f"--type {args.type}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Weak-scaling campaign generator.
+
+TPU-native counterpart of the reference's ``scripts/gen_weak.py``: fixed
+work per device — N grows with sqrt(devices) so the per-device tile count is
+constant.
+
+Usage: python scripts/gen_weak.py --miniapp cholesky --m-per-device 8192 \
+           -b 512 --grids 1x1 2x2 4x4 > weak.sh
+"""
+
+import argparse
+import math
+
+from gen_strong import MINIAPPS
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--miniapp", choices=MINIAPPS, default="cholesky")
+    p.add_argument("--m-per-device", type=int, default=8192)
+    p.add_argument("-b", type=int, default=512)
+    p.add_argument("--grids", nargs="+", default=["1x1", "2x2", "4x4"])
+    p.add_argument("--nruns", type=int, default=5)
+    p.add_argument("--type", default="d")
+    args = p.parse_args()
+    mod = MINIAPPS[args.miniapp]
+    print("#!/bin/sh")
+    print(f"# weak scaling: {args.miniapp} m/device={args.m_per_device}")
+    for g in args.grids:
+        r, c = (int(x) for x in g.split("x"))
+        n = int(args.m_per_device * math.sqrt(r * c))
+        n = (n // args.b) * args.b or args.b
+        print(f"python -m {mod} -m {n} -b {args.b} --grid-rows {r} "
+              f"--grid-cols {c} --nruns {args.nruns} --type {args.type}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Parse miniapp output lines and plot/tabulate scaling results.
+
+TPU-native counterpart of the reference's ``scripts/plot_*.py``: consumes the
+schema-stable ``[i] <t>s <gflops>GFlop/s ...`` lines from one or more run
+logs and prints a per-configuration summary (median time, best GFLOP/s);
+``--plot out.png`` additionally renders a matplotlib scaling curve when
+matplotlib is available.
+"""
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+LINE = re.compile(
+    r"\[(\d+)\]\s+([0-9.eE+-]+)s\s+([0-9.eE+-]+)GFlop/s\s+(\S+)\s+\(([\d, ]+)\)"
+    r"\s+\(([\d, ]+)\)\s+\(([\d, ]+)\)")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logs", nargs="+", help="miniapp output files ('-' = stdin)")
+    p.add_argument("--plot", default=None, help="write a PNG scaling plot")
+    args = p.parse_args()
+    groups = defaultdict(list)
+    for path in args.logs:
+        fh = sys.stdin if path == "-" else open(path)
+        for line in fh:
+            m = LINE.search(line)
+            if not m:
+                continue
+            _, t, gf, kind, size, block, grid = m.groups()
+            key = (kind, size.replace(" ", ""), block.replace(" ", ""),
+                   grid.replace(" ", ""))
+            groups[key].append((float(t), float(gf)))
+    rows = []
+    for key in sorted(groups):
+        runs = groups[key]
+        ts = sorted(t for t, _ in runs)
+        med = ts[len(ts) // 2]
+        best = max(g for _, g in runs)
+        ndev = 1
+        gr = key[3].strip("()").split(",")
+        if len(gr) == 2:
+            ndev = int(gr[0]) * int(gr[1])
+        rows.append((key, med, best, ndev))
+        print(f"{key[0]:>6} size={key[1]:>14} nb={key[2]:>10} grid={key[3]:>8} "
+              f"runs={len(runs):>3} median={med:.4f}s best={best:.1f}GF/s "
+              f"({best / ndev:.1f}/dev)")
+    if args.plot and rows:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            xs = [r[3] for r in rows]
+            ys = [r[2] for r in rows]
+            plt.plot(xs, ys, "o-")
+            plt.xlabel("devices")
+            plt.ylabel("GFlop/s")
+            plt.xscale("log", base=2)
+            plt.yscale("log", base=2)
+            plt.grid(True, which="both", alpha=0.3)
+            plt.savefig(args.plot, dpi=120, bbox_inches="tight")
+            print(f"wrote {args.plot}")
+        except ImportError:
+            print("matplotlib unavailable; table only", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

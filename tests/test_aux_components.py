@@ -1,0 +1,104 @@
+"""Tests for auxiliary components: timer, views, printing, memory helpers,
+tpu_info, kernel/band miniapps, scaling scripts."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dlaf_tpu.common.index2d import GlobalElementIndex, GlobalElementSize, \
+    GlobalTileIndex, TileElementSize
+from dlaf_tpu.common.timer import PhaseTimer, Timer
+from dlaf_tpu.matrix import printing
+from dlaf_tpu.matrix.distribution import Distribution
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.matrix.views import SubMatrixView, SubTileSpec
+
+
+def test_timer():
+    t = Timer()
+    assert t.elapsed() >= 0
+    pt = PhaseTimer()
+    with pt.phase("a"):
+        pass
+    with pt.phase("a"):
+        pass
+    assert "a" in pt.report() and pt.report()["a"] >= 0
+
+
+def test_submatrix_view():
+    d = Distribution(GlobalElementSize(16, 16), TileElementSize(4, 4))
+    v = SubMatrixView(d, GlobalElementIndex(5, 2))
+    assert v.begin_tile == GlobalTileIndex(1, 0)
+    spec = v.tile_spec(GlobalTileIndex(1, 0))
+    assert spec == SubTileSpec(1, 2, 3, 2)
+    spec2 = v.tile_spec(GlobalTileIndex(2, 1))
+    assert spec2 == SubTileSpec(0, 0, 4, 4)
+
+
+def test_printing(capsys):
+    a = np.arange(4.0).reshape(2, 2)
+    mat = Matrix.from_global(a, TileElementSize(2, 2))
+    s = printing.print_numpy(mat, name="m")
+    assert s.startswith("m = np.array(") and "dtype=np.float64" in s
+    ns = {"np": np}
+    exec(s, ns)
+    np.testing.assert_array_equal(ns["m"], a)
+    c = printing.print_csv(mat)
+    assert c.splitlines()[0] == "0.0,1.0"
+
+
+def test_memory_helpers():
+    from dlaf_tpu.matrix import memory as mem
+
+    x = mem.place(np.ones((4, 4)))
+    assert x.shape == (4, 4)
+    assert mem.nbytes(x) == 16 * 8
+
+    fn = mem.donate_wrapper(lambda a: a * 2)
+    out = fn(x)
+    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones((4, 4)))
+
+
+def test_tpu_info():
+    from dlaf_tpu import tpu_info
+
+    devs = tpu_info.devices()
+    assert len(devs) == 8
+    assert all(d.platform == "cpu" for d in devs)
+
+
+def test_miniapp_kernel_and_band():
+    from dlaf_tpu.miniapp.miniapp_kernel import run as krun
+
+    res = krun(["--kernel", "gemm", "-m", "32", "--batch", "4", "--nruns", "1"])
+    assert len(res) == 1 and res[0]["gflops"] > 0
+
+    from dlaf_tpu.miniapp.miniapp_band_to_tridiag import run as brun
+
+    res = brun(["-m", "64", "-b", "8", "--nruns", "1", "--check-result", "last"])
+    assert len(res) == 1
+
+
+def test_scaling_scripts():
+    out = subprocess.run(
+        [sys.executable, "scripts/gen_strong.py", "--miniapp", "cholesky",
+         "-m", "1024", "-b", "128", "--grids", "1x1", "2x2"],
+        capture_output=True, text=True, check=True, cwd="/root/repo").stdout
+    assert out.count("miniapp_cholesky") == 2 and "--grid-rows 2" in out
+    out = subprocess.run(
+        [sys.executable, "scripts/gen_weak.py", "--m-per-device", "512",
+         "-b", "128", "--grids", "1x1", "2x2"],
+        capture_output=True, text=True, check=True, cwd="/root/repo").stdout
+    assert "-m 512" in out and "-m 1024" in out
+
+
+def test_plot_bench_parses(tmp_path):
+    log = tmp_path / "run.log"
+    log.write_text("[0] 1.5s 100.0GFlop/s dL (4096, 4096) (256, 256) (2, 2) 8 tpu\n"
+                   "[1] 1.0s 150.0GFlop/s dL (4096, 4096) (256, 256) (2, 2) 8 tpu\n")
+    out = subprocess.run(
+        [sys.executable, "scripts/plot_bench.py", str(log)],
+        capture_output=True, text=True, check=True, cwd="/root/repo").stdout
+    assert "best=150.0GF/s" in out and "median=1.5" in out.replace("median=1.5000", "median=1.5")
