@@ -44,6 +44,7 @@ from ..matrix.matrix import Matrix
 from ..matrix.tiling import storage_tile_grid, tiles_to_global, global_to_tiles
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
+from ..tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
 from ..types import ceil_div
 
 
@@ -110,6 +111,8 @@ def _build_dist_cholesky(dist, mesh, dtype):
     Pr, Qc = dist.grid_size.row, dist.grid_size.col
     sr, sc = dist.source_rank.row, dist.source_rank.col
     _, _, ltr, ltc = storage_tile_grid(dist)
+    platform = next(iter(mesh.devices.flat)).platform
+    use_pallas = supports_pallas_update(dtype, platform)
 
     def local_rows_global(lu, rr, count):
         """Global tile rows of local row slots lu..lu+count-1 (traced rr)."""
@@ -181,18 +184,25 @@ def _build_dist_cholesky(dist, mesh, dtype):
 
         # -- trailing update (reference impl.h:242-271) ---------------------
         # A[i,j] -= L[i,k] L[j,k]^H for trailing lower-triangle tiles
-        upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
-                         preferred_element_type=vr.dtype)
         pair = row_valid[:, None] & col_valid[None, :]
         # strictly-lower tiles: full update; diagonal tiles: lower triangle
         # only (the matrix's upper triangle passes through untouched, like
         # the reference's herk vs gemm split)
         below = pair & (g_rows[:, None] > g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-        tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
-        mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
-        upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
-        lt = lt.at[lu_r:, lu_c:].add(-upd)
+        if use_pallas:
+            # predicated Pallas kernel: masked-out tile pairs skip the MXU
+            # work entirely (exact flops instead of rectangle-then-mask)
+            mode = below.astype(jnp.int32) + 2 * ondiag.astype(jnp.int32)
+            new_block = masked_trailing_update(lt[lu_r:, lu_c:], vr, vc, mode)
+            lt = lt.at[lu_r:, lu_c:].set(new_block)
+        else:
+            upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
+                             preferred_element_type=vr.dtype)
+            tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+            mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
+            upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
+            lt = lt.at[lu_r:, lu_c:].add(-upd)
         return lt
 
     def factorize(lt):

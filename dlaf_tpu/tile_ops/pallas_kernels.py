@@ -1,0 +1,79 @@
+"""Pallas TPU kernels for the hot tile ops.
+
+The reference needed custom CUDA kernels where vendor libraries fell short
+(SURVEY §2/L5). On TPU most of those collapse into trivial XLA ops; the one
+place a custom kernel genuinely pays is the Cholesky trailing update in SPMD
+form: the batched einsum over local tile pairs computes the FULL (rows x
+cols) rectangle and then masks, spending ~2x the required MXU flops (only
+trailing lower-triangle tile pairs matter). This kernel predicates per tile
+pair with ``@pl.when``, so masked-out pairs skip the matmul entirely —
+exact-flop trailing updates with the masking fused into the epilogue.
+
+``mode`` per tile pair: 0 = untouched, 1 = full update, 2 = update only the
+within-tile lower triangle (diagonal tiles).
+
+Supported dtypes: float32 / bfloat16 (MXU-native). float64 and complex fall
+back to the einsum path at the call site (TPU f64 is emulated anyway; complex
+matmul is not a single MXU op).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _update_kernel(mode_ref, vr_ref, vc_ref, a_ref, out_ref):
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+    mode = mode_ref[r, c]
+
+    @pl.when(mode == 0)
+    def _():
+        out_ref[...] = a_ref[...]
+
+    @pl.when(mode > 0)
+    def _():
+        acc = jax.lax.dot_general(
+            vr_ref[0], vc_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        upd = a_ref[0].astype(jnp.float32) - acc
+        nb = upd.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+        tri = rows >= cols
+        keep_full = mode == 1
+        sel = jnp.where(keep_full | tri, upd, a_ref[0].astype(jnp.float32))
+        out_ref[0] = sel.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_trailing_update(a, vr, vc, mode, *, interpret: bool = False):
+    """``a[r,c] -= vr[r] @ vc[c]^T`` where ``mode[r,c]`` directs the update
+    (0 skip / 1 full / 2 tile lower triangle). Shapes: a (R, C, nb, nb),
+    vr (R, nb, nb), vc (C, nb, nb), mode (R, C) int32."""
+    R, C, nb, _ = a.shape
+    return pl.pallas_call(
+        _update_kernel,
+        grid=(R, C),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                 # mode
+            pl.BlockSpec((1, nb, nb), lambda r, c: (r, 0, 0)),     # vr
+            pl.BlockSpec((1, nb, nb), lambda r, c: (c, 0, 0)),     # vc
+            pl.BlockSpec((1, 1, nb, nb), lambda r, c: (r, c, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, nb, nb), lambda r, c: (r, c, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        interpret=interpret,
+    )(mode, vr, vc, a)
+
+
+def supports_pallas_update(dtype, platform: str) -> bool:
+    """Gate: MXU-native real dtypes on real TPU hardware."""
+    return platform == "tpu" and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                                      jnp.dtype(jnp.bfloat16))

@@ -1,0 +1,39 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dlaf_tpu.tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
+
+
+@pytest.mark.parametrize("R,C,nb", [(3, 2, 16), (2, 2, 8), (1, 1, 8)])
+def test_masked_trailing_update(R, C, nb):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((R, C, nb, nb)).astype(np.float32)
+    vr = rng.standard_normal((R, nb, nb)).astype(np.float32)
+    vc = rng.standard_normal((C, nb, nb)).astype(np.float32)
+    mode = rng.integers(0, 3, size=(R, C)).astype(np.int32)
+    out = np.asarray(masked_trailing_update(
+        jnp.asarray(a), jnp.asarray(vr), jnp.asarray(vc), jnp.asarray(mode),
+        interpret=True))
+    tri = np.tril(np.ones((nb, nb), dtype=bool))
+    for r in range(R):
+        for c in range(C):
+            full = a[r, c] - vr[r] @ vc[c].T
+            if mode[r, c] == 0:
+                expect = a[r, c]
+            elif mode[r, c] == 1:
+                expect = full
+            else:
+                expect = np.where(tri, full, a[r, c])
+            np.testing.assert_allclose(out[r, c], expect, rtol=2e-5, atol=2e-5)
+
+
+def test_gate():
+    assert supports_pallas_update(jnp.float32, "tpu")
+    assert supports_pallas_update(jnp.bfloat16, "tpu")
+    assert not supports_pallas_update(jnp.float64, "tpu")
+    assert not supports_pallas_update(jnp.float32, "cpu")
+    assert not supports_pallas_update(jnp.complex64, "tpu")
