@@ -46,11 +46,9 @@ def probe_devices() -> bool:
 
 
 def cpu_env() -> dict:
-    env = dict(os.environ)
-    # prevent accelerator-plugin registration entirely (sitecustomize gates
-    # on PALLAS_AXON_POOL_IPS) and select the CPU platform
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    from dlaf_tpu.tpu_info import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
     env["DLAF_BENCH_CHILD"] = "1"
     return env
 

@@ -98,7 +98,7 @@ def _cholesky_local(a, *, uplo: str, nb: int):
 # Distributed — reference impl.h:174-276
 # ---------------------------------------------------------------------------
 
-def _build_dist_cholesky(dist, mesh, dtype):
+def _build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret):
     """Build the shard_map'd factorization program for one (dist, mesh).
 
     The returned function maps tile storage -> tile storage. All index
@@ -111,8 +111,6 @@ def _build_dist_cholesky(dist, mesh, dtype):
     Pr, Qc = dist.grid_size.row, dist.grid_size.col
     sr, sc = dist.source_rank.row, dist.source_rank.col
     _, _, ltr, ltc = storage_tile_grid(dist)
-    platform = next(iter(mesh.devices.flat)).platform
-    use_pallas = supports_pallas_update(dtype, platform)
 
     def local_rows_global(lu, rr, count):
         """Global tile rows of local row slots lu..lu+count-1 (traced rr)."""
@@ -194,7 +192,8 @@ def _build_dist_cholesky(dist, mesh, dtype):
             # predicated Pallas kernel: masked-out tile pairs skip the MXU
             # work entirely (exact flops instead of rectangle-then-mask)
             mode = below.astype(jnp.int32) + 2 * ondiag.astype(jnp.int32)
-            new_block = masked_trailing_update(lt[lu_r:, lu_c:], vr, vc, mode)
+            new_block = masked_trailing_update(lt[lu_r:, lu_c:], vr, vc, mode,
+                                               interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
         else:
             upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
@@ -215,8 +214,10 @@ def _build_dist_cholesky(dist, mesh, dtype):
 
 
 @functools.lru_cache(maxsize=64)
-def _dist_cholesky_cached(dist, mesh, dtype):
-    return jax.jit(_build_dist_cholesky(dist, mesh, dtype))
+def _dist_cholesky_cached(dist, mesh, dtype, use_pallas, pallas_interpret):
+    # dtype stays in the cache key: storage dtype changes retrace the jit
+    # anyway, but distinct keys keep program caches per element type
+    return jax.jit(_build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret))
 
 
 # ---------------------------------------------------------------------------
@@ -241,5 +242,8 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     if uplo != "L":
         raise NotImplementedError("distributed cholesky: uplo='U' lands with "
                                   "the transposed-storage path")
-    fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, np.dtype(mat.dtype).name)
+    platform = next(iter(mat.grid.mesh.devices.flat)).platform
+    fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, np.dtype(mat.dtype).name,
+                               supports_pallas_update(mat.dtype, platform),
+                               platform != "tpu")
     return mat.with_storage(fn(mat.storage))

@@ -65,11 +65,30 @@ def parse_miniapp_options(args: argparse.Namespace) -> MiniappOptions:
 def select_devices(opts: MiniappOptions):
     """Device list for the requested backend; uses the virtual-device trick
     when the host must emulate a grid (tests / CPU runs)."""
+    import os
+
     import jax
 
+    # An accelerator plugin's register() may force-set jax_platforms at
+    # interpreter start, silently overriding the JAX_PLATFORMS env var; the
+    # config-level update wins (as long as no backend is initialized yet), so
+    # re-assert the user's env choice here.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms and opts.backend == "default":
+        # only the 'default' backend defers to the env; an explicit
+        # --backend mc/tpu wins over an inherited JAX_PLATFORMS
+        jax.config.update("jax_platforms", env_platforms)
     if opts.backend == "mc":
         jax.config.update("jax_platforms", "cpu")
+    elif opts.backend == "tpu" and env_platforms:
+        # defeat a leaked JAX_PLATFORMS=cpu: None = automatic discovery,
+        # which prefers the registered accelerator plugin (whatever its
+        # platform name) over CPU
+        jax.config.update("jax_platforms", None)
     devs = jax.devices()
+    if opts.backend == "tpu" and devs[0].platform == "cpu":
+        raise SystemExit("--backend tpu requested but only CPU devices are "
+                         "visible")
     need = opts.grid_rows * opts.grid_cols
     if len(devs) < need:
         raise SystemExit(

@@ -74,6 +74,16 @@ def masked_trailing_update(a, vr, vc, mode, *, interpret: bool = False):
 
 
 def supports_pallas_update(dtype, platform: str) -> bool:
-    """Gate: MXU-native real dtypes on real TPU hardware."""
-    return platform == "tpu" and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
-                                                      jnp.dtype(jnp.bfloat16))
+    """Gate: MXU-native real dtypes on real TPU hardware.
+
+    ``DLAF_FORCE_PALLAS_UPDATE=1`` drops the platform requirement so tests can
+    exercise the Pallas integration path off-TPU (the call site then runs the
+    kernel in interpret mode).
+    """
+    import os
+
+    dtype_ok = jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                    jnp.dtype(jnp.bfloat16))
+    if os.environ.get("DLAF_FORCE_PALLAS_UPDATE") == "1":
+        return dtype_ok
+    return platform == "tpu" and dtype_ok

@@ -52,6 +52,33 @@ def default_device() -> Device:
     return Device.CPU if jax.devices()[0].platform == "cpu" else Device.TPU
 
 
+def cpu_subprocess_env(n_virtual_devices: Optional[int] = None) -> dict:
+    """Environment for a subprocess that must come up on the pure-CPU
+    platform (accelerator plugin registration disabled), optionally with an
+    n-device virtual CPU platform.
+
+    Needed because a TPU plugin's register() force-sets ``jax_platforms`` at
+    interpreter start, overriding the ``JAX_PLATFORMS`` env var; gating the
+    plugin out of the child entirely is the only env-only way to force CPU.
+    """
+    import os
+    import re
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # sitecustomize gates plugin on this
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_virtual_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={n_virtual_devices}"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                           flag, flags)
+        else:
+            flags = (flags + " " + flag).strip()
+        env["XLA_FLAGS"] = flags
+    return env
+
+
 def memory_in_use(device_index: int = 0) -> Optional[int]:
     """Live HBM bytes on a device (PJRT allocator stats), if reported."""
     import jax
