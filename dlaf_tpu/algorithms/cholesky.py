@@ -98,12 +98,18 @@ def _cholesky_local(a, *, uplo: str, nb: int):
 # Distributed — reference impl.h:174-276
 # ---------------------------------------------------------------------------
 
-def _build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret):
-    """Build the shard_map'd factorization program for one (dist, mesh).
+def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
+    """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     The returned function maps tile storage -> tile storage. All index
     arithmetic below is trace-time (static per k); only data and the
     rank-dependent validity masks are traced values.
+
+    uplo='U' is the mirrored sweep (reference ``call_U``): the panel is the
+    block *row* ``k`` (``trsm('L','U','C','N')`` per tile), broadcast along
+    the column axis, all-gathered along the row axis to index the transposed
+    panel by local trailing rows, and the trailing update
+    ``A[i,j] -= U[k,i]^H U[k,j]`` touches the upper-triangle tile pairs.
     """
     nt = dist.nr_tiles.row
     mb = dist.block_size.row
@@ -137,13 +143,15 @@ def _build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret):
             pad = (jnp.arange(mb) >= ts)
             diag = jnp.where(pad[:, None] | pad[None, :], 0, diag) \
                 + jnp.diag(pad.astype(diag.dtype))
-        lkk = tl.potrf("L", diag)  # redundant tiny compute on every rank
+        lkk = tl.potrf(uplo, diag)  # redundant tiny compute on every rank
 
         # owner writes the factored diagonal back
         upd_tile = jnp.where(is_owner_r & is_owner_c, lkk, lt[kr, kc])
         lt = lt.at[kr, kc].set(upd_tile)
         if k == nt - 1:
             return lt
+        if uplo == "U":
+            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk)
 
         # -- panel trsm on owner column (reference impl.h:222-231) ----------
         # uniform local row start: every rank's rows >= k+1 live at slots
@@ -204,6 +212,62 @@ def _build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret):
             lt = lt.at[lu_r:, lu_c:].add(-upd)
         return lt
 
+    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk):
+        """Mirrored sweep for uplo='U' (reference ``call_U``): panel is the
+        block row k, trailing update hits upper-triangle tile pairs."""
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+
+        # -- panel trsm on owner row: A[k, j] <- Ukk^-H A[k, j] -------------
+        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
+        ncols = ltc - lu_c
+        if ncols == 0:
+            return lt
+        g_cols = local_cols_global(lu_c, rc, ncols)
+        col_valid = (g_cols > k) & (g_cols < nt)
+        pan = tb.trsm("L", "U", "C", "N",
+                      jnp.broadcast_to(ukk, (ncols,) + ukk.shape), lt[kr, lu_c:])
+        pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
+        keep = (is_owner_r & col_valid)[:, None, None]
+        lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
+
+        # -- panel broadcast: col-wise down the mesh, then all_gather along
+        # the column axis to index the transposed panel by local rows -------
+        vc = cc.bcast(pan, ROW_AXIS, owner_r)
+        full_pan = cc.all_gather(vc, COL_AXIS)          # (Qc, ncols, mb, mb)
+        full_pan = full_pan.reshape(Qc * ncols, mb, mb)
+        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
+        nrows = ltr - lu_r
+        if nrows == 0:
+            return lt
+        g_rows = local_rows_global(lu_r, rr, nrows)
+        row_valid = (g_rows > k) & (g_rows < nt)
+        pj = (sc + g_rows) % Qc                          # owning grid col
+        lj = g_rows // Qc                                # its local col slot
+        flat = pj * ncols + jnp.clip(lj - lu_c, 0, ncols - 1)
+        vr = full_pan[flat]                              # (nrows, mb, mb)
+        vr = jnp.where(row_valid[:, None, None], vr, jnp.zeros_like(vr))
+
+        # -- trailing update: A[i,j] -= U[k,i]^H U[k,j], upper triangle -----
+        pair = row_valid[:, None] & col_valid[None, :]
+        above = pair & (g_rows[:, None] < g_cols[None, :])
+        ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        if use_pallas:
+            # transposed tiles keep the kernel's vr @ vc^T contraction;
+            # mode 3 = within-tile upper triangle on diagonal tiles
+            mode = above.astype(jnp.int32) + 3 * ondiag.astype(jnp.int32)
+            new_block = masked_trailing_update(
+                lt[lu_r:, lu_c:], jnp.swapaxes(vr, -1, -2),
+                jnp.swapaxes(vc, -1, -2), mode, interpret=pallas_interpret)
+            lt = lt.at[lu_r:, lu_c:].set(new_block)
+        else:
+            upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vr), vc,
+                             preferred_element_type=vr.dtype)
+            triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+            mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
+            upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
+            lt = lt.at[lu_r:, lu_c:].add(-upd)
+        return lt
+
     def factorize(lt):
         for k in range(nt):
             lt = step(lt, k)
@@ -214,10 +278,11 @@ def _build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret):
 
 
 @functools.lru_cache(maxsize=64)
-def _dist_cholesky_cached(dist, mesh, dtype, use_pallas, pallas_interpret):
+def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas, pallas_interpret):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
-    return jax.jit(_build_dist_cholesky(dist, mesh, use_pallas, pallas_interpret))
+    return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
+                                        pallas_interpret))
 
 
 # ---------------------------------------------------------------------------
@@ -239,11 +304,8 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
         a = tiles_to_global(mat.storage, mat.dist)
         out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row)
         return mat.with_storage(global_to_tiles(out, mat.dist))
-    if uplo != "L":
-        raise NotImplementedError("distributed cholesky: uplo='U' lands with "
-                                  "the transposed-storage path")
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, np.dtype(mat.dtype).name,
-                               supports_pallas_update(mat.dtype, platform),
+                               uplo, supports_pallas_update(mat.dtype, platform),
                                platform != "tpu")
     return mat.with_storage(fn(mat.storage))

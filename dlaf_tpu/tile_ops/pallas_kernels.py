@@ -10,7 +10,9 @@ pair with ``@pl.when``, so masked-out pairs skip the matmul entirely —
 exact-flop trailing updates with the masking fused into the epilogue.
 
 ``mode`` per tile pair: 0 = untouched, 1 = full update, 2 = update only the
-within-tile lower triangle (diagonal tiles).
+within-tile lower triangle (diagonal tiles of the uplo='L' sweep), 3 = only
+the within-tile upper triangle (diagonal tiles of the uplo='U' sweep; the
+caller passes transposed panel tiles so the contraction stays vr @ vc^T).
 
 Supported dtypes: float32 / bfloat16 (MXU-native). float64 and complex fall
 back to the einsum path at the call site (TPU f64 is emulated anyway; complex
@@ -46,7 +48,7 @@ def _update_kernel(mode_ref, vr_ref, vc_ref, a_ref, out_ref):
         nb = upd.shape[-1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
-        tri = rows >= cols
+        tri = jnp.where(mode == 3, rows <= cols, rows >= cols)
         keep_full = mode == 1
         sel = jnp.where(keep_full | tri, upd, a_ref[0].astype(jnp.float32))
         out_ref[0] = sel.astype(out_ref.dtype)
@@ -55,7 +57,8 @@ def _update_kernel(mode_ref, vr_ref, vc_ref, a_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def masked_trailing_update(a, vr, vc, mode, *, interpret: bool = False):
     """``a[r,c] -= vr[r] @ vc[c]^T`` where ``mode[r,c]`` directs the update
-    (0 skip / 1 full / 2 tile lower triangle). Shapes: a (R, C, nb, nb),
+    (0 skip / 1 full / 2 tile lower triangle / 3 tile upper triangle).
+    Shapes: a (R, C, nb, nb),
     vr (R, nb, nb), vc (C, nb, nb), mode (R, C) int32."""
     R, C, nb, _ = a.shape
     return pl.pallas_call(

@@ -67,22 +67,24 @@ GRIDS = [(1, 1, 0, 0), (2, 2, 0, 0), (2, 4, 1, 2), (4, 2, 3, 1), (1, 8, 0, 5),
          (8, 1, 2, 0)]
 
 
+@pytest.mark.parametrize("uplo", ["L", "U"])
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
 @pytest.mark.parametrize("rows,cols,sr,sc", GRIDS)
 @pytest.mark.parametrize("n,nb", [(16, 4), (13, 4), (29, 8), (8, 8), (3, 4)])
-def test_cholesky_distributed(rows, cols, sr, sc, n, nb, dtype, devices8):
+def test_cholesky_distributed(uplo, rows, cols, sr, sc, n, nb, dtype, devices8):
     grid = Grid(rows, cols)
     a = hpd_matrix(n, dtype, seed=n + rows)
     mat = Matrix_from(a, nb, grid=grid, src=RankIndex2D(sr % rows, sc % cols))
-    out = cholesky("L", mat).to_numpy()
-    check_factor("L", a, out, dtype)
+    out = cholesky(uplo, mat).to_numpy()
+    check_factor(uplo, a, out, dtype)
 
 
-def test_cholesky_distributed_matches_local(devices8):
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_cholesky_distributed_matches_local(uplo, devices8):
     n, nb = 24, 4
     a = hpd_matrix(n, np.float64, seed=9)
-    local = cholesky("L", Matrix_from(a, nb)).to_numpy()
-    dist = cholesky("L", Matrix_from(a, nb, grid=Grid(2, 4))).to_numpy()
+    local = cholesky(uplo, Matrix_from(a, nb)).to_numpy()
+    dist = cholesky(uplo, Matrix_from(a, nb, grid=Grid(2, 4))).to_numpy()
     np.testing.assert_allclose(dist, local, rtol=1e-12, atol=1e-12)
 
 

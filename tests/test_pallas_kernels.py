@@ -14,11 +14,12 @@ def test_masked_trailing_update(R, C, nb):
     a = rng.standard_normal((R, C, nb, nb)).astype(np.float32)
     vr = rng.standard_normal((R, nb, nb)).astype(np.float32)
     vc = rng.standard_normal((C, nb, nb)).astype(np.float32)
-    mode = rng.integers(0, 3, size=(R, C)).astype(np.int32)
+    mode = rng.integers(0, 4, size=(R, C)).astype(np.int32)
     out = np.asarray(masked_trailing_update(
         jnp.asarray(a), jnp.asarray(vr), jnp.asarray(vc), jnp.asarray(mode),
         interpret=True))
-    tri = np.tril(np.ones((nb, nb), dtype=bool))
+    tril = np.tril(np.ones((nb, nb), dtype=bool))
+    triu = np.triu(np.ones((nb, nb), dtype=bool))
     for r in range(R):
         for c in range(C):
             full = a[r, c] - vr[r] @ vc[c].T
@@ -26,8 +27,10 @@ def test_masked_trailing_update(R, C, nb):
                 expect = a[r, c]
             elif mode[r, c] == 1:
                 expect = full
+            elif mode[r, c] == 2:
+                expect = np.where(tril, full, a[r, c])
             else:
-                expect = np.where(tri, full, a[r, c])
+                expect = np.where(triu, full, a[r, c])
             np.testing.assert_allclose(out[r, c], expect, rtol=2e-5, atol=2e-5)
 
 
@@ -72,7 +75,8 @@ def test_masked_trailing_update_dtypes(R, C, nb, dtype, rtol):
                                               np.asarray(a[r, c]))
 
 
-def test_distributed_cholesky_pallas_branch(monkeypatch, devices8):
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_distributed_cholesky_pallas_branch(monkeypatch, devices8, uplo):
     """Force the Pallas integration branch of the distributed trailing
     update (mode construction + .set() wiring) off-TPU via
     DLAF_FORCE_PALLAS_UPDATE; kernel runs in interpret mode on CPU."""
@@ -88,8 +92,14 @@ def test_distributed_cholesky_pallas_branch(monkeypatch, devices8):
     x = rng.standard_normal((n, n))
     a = (x @ x.T + n * np.eye(n)).astype(np.float32)
     mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
-    out = cholesky("L", mat).to_numpy()
-    f = np.tril(out)
-    resid = np.linalg.norm(f @ f.T - a) / np.linalg.norm(a)
-    assert resid < 60 * n * np.finfo(np.float32).eps
-    np.testing.assert_array_equal(np.triu(out, 1), np.triu(a, 1))
+    out = cholesky(uplo, mat).to_numpy()
+    eps = np.finfo(np.float32).eps
+    if uplo == "L":
+        f = np.tril(out)
+        resid = np.linalg.norm(f @ f.T - a) / np.linalg.norm(a)
+        np.testing.assert_array_equal(np.triu(out, 1), np.triu(a, 1))
+    else:
+        f = np.triu(out)
+        resid = np.linalg.norm(f.T @ f - a) / np.linalg.norm(a)
+        np.testing.assert_array_equal(np.tril(out, -1), np.tril(a, -1))
+    assert resid < 60 * n * eps
