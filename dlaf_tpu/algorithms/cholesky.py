@@ -41,6 +41,7 @@ from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix import util_distribution as ud
 from ..matrix.matrix import Matrix
+from ..matrix.panel import DistContext, transpose_col_to_rows, transpose_row_to_cols
 from ..matrix.tiling import storage_tile_grid, tiles_to_global, global_to_tiles
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
@@ -174,18 +175,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
         vr = cc.bcast(pan, COL_AXIS, owner_c)
         # transposed panel: all_gather along 'row' -> all panel tiles,
         # then gather the tiles matching my local trailing columns
-        full_pan = cc.all_gather(vr, ROW_AXIS)          # (Pr, nrows, mb, mb)
-        full_pan = full_pan.reshape(Pr * nrows, mb, mb)
         lu_c = max(0, -(-(k + 2 - Qc) // Qc))
         ncols = ltc - lu_c
         if ncols == 0:
             return lt
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
-        pj = (sr + g_cols) % Pr                          # owning grid row
-        lj = g_cols // Pr                                # its local row slot
-        flat = pj * nrows + jnp.clip(lj - lu_r, 0, nrows - 1)
-        vc = full_pan[flat]                              # (ncols, mb, mb)
+        vc = transpose_col_to_rows(DistContext(dist), vr, lu_r, g_cols)
         vc = jnp.where(col_valid[:, None, None], vc, jnp.zeros_like(vc))
 
         # -- trailing update (reference impl.h:242-271) ---------------------
@@ -233,18 +229,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
         # -- panel broadcast: col-wise down the mesh, then all_gather along
         # the column axis to index the transposed panel by local rows -------
         vc = cc.bcast(pan, ROW_AXIS, owner_r)
-        full_pan = cc.all_gather(vc, COL_AXIS)          # (Qc, ncols, mb, mb)
-        full_pan = full_pan.reshape(Qc * ncols, mb, mb)
         lu_r = max(0, -(-(k + 2 - Pr) // Pr))
         nrows = ltr - lu_r
         if nrows == 0:
             return lt
         g_rows = local_rows_global(lu_r, rr, nrows)
         row_valid = (g_rows > k) & (g_rows < nt)
-        pj = (sc + g_rows) % Qc                          # owning grid col
-        lj = g_rows // Qc                                # its local col slot
-        flat = pj * ncols + jnp.clip(lj - lu_c, 0, ncols - 1)
-        vr = full_pan[flat]                              # (nrows, mb, mb)
+        vr = transpose_row_to_cols(DistContext(dist), vc, lu_c, g_rows)
         vr = jnp.where(row_valid[:, None, None], vr, jnp.zeros_like(vr))
 
         # -- trailing update: A[i,j] -= U[k,i]^H U[k,j], upper triangle -----
@@ -297,6 +288,7 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     reference's two overloads. Returns a new Matrix whose ``uplo`` triangle
     holds the factor; the other triangle passes through.
     """
+    dlaf_assert(uplo in ("L", "U"), f"cholesky: uplo must be 'L' or 'U', got {uplo!r}")
     dlaf_assert(mat.size.row == mat.size.col, "cholesky: matrix must be square")
     dlaf_assert(mat.block_size.row == mat.block_size.col,
                 "cholesky: block must be square")

@@ -14,7 +14,24 @@ TPU-native counterpart of the reference's two back-transformation stages:
   small T solve per panel, trace-time unrolled.
 
 Both consume the storage contracts of :mod:`.band_to_tridiag` and
-:mod:`.reduction_to_band` directly.
+:mod:`.reduction_to_band` directly, and both have local AND distributed
+variants matching the reference (``bt_reduction_to_band/api.h:18-23``,
+``bt_band_to_tridiag/api.h:21-22``):
+
+* distributed ``bt_reduction_to_band``: per panel (reverse order) the V
+  column is gathered along the mesh exactly like the forward reduction,
+  T is formed redundantly, W2 = (VT)^H C is a partial einsum psum-reduced
+  over the row axis, and C -= V W2 is a local update — the reference's
+  trmmPanel/gemmUpdateW2/gemmTrailingMatrix trio as three einsums.
+* distributed ``bt_band_to_tridiag``: the chase reflectors mix ROWS only
+  and every eigenvector column is independent, so the natural TPU layout
+  change is one ``all_to_all`` along the row axis converting the
+  block-cyclic row sharding into a column split (each device gets ALL rows
+  for 1/P of its column group's columns), the whole sweep scan runs
+  locally with zero further communication, and a second ``all_to_all``
+  restores the block-cyclic layout. The reference instead pipelines per-
+  tile sends of HH groups (``impl.h:1-938``); on ICI the two transposes
+  are cheaper than n_sweeps round trips.
 """
 
 from __future__ import annotations
@@ -26,7 +43,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
+from ..comm import collectives as cc
+from ..comm.grid import COL_AXIS, ROW_AXIS
+from ..common.asserts import dlaf_assert
+from ..matrix.matrix import Matrix
+from ..matrix.panel import DistContext, gather_col_panel_ordered
+from ..matrix.tiling import (_axis_perm_inv, global_to_tiles, storage_tile_grid,
+                             tiles_to_global)
 from ..tile_ops.lapack import larft
 from ..types import ceil_div
 from .band_to_tridiag import TridiagResult
@@ -58,19 +84,98 @@ def _bt_b2t_scan(v_all, tau_all, e, *, b: int, n: int):
     return e_pad[:n]
 
 
-def bt_band_to_tridiag(tri: TridiagResult, evecs) -> jax.Array:
-    """Eigenvectors of the BAND matrix from eigenvectors of the tridiagonal:
-    apply the complex phases (see band_to_tridiag), then the chase reflectors
-    in reverse sweep order."""
+def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int):
+    """Distributed chase back-transform: two layout transposes around the
+    purely local sweep scan (see module docstring)."""
+    n = dist.size.row
+    nb = dist.block_size.row
+    Pr = dist.grid_size.row
+    Sr, _, ltr, ltc = storage_tile_grid(dist)
+    ntr = dist.nr_tiles.row
+    chunk = ceil_div(ltc, Pr) if ltc else 0
+    ltc_pad = chunk * Pr
+
+    # static permutations: a2a slot (p*ltr + l) <-> global row tile g
+    # (global->slot map shared with tiling's storage order)
+    row_order = [0] * Sr
+    slots = _axis_perm_inv(ntr, Pr, dist.source_rank.row, ltr)
+    for g, slot in enumerate(slots):
+        row_order[g] = slot
+    pads = [s for s in range(Sr) if s not in set(slots)]
+    for i, s in enumerate(pads):
+        row_order[ntr + i] = s
+    inv_order = [0] * Sr
+    for pos, slot in enumerate(row_order):
+        inv_order[slot] = pos
+    row_order = jnp.array(row_order, dtype=jnp.int32)
+    inv_order = jnp.array(inv_order, dtype=jnp.int32)
+
+    def run(v_all, tau_all, phase, lt):
+        x = jnp.pad(lt, ((0, 0), (0, ltc_pad - ltc), (0, 0), (0, 0)))
+        # block-cyclic rows -> full rows x 1/P of my column group's columns
+        x = lax.all_to_all(x, ROW_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        x = x[row_order]                              # global row-tile order
+        e = x.transpose(0, 2, 1, 3).reshape(Sr * nb, chunk * nb)[:n]
+        if cplx:
+            e = e * phase[:, None]
+        if n_sweeps:
+            e = _bt_b2t_scan(v_all, tau_all, e, b=b, n=n)
+        e = jnp.pad(e, ((0, Sr * nb - n), (0, 0)))
+        x = e.reshape(Sr, nb, chunk, nb).transpose(0, 2, 1, 3)
+        x = x[inv_order]
+        x = lax.all_to_all(x, ROW_AXIS, split_axis=0, concat_axis=1, tiled=True)
+        return x[:, :ltc]
+
+    return shard_map(run, mesh=mesh,
+                     in_specs=(P(), P(), P(), P(ROW_AXIS, COL_AXIS)),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
+@functools.lru_cache(maxsize=32)
+def _dist_bt_b2t_cached(dist, mesh, b, cplx, n_sweeps):
+    return jax.jit(_build_dist_bt_b2t(dist, mesh, b=b, cplx=cplx,
+                                      n_sweeps=n_sweeps))
+
+
+def _bt_b2t_local_array(tri: TridiagResult, e) -> jax.Array:
     n = tri.d.shape[0]
     cplx = np.issubdtype(tri.v.dtype, np.complexfloating)
-    e = jnp.asarray(evecs)
+    e = jnp.asarray(e)
     if cplx:
         e = e.astype(tri.v.dtype) * jnp.asarray(tri.phase)[:, None]
     if tri.v.shape[0] == 0:
         return e
     return _bt_b2t_scan(jnp.asarray(tri.v), jnp.asarray(tri.tau), e,
                         b=tri.band, n=n)
+
+
+def bt_band_to_tridiag(tri: TridiagResult, evecs):
+    """Eigenvectors of the BAND matrix from eigenvectors of the tridiagonal:
+    apply the complex phases (see band_to_tridiag), then the chase reflectors
+    in reverse sweep order.
+
+    ``evecs`` may be an array (local; returns an array) or a
+    :class:`~dlaf_tpu.matrix.matrix.Matrix` (local or distributed; returns a
+    Matrix — reference distributed overload ``bt_band_to_tridiag/api.h:21-22``).
+    """
+    if not isinstance(evecs, Matrix):
+        return _bt_b2t_local_array(tri, evecs)
+    if evecs.grid is None or evecs.grid.num_devices == 1:
+        out = _bt_b2t_local_array(tri, tiles_to_global(evecs.storage, evecs.dist))
+        return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
+    dlaf_assert(evecs.size.row == tri.d.shape[0],
+                "bt_band_to_tridiag: eigenvector rows != n")
+    dlaf_assert(evecs.block_size.row == evecs.block_size.col,
+                "bt_band_to_tridiag: square blocks only (distributed)")
+    cplx = bool(np.issubdtype(tri.v.dtype, np.complexfloating))
+    storage = evecs.storage
+    if cplx and not np.issubdtype(storage.dtype, np.complexfloating):
+        storage = storage.astype(tri.v.dtype)
+    fn = _dist_bt_b2t_cached(evecs.dist, evecs.grid.mesh, tri.band, cplx,
+                             int(tri.v.shape[0]))
+    out = fn(jnp.asarray(tri.v), jnp.asarray(tri.tau),
+             jnp.asarray(tri.phase), storage)
+    return Matrix(evecs.dist, out, evecs.grid)
 
 
 @functools.partial(jax.jit, static_argnames=("nb",))
@@ -90,13 +195,98 @@ def _bt_r2b_local(a_v, taus, e, *, nb: int):
     return e
 
 
-def bt_reduction_to_band(red: BandReduction, evecs) -> jax.Array:
-    """Eigenvectors of the ORIGINAL matrix from eigenvectors of the band
-    matrix: apply the panel reflector blocks in reverse order (local;
-    the reference's distributed variant lands with the distributed
-    eigensolver driver)."""
-    from ..matrix.tiling import tiles_to_global
+def _build_dist_bt_r2b(dist_a, dist_c, mesh):
+    """Distributed reflector-block back-transform C <- (I - V T V^H) C,
+    panels in reverse order (reference ``bt_reduction_to_band/impl.h:82-373``:
+    trmmPanel W=VT, gemmUpdateW2 W2=W^H C, gemmTrailingMatrix C-=V W2)."""
+    nt = dist_a.nr_tiles.row
+    nb = dist_a.block_size.row
 
-    a_v = tiles_to_global(red.matrix.storage, red.matrix.dist)
-    e = jnp.asarray(evecs, dtype=a_v.dtype)
-    return _bt_r2b_local(a_v, jnp.asarray(red.taus), e, nb=red.band)
+    def run(lt_a, taus, lt_c):
+        ctx_a = DistContext(dist_a)
+        ctx_c = DistContext(dist_c)
+        for k in range(nt - 2, -1, -1):
+            k1 = k + 1
+            # -- gather the full V panel (column k, tile rows k1..nt-1) ------
+            lu = ctx_a.row_start(k1)
+            nrows = ctx_a.ltr - lu
+            if nrows <= 0:
+                continue
+            g_rows = ctx_a.g_rows(lu, nrows)
+            row_valid = (g_rows >= k1) & (g_rows < nt)
+            mine = lt_a[lu:, ctx_a.kc(k)]
+            mine = jnp.where(row_valid[:, None, None], mine, jnp.zeros_like(mine))
+            mine = cc.bcast(mine, COL_AXIS, ctx_a.owner_c(k))
+            vtiles = gather_col_panel_ordered(ctx_a, mine, k1, lu)
+            m_p = (nt - k1) * nb
+            vfull = vtiles.reshape(m_p, nb)
+            v = jnp.tril(vfull, -1) + jnp.eye(m_p, nb, dtype=vfull.dtype)
+            t = larft(v, taus[k])
+            vt = v.reshape(nt - k1, nb, nb)
+
+            # -- W2 = T (V^H C): partial V^H C over my C rows, psum 'row' ----
+            luc = ctx_c.row_start(k1)
+            nrows_c = ctx_c.ltr - luc
+            if nrows_c <= 0:
+                continue
+            g_rows_c = ctx_c.g_rows(luc, nrows_c)
+            rv_c = (g_rows_c >= k1) & (g_rows_c < nt)
+            sel = jnp.clip(g_rows_c - k1, 0, nt - k1 - 1)
+            v_my = jnp.where(rv_c[:, None, None], vt[sel],
+                             jnp.zeros((nrows_c, nb, nb), dtype=vfull.dtype))
+            cpart = lt_c[luc:]
+            w2 = jnp.einsum("rab,rcad->cbd", jnp.conj(v_my), cpart,
+                            preferred_element_type=cpart.dtype)
+            w2 = cc.all_reduce(w2, ROW_AXIS)         # (ltc_c, nb, nb) = V^H C
+            w2 = jnp.einsum("xb,cbd->cxd", t, w2,
+                            preferred_element_type=cpart.dtype)
+
+            # -- C -= V W2 (local rows x local cols) -------------------------
+            upd = jnp.einsum("rab,cbd->rcad", v_my, w2,
+                             preferred_element_type=cpart.dtype)
+            lt_c = lt_c.at[luc:].add(-upd)
+        return lt_c
+
+    return shard_map(run, mesh=mesh,
+                     in_specs=(P(ROW_AXIS, COL_AXIS), P(), P(ROW_AXIS, COL_AXIS)),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
+@functools.lru_cache(maxsize=32)
+def _dist_bt_r2b_cached(dist_a, dist_c, mesh):
+    return jax.jit(_build_dist_bt_r2b(dist_a, dist_c, mesh))
+
+
+def bt_reduction_to_band(red: BandReduction, evecs):
+    """Eigenvectors of the ORIGINAL matrix from eigenvectors of the band
+    matrix: apply the panel reflector blocks in reverse order.
+
+    Local when ``red.matrix`` is local (``evecs`` array -> array); distributed
+    when both ``red.matrix`` and ``evecs`` live on a grid (Matrix -> Matrix,
+    reference ``bt_reduction_to_band/api.h:18-23`` distributed overload).
+    """
+    a = red.matrix
+    if isinstance(evecs, Matrix) and a.grid is not None and a.grid.num_devices > 1:
+        dlaf_assert(evecs.grid is not None
+                    and evecs.grid.size == a.grid.size,
+                    "bt_reduction_to_band: V and C must share the grid")
+        dlaf_assert(evecs.block_size.row == red.band,
+                    "bt_reduction_to_band: C row block != band")
+        dlaf_assert(evecs.size.row == a.size.row,
+                    "bt_reduction_to_band: C rows != n")
+        storage = evecs.storage
+        if storage.dtype != a.dtype:
+            storage = storage.astype(a.dtype)
+        fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh)
+        out = fn(a.storage, jnp.asarray(red.taus), storage)
+        return Matrix(evecs.dist, out, evecs.grid)
+    a_v = tiles_to_global(a.storage, a.dist)
+    arr = evecs
+    ret_matrix = isinstance(evecs, Matrix)
+    if ret_matrix:
+        arr = tiles_to_global(evecs.storage, evecs.dist)
+    e = jnp.asarray(arr, dtype=a_v.dtype)
+    out = _bt_r2b_local(a_v, jnp.asarray(red.taus), e, nb=red.band)
+    if ret_matrix:
+        return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
+    return out

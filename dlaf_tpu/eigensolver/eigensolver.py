@@ -43,29 +43,41 @@ class EigensolverResult:
 
 def eigensolver(uplo: str, a: Matrix) -> EigensolverResult:
     """Eigendecomposition of Hermitian ``a`` stored in ``uplo``
-    (reference ``eigensolver::eigensolver``; local)."""
-    dlaf_assert(a.grid is None or a.grid.num_devices == 1,
-                "eigensolver is local-only (reference parity, api.h:28-31)")
+    (reference ``eigensolver::eigensolver``, ``api.h:28-31``).
+
+    The reference is LOCAL-only at this snapshot; here the same pipeline also
+    runs distributed (beyond-parity): distributed reduction_to_band, host
+    band/tridiag/D&C stages (the reference keeps these on CPU too), then the
+    two distributed back-transformations.
+    """
     dlaf_assert(a.size.row == a.size.col, "eigensolver: square only")
     n = a.size.row
     nb = a.block_size.row
     if n == 0:
         return EigensolverResult(np.zeros(0), a)
+    distributed = a.grid is not None and a.grid.num_devices > 1
     ah = mops.hermitianize(a, uplo)
     red = reduction_to_band(ah)
     band = extract_band(red)
     tri = band_to_tridiag(band, red.band)
     lam, z = tridiag_solver(tri.d, tri.e, nb)
-    zb = bt_band_to_tridiag(tri, z)
-    zf = bt_reduction_to_band(red, zb)
-    vecs = Matrix.from_global(np.asarray(zf), a.block_size, grid=a.grid,
-                              source_rank=a.dist.source_rank)
+    if distributed:
+        zm = Matrix.from_global(np.asarray(z), a.block_size, grid=a.grid,
+                                source_rank=a.dist.source_rank)
+        zb = bt_band_to_tridiag(tri, zm)
+        vecs = bt_reduction_to_band(red, zb)
+    else:
+        zb = bt_band_to_tridiag(tri, z)
+        zf = bt_reduction_to_band(red, zb)
+        vecs = Matrix.from_global(np.asarray(zf), a.block_size, grid=a.grid,
+                                  source_rank=a.dist.source_rank)
     return EigensolverResult(lam, vecs)
 
 
 def gen_eigensolver(uplo: str, a: Matrix, b: Matrix) -> EigensolverResult:
     """Generalized problem ``A x = lambda B x`` with Hermitian ``a`` and
-    HPD ``b`` (reference ``eigensolver::genEigensolver``; local)."""
+    HPD ``b`` (reference ``eigensolver::genEigensolver``, ``api.h:17-21``;
+    LOCAL-only in the reference — here every stage also runs distributed)."""
     dlaf_assert(a.size == b.size, "gen_eigensolver: A/B size mismatch")
     bf = cholesky(uplo, b)
     astd = gen_to_std(uplo, a, bf)

@@ -44,7 +44,7 @@ from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
-from ..matrix.panel import DistContext
+from ..matrix.panel import DistContext, gather_col_panel_ordered
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft
@@ -99,22 +99,10 @@ def _build_dist_red2band(dist, mesh, dtype):
     n = dist.size.row
 
     def full_col_panel(ctx, tiles, k1):
-        """All panel tiles (global tile rows k1..nt-1, ordered) on every rank.
-
-        ``tiles``: my local row tiles of the panel column (already
-        col-broadcast), slots lu.. covering rows >= k1.
-        """
-        nrows = tiles.shape[0]
-        full = cc.all_gather(tiles, ROW_AXIS)      # (P, nrows, mb, nb)
-        full = full.reshape(ctx.P * nrows, nb, nb)
-        lu = ctx.ltr - nrows
-        # static reorder: global tile g -> gathered slot
-        order = []
-        for g in range(k1, nt):
-            p = (dist.source_rank.row + g) % ctx.P
-            l = g // ctx.P
-            order.append(p * nrows + (l - lu))
-        return full[jnp.array(order, dtype=jnp.int32)]  # (nt-k1, nb, nb)
+        """All panel tiles (global tile rows k1..nt-1, ordered) on every rank
+        (shared helper; ``tiles``: my local row tiles of the panel column,
+        already col-broadcast, slots lu.. covering rows >= k1)."""
+        return gather_col_panel_ordered(ctx, tiles, k1, ctx.ltr - tiles.shape[0])
 
     def step(lt, taus_out, k):
         ctx = DistContext(dist)

@@ -116,6 +116,26 @@ def row_panel(ctx: DistContext, lt, k: int, lu: int):
     return cc.bcast(mine, ROW_AXIS, ctx.owner_r(k))
 
 
+def gather_col_panel_ordered(ctx: DistContext, col_tiles, k1: int, lu: int):
+    """Every panel tile (global tile rows ``k1..nt_row-1``, in global order)
+    on every rank: all_gather the per-rank row slices along the row axis and
+    reorder the block-cyclic slots statically.
+
+    ``col_tiles``: my local row tiles of the panel column (already
+    :func:`col_panel`-broadcast), slots ``lu..`` covering rows >= ``k1``.
+    Shared by the forward reduction_to_band and its back-transform.
+    """
+    nt = ctx.nt.row
+    nrows = col_tiles.shape[0]
+    full = cc.all_gather(col_tiles, ROW_AXIS)            # (P, nrows, mb, nb)
+    full = full.reshape(ctx.P * nrows, *col_tiles.shape[1:])
+    order = []
+    for g in range(k1, nt):
+        p = (ctx.sr + g) % ctx.P
+        order.append(p * nrows + (g // ctx.P - lu))
+    return full[jnp.array(order, dtype=jnp.int32)]       # (nt-k1, mb, nb)
+
+
 def transpose_col_to_rows(ctx: DistContext, col_tiles, lu_r: int, g_cols):
     """Transposed-panel exchange (reference ``panelT`` + transposed
     ``broadcast_panel``, ``broadcast_panel.h:101-193``): given each rank's

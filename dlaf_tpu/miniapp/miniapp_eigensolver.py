@@ -6,8 +6,8 @@ canonical full Hermitian eigensolver cost ~(4/3 + 4/3 + 2) n^3 -> reported as
 the reference does via time + derived GFLOPS with the 4n^3/3 reduction term
 dominant; we report 10n^3/3 total (reduction + tridiag D&C + two back
 transforms), muls = adds. BASELINE config #5: gen_eigensolver d N=32768
-nb=512 8x8 (the eigensolver itself is local at this snapshot — grid options
-accepted for forward-compatibility).
+nb=512 8x8. Grid options > 1x1 run the distributed pipeline (beyond the
+reference, whose eigensolver is local-only at this snapshot).
 
 Run:  python -m dlaf_tpu.miniapp.miniapp_eigensolver -m 4096 -b 256
       python -m dlaf_tpu.miniapp.miniapp_eigensolver -m 4096 -b 256 --generalized
@@ -55,9 +55,14 @@ def run(argv=None) -> list[dict]:
     def herm_fn(i, j):
         return np.cos(0.001 * (i * 31 + j * 17)) + np.cos(0.001 * (j * 31 + i * 17))
 
-    am = Matrix.from_element_fn(herm_fn, size, block, dtype=opts.dtype)
+    grid = None
+    if opts.grid_rows * opts.grid_cols > 1:
+        from ..comm.grid import Grid
+
+        grid = Grid(opts.grid_rows, opts.grid_cols, devices=devices)
+    am = Matrix.from_element_fn(herm_fn, size, block, grid=grid, dtype=opts.dtype)
     bm = Matrix.from_element_fn(hpd_element_fn(n, opts.dtype), size, block,
-                                dtype=opts.dtype) if args.generalized else None
+                                grid=grid, dtype=opts.dtype) if args.generalized else None
 
     backend = devices[0].platform
     results = []

@@ -1,0 +1,28 @@
+"""Generalized eigensolver benchmark driver.
+
+TPU-native counterpart of the reference's
+``miniapp/miniapp_gen_eigensolver.cpp`` (190 LoC). The pipeline (cholesky ->
+gen_to_std -> eigensolver -> triangular back-substitution) and the timing
+protocol are shared with :mod:`.miniapp_eigensolver`; this standalone entry
+point mirrors the reference's separate executable and BASELINE config #5
+(gen_eigensolver d N=32768 nb=512 8x8).
+
+Run:  python -m dlaf_tpu.miniapp.miniapp_gen_eigensolver -m 4096 -b 256
+"""
+
+from __future__ import annotations
+
+from .miniapp_eigensolver import run as _run_eigensolver
+
+
+def run(argv=None) -> list[dict]:
+    import sys
+
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--generalized" not in argv:
+        argv.append("--generalized")
+    return _run_eigensolver(argv)
+
+
+if __name__ == "__main__":
+    run()

@@ -81,6 +81,23 @@ def test_miniapp_kernel_and_band():
     assert len(res) == 1
 
 
+def test_miniapp_bt_band_to_tridiag():
+    from dlaf_tpu.miniapp.miniapp_bt_band_to_tridiag import run as btrun
+
+    res = btrun(["-m", "64", "-b", "8", "--nruns", "1", "--check-result", "last"])
+    assert len(res) == 1 and res[0]["gflops"] > 0
+    res = btrun(["-m", "64", "-b", "8", "--grid-rows", "2", "--grid-cols", "2",
+                 "--nruns", "1", "--check-result", "last"])
+    assert len(res) == 1
+
+
+def test_miniapp_gen_eigensolver_standalone():
+    from dlaf_tpu.miniapp.miniapp_gen_eigensolver import run as grun
+
+    res = grun(["-m", "32", "-b", "8", "--nruns", "1", "--check-result", "last"])
+    assert len(res) == 1 and res[0]["gflops"] > 0
+
+
 def test_scaling_scripts():
     out = subprocess.run(
         [sys.executable, "scripts/gen_strong.py", "--miniapp", "cholesky",

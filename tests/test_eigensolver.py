@@ -9,7 +9,8 @@ import pytest
 import scipy.linalg as sla
 
 from dlaf_tpu.algorithms.permutations import permute
-from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import RankIndex2D, TileElementSize
 from dlaf_tpu.eigensolver.back_transform import bt_band_to_tridiag, bt_reduction_to_band
 from dlaf_tpu.eigensolver.band_to_tridiag import band_to_tridiag_numpy
 from dlaf_tpu.eigensolver.eigensolver import eigensolver, gen_eigensolver
@@ -71,6 +72,61 @@ def test_bt_reduction_to_band(dtype):
     assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
 
 
+# -- distributed back-transforms (reference distributed overloads,
+#    bt_reduction_to_band/api.h:18-23, bt_band_to_tridiag/api.h:21-22) ------
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("grid_shape,src", [((2, 2), (0, 0)), ((2, 4), (1, 1)),
+                                            ((4, 2), (1, 0))])
+@pytest.mark.parametrize("n,nb", [(24, 4), (21, 4)])
+def test_bt_reduction_to_band_distributed(n, nb, grid_shape, src, dtype, devices8):
+    a = herm(n, dtype, n + grid_shape[0])
+    rng = np.random.default_rng(n)
+    c = rng.standard_normal((n, n)).astype(dtype)
+    red_local = reduction_to_band(M(a, nb))
+    q_local = np.asarray(bt_reduction_to_band(red_local, c))
+
+    grid = Grid(*grid_shape)
+    srk = RankIndex2D(src[0] % grid_shape[0], src[1] % grid_shape[1])
+    red_dist = reduction_to_band(Matrix.from_global(a, TileElementSize(nb, nb),
+                                                    grid=grid, source_rank=srk))
+    cm = Matrix.from_global(c, TileElementSize(nb, nb), grid=grid, source_rank=srk)
+    q_dist = bt_reduction_to_band(red_dist, cm)
+    assert isinstance(q_dist, Matrix)
+    np.testing.assert_allclose(q_dist.to_numpy(), q_local, atol=1e-12 * n)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("grid_shape,src", [((2, 2), (0, 0)), ((4, 2), (1, 1)),
+                                            ((2, 4), (0, 1))])
+@pytest.mark.parametrize("n,b", [(24, 4), (21, 4)])
+def test_bt_band_to_tridiag_distributed(n, b, grid_shape, src, dtype, devices8):
+    rng = np.random.default_rng(n + b)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    a = ((x + x.conj().T) / 2)
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) <= b
+    a = np.where(mask, a, 0).astype(dtype)
+    band = np.zeros((b + 1, n), dtype=dtype)
+    for r in range(b + 1):
+        band[r, : n - r] = np.diagonal(a, -r)
+    tri = band_to_tridiag_numpy(band, b)
+    lam, z = tridiag_solver(tri.d, tri.e, b, use_device=False)
+    q_local = np.asarray(bt_band_to_tridiag(tri, z))
+
+    grid = Grid(*grid_shape)
+    srk = RankIndex2D(src[0] % grid_shape[0], src[1] % grid_shape[1])
+    zm = Matrix.from_global(np.asarray(z), TileElementSize(b, b), grid=grid,
+                            source_rank=srk)
+    q_dist = bt_band_to_tridiag(tri, zm)
+    assert isinstance(q_dist, Matrix)
+    np.testing.assert_allclose(q_dist.to_numpy(), q_local, atol=1e-12 * n)
+    # and it must still diagonalize the band matrix
+    q = q_dist.to_numpy()
+    assert np.linalg.norm(a @ q - q * lam[None, :]) < 1e-10 * n
+
+
 # -- full pipeline ----------------------------------------------------------
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
@@ -88,6 +144,41 @@ def test_eigensolver(n, nb, uplo, dtype):
     np.testing.assert_allclose(lam, np.linalg.eigvalsh(afull), atol=tol)
     assert np.linalg.norm(afull @ q - q * lam[None, :]) < tol * 10
     assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 100 * n * eps
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("grid_shape,src", [((2, 2), (0, 0)), ((2, 4), (1, 1))])
+@pytest.mark.parametrize("n,nb", [(24, 4), (21, 4)])
+def test_eigensolver_distributed(n, nb, grid_shape, src, dtype, devices8):
+    """Beyond-parity: the full pipeline over a device grid (the reference's
+    eigensolver is local-only, api.h:28-31)."""
+    a = herm(n, dtype, n + nb)
+    grid = Grid(*grid_shape)
+    srk = RankIndex2D(src[0] % grid_shape[0], src[1] % grid_shape[1])
+    am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid, source_rank=srk)
+    res = eigensolver("L", am)
+    lam, q = res.eigenvalues, res.eigenvectors.to_numpy()
+    afull = np.tril(a) + np.tril(a, -1).conj().T
+    np.fill_diagonal(afull, np.real(np.diag(afull)))
+    np.testing.assert_allclose(lam, np.linalg.eigvalsh(afull), atol=1e-10 * n)
+    assert np.linalg.norm(afull @ q - q * lam[None, :]) < 1e-10 * n
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_gen_eigensolver_distributed(dtype, devices8):
+    n, nb = 24, 4
+    a = herm(n, dtype, 21)
+    b = herm(n, dtype, 22, pd=True)
+    grid = Grid(2, 2)
+    am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+    bm = Matrix.from_global(b, TileElementSize(nb, nb), grid=grid)
+    res = gen_eigensolver("L", am, bm)
+    lam, q = res.eigenvalues, res.eigenvectors.to_numpy()
+    w = sla.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(lam, w, atol=1e-9)
+    assert np.linalg.norm(a @ q - (b @ q) * lam[None, :]) < 1e-9 * n
+    assert np.linalg.norm(q.conj().T @ b @ q - np.eye(n)) < 1e-10 * n
 
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
