@@ -53,8 +53,8 @@ from ..types import ceil_div
 # Local (single device) — reference impl.h:134-171
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("uplo", "nb"))
-def _cholesky_local(a, *, uplo: str, nb: int):
+@functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
+def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     n = a.shape[0]
     nt = ceil_div(n, nb) if n else 0
     for k in range(nt):
@@ -63,35 +63,58 @@ def _cholesky_local(a, *, uplo: str, nb: int):
         a = a.at[k0:k1, k0:k1].set(diag)
         if k1 == n:
             break
+        m = n - k1
         if uplo == "L":
             # panel: A[k1:, k] <- A[k1:, k] Lkk^-H   (tile::trsm, high-prio
             # in the reference impl.h:147-156; here XLA schedules it)
-            panel = tb.trsm("R", "L", "C", "N", diag, a[k1:, k0:k1])
+            if trailing == "invgemm":
+                # explicit small triangular inverse, panel formed on the MXU
+                dinv = tb.trsm("L", "L", "N", "N", diag,
+                               jnp.eye(k1 - k0, dtype=a.dtype))
+                panel = a[k1:, k0:k1] @ jnp.conj(dinv).T
+            else:
+                panel = tb.trsm("R", "L", "C", "N", diag, a[k1:, k0:k1])
             a = a.at[k1:, k0:k1].set(panel)
-            # trailing per block column: herk on the diagonal block + one
-            # gemm below it — exact n^3/3 flops (reference impl.h:242-271)
-            for j in range(k + 1, nt):
-                j0, j1 = j * nb, min((j + 1) * nb, n)
-                pj = panel[j0 - k1: j1 - k1]
-                a = a.at[j0:j1, j0:j1].set(
-                    tb.herk("L", "N", pj, a[j0:j1, j0:j1], alpha=-1.0))
-                if j1 < n:
-                    below = tb.gemm(panel[j1 - k1:], pj, a[j1:, j0:j1],
-                                    alpha=-1.0, beta=1.0, op_b="C")
-                    a = a.at[j1:, j0:j1].set(below)
+            if trailing == "loop":
+                # trailing per block column: herk on the diagonal block + one
+                # gemm below it — exact n^3/3 flops (reference impl.h:242-271)
+                for j in range(k + 1, nt):
+                    j0, j1 = j * nb, min((j + 1) * nb, n)
+                    pj = panel[j0 - k1: j1 - k1]
+                    a = a.at[j0:j1, j0:j1].set(
+                        tb.herk("L", "N", pj, a[j0:j1, j0:j1], alpha=-1.0))
+                    if j1 < n:
+                        below = tb.gemm(panel[j1 - k1:], pj, a[j1:, j0:j1],
+                                        alpha=-1.0, beta=1.0, op_b="C")
+                        a = a.at[j1:, j0:j1].set(below)
+            else:
+                # ONE full trailing gemm, masked to the lower triangle
+                upd = panel @ jnp.conj(panel).T
+                mask = jnp.tril(jnp.ones((m, m), dtype=bool))
+                a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
         else:
             # upper: A = U^H U; panel is a block row
-            panel = tb.trsm("L", "U", "C", "N", diag, a[k0:k1, k1:])
+            if trailing == "invgemm":
+                dinv = tb.trsm("L", "U", "N", "N", diag,
+                               jnp.eye(k1 - k0, dtype=a.dtype))
+                panel = jnp.conj(dinv).T @ a[k0:k1, k1:]
+            else:
+                panel = tb.trsm("L", "U", "C", "N", diag, a[k0:k1, k1:])
             a = a.at[k0:k1, k1:].set(panel)
-            for j in range(k + 1, nt):
-                j0, j1 = j * nb, min((j + 1) * nb, n)
-                pj = panel[:, j0 - k1: j1 - k1]
-                a = a.at[j0:j1, j0:j1].set(
-                    tb.herk("U", "C", pj, a[j0:j1, j0:j1], alpha=-1.0))
-                if j1 < n:
-                    right = tb.gemm(pj, panel[:, j1 - k1:], a[j0:j1, j1:],
-                                    alpha=-1.0, beta=1.0, op_a="C")
-                    a = a.at[j0:j1, j1:].set(right)
+            if trailing == "loop":
+                for j in range(k + 1, nt):
+                    j0, j1 = j * nb, min((j + 1) * nb, n)
+                    pj = panel[:, j0 - k1: j1 - k1]
+                    a = a.at[j0:j1, j0:j1].set(
+                        tb.herk("U", "C", pj, a[j0:j1, j0:j1], alpha=-1.0))
+                    if j1 < n:
+                        right = tb.gemm(pj, panel[:, j1 - k1:], a[j0:j1, j1:],
+                                        alpha=-1.0, beta=1.0, op_a="C")
+                        a = a.at[j0:j1, j1:].set(right)
+            else:
+                upd = jnp.conj(panel).T @ panel
+                mask = jnp.triu(jnp.ones((m, m), dtype=bool))
+                a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
     return a
 
 
@@ -289,12 +312,18 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     holds the factor; the other triangle passes through.
     """
     dlaf_assert(uplo in ("L", "U"), f"cholesky: uplo must be 'L' or 'U', got {uplo!r}")
+    from ..config import get_configuration
+
+    trailing = get_configuration().cholesky_trailing
+    dlaf_assert(trailing in ("loop", "biggemm", "invgemm"),
+                f"cholesky_trailing must be loop|biggemm|invgemm, got {trailing!r}")
     dlaf_assert(mat.size.row == mat.size.col, "cholesky: matrix must be square")
     dlaf_assert(mat.block_size.row == mat.block_size.col,
                 "cholesky: block must be square")
     if mat.grid is None or mat.grid.num_devices == 1:
         a = tiles_to_global(mat.storage, mat.dist)
-        out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row)
+        out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
+                              trailing=trailing)
         return mat.with_storage(global_to_tiles(out, mat.dist))
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, np.dtype(mat.dtype).name,

@@ -101,7 +101,8 @@ def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int):
     slots = _axis_perm_inv(ntr, Pr, dist.source_rank.row, ltr)
     for g, slot in enumerate(slots):
         row_order[g] = slot
-    pads = [s for s in range(Sr) if s not in set(slots)]
+    used = set(slots)
+    pads = [s for s in range(Sr) if s not in used]
     for i, s in enumerate(pads):
         row_order[ntr + i] = s
     inv_order = [0] * Sr

@@ -21,11 +21,18 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..tile_ops.lapack import stedc
 
 _EPS = np.finfo(np.float64).eps
+
+# Above this deflated-problem size the O(k^2)-per-iteration secular solve and
+# the O(k^2) z-refinement run on the device (HBM-bound batched math) instead
+# of host numpy. The math is identical.
+_DEVICE_SECULAR_MIN_K = 1024
 
 
 def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
@@ -67,6 +74,51 @@ def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
         lo = np.where(take_left, lo, mu)
     mu = 0.5 * (lo + hi)
     return anchor, mu
+
+
+@jax.jit
+def _secular_vcols_device(ds, zs, rho):
+    """Device twin of :func:`_secular_roots` + the Gu-Eisenstat refinement +
+    eigenvector-coefficient assembly: returns ``(lam_live, vcols)``. The pole
+    differences ``m[i, j] = d_j - lambda_i`` are formed internally in the
+    shifted (cancellation-free) representation. All f64; one fused HBM-bound
+    program instead of ~90 numpy sweeps.
+    """
+    k = ds.shape[0]
+    zsq = zs * zs
+    upper = jnp.concatenate([ds[1:], (ds[-1] + rho * zsq.sum())[None]])
+    gaps = upper - ds
+    mid = ds + gaps / 2
+    fmid = 1.0 + rho * (zsq[None, :] / (ds[None, :] - mid[:, None])).sum(1)
+    idx = jnp.arange(k)
+    anchor = jnp.where(fmid >= 0, idx, jnp.minimum(idx + 1, k - 1))
+    anchor = anchor.at[-1].set(k - 1)
+    danchor = ds[anchor]
+    lo = jnp.where(anchor == idx, 0.0, ds - upper)
+    hi = jnp.where(anchor == idx, gaps, 0.0)
+    delta = ds[None, :] - danchor[:, None]
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mu = 0.5 * (lo + hi)
+        f = 1.0 + rho * (zsq[None, :] / (delta - mu[:, None])).sum(1)
+        take_left = f >= 0
+        return jnp.where(take_left, lo, mu), jnp.where(take_left, mu, hi)
+
+    lo, hi = lax.fori_loop(0, 90, body, (lo, hi))
+    mu = 0.5 * (lo + hi)
+    lam_live = danchor + mu
+    m = delta - mu[:, None]
+    logm = jnp.log(jnp.abs(m))
+    dd = ds[None, :] - ds[:, None]
+    dd = dd.at[idx, idx].set(1.0)
+    logdd = jnp.log(jnp.abs(dd))
+    logdd = logdd.at[idx, idx].set(0.0)
+    log_zhat2 = logm.sum(0) - logdd.sum(0)
+    zhat = jnp.sign(zs) * jnp.exp(0.5 * log_zhat2)
+    vcols = zhat[None, :] / m
+    vcols = vcols / jnp.linalg.norm(vcols, axis=1, keepdims=True)
+    return lam_live, vcols
 
 
 def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
@@ -139,21 +191,28 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
         else:
             dsk = ds[idx_live]
             zsk = zs[idx_live]
-            anchor, mu = _secular_roots(dsk, zsk, rho_n)
-            lam_live = dsk[anchor] + mu
-            # accurate pole-root differences: m[i, j] = d_j - lambda_i
-            m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]
-            # Gu-Eisenstat z refinement (reference laed4/dlaed3 step)
-            logm = np.log(np.abs(m))
-            dd = dsk[None, :] - dsk[:, None]
-            np.fill_diagonal(dd, 1.0)
-            logdd = np.log(np.abs(dd))
-            np.fill_diagonal(logdd, 0.0)
-            log_zhat2 = logm.sum(0) - logdd.sum(0)
-            zhat = np.sign(zsk) * np.exp(0.5 * log_zhat2)
-            # eigenvector coefficients: v_i[j] = zhat_j / (d_j - lambda_i)
-            vcols = (zhat[None, :] / m)
-            vcols /= np.linalg.norm(vcols, axis=1, keepdims=True)
+            if (use_device and k >= _DEVICE_SECULAR_MIN_K
+                    and jax.config.jax_enable_x64):
+                lam_j, vcols_j = _secular_vcols_device(
+                    jnp.asarray(dsk), jnp.asarray(zsk), jnp.float64(rho_n))
+                lam_live = np.asarray(lam_j)
+                vcols = np.asarray(vcols_j)
+            else:
+                anchor, mu = _secular_roots(dsk, zsk, rho_n)
+                lam_live = dsk[anchor] + mu
+                # accurate pole-root differences: m[i, j] = d_j - lambda_i
+                m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]
+                # Gu-Eisenstat z refinement (reference laed4/dlaed3 step)
+                logm = np.log(np.abs(m))
+                dd = dsk[None, :] - dsk[:, None]
+                np.fill_diagonal(dd, 1.0)
+                logdd = np.log(np.abs(dd))
+                np.fill_diagonal(logdd, 0.0)
+                log_zhat2 = logm.sum(0) - logdd.sum(0)
+                zhat = np.sign(zsk) * np.exp(0.5 * log_zhat2)
+                # eigenvector coefficients: v_i[j] = zhat_j / (d_j - lambda_i)
+                vcols = (zhat[None, :] / m)
+                vcols /= np.linalg.norm(vcols, axis=1, keepdims=True)
             u_live = np.zeros((n, k), dtype=dtype)
             u_live[idx_live, :] = vcols.T.astype(dtype)
             # deflated eigenpairs: unit vectors

@@ -58,6 +58,26 @@ def test_cholesky_local(uplo, n, nb, dtype):
     check_factor(uplo, a, out, dtype)
 
 
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("trailing", ["biggemm", "invgemm"])
+@pytest.mark.parametrize("n,nb", [(32, 8), (29, 8)])
+def test_cholesky_local_trailing_variants(uplo, trailing, n, nb, dtype, monkeypatch):
+    """MXU-shaped trailing-update strategies must match the reference loop
+    (config knob ``cholesky_trailing``; see bench.py for the perf A/B)."""
+    monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", trailing)
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        a = hpd_matrix(n, dtype)
+        out = cholesky(uplo, Matrix_from(a, nb)).to_numpy()
+        check_factor(uplo, a, out, dtype)
+    finally:
+        monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+        config.initialize()
+
+
 def Matrix_from(a, nb, grid=None, src=RankIndex2D(0, 0)):
     from dlaf_tpu.matrix.matrix import Matrix
     return Matrix.from_global(a, TileElementSize(nb, nb), grid=grid, source_rank=src)

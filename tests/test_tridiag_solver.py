@@ -78,3 +78,19 @@ def test_device_path_matches():
     l2, q2 = tridiag_solver(d, e, 8, use_device=True)
     np.testing.assert_allclose(l1, l2, atol=1e-12)
     np.testing.assert_allclose(np.abs(q1), np.abs(q2), atol=1e-10)
+
+
+def test_device_secular_path(monkeypatch):
+    """Force the device secular/refinement branch (used for big merges) and
+    check it reproduces the host branch + a correct decomposition."""
+    from dlaf_tpu.eigensolver import tridiag_solver as ts_mod
+
+    rng = np.random.default_rng(10)
+    n = 64
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    l_host, _ = tridiag_solver(d, e, 16, use_device=False)
+    monkeypatch.setattr(ts_mod, "_DEVICE_SECULAR_MIN_K", 1)
+    lam, q = tridiag_solver(d, e, 16, use_device=True)
+    check(d, e, lam, q)
+    np.testing.assert_allclose(lam, l_host, atol=1e-11)
