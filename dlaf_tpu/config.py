@@ -31,6 +31,10 @@ class Configuration:
     #: Implementation of the band->tridiag bulge chasing stage:
     #: "native" (C++ via ctypes) with automatic fallback to "numpy".
     band_to_tridiag_impl: str = "native"
+    #: Host secular-equation solver in the D&C merge: "native" (C++
+    #: safeguarded Newton, the laed4 analog) with fallback to "numpy"
+    #: (vectorized bisection).
+    secular_impl: str = "native"
     #: Look-ahead depth for panel pipelining in distributed factorizations
     #: (analog of the reference's round-robin workspace count,
     #: ``factorization/cholesky/impl.h:187-189``).
@@ -44,6 +48,10 @@ class Configuration:
     cholesky_trailing: str = "loop"
     #: Enable float64/complex128 support (sets jax_enable_x64).
     enable_x64: bool = True
+    #: When non-empty, miniapps emit XLA/PJRT execution profiles
+    #: (jax.profiler traces with named phases) into this directory
+    #: (the green-field tracing hook SURVEY §5 calls for).
+    profile_dir: str = ""
 
     def _fields(self):
         return {f.name: f for f in dataclasses.fields(self)}

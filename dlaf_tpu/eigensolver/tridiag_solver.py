@@ -29,10 +29,12 @@ from ..tile_ops.lapack import stedc
 
 _EPS = np.finfo(np.float64).eps
 
-# Above this deflated-problem size the O(k^2)-per-iteration secular solve and
-# the O(k^2) z-refinement run on the device (HBM-bound batched math) instead
-# of host numpy. The math is identical.
-_DEVICE_SECULAR_MIN_K = 1024
+# Above this deflated-problem size the secular solve and the O(k^2)
+# z-refinement run on the device (HBM-bound batched math). Below it the host
+# path wins: the native C++ Newton solver (secular.cpp) is O(iters*k) per
+# root with a small constant (~50ms at k=2000 vs ~4s for the numpy
+# bisection), so only the k^2 log-sum refinement is left to amortize.
+_DEVICE_SECULAR_MIN_K = 4096
 
 
 def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
@@ -76,6 +78,22 @@ def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
     return anchor, mu
 
 
+def _secular_roots_host(ds, zs, rho):
+    """Host secular solve: native C++ safeguarded Newton (``native/
+    secular.cpp``, the laed4 analog — the reference calls LAPACK laed4 here,
+    ``merge.h:590-629``) with transparent fallback to the numpy bisection."""
+    from ..config import get_configuration
+
+    if get_configuration().secular_impl == "native":
+        try:
+            from ..native import bindings
+
+            return bindings.secular_roots(ds, zs, rho)
+        except Exception:
+            pass
+    return _secular_roots(ds, zs, rho)
+
+
 @jax.jit
 def _secular_vcols_device(ds, zs, rho):
     """Device twin of :func:`_secular_roots` + the Gu-Eisenstat refinement +
@@ -105,7 +123,10 @@ def _secular_vcols_device(ds, zs, rho):
         take_left = f >= 0
         return jnp.where(take_left, lo, mu), jnp.where(take_left, mu, hi)
 
-    lo, hi = lax.fori_loop(0, 90, body, (lo, hi))
+    # 300 halvings (matching the native solver's iteration cap): roots next
+    # to near-deflated poles sit ~1e-28*gap from the anchor and need >90
+    # halvings before the offset mu carries any relative accuracy
+    lo, hi = lax.fori_loop(0, 300, body, (lo, hi))
     mu = 0.5 * (lo + hi)
     lam_live = danchor + mu
     m = delta - mu[:, None]
@@ -198,7 +219,7 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
                 lam_live = np.asarray(lam_j)
                 vcols = np.asarray(vcols_j)
             else:
-                anchor, mu = _secular_roots(dsk, zsk, rho_n)
+                anchor, mu = _secular_roots_host(dsk, zsk, rho_n)
                 lam_live = dsk[anchor] + mu
                 # accurate pole-root differences: m[i, j] = d_j - lambda_i
                 m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]

@@ -59,12 +59,24 @@ def run(argv=None) -> list[dict]:
     backend = devices[0].platform
     threads = os.cpu_count()
     results = []
+    from ..common.timer import PhaseTimer
+
+    ptimer = PhaseTimer(config.get_configuration().profile_dir or None)
+    try:
+        return _timed_runs(args, opts, ref, ptimer, backend, threads, results)
+    finally:
+        ptimer.stop()
+
+
+def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
+    n, nb = args.matrix_size, args.block_size
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)   # fresh copy per run (:127-128)
         mat.storage.block_until_ready()           # start fence (:134-136)
         t0 = time.perf_counter()
-        out = cholesky(args.uplo, mat)
-        out.storage.block_until_ready()           # end fence (:142-144)
+        with ptimer.phase(f"cholesky[{run_i}]"):
+            out = cholesky(args.uplo, mat)
+            out.storage.block_until_ready()       # end fence (:142-144)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, n**3 / 6, n**3 / 6) / t / 1e9
         if run_i < 0:

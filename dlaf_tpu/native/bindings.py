@@ -19,31 +19,73 @@ import numpy as np
 from ..types import ceil_div
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "band_to_tridiag.cpp")
+_SRCS = [os.path.join(_HERE, "band_to_tridiag.cpp"),
+         os.path.join(_HERE, "secular.cpp")]
 _LIB = os.path.join(_HERE, "libdlaf_native.so")
 _lock = threading.Lock()
 _lib = None
+_load_error: Exception | None = None
 
 
 def _build() -> str:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
+           "-o", _LIB, "-lpthread"]
     subprocess.run(cmd, check=True, capture_output=True)
     return _LIB
 
 
 def get_lib():
-    global _lib
+    """Load (building if stale) the native library. A failed build/load is
+    cached and re-raised immediately so callers with numpy fallbacks don't
+    respawn the compiler on every call."""
+    global _lib, _load_error
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-            _build()
-        lib = ctypes.CDLL(_LIB)
-        for name in ("dlaf_band_to_tridiag_d", "dlaf_band_to_tridiag_z"):
-            fn = getattr(lib, name)
-            fn.restype = ctypes.c_int
+        if _load_error is not None:
+            raise _load_error
+        try:
+            if (not os.path.exists(_LIB)
+                    or any(os.path.getmtime(_LIB) < os.path.getmtime(s)
+                           for s in _SRCS)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            for name in ("dlaf_band_to_tridiag_d", "dlaf_band_to_tridiag_z",
+                         "dlaf_secular_roots_d"):
+                fn = getattr(lib, name)
+                fn.restype = ctypes.c_int
+        except Exception as e:
+            _load_error = e
+            import sys
+
+            print(f"dlaf_tpu.native: build/load failed ({e!r}); "
+                  "numpy fallbacks in effect", file=sys.stderr)
+            raise
         _lib = lib
         return lib
+
+
+def secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
+    """Native counterpart of the host secular solver (safeguarded-Newton
+    laed4 analog, ``secular.cpp``): returns ``(anchor, mu)`` with the same
+    contract as ``tridiag_solver._secular_roots``."""
+    ds = np.ascontiguousarray(ds, dtype=np.float64)
+    zs = np.ascontiguousarray(zs, dtype=np.float64)
+    k = ds.shape[0]
+    anchor = np.zeros(k, dtype=np.int64)
+    mu = np.zeros(k, dtype=np.float64)
+    if k == 0:
+        return anchor, mu
+    lib = get_lib()
+    rc = lib.dlaf_secular_roots_d(
+        ds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        zs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_double(float(rho)), ctypes.c_long(k),
+        anchor.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        mu.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    if rc != 0:
+        raise RuntimeError(f"native secular_roots failed rc={rc}")
+    return anchor, mu
 
 
 def band_to_tridiag(band: np.ndarray, b: int):

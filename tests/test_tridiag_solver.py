@@ -80,6 +80,56 @@ def test_device_path_matches():
     np.testing.assert_allclose(np.abs(q1), np.abs(q2), atol=1e-10)
 
 
+def test_native_secular_matches_numpy():
+    """C++ safeguarded-Newton secular solver vs the numpy bisection: same
+    anchors, same roots, and the roots actually satisfy the secular eq."""
+    from dlaf_tpu.eigensolver.tridiag_solver import _secular_roots
+    from dlaf_tpu.native import bindings
+
+    rng = np.random.default_rng(4)
+    for k in (1, 2, 7, 129, 500):
+        ds = np.sort(rng.standard_normal(k)) * 3
+        # enforce the post-deflation gap so poles are distinct
+        ds += np.arange(k) * 1e-6
+        zs = rng.standard_normal(k)
+        zs[np.abs(zs) < 0.05] = 0.05
+        zs /= np.linalg.norm(zs)
+        rho = abs(rng.standard_normal()) + 0.5
+        a_np, mu_np = _secular_roots(ds, zs, rho)
+        a_nat, mu_nat = bindings.secular_roots(ds, zs, rho)
+        lam_np = ds[a_np] + mu_np
+        lam_nat = ds[a_nat] + mu_nat
+        scale = np.abs(ds).max() + rho
+        np.testing.assert_allclose(lam_nat, lam_np, atol=1e-11 * scale)
+        # residual of the secular equation at the native roots
+        f = 1.0 + rho * (zs[None, :] ** 2 /
+                         ((ds[None, :] - ds[a_nat][:, None]) - mu_nat[:, None])).sum(1)
+        fprime = rho * (zs[None, :] ** 2 /
+                        ((ds[None, :] - ds[a_nat][:, None]) - mu_nat[:, None]) ** 2).sum(1)
+        # |f| should be ~eps * f' * ulp-level root error
+        assert np.all(np.abs(f) < 1e-6 * np.maximum(fprime * scale * 1e-10, 1.0) + 1e-7)
+
+
+def test_secular_impl_config(monkeypatch):
+    """The secular_impl knob selects the native path and both give the same
+    full decomposition."""
+    import dlaf_tpu.config as config
+
+    rng = np.random.default_rng(12)
+    d = rng.standard_normal(48)
+    e = rng.standard_normal(47)
+    monkeypatch.setenv("DLAF_SECULAR_IMPL", "numpy")
+    config.initialize()
+    l1, _ = tridiag_solver(d, e, 8, use_device=False)
+    monkeypatch.setenv("DLAF_SECULAR_IMPL", "native")
+    config.initialize()
+    l2, q2 = tridiag_solver(d, e, 8, use_device=False)
+    monkeypatch.delenv("DLAF_SECULAR_IMPL")
+    config.initialize()
+    np.testing.assert_allclose(l1, l2, atol=1e-11)
+    check(d, e, l2, q2)
+
+
 def test_device_secular_path(monkeypatch):
     """Force the device secular/refinement branch (used for big merges) and
     check it reproduces the host branch + a correct decomposition."""
