@@ -83,8 +83,10 @@ def run_bench() -> None:
     # Trailing-update strategy A/B (config knob cholesky_trailing): measure
     # each on the actual hardware, report the best. DLAF_BENCH_TRAILING pins
     # a single variant (skips the sweep).
+    from dlaf_tpu.algorithms.cholesky import VALID_TRAILING
+
     pinned = os.environ.get("DLAF_BENCH_TRAILING")
-    variants = [pinned] if pinned else ["loop", "biggemm", "invgemm"]
+    variants = [pinned] if pinned else list(VALID_TRAILING)
 
     import dlaf_tpu.config as config
 

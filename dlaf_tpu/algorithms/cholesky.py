@@ -53,9 +53,27 @@ from ..types import ceil_div
 # Local (single device) — reference impl.h:134-171
 # ---------------------------------------------------------------------------
 
+#: Valid cholesky_trailing strategies (see config.Configuration); bench.py
+#: sweeps this set on the measured hardware.
+VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla")
+
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     n = a.shape[0]
+    if trailing == "xla" and n:
+        # whole-matrix XLA cholesky: the compiler's own fused/blocked
+        # factorization (a TPU-native option the reference cannot take —
+        # its local algorithm must hand-block; ours may delegate blocking
+        # to XLA). Triangle pass-through semantics preserved.
+        from jax import lax
+
+        if uplo == "L":
+            ah = jnp.tril(a) + jnp.conj(jnp.tril(a, -1)).T
+            l = lax.linalg.cholesky(ah)
+            return jnp.tril(l) + jnp.triu(a, 1)
+        ah = jnp.triu(a) + jnp.conj(jnp.triu(a, 1)).T
+        l = lax.linalg.cholesky(ah)
+        return jnp.triu(jnp.conj(l).T) + jnp.tril(a, -1)
     nt = ceil_div(n, nb) if n else 0
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, n)
@@ -315,8 +333,8 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     from ..config import get_configuration
 
     trailing = get_configuration().cholesky_trailing
-    dlaf_assert(trailing in ("loop", "biggemm", "invgemm"),
-                f"cholesky_trailing must be loop|biggemm|invgemm, got {trailing!r}")
+    dlaf_assert(trailing in VALID_TRAILING,
+                f"cholesky_trailing must be one of {VALID_TRAILING}, got {trailing!r}")
     dlaf_assert(mat.size.row == mat.size.col, "cholesky: matrix must be square")
     dlaf_assert(mat.block_size.row == mat.block_size.col,
                 "cholesky: block must be square")

@@ -42,9 +42,10 @@ class Configuration:
     #: Local Cholesky trailing-update strategy: "loop" (exact-flop per-column
     #: herk/gemm, the reference's task shape), "biggemm" (ONE masked full
     #: trailing gemm per step — 2x flops on the strict triangle but a single
-    #: large MXU op), or "invgemm" (biggemm + panel formed by gemm against
-    #: the explicit inverse of the diagonal factor instead of a triangular
-    #: solve). Benchmarked per hardware; see bench.py.
+    #: large MXU op), "invgemm" (biggemm + panel formed by gemm against the
+    #: explicit inverse of the diagonal factor instead of a triangular
+    #: solve), or "xla" (delegate the whole local factorization to XLA's
+    #: fused native cholesky). Benchmarked per hardware; see bench.py.
     cholesky_trailing: str = "loop"
     #: Enable float64/complex128 support (sets jax_enable_x64).
     enable_x64: bool = True

@@ -20,10 +20,13 @@ import dataclasses
 
 import numpy as np
 
+from typing import Optional
+
 from ..algorithms.cholesky import cholesky
 from ..algorithms.gen_to_std import gen_to_std
 from ..algorithms.triangular import triangular_solve
 from ..common.asserts import dlaf_assert
+from ..common.timer import PhaseTimer
 from ..matrix import ops as mops
 from ..matrix.matrix import Matrix
 from .back_transform import bt_band_to_tridiag, bt_reduction_to_band
@@ -41,7 +44,8 @@ class EigensolverResult:
     eigenvectors: Matrix      # columns are eigenvectors
 
 
-def eigensolver(uplo: str, a: Matrix) -> EigensolverResult:
+def eigensolver(uplo: str, a: Matrix,
+                phases: Optional[PhaseTimer] = None) -> EigensolverResult:
     """Eigendecomposition of Hermitian ``a`` stored in ``uplo``
     (reference ``eigensolver::eigensolver``, ``api.h:28-31``).
 
@@ -49,44 +53,75 @@ def eigensolver(uplo: str, a: Matrix) -> EigensolverResult:
     runs distributed (beyond-parity): distributed reduction_to_band, host
     band/tridiag/D&C stages (the reference keeps these on CPU too), then the
     two distributed back-transformations.
+
+    ``phases`` (optional :class:`PhaseTimer`) collects per-stage wall times —
+    the per-algorithm phase instrumentation SURVEY §5 calls for.
     """
     dlaf_assert(a.size.row == a.size.col, "eigensolver: square only")
     n = a.size.row
     nb = a.block_size.row
     if n == 0:
         return EigensolverResult(np.zeros(0), a)
+    pt = phases if phases is not None else PhaseTimer()
+    # per-phase device fences only when timing was requested — they would
+    # otherwise serialize stage compile/dispatch against device execution
+    fence = ((lambda x: x.block_until_ready()) if phases is not None
+             else (lambda x: None))
     distributed = a.grid is not None and a.grid.num_devices > 1
-    ah = mops.hermitianize(a, uplo)
-    red = reduction_to_band(ah)
-    band = extract_band(red)
-    tri = band_to_tridiag(band, red.band)
-    lam, z = tridiag_solver(tri.d, tri.e, nb)
-    if distributed:
-        zm = Matrix.from_global(np.asarray(z), a.block_size, grid=a.grid,
-                                source_rank=a.dist.source_rank)
-        zb = bt_band_to_tridiag(tri, zm)
-        vecs = bt_reduction_to_band(red, zb)
-    else:
-        zb = bt_band_to_tridiag(tri, z)
-        zf = bt_reduction_to_band(red, zb)
-        vecs = Matrix.from_global(np.asarray(zf), a.block_size, grid=a.grid,
-                                  source_rank=a.dist.source_rank)
+    with pt.phase("reduction_to_band"):
+        ah = mops.hermitianize(a, uplo)
+        red = reduction_to_band(ah)
+        fence(red.matrix.storage)
+    with pt.phase("band_to_tridiag"):
+        band = extract_band(red)
+        tri = band_to_tridiag(band, red.band)
+    with pt.phase("tridiag_solver"):
+        lam, z = tridiag_solver(tri.d, tri.e, nb)
+    with pt.phase("bt_band_to_tridiag"):
+        if distributed:
+            zb = bt_band_to_tridiag(
+                tri, Matrix.from_global(np.asarray(z), a.block_size,
+                                        grid=a.grid,
+                                        source_rank=a.dist.source_rank))
+            fence(zb.storage)
+        else:
+            zb = bt_band_to_tridiag(tri, z)
+            fence(zb)
+    with pt.phase("bt_reduction_to_band"):
+        out = bt_reduction_to_band(red, zb)
+        if distributed:
+            vecs = out
+            fence(vecs.storage)
+        else:
+            vecs = Matrix.from_global(np.asarray(out), a.block_size,
+                                      grid=a.grid,
+                                      source_rank=a.dist.source_rank)
     return EigensolverResult(lam, vecs)
 
 
-def gen_eigensolver(uplo: str, a: Matrix, b: Matrix) -> EigensolverResult:
+def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
+                    phases: Optional[PhaseTimer] = None) -> EigensolverResult:
     """Generalized problem ``A x = lambda B x`` with Hermitian ``a`` and
     HPD ``b`` (reference ``eigensolver::genEigensolver``, ``api.h:17-21``;
     LOCAL-only in the reference — here every stage also runs distributed)."""
     dlaf_assert(a.size == b.size, "gen_eigensolver: A/B size mismatch")
-    bf = cholesky(uplo, b)
-    astd = gen_to_std(uplo, a, bf)
-    res = eigensolver(uplo, astd)
+    pt = phases if phases is not None else PhaseTimer()
+    fence = ((lambda x: x.block_until_ready()) if phases is not None
+             else (lambda x: None))
+    with pt.phase("cholesky"):
+        bf = cholesky(uplo, b)
+        fence(bf.storage)
+    with pt.phase("gen_to_std"):
+        astd = gen_to_std(uplo, a, bf)
+        fence(astd.storage)
+    res = eigensolver(uplo, astd, phases=phases)
     # back-substitute eigenvectors (reference gen_eigensolver/impl.h:24-35):
     # uplo=L: B = L L^H, standard vec y -> x = L^-H y
     # uplo=U: B = U^H U,                x = U^-1 y
-    if uplo == "L":
-        vecs = triangular_solve("L", "L", "C", "N", 1.0, bf, res.eigenvectors)
-    else:
-        vecs = triangular_solve("L", "U", "N", "N", 1.0, bf, res.eigenvectors)
+    with pt.phase("back_substitution"):
+        if uplo == "L":
+            vecs = triangular_solve("L", "L", "C", "N", 1.0, bf, res.eigenvectors)
+        else:
+            vecs = triangular_solve("L", "U", "N", "N", 1.0, bf, res.eigenvectors)
+        fence(vecs.storage)
     return EigensolverResult(res.eigenvalues, vecs)

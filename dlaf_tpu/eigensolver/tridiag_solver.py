@@ -31,10 +31,25 @@ _EPS = np.finfo(np.float64).eps
 
 # Above this deflated-problem size the secular solve and the O(k^2)
 # z-refinement run on the device (HBM-bound batched math). Below it the host
-# path wins: the native C++ Newton solver (secular.cpp) is O(iters*k) per
-# root with a small constant (~50ms at k=2000 vs ~4s for the numpy
-# bisection), so only the k^2 log-sum refinement is left to amortize.
+# path wins — but only when the native C++ Newton solver (secular.cpp,
+# O(iters*k) per root, ~50ms at k=2000) actually loaded; with the numpy
+# bisection fallback (~4s at k=2000) the device takes over much earlier.
 _DEVICE_SECULAR_MIN_K = 4096
+_DEVICE_SECULAR_MIN_K_NO_NATIVE = 1024
+
+
+def _device_secular_min_k() -> int:
+    from ..config import get_configuration
+
+    if get_configuration().secular_impl == "native":
+        try:
+            from ..native import bindings
+
+            bindings.get_lib()
+            return _DEVICE_SECULAR_MIN_K
+        except Exception:
+            pass
+    return _DEVICE_SECULAR_MIN_K_NO_NATIVE
 
 
 def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
@@ -212,7 +227,7 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
         else:
             dsk = ds[idx_live]
             zsk = zs[idx_live]
-            if (use_device and k >= _DEVICE_SECULAR_MIN_K
+            if (use_device and k >= _device_secular_min_k()
                     and jax.config.jax_enable_x64):
                 lam_j, vcols_j = _secular_vcols_device(
                     jnp.asarray(dsk), jnp.asarray(zsk), jnp.float64(rho_n))

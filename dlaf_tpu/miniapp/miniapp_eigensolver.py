@@ -66,15 +66,21 @@ def run(argv=None) -> list[dict]:
 
     backend = devices[0].platform
     results = []
+    from ..common.timer import PhaseTimer
+
     for run_i in range(-opts.nwarmups, opts.nruns):
+        ptimer = PhaseTimer(config.get_configuration().profile_dir or None)
         a_in = am.with_storage(am.storage + 0)
         a_in.storage.block_until_ready()
         t0 = time.perf_counter()
-        if args.generalized:
-            res = gen_eigensolver(args.uplo, a_in, bm)
-        else:
-            res = eigensolver(args.uplo, a_in)
-        res.eigenvectors.storage.block_until_ready()
+        try:
+            if args.generalized:
+                res = gen_eigensolver(args.uplo, a_in, bm, phases=ptimer)
+            else:
+                res = eigensolver(args.uplo, a_in, phases=ptimer)
+            res.eigenvectors.storage.block_until_ready()
+        finally:
+            ptimer.stop()
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, 5 * n**3 / 3, 5 * n**3 / 3) / t / 1e9
         if run_i < 0:
@@ -84,6 +90,8 @@ def run(argv=None) -> list[dict]:
               f"{type_letter(opts.dtype)}{args.uplo} {name} ({n}, {n}) "
               f"({nb}, {nb}) ({opts.grid_rows}, {opts.grid_cols}) "
               f"{os.cpu_count()} {backend}", flush=True)
+        phase_str = " ".join(f"{k}={v:.4f}s" for k, v in ptimer.report().items())
+        print(f"[{run_i}] phases: {phase_str}", flush=True)
         results.append({"run": run_i, "time_s": t, "gflops": gflops})
         last = run_i == opts.nruns - 1
         if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):

@@ -60,7 +60,7 @@ def test_cholesky_local(uplo, n, nb, dtype):
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
 @pytest.mark.parametrize("uplo", ["L", "U"])
-@pytest.mark.parametrize("trailing", ["biggemm", "invgemm"])
+@pytest.mark.parametrize("trailing", ["biggemm", "invgemm", "xla"])
 @pytest.mark.parametrize("n,nb", [(32, 8), (29, 8)])
 def test_cholesky_local_trailing_variants(uplo, trailing, n, nb, dtype, monkeypatch):
     """MXU-shaped trailing-update strategies must match the reference loop
