@@ -86,12 +86,22 @@ def run_bench() -> None:
     from dlaf_tpu.algorithms.cholesky import VALID_TRAILING
 
     pinned = os.environ.get("DLAF_BENCH_TRAILING")
-    variants = [pinned] if pinned else list(VALID_TRAILING)
+    # likely winner first: if the time budget runs out (or the accelerator
+    # tunnel wedges mid-sweep) a usable measurement has already landed
+    order = ["xla", "biggemm", "loop", "invgemm"]
+    variants = [pinned] if pinned else \
+        [v for v in order if v in VALID_TRAILING] + \
+        [v for v in VALID_TRAILING if v not in order]
+    budget_s = float(os.environ.get("DLAF_BENCH_BUDGET", "1500"))
 
     import dlaf_tpu.config as config
 
     best, best_variant = 0.0, variants[0]
-    for variant in variants:
+    sweep_t0 = time.perf_counter()
+    for vi, variant in enumerate(variants):
+        if vi > 0 and time.perf_counter() - sweep_t0 > budget_s:
+            log(f"budget {budget_s}s exhausted; skipping {variants[vi:]}")
+            break
         os.environ["DLAF_CHOLESKY_TRAILING"] = variant
         config.initialize()
         try:

@@ -1,0 +1,73 @@
+"""Matrix persistence via orbax (application-owned checkpoint hook).
+
+The reference has NO checkpoint subsystem (SURVEY §5): applications own
+persistence by wrapping user memory (``matrix/matrix.h:94-109``). This module
+keeps the same stance — nothing in the algorithms checkpoints — but makes the
+application hook concrete for the JAX ecosystem: a distributed
+:class:`~dlaf_tpu.matrix.matrix.Matrix` round-trips through an orbax
+checkpoint (sharded tile storage + the Distribution metadata needed to
+rebuild it on any grid of the same shape).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..comm.grid import Grid
+from ..common.asserts import dlaf_assert
+from ..common.index2d import GlobalElementSize, RankIndex2D, TileElementSize
+from .matrix import Matrix
+
+
+def save(path: str, mat: Matrix) -> None:
+    """Write ``mat`` (storage + layout metadata) to ``path`` (a directory)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    tree = {
+        "storage": mat.storage,
+        "meta": {
+            "size": np.array([mat.size.row, mat.size.col], dtype=np.int64),
+            "block_size": np.array([mat.block_size.row, mat.block_size.col],
+                                   dtype=np.int64),
+            "grid_size": np.array([mat.dist.grid_size.row,
+                                   mat.dist.grid_size.col], dtype=np.int64),
+            "source_rank": np.array([mat.dist.source_rank.row,
+                                     mat.dist.source_rank.col], dtype=np.int64),
+        },
+    }
+    with ocp.PyTreeCheckpointer() as ckpt:
+        ckpt.save(path, tree, force=True)
+
+
+def load(path: str, grid: Optional[Grid] = None) -> Matrix:
+    """Rebuild a Matrix from ``path``. ``grid`` must match the saved grid
+    shape (or be omitted for a matrix saved without a grid)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.PyTreeCheckpointer() as ckpt:
+        tree = ckpt.restore(path)
+    meta = tree["meta"]
+    gr, gc = (int(x) for x in meta["grid_size"])
+    if grid is None:
+        dlaf_assert(gr * gc == 1,
+                    f"checkpoint was saved on a {gr}x{gc} grid; pass grid=")
+    else:
+        dlaf_assert((grid.size.row, grid.size.col) == (gr, gc),
+                    f"grid {grid.size} != saved {gr}x{gc}")
+    size = GlobalElementSize(*(int(x) for x in meta["size"]))
+    block = TileElementSize(*(int(x) for x in meta["block_size"]))
+    src = RankIndex2D(*(int(x) for x in meta["source_rank"]))
+    from .matrix import _make_dist
+
+    dist = _make_dist(size, block, grid, src)
+    storage = tree["storage"]
+    if grid is not None and grid.num_devices > 1:
+        import jax
+
+        storage = jax.device_put(storage, grid.tile_sharding())
+    return Matrix(dist, storage, grid)
