@@ -1,0 +1,21 @@
+"""L6 algorithms — public API (reference's free-function layer:
+``factorization::cholesky``, ``solver::triangular``,
+``multiplication::triangular``/``general``, ``eigensolver::genToStd``,
+``permutations::permute``, ``auxiliary::norm``)."""
+
+from .cholesky import cholesky
+from .gen_to_std import gen_to_std
+from .general import general_sub_multiply
+from .norm import max_norm
+from .permutations import permute
+from .triangular import triangular_multiply, triangular_solve
+
+__all__ = [
+    "cholesky",
+    "gen_to_std",
+    "general_sub_multiply",
+    "max_norm",
+    "permute",
+    "triangular_multiply",
+    "triangular_solve",
+]

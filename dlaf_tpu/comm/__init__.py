@@ -1,0 +1,6 @@
+"""L4 communication — public API (reference ``communication/``:
+CommunicatorGrid + collective verbs over mesh axes)."""
+
+from .grid import COL_AXIS, ROW_AXIS, Grid
+
+__all__ = ["COL_AXIS", "ROW_AXIS", "Grid"]

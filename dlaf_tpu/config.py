@@ -35,10 +35,13 @@ class Configuration:
     #: safeguarded Newton, the laed4 analog) with fallback to "numpy"
     #: (vectorized bisection).
     secular_impl: str = "native"
-    #: Look-ahead depth for panel pipelining in distributed factorizations
-    #: (analog of the reference's round-robin workspace count,
-    #: ``factorization/cholesky/impl.h:187-189``).
-    lookahead: int = 2
+    #: Deflated-merge size above which the D&C secular solve + z-refinement
+    #: run on the device (see eigensolver/tridiag_solver.py; the threshold
+    #: drops automatically when the native host solver failed to build).
+    #: The reference's look-ahead/round-robin workspace knobs
+    #: (``factorization/cholesky/impl.h:187-189``) have no analog here:
+    #: XLA sees the whole step DAG at compile time and owns the overlap.
+    secular_device_min_k: int = 4096
     #: Local Cholesky trailing-update strategy: "loop" (exact-flop per-column
     #: herk/gemm, the reference's task shape), "biggemm" (ONE masked full
     #: trailing gemm per step — 2x flops on the strict triangle but a single

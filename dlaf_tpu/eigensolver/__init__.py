@@ -1,0 +1,21 @@
+"""Eigensolver pipeline — public API (reference ``eigensolver.h:13-19``
+umbrella: reductionToBand, bandToTridiag, tridiagSolver,
+backTransformation*, eigensolver, genEigensolver)."""
+
+from .back_transform import bt_band_to_tridiag, bt_reduction_to_band
+from .band_to_tridiag import band_to_tridiag
+from .eigensolver import EigensolverResult, eigensolver, gen_eigensolver
+from .reduction_to_band import extract_band, reduction_to_band
+from .tridiag_solver import tridiag_solver
+
+__all__ = [
+    "EigensolverResult",
+    "band_to_tridiag",
+    "bt_band_to_tridiag",
+    "bt_reduction_to_band",
+    "eigensolver",
+    "extract_band",
+    "gen_eigensolver",
+    "reduction_to_band",
+    "tridiag_solver",
+]

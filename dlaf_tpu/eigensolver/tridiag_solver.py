@@ -34,22 +34,23 @@ _EPS = np.finfo(np.float64).eps
 # path wins — but only when the native C++ Newton solver (secular.cpp,
 # O(iters*k) per root, ~50ms at k=2000) actually loaded; with the numpy
 # bisection fallback (~4s at k=2000) the device takes over much earlier.
-_DEVICE_SECULAR_MIN_K = 4096
+# The configured default lives in config.Configuration.secular_device_min_k.
 _DEVICE_SECULAR_MIN_K_NO_NATIVE = 1024
 
 
 def _device_secular_min_k() -> int:
     from ..config import get_configuration
 
-    if get_configuration().secular_impl == "native":
+    cfg = get_configuration()
+    if cfg.secular_impl == "native":
         try:
             from ..native import bindings
 
             bindings.get_lib()
-            return _DEVICE_SECULAR_MIN_K
+            return cfg.secular_device_min_k
         except Exception:
             pass
-    return _DEVICE_SECULAR_MIN_K_NO_NATIVE
+    return min(cfg.secular_device_min_k, _DEVICE_SECULAR_MIN_K_NO_NATIVE)
 
 
 def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):

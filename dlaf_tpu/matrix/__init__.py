@@ -1,0 +1,7 @@
+"""L3 matrix model — public API (reference ``matrix/``: Matrix,
+Distribution, LayoutInfo, Panel, views, mirror, copy, print)."""
+
+from .distribution import Distribution
+from .matrix import Matrix
+
+__all__ = ["Distribution", "Matrix"]

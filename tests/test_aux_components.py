@@ -81,6 +81,27 @@ def test_miniapp_kernel_and_band():
     assert len(res) == 1
 
 
+def test_public_api_surface():
+    """The reference's free-function layer is reachable from the subpackage
+    roots (user-facing API contract)."""
+    import numpy as np
+
+    import dlaf_tpu.algorithms as alg
+    import dlaf_tpu.eigensolver as eig
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix import Matrix
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 16))
+    a = x @ x.T + 16 * np.eye(16)
+    m = Matrix.from_global(a, TileElementSize(4, 4))
+    out = alg.cholesky("L", m).to_numpy()
+    l = np.tril(out)
+    assert np.linalg.norm(l @ l.T - a) < 1e-10 * np.linalg.norm(a)
+    res = eig.eigensolver("L", m)
+    np.testing.assert_allclose(res.eigenvalues, np.linalg.eigvalsh(a), atol=1e-9)
+
+
 def test_checkpoint_roundtrip(tmp_path, devices8):
     """Matrix -> orbax checkpoint -> Matrix, local and distributed
     (the application-owned persistence hook; the reference has no

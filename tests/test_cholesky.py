@@ -58,6 +58,18 @@ def test_cholesky_local(uplo, n, nb, dtype):
     check_factor(uplo, a, out, dtype)
 
 
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_cholesky_distributed_col_major_grid(uplo, devices8):
+    """Algorithms must be ordering-agnostic: the reference's 6-rank fixture
+    includes a col-major 2x3 grid (grids_6_ranks.h); here a col-major 2x4."""
+    n, nb = 24, 4
+    a = hpd_matrix(n, np.float64)
+    grid = Grid(2, 4, ordering="col-major")
+    out = cholesky(uplo, Matrix_from(a, nb, grid=grid,
+                                     src=RankIndex2D(1, 2))).to_numpy()
+    check_factor(uplo, a, out, np.float64)
+
+
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
 @pytest.mark.parametrize("uplo", ["L", "U"])
 @pytest.mark.parametrize("trailing", ["biggemm", "invgemm", "xla"])

@@ -6,25 +6,25 @@ import dlaf_tpu.config as C
 def test_defaults():
     cfg = C.update_configuration()
     assert cfg.grid_ordering == "row-major"
-    assert cfg.lookahead == 2
+    assert cfg.secular_device_min_k == 4096
 
 
 def test_user_struct_layer():
-    cfg = C.update_configuration(C.Configuration(lookahead=3))
-    assert cfg.lookahead == 3
+    cfg = C.update_configuration(C.Configuration(secular_device_min_k=3))
+    assert cfg.secular_device_min_k == 3
 
 
 def test_env_overrides_user(monkeypatch):
-    monkeypatch.setenv("DLAF_LOOKAHEAD", "4")
-    cfg = C.update_configuration(C.Configuration(lookahead=3))
-    assert cfg.lookahead == 4
+    monkeypatch.setenv("DLAF_SECULAR_DEVICE_MIN_K", "4")
+    cfg = C.update_configuration(C.Configuration(secular_device_min_k=3))
+    assert cfg.secular_device_min_k == 4
 
 
 def test_cli_overrides_env(monkeypatch):
-    monkeypatch.setenv("DLAF_LOOKAHEAD", "4")
-    cfg = C.update_configuration(C.Configuration(lookahead=3),
-                                 argv=["--dlaf:lookahead=5", "ignored", "--other"])
-    assert cfg.lookahead == 5
+    monkeypatch.setenv("DLAF_SECULAR_DEVICE_MIN_K", "4")
+    cfg = C.update_configuration(C.Configuration(secular_device_min_k=3),
+                                 argv=["--dlaf:secular-device-min-k=5", "ignored", "--other"])
+    assert cfg.secular_device_min_k == 5
 
 
 def test_cli_bool_and_dashes(monkeypatch):

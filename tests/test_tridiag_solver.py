@@ -135,12 +135,18 @@ def test_device_secular_path(monkeypatch):
     check it reproduces the host branch + a correct decomposition."""
     from dlaf_tpu.eigensolver import tridiag_solver as ts_mod
 
+    import dlaf_tpu.config as config
+
     rng = np.random.default_rng(10)
     n = 64
     d = rng.standard_normal(n)
     e = rng.standard_normal(n - 1)
     l_host, _ = tridiag_solver(d, e, 16, use_device=False)
-    monkeypatch.setattr(ts_mod, "_DEVICE_SECULAR_MIN_K", 1)
+    monkeypatch.setenv("DLAF_SECULAR_DEVICE_MIN_K", "1")
+    config.initialize()
+    assert ts_mod._device_secular_min_k() == 1
     lam, q = tridiag_solver(d, e, 16, use_device=True)
+    monkeypatch.delenv("DLAF_SECULAR_DEVICE_MIN_K")
+    config.initialize()
     check(d, e, lam, q)
     np.testing.assert_allclose(lam, l_host, atol=1e-11)
