@@ -133,7 +133,9 @@ def test_secular_impl_config(monkeypatch):
 def test_device_secular_path(monkeypatch):
     """Force the device secular/refinement branch (used for big merges) and
     check it reproduces the host branch + a correct decomposition."""
-    from dlaf_tpu.eigensolver import tridiag_solver as ts_mod
+    import importlib
+
+    ts_mod = importlib.import_module("dlaf_tpu.eigensolver.tridiag_solver")
 
     import dlaf_tpu.config as config
 
