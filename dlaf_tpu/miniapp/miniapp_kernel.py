@@ -23,7 +23,7 @@ from .. import config
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
 from ..types import total_ops, type_letter
-from .options import add_miniapp_arguments, parse_miniapp_options
+from .options import add_miniapp_arguments, parse_miniapp_options, select_devices
 
 
 def build_parser():
@@ -40,6 +40,7 @@ def run(argv=None):
     args, extra = build_parser().parse_known_args(argv)
     config.initialize(argv=extra)
     opts = parse_miniapp_options(args)
+    select_devices(opts)
     m, batch = args.tile_size, args.batch
     dtype = opts.dtype
     rng = np.random.default_rng(0)
