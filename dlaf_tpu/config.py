@@ -50,6 +50,15 @@ class Configuration:
     #: solve), or "xla" (delegate the whole local factorization to XLA's
     #: fused native cholesky). Benchmarked per hardware; see bench.py.
     cholesky_trailing: str = "loop"
+    #: bt_band_to_tridiag reflector application: "blocked" (compact-WY
+    #: staircase groups -> larft + two gemms per step level, the MXU form of
+    #: the reference's b x b HH re-tiling) or "sweeps" (one batched rank-1
+    #: segment update per sweep).
+    bt_b2t_impl: str = "blocked"
+    #: Sweeps per compact-WY group for bt_b2t_impl="blocked"; 0 = band size
+    #: (the reference's group shape). Clamped to [1, min(band+1, n_sweeps)]
+    #: — band+1 is the disjointness bound of the blocked level reordering.
+    bt_b2t_group: int = 0
     #: Enable float64/complex128 support (sets jax_enable_x64).
     enable_x64: bool = True
     #: When non-empty, miniapps emit XLA/PJRT execution profiles

@@ -59,6 +59,62 @@ from .band_to_tridiag import TridiagResult
 from .reduction_to_band import BandReduction
 
 
+@functools.partial(jax.jit, static_argnames=("b", "n", "group"))
+def _bt_b2t_blocked(v_all, tau_all, e, *, b: int, n: int, group: int):
+    """E <- Q E via blocked compact-WY groups — the MXU form of the
+    reference's cache-friendly b x b HH re-tiling (``bt_band_to_tridiag/
+    impl.h``: larft + trmm/gemm per group, vs. our sweep-at-a-time scan's
+    rank-1 row updates).
+
+    ``group`` (= G <= b) consecutive sweeps' reflectors at one chase step
+    level form a (b+G-1) x G staircase V (column j = sweep s0+j's reflector
+    at row offset j; v[0]=1 heads land on the staircase diagonal). Validity
+    of the reordering: reflector (s, t) overlaps (s+k, t-1) for k >= 1
+    (1..k shared rows) so a lower level containing HIGHER sweeps must be
+    applied first — and (s, t-1) is row-disjoint from (s+k, t), so applying
+    whole levels ascending preserves the required "sweep s+1 fully before
+    sweep s" order. Cross-level pairs separated by >= 2 steps are disjoint
+    whenever G <= b+1 (enforced). Each level is then T = larft(V) and two
+    tall gemms instead of G separate rank-1 updates.
+    """
+    dlaf_assert(group <= b + 1, "bt_b2t blocked: group must be <= band+1")
+    n_sweeps, n_steps, _ = v_all.shape
+    m = e.shape[1]
+    G = group
+    nblk = ceil_div(n_sweeps, G)
+    S = nblk * G
+    v_all = jnp.pad(v_all, ((0, S - n_sweeps), (0, 0), (0, 0)))
+    tau_all = jnp.pad(tau_all, ((0, S - n_sweeps), (0, 0)))
+    L = b + G - 1
+    rows = S + n_steps * b + b
+    e_pad = jnp.pad(e, ((0, rows - n), (0, 0)))
+
+    # iteration sequence in application order: sweep blocks descending,
+    # step levels ascending within a block
+    v_seq = v_all.reshape(nblk, G, n_steps, b)[::-1].transpose(0, 2, 1, 3) \
+        .reshape(nblk * n_steps, G, b)
+    tau_seq = tau_all.reshape(nblk, G, n_steps)[::-1].transpose(0, 2, 1) \
+        .reshape(nblk * n_steps, G)
+    blk_idx = jnp.repeat(jnp.arange(nblk - 1, -1, -1), n_steps)
+    t_idx = jnp.tile(jnp.arange(n_steps), nblk)
+    base_seq = blk_idx * G + 1 + t_idx * b
+    col_off = jnp.arange(G)
+
+    def body(e_pad, xs):
+        vcols, taus, base = xs
+        stair = jax.vmap(
+            lambda vj, j: lax.dynamic_update_slice(
+                jnp.zeros((L,), vcols.dtype), vj, (j,)))(vcols, col_off).T
+        t_mat = larft(stair, jnp.conj(taus))
+        seg = lax.dynamic_slice(e_pad, (base, 0), (L, m))
+        w = t_mat @ (jnp.conj(stair).T @ seg)
+        seg = seg - stair @ w
+        return lax.dynamic_update_slice(e_pad, seg, (base, 0)), None
+
+    e_pad, _ = lax.scan(body, e_pad, (v_seq, tau_seq, base_seq))
+    return e_pad[:n]
+
+
 @functools.partial(jax.jit, static_argnames=("b", "n"))
 def _bt_b2t_scan(v_all, tau_all, e, *, b: int, n: int):
     """E <- Q E with Q = prod over reflectors H^H in reverse sweep order."""
@@ -84,7 +140,34 @@ def _bt_b2t_scan(v_all, tau_all, e, *, b: int, n: int):
     return e_pad[:n]
 
 
-def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int):
+def _bt_b2t_params():
+    """(impl, group) from config: how to apply the chase reflectors."""
+    from ..config import get_configuration
+
+    cfg = get_configuration()
+    dlaf_assert(cfg.bt_b2t_impl in ("blocked", "sweeps"),
+                f"bt_b2t_impl must be 'blocked' or 'sweeps', got {cfg.bt_b2t_impl!r}")
+    return cfg.bt_b2t_impl, cfg.bt_b2t_group
+
+
+def _effective_group(b: int, n_sweeps: int, group: int) -> int:
+    """Effective compact-WY group size: 0 means band size; values are
+    clamped to [1, min(band+1, n_sweeps)] (the disjointness bound of the
+    level reordering; see _bt_b2t_blocked)."""
+    g = group if group > 0 else b
+    return max(1, min(g, b + 1, n_sweeps))
+
+
+def _apply_chase_reflectors(v_all, tau_all, e, *, b: int, n: int,
+                            impl: str, group: int):
+    if impl == "blocked":
+        g = _effective_group(b, int(v_all.shape[0]), group)
+        return _bt_b2t_blocked(v_all, tau_all, e, b=b, n=n, group=g)
+    return _bt_b2t_scan(v_all, tau_all, e, b=b, n=n)
+
+
+def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int,
+                       impl: str = "blocked", group: int = 0):
     """Distributed chase back-transform: two layout transposes around the
     purely local sweep scan (see module docstring)."""
     n = dist.size.row
@@ -120,7 +203,8 @@ def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int):
         if cplx:
             e = e * phase[:, None]
         if n_sweeps:
-            e = _bt_b2t_scan(v_all, tau_all, e, b=b, n=n)
+            e = _apply_chase_reflectors(v_all, tau_all, e, b=b, n=n,
+                                        impl=impl, group=group)
         e = jnp.pad(e, ((0, Sr * nb - n), (0, 0)))
         x = e.reshape(Sr, nb, chunk, nb).transpose(0, 2, 1, 3)
         x = x[inv_order]
@@ -133,9 +217,10 @@ def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _dist_bt_b2t_cached(dist, mesh, b, cplx, n_sweeps):
+def _dist_bt_b2t_cached(dist, mesh, b, cplx, n_sweeps, impl, group):
     return jax.jit(_build_dist_bt_b2t(dist, mesh, b=b, cplx=cplx,
-                                      n_sweeps=n_sweeps))
+                                      n_sweeps=n_sweeps, impl=impl,
+                                      group=group))
 
 
 def _bt_b2t_local_array(tri: TridiagResult, e) -> jax.Array:
@@ -146,8 +231,9 @@ def _bt_b2t_local_array(tri: TridiagResult, e) -> jax.Array:
         e = e.astype(tri.v.dtype) * jnp.asarray(tri.phase)[:, None]
     if tri.v.shape[0] == 0:
         return e
-    return _bt_b2t_scan(jnp.asarray(tri.v), jnp.asarray(tri.tau), e,
-                        b=tri.band, n=n)
+    impl, group = _bt_b2t_params()
+    return _apply_chase_reflectors(jnp.asarray(tri.v), jnp.asarray(tri.tau),
+                                   e, b=tri.band, n=n, impl=impl, group=group)
 
 
 def bt_band_to_tridiag(tri: TridiagResult, evecs):
@@ -172,8 +258,13 @@ def bt_band_to_tridiag(tri: TridiagResult, evecs):
     storage = evecs.storage
     if cplx and not np.issubdtype(storage.dtype, np.complexfloating):
         storage = storage.astype(tri.v.dtype)
+    impl, group = _bt_b2t_params()
+    # normalized cache key: group is pre-clamped and irrelevant for "sweeps",
+    # so equivalent configurations share one compiled program
+    n_sweeps = int(tri.v.shape[0])
+    group = _effective_group(tri.band, n_sweeps, group) if impl == "blocked" else 0
     fn = _dist_bt_b2t_cached(evecs.dist, evecs.grid.mesh, tri.band, cplx,
-                             int(tri.v.shape[0]))
+                             n_sweeps, impl, group)
     out = fn(jnp.asarray(tri.v), jnp.asarray(tri.tau),
              jnp.asarray(tri.phase), storage)
     return Matrix(evecs.dist, out, evecs.grid)
