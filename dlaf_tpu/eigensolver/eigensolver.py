@@ -45,7 +45,8 @@ class EigensolverResult:
 
 
 def eigensolver(uplo: str, a: Matrix,
-                phases: Optional[PhaseTimer] = None) -> EigensolverResult:
+                phases: Optional[PhaseTimer] = None,
+                band_size: int | None = None) -> EigensolverResult:
     """Eigendecomposition of Hermitian ``a`` stored in ``uplo``
     (reference ``eigensolver::eigensolver``, ``api.h:28-31``).
 
@@ -68,9 +69,12 @@ def eigensolver(uplo: str, a: Matrix,
     fence = ((lambda x: x.block_until_ready()) if phases is not None
              else (lambda x: None))
     distributed = a.grid is not None and a.grid.num_devices > 1
+    dlaf_assert(band_size is None or band_size == nb or not distributed,
+                "eigensolver: band_size != block size is local-only "
+                "(distributed reduction_to_band restriction)")
     with pt.phase("reduction_to_band"):
         ah = mops.hermitianize(a, uplo)
-        red = reduction_to_band(ah)
+        red = reduction_to_band(ah, band_size=band_size)
         fence(red.matrix.storage)
     with pt.phase("band_to_tridiag"):
         band = extract_band(red)
@@ -100,7 +104,8 @@ def eigensolver(uplo: str, a: Matrix,
 
 
 def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
-                    phases: Optional[PhaseTimer] = None) -> EigensolverResult:
+                    phases: Optional[PhaseTimer] = None,
+                    band_size: int | None = None) -> EigensolverResult:
     """Generalized problem ``A x = lambda B x`` with Hermitian ``a`` and
     HPD ``b`` (reference ``eigensolver::genEigensolver``, ``api.h:17-21``;
     LOCAL-only in the reference — here every stage also runs distributed)."""
@@ -114,7 +119,7 @@ def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
     with pt.phase("gen_to_std"):
         astd = gen_to_std(uplo, a, bf)
         fence(astd.storage)
-    res = eigensolver(uplo, astd, phases=phases)
+    res = eigensolver(uplo, astd, phases=phases, band_size=band_size)
     # back-substitute eigenvectors (reference gen_eigensolver/impl.h:24-35):
     # uplo=L: B = L L^H, standard vec y -> x = L^-H y
     # uplo=U: B = U^H U,                x = U^-1 y

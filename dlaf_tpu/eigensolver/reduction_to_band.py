@@ -66,6 +66,9 @@ class BandReduction:
 
 @functools.partial(jax.jit, static_argnames=("nb",))
 def _red2band_local(a, *, nb: int):
+    """Panels of width ``nb`` = the target bandwidth (any 1 <= nb <= n; the
+    reference's local variant likewise supports band_size < block size,
+    ``reduction_to_band.h:78-87`` with ``mb % band_size == 0``)."""
     n = a.shape[0]
     nt = ceil_div(n, nb) if n else 0
     taus_out = jnp.zeros((max(nt - 1, 0), nb), dtype=a.dtype)
@@ -215,17 +218,33 @@ def _dist_red2band_cached(dist, mesh, dtype):
 # Public API (reference eigensolver/reduction_to_band.h)
 # ---------------------------------------------------------------------------
 
-def reduction_to_band(a: Matrix) -> BandReduction:
-    """Reduce Hermitian ``a`` (FULL storage — both triangles) to band form
-    with bandwidth = block size. Local or distributed per ``a.grid``."""
+def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
+    """Reduce Hermitian ``a`` (FULL storage — both triangles) to band form.
+
+    ``band_size`` (default: block size) sets the bandwidth; like the
+    reference (``reduction_to_band.h:78-87``) the local variant accepts any
+    ``band_size`` dividing the block size, while the distributed variant
+    supports only ``band_size == block size`` (the reference raises the same
+    restriction, ``miniapp_reduction_to_band.cpp:60``). Smaller bands shift
+    work from the host bulge-chasing stage (O(n^2 b)) into this stage's
+    device gemms — the standard two-stage tradeoff knob.
+    """
     dlaf_assert(a.size.row == a.size.col, "reduction_to_band: square only")
     dlaf_assert(a.block_size.row == a.block_size.col, "square blocks only")
     nb = a.block_size.row
+    band = nb if band_size is None else band_size
+    dlaf_assert(band >= 1, f"reduction_to_band: band_size must be >= 1, got {band}")
+    dlaf_assert(nb % band == 0,
+                f"reduction_to_band: block size {nb} not divisible by band_size {band}"
+                " (reference reduction_to_band.h:84)")
     if a.grid is None or a.grid.num_devices == 1:
         g = tiles_to_global(a.storage, a.dist)
-        out, taus = _red2band_local(g, nb=nb)
+        out, taus = _red2band_local(g, nb=band)
         return BandReduction(a.with_storage(global_to_tiles(out, a.dist)),
-                             taus, nb)
+                             taus, band)
+    dlaf_assert(band == nb,
+                "reduction_to_band: distributed variant supports only "
+                "band_size == block size (same restriction as the reference)")
     fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name)
     storage, taus = fn(a.storage)
     return BandReduction(a.with_storage(storage), taus, nb)

@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--uplo", choices=["L", "U"], default="L")
     p.add_argument("--generalized", action="store_true",
                    help="solve A x = lambda B x (miniapp_gen_eigensolver)")
+    p.add_argument("--band-size", type=int, default=-1,
+                   help="reduction bandwidth; negative = block-size "
+                        "(must divide block-size; local grids only)")
     add_miniapp_arguments(p)
     return p
 
@@ -49,6 +52,7 @@ def run(argv=None) -> list[dict]:
     devices = select_devices(opts)
 
     n, nb = args.matrix_size, args.block_size
+    band = None if args.band_size < 0 else args.band_size
     size = GlobalElementSize(n, n)
     block = TileElementSize(nb, nb)
 
@@ -80,9 +84,11 @@ def run(argv=None) -> list[dict]:
         t0 = time.perf_counter()
         try:
             if args.generalized:
-                res = gen_eigensolver(args.uplo, a_in, bm, phases=phases)
+                res = gen_eigensolver(args.uplo, a_in, bm, phases=phases,
+                                      band_size=band)
             else:
-                res = eigensolver(args.uplo, a_in, phases=phases)
+                res = eigensolver(args.uplo, a_in, phases=phases,
+                                  band_size=band)
             res.eigenvectors.storage.block_until_ready()
         finally:
             ptimer.stop()

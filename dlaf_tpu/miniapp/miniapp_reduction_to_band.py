@@ -31,7 +31,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-m", "--matrix-size", type=int, default=4096)
     p.add_argument("-b", "--block-size", type=int, default=256,
-                   help="tile size == band size (reference --block-size)")
+                   help="tile size (reference --block-size)")
+    p.add_argument("--band-size", type=int, default=-1,
+                   help="bandwidth; negative = block-size (reference "
+                        "--band-size; must divide block-size, local grids "
+                        "only when != block-size)")
     add_miniapp_arguments(p)
     return p
 
@@ -43,6 +47,7 @@ def run(argv=None) -> list[dict]:
     devices = select_devices(opts)
 
     n, nb = args.matrix_size, args.block_size
+    band = nb if args.band_size < 0 else args.band_size
     grid = Grid(opts.grid_rows, opts.grid_cols, devices=devices,
                 ordering=config.get_configuration().grid_ordering)
     use_grid = None if grid.num_devices == 1 else grid
@@ -59,7 +64,7 @@ def run(argv=None) -> list[dict]:
         mat = ref.with_storage(ref.storage + 0)
         mat.storage.block_until_ready()
         t0 = time.perf_counter()
-        red = reduction_to_band(mat)
+        red = reduction_to_band(mat, band_size=band)
         red.matrix.storage.block_until_ready()
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, 2 * n**3 / 3, 2 * n**3 / 3) / t / 1e9
@@ -72,16 +77,16 @@ def run(argv=None) -> list[dict]:
         results.append({"run": run_i, "time_s": t, "gflops": gflops})
         last = run_i == opts.nruns - 1
         if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
-            check(ref, red, n, nb)
+            check(ref, red, n, band)
     return results
 
 
-def check(ref, red, n, nb) -> None:
+def check(ref, red, n, band) -> None:
     """Eigenvalues of the band matrix must match the input's."""
     a = ref.to_numpy()
     full = red.matrix.to_numpy()
     bd = np.zeros_like(a)
-    for r in range(nb + 1):
+    for r in range(band + 1):
         d = np.diagonal(full, -r)
         bd += np.diag(d, -r)
         if r:

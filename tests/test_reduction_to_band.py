@@ -77,6 +77,36 @@ def test_red2band_local(n, nb, dtype):
                                atol=1e-10)
 
 
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb,band", [(24, 8, 4), (24, 8, 2), (32, 16, 4),
+                                       (13, 4, 2)])
+def test_red2band_local_band_size(n, nb, band, dtype):
+    """band_size < block size (reference reduction_to_band.h:78-87; must
+    divide the block size): band structure + similarity must hold at the
+    NARROW bandwidth."""
+    a = herm(n, dtype, n + band)
+    mat = Matrix.from_global(a, TileElementSize(nb, nb))
+    red = reduction_to_band(mat, band_size=band)
+    assert red.band == band
+    bd = band_dense(red, n)
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > band
+    assert np.allclose(bd[mask], 0)
+    q = q_from_vt(red, n)
+    np.testing.assert_allclose(q @ q.conj().T, np.eye(n), atol=1e-12)
+    np.testing.assert_allclose(q.conj().T @ a @ q, bd, atol=1e-10)
+    np.testing.assert_allclose(np.linalg.eigvalsh(bd), np.linalg.eigvalsh(a),
+                               atol=1e-10)
+
+
+def test_red2band_band_size_validation():
+    from dlaf_tpu.common.asserts import DlafAssertError
+
+    a = herm(16, np.float64, 1)
+    mat = Matrix.from_global(a, TileElementSize(4, 4))
+    with pytest.raises(DlafAssertError, match="not divisible"):
+        reduction_to_band(mat, band_size=3)  # 4 % 3 != 0
+
+
 def test_extract_band_layout():
     n, nb = 16, 4
     a = herm(n, np.float64, 3)
