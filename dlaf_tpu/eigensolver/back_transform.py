@@ -359,6 +359,10 @@ def bt_reduction_to_band(red: BandReduction, evecs):
     """
     a = red.matrix
     if isinstance(evecs, Matrix) and a.grid is not None and a.grid.num_devices > 1:
+        dlaf_assert(red.band == a.block_size.row,
+                    "bt_reduction_to_band: the distributed back-transform "
+                    "supports only band == block size (reduce locally or "
+                    "with band_size == block size for distributed pipelines)")
         dlaf_assert(evecs.grid is not None
                     and evecs.grid.size == a.grid.size,
                     "bt_reduction_to_band: V and C must share the grid")

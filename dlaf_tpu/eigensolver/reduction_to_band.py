@@ -1,4 +1,6 @@
-"""Reduction of a Hermitian matrix to band form (bandwidth = block size).
+"""Reduction of a Hermitian matrix to band form (bandwidth = block size by
+default; any band_size dividing the block size is supported, distributed
+included).
 
 TPU-native counterpart of the reference's ``eigensolver/reduction_to_band``
 (``api.h:18-22``, ``impl.h``; band = blockSize) plus the QR T-factor
@@ -53,7 +55,8 @@ from ..types import ceil_div
 
 @dataclasses.dataclass
 class BandReduction:
-    """Result: band+V matrix, taus (nt-1, nb), and the bandwidth."""
+    """Result: band+V matrix, taus (ceil(n/band)-1, band) zero-padded, and
+    the bandwidth ``band`` (= block size unless band_size was given)."""
 
     matrix: Matrix
     taus: jax.Array  # (nt-1, nb), zero-padded
@@ -96,75 +99,102 @@ def _red2band_local(a, *, nb: int):
 # Distributed
 # ---------------------------------------------------------------------------
 
-def _build_dist_red2band(dist, mesh, dtype):
+def _build_dist_red2band(dist, mesh, dtype, band):
+    """Distributed reduction with bandwidth ``band`` <= block size (``band``
+    must divide it, so every sub-panel boundary offset is trace-time static).
+
+    Beyond-reference: the reference's distributed variant requires
+    band == block size (``miniapp_reduction_to_band.cpp:60``). Here panel p
+    covers element columns [p*b, (p+1)*b) — a static width-b slice of one
+    tile column — and the elimination boundary (p+1)*b cuts through tiles at
+    a static in-tile offset, so tile-level validity masks simply become
+    element-level masks; everything else (redundant panel factorization,
+    W/M psums, X all_gather) is unchanged from the band == nb scheme.
+    """
     nt = dist.nr_tiles.row
     nb = dist.block_size.row
     n = dist.size.row
+    b = band
+    npan = ceil_div(n, b) - 1 if n else 0
 
-    def full_col_panel(ctx, tiles, k1):
-        """All panel tiles (global tile rows k1..nt-1, ordered) on every rank
-        (shared helper; ``tiles``: my local row tiles of the panel column,
-        already col-broadcast, slots lu.. covering rows >= k1)."""
-        return gather_col_panel_ordered(ctx, tiles, k1, ctx.ltr - tiles.shape[0])
-
-    def step(lt, taus_out, k):
+    def step(lt, taus_out, p):
         ctx = DistContext(dist)
-        k1 = k + 1
-        lu = ctx.row_start(k1)
+        bdy = (p + 1) * b              # first eliminated element row
+        tc = (p * b) // nb             # tile column holding the panel
+        co = (p * b) % nb              # its in-tile column offset
+        tr0 = bdy // nb                # first tile row with panel rows
+        ro = bdy % nb                  # boundary's in-tile row offset
+        lu = ctx.row_start(tr0)
         nrows = ctx.ltr - lu
+        if nrows <= 0:
+            return lt, taus_out
         g_rows = ctx.g_rows(lu, nrows)
-        row_valid = (g_rows >= k1) & (g_rows < nt)
+        arange_nb = jnp.arange(nb)
+        g_erows = g_rows[:, None] * nb + arange_nb[None, :]
+        row_val_e = (g_erows >= bdy) & (g_erows < n)       # (nrows, nb)
 
-        # -- gather the full panel, factor redundantly ----------------------
-        mine = lt[lu:, ctx.kc(k)]
-        mine = jnp.where(row_valid[:, None, None], mine, jnp.zeros_like(mine))
-        mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(k))
-        ptiles = full_col_panel(ctx, mine, k1)          # (nt-k1, nb, nb)
-        m_p = (nt - k1) * nb
-        pan = ptiles.reshape(m_p, nb)
+        # -- gather the full sub-panel, factor redundantly ------------------
+        mine = lt[lu:, ctx.kc(tc), :, co:co + b]
+        mine = jnp.where(row_val_e[:, :, None], mine, jnp.zeros_like(mine))
+        mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
+        ptiles = gather_col_panel_ordered(ctx, mine, tr0, lu)  # (nt-tr0, nb, b)
+        m_full = (nt - tr0) * nb
+        pan = ptiles.reshape(m_full, b)[ro:]
+        m_p = m_full - ro
         vfull, taus = geqrf(pan)
         ntau = taus.shape[0]
-        if ntau < nb:
-            taus = jnp.pad(taus, (0, nb - ntau))
+        if ntau < b:
+            taus = jnp.pad(taus, (0, b - ntau))
         # null out reflectors beyond the real row count (zero-padded rows
         # produce tau=0 from geqrf already; this is belt-and-braces)
-        real_rows = n - k1 * nb
-        col_live = jnp.arange(nb) < real_rows
+        col_live = jnp.arange(b) < (n - bdy)
         taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
-        taus_out = taus_out.at[k].set(taus)
-        v = jnp.tril(vfull, -1) + jnp.eye(m_p, nb, dtype=pan.dtype)
+        taus_out = taus_out.at[p].set(taus)
+        v = jnp.tril(vfull, -1) + jnp.eye(m_p, b, dtype=pan.dtype)
         t = larft(v, taus)
 
+        def tiles_of(mat):
+            """Align an (m_p, b) panel-row matrix to tile rows: pad the ro
+            above-boundary rows (masked out everywhere) and cut into tiles."""
+            return jnp.concatenate(
+                [jnp.zeros((ro, b), dtype=mat.dtype), mat]).reshape(
+                    nt - tr0, nb, b)
+
         # -- write the factored panel back (owner column, my rows) ----------
-        vtiles = vfull.reshape(nt - k1, nb, nb)
-        sel = jnp.clip(g_rows - k1, 0, nt - k1 - 1)
+        vtiles = tiles_of(vfull)
+        sel = jnp.clip(g_rows - tr0, 0, nt - tr0 - 1)
         my_new = vtiles[sel]
-        keep = ((ctx.rank_c == ctx.owner_c(k)) & row_valid)[:, None, None]
-        lt = lt.at[lu:, ctx.kc(k)].set(jnp.where(keep, my_new, lt[lu:, ctx.kc(k)]))
+        keep = (ctx.rank_c == ctx.owner_c(tc)) & row_val_e
+        col_block = lt[lu:, ctx.kc(tc)]
+        col_block = col_block.at[:, :, co:co + b].set(
+            jnp.where(keep[:, :, None], my_new, col_block[:, :, co:co + b]))
+        lt = lt.at[lu:, ctx.kc(tc)].set(col_block)
 
         # -- trailing update ------------------------------------------------
-        luc = ctx.col_start(k1)
+        luc = ctx.col_start(tr0)
         ncols = ctx.ltc - luc
         if ncols == 0 or nrows == 0:
             return lt, taus_out
         g_cols = ctx.g_cols(luc, ncols)
-        col_valid = (g_cols >= k1) & (g_cols < nt)
-        vt = (v @ t).reshape(nt - k1, nb, nb)
-        vtl = jnp.where(col_valid[:, None, None],
-                        vt[jnp.clip(g_cols - k1, 0, nt - k1 - 1)],
-                        jnp.zeros((ncols, nb, nb), dtype=pan.dtype))
+        g_ecols = g_cols[:, None] * nb + arange_nb[None, :]
+        col_val_e = (g_ecols >= bdy) & (g_ecols < n)       # (ncols, nb)
+        selc = jnp.clip(g_cols - tr0, 0, nt - tr0 - 1)
+        v_tiles = tiles_of(v)
+        vt_tiles = tiles_of(v @ t)
+        vtl = jnp.where(col_val_e[:, :, None], vt_tiles[selc],
+                        jnp.zeros((ncols, nb, b), dtype=pan.dtype))
         atr = lt[lu:, luc:]
-        atr = jnp.where((row_valid[:, None] & col_valid[None, :])[:, :, None, None],
-                        atr, jnp.zeros_like(atr))
+        atr = jnp.where((row_val_e[:, None, :, None]
+                         & col_val_e[None, :, None, :]), atr,
+                        jnp.zeros_like(atr))
         # W partial over my local cols -> psum along 'col' (replicates W rows
         # across each grid row)
         w_loc = jnp.einsum("rcab,cbd->rad", atr, vtl,
                            preferred_element_type=atr.dtype)
-        w_loc = cc.all_reduce(w_loc, COL_AXIS)           # (nrows, nb, pw)
+        w_loc = cc.all_reduce(w_loc, COL_AXIS)           # (nrows, nb, b)
         # M = V^H W partial over my rows -> psum along 'row'
-        vr = jnp.where(row_valid[:, None, None],
-                       v.reshape(nt - k1, nb, nb)[jnp.clip(g_rows - k1, 0, nt - k1 - 1)],
-                       jnp.zeros((nrows, nb, nb), dtype=pan.dtype))
+        vr = jnp.where(row_val_e[:, :, None], v_tiles[sel],
+                       jnp.zeros((nrows, nb, b), dtype=pan.dtype))
         m_mat = jnp.einsum("rab,rad->bd", jnp.conj(vr), w_loc,
                            preferred_element_type=atr.dtype)
         m_mat = cc.all_reduce(m_mat, ROW_AXIS)           # replicated everywhere
@@ -172,33 +202,28 @@ def _build_dist_red2band(dist, mesh, dtype):
                                          t.conj().T @ m_mat,
                                          preferred_element_type=atr.dtype)
         # full X (ordered) for column-side updates
-        xfull = cc.all_gather(x_loc, ROW_AXIS).reshape(ctx.P * nrows, nb, nb)
+        xfull = cc.all_gather(x_loc, ROW_AXIS).reshape(ctx.P * nrows, nb, b)
         order = []
-        for g in range(k1, nt):
-            p = (dist.source_rank.row + g) % ctx.P
-            order.append(p * nrows + (g // ctx.P - lu))
-        xfull = xfull[jnp.array(order, dtype=jnp.int32)]  # (nt-k1, nb, nb)
-        xc = jnp.where(col_valid[:, None, None],
-                       xfull[jnp.clip(g_cols - k1, 0, nt - k1 - 1)],
-                       jnp.zeros((ncols, nb, nb), dtype=pan.dtype))
-        vc = jnp.where(col_valid[:, None, None],
-                       v.reshape(nt - k1, nb, nb)[jnp.clip(g_cols - k1, 0, nt - k1 - 1)],
-                       jnp.zeros((ncols, nb, nb), dtype=pan.dtype))
-        xr = jnp.where(row_valid[:, None, None], x_loc,
-                       jnp.zeros_like(x_loc))
+        for g in range(tr0, nt):
+            pr = (dist.source_rank.row + g) % ctx.P
+            order.append(pr * nrows + (g // ctx.P - lu))
+        xfull = xfull[jnp.array(order, dtype=jnp.int32)]  # (nt-tr0, nb, b)
+        xc = jnp.where(col_val_e[:, :, None], xfull[selc],
+                       jnp.zeros((ncols, nb, b), dtype=pan.dtype))
+        vc = jnp.where(col_val_e[:, :, None], v_tiles[selc],
+                       jnp.zeros((ncols, nb, b), dtype=pan.dtype))
+        xr = jnp.where(row_val_e[:, :, None], x_loc, jnp.zeros_like(x_loc))
         upd = (jnp.einsum("rad,cbd->rcab", xr, jnp.conj(vc),
                           preferred_element_type=atr.dtype)
                + jnp.einsum("rad,cbd->rcab", vr, jnp.conj(xc),
                             preferred_element_type=atr.dtype))
-        pair = (row_valid[:, None] & col_valid[None, :])[:, :, None, None]
-        upd = jnp.where(pair, upd, jnp.zeros_like(upd))
         lt = lt.at[lu:, luc:].add(-upd)
         return lt, taus_out
 
     def prog(lt):
-        taus_out = jnp.zeros((max(nt - 1, 0), nb), dtype=lt.dtype)
-        for k in range(nt - 1):
-            lt, taus_out = step(lt, taus_out, k)
+        taus_out = jnp.zeros((max(npan, 0), b), dtype=lt.dtype)
+        for p in range(npan):
+            lt, taus_out = step(lt, taus_out, p)
         return lt, taus_out
 
     def run(lt):
@@ -210,8 +235,8 @@ def _build_dist_red2band(dist, mesh, dtype):
 
 
 @functools.lru_cache(maxsize=32)
-def _dist_red2band_cached(dist, mesh, dtype):
-    return jax.jit(_build_dist_red2band(dist, mesh, dtype))
+def _dist_red2band_cached(dist, mesh, dtype, band):
+    return jax.jit(_build_dist_red2band(dist, mesh, dtype, band))
 
 
 # ---------------------------------------------------------------------------
@@ -221,13 +246,13 @@ def _dist_red2band_cached(dist, mesh, dtype):
 def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
     """Reduce Hermitian ``a`` (FULL storage — both triangles) to band form.
 
-    ``band_size`` (default: block size) sets the bandwidth; like the
-    reference (``reduction_to_band.h:78-87``) the local variant accepts any
-    ``band_size`` dividing the block size, while the distributed variant
-    supports only ``band_size == block size`` (the reference raises the same
-    restriction, ``miniapp_reduction_to_band.cpp:60``). Smaller bands shift
-    work from the host bulge-chasing stage (O(n^2 b)) into this stage's
-    device gemms — the standard two-stage tradeoff knob.
+    ``band_size`` (default: block size) sets the bandwidth; it must divide
+    the block size (reference ``reduction_to_band.h:84``). Both the local
+    AND the distributed variant accept ``band_size < block size`` — the
+    distributed case goes beyond the reference, whose distributed variant
+    requires band == block size (``miniapp_reduction_to_band.cpp:60``).
+    Smaller bands shift work from the host bulge-chasing stage (O(n^2 b))
+    into this stage's device gemms — the standard two-stage tradeoff knob.
     """
     dlaf_assert(a.size.row == a.size.col, "reduction_to_band: square only")
     dlaf_assert(a.block_size.row == a.block_size.col, "square blocks only")
@@ -242,12 +267,10 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
         out, taus = _red2band_local(g, nb=band)
         return BandReduction(a.with_storage(global_to_tiles(out, a.dist)),
                              taus, band)
-    dlaf_assert(band == nb,
-                "reduction_to_band: distributed variant supports only "
-                "band_size == block size (same restriction as the reference)")
-    fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name)
+    fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name,
+                               band)
     storage, taus = fn(a.storage)
-    return BandReduction(a.with_storage(storage), taus, nb)
+    return BandReduction(a.with_storage(storage), taus, band)
 
 
 def extract_band(red: BandReduction) -> np.ndarray:

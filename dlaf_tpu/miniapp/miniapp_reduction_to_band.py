@@ -34,8 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tile size (reference --block-size)")
     p.add_argument("--band-size", type=int, default=-1,
                    help="bandwidth; negative = block-size (reference "
-                        "--band-size; must divide block-size, local grids "
-                        "only when != block-size)")
+                        "--band-size; must divide block-size; unlike the "
+                        "reference this also works distributed). NOTE: the "
+                        "step loop unrolls ceil(n/band)-1 panels at trace "
+                        "time — very small bands inflate compile time")
     add_miniapp_arguments(p)
     return p
 

@@ -219,6 +219,21 @@ def test_gen_eigensolver_distributed(dtype, devices8):
 
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb,band", [(32, 8, 4), (29, 8, 2), (24, 8, 4)])
+def test_eigensolver_band_size(n, nb, band, dtype):
+    """Full local pipeline at band < block size: every stage (extract_band,
+    chase, both back-transforms) must consume the narrow-band layout."""
+    a = herm(n, dtype, seed=n + 3 * band)
+    res = eigensolver("L", M(a, nb), band_size=band)
+    q = res.eigenvectors.to_numpy()
+    lam = res.eigenvalues
+    assert np.linalg.norm(a @ q - q * lam[None, :]) < 1e-10 * n
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
+    np.testing.assert_allclose(np.sort(lam), np.sort(sla.eigvalsh(a)),
+                               atol=1e-10 * n)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
 @pytest.mark.parametrize("uplo", ["L", "U"])
 def test_gen_eigensolver(uplo, dtype):
     n, nb = 16, 4
