@@ -50,7 +50,8 @@ from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
-from ..matrix.panel import DistContext, gather_col_panel_ordered
+from ..matrix.panel import (DistContext, gather_sub_panel,
+                            pad_sub_panel_to_tiles)
 from ..matrix.tiling import (_axis_perm_inv, global_to_tiles, storage_tile_grid,
                              tiles_to_global)
 from ..tile_ops.lapack import larft
@@ -287,49 +288,54 @@ def _bt_r2b_local(a_v, taus, e, *, nb: int):
     return e
 
 
-def _build_dist_bt_r2b(dist_a, dist_c, mesh):
+def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
     """Distributed reflector-block back-transform C <- (I - V T V^H) C,
     panels in reverse order (reference ``bt_reduction_to_band/impl.h:82-373``:
-    trmmPanel W=VT, gemmUpdateW2 W2=W^H C, gemmTrailingMatrix C-=V W2)."""
+    trmmPanel W=VT, gemmUpdateW2 W2=W^H C, gemmTrailingMatrix C-=V W2).
+
+    ``band`` <= block size (must divide it): panel p is the width-band slice
+    of V at element columns [p*band, (p+1)*band), acting on C rows >=
+    (p+1)*band — static sub-tile offsets, element-level masks, same scheme
+    as the generalized forward reduction (beyond-reference: the reference's
+    distributed back-transform exists only for band == block size)."""
     nt = dist_a.nr_tiles.row
     nb = dist_a.block_size.row
+    n = dist_a.size.row
+    b = band
+    npan = ceil_div(n, b) - 1 if n else 0
 
     def run(lt_a, taus, lt_c):
         ctx_a = DistContext(dist_a)
         ctx_c = DistContext(dist_c)
-        for k in range(nt - 2, -1, -1):
-            k1 = k + 1
-            # -- gather the full V panel (column k, tile rows k1..nt-1) ------
-            lu = ctx_a.row_start(k1)
-            nrows = ctx_a.ltr - lu
-            if nrows <= 0:
+        arange_nb = jnp.arange(nb)
+        for p in range(npan - 1, -1, -1):
+            bdy = (p + 1) * b
+            # -- gather the full V sub-panel (element rows >= bdy) -----------
+            got = gather_sub_panel(ctx_a, lt_a, pb=p * b, b=b, n=n)
+            if got is None:
                 continue
-            g_rows = ctx_a.g_rows(lu, nrows)
-            row_valid = (g_rows >= k1) & (g_rows < nt)
-            mine = lt_a[lu:, ctx_a.kc(k)]
-            mine = jnp.where(row_valid[:, None, None], mine, jnp.zeros_like(mine))
-            mine = cc.bcast(mine, COL_AXIS, ctx_a.owner_c(k))
-            vtiles = gather_col_panel_ordered(ctx_a, mine, k1, lu)
-            m_p = (nt - k1) * nb
-            vfull = vtiles.reshape(m_p, nb)
-            v = jnp.tril(vfull, -1) + jnp.eye(m_p, nb, dtype=vfull.dtype)
-            t = larft(v, taus[k])
-            vt = v.reshape(nt - k1, nb, nb)
+            vfull, _, tr0, ro, _, _ = got  # A-side masks unused: the C-side
+            # loop below recomputes its own element masks from ctx_c
+            m_p = (nt - tr0) * nb - ro
+            v = jnp.tril(vfull, -1) + jnp.eye(m_p, b, dtype=vfull.dtype)
+            t = larft(v, taus[p])
+            vt = pad_sub_panel_to_tiles(ctx_a, v, tr0=tr0, ro=ro)
 
             # -- W2 = T (V^H C): partial V^H C over my C rows, psum 'row' ----
-            luc = ctx_c.row_start(k1)
+            luc = ctx_c.row_start(tr0)
             nrows_c = ctx_c.ltr - luc
             if nrows_c <= 0:
                 continue
             g_rows_c = ctx_c.g_rows(luc, nrows_c)
-            rv_c = (g_rows_c >= k1) & (g_rows_c < nt)
-            sel = jnp.clip(g_rows_c - k1, 0, nt - k1 - 1)
-            v_my = jnp.where(rv_c[:, None, None], vt[sel],
-                             jnp.zeros((nrows_c, nb, nb), dtype=vfull.dtype))
+            g_erows_c = g_rows_c[:, None] * nb + arange_nb[None, :]
+            rv_c_e = (g_erows_c >= bdy) & (g_erows_c < n)
+            sel = jnp.clip(g_rows_c - tr0, 0, nt - tr0 - 1)
+            v_my = jnp.where(rv_c_e[:, :, None], vt[sel],
+                             jnp.zeros((nrows_c, nb, b), dtype=vfull.dtype))
             cpart = lt_c[luc:]
             w2 = jnp.einsum("rab,rcad->cbd", jnp.conj(v_my), cpart,
                             preferred_element_type=cpart.dtype)
-            w2 = cc.all_reduce(w2, ROW_AXIS)         # (ltc_c, nb, nb) = V^H C
+            w2 = cc.all_reduce(w2, ROW_AXIS)         # (ltc_c, b, nb_c) = V^H C
             w2 = jnp.einsum("xb,cbd->cxd", t, w2,
                             preferred_element_type=cpart.dtype)
 
@@ -345,8 +351,8 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh):
 
 
 @functools.lru_cache(maxsize=32)
-def _dist_bt_r2b_cached(dist_a, dist_c, mesh):
-    return jax.jit(_build_dist_bt_r2b(dist_a, dist_c, mesh))
+def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band):
+    return jax.jit(_build_dist_bt_r2b(dist_a, dist_c, mesh, band))
 
 
 def bt_reduction_to_band(red: BandReduction, evecs):
@@ -359,21 +365,19 @@ def bt_reduction_to_band(red: BandReduction, evecs):
     """
     a = red.matrix
     if isinstance(evecs, Matrix) and a.grid is not None and a.grid.num_devices > 1:
-        dlaf_assert(red.band == a.block_size.row,
-                    "bt_reduction_to_band: the distributed back-transform "
-                    "supports only band == block size (reduce locally or "
-                    "with band_size == block size for distributed pipelines)")
         dlaf_assert(evecs.grid is not None
                     and evecs.grid.size == a.grid.size,
                     "bt_reduction_to_band: V and C must share the grid")
-        dlaf_assert(evecs.block_size.row == red.band,
-                    "bt_reduction_to_band: C row block != band")
+        dlaf_assert(evecs.block_size.row == a.block_size.row,
+                    "bt_reduction_to_band: C row block != V block")
         dlaf_assert(evecs.size.row == a.size.row,
                     "bt_reduction_to_band: C rows != n")
+        dlaf_assert(a.block_size.row % red.band == 0,
+                    "bt_reduction_to_band: band must divide the block size")
         storage = evecs.storage
         if storage.dtype != a.dtype:
             storage = storage.astype(a.dtype)
-        fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh)
+        fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band)
         out = fn(a.storage, jnp.asarray(red.taus), storage)
         return Matrix(evecs.dist, out, evecs.grid)
     a_v = tiles_to_global(a.storage, a.dist)

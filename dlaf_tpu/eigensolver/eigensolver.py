@@ -69,9 +69,6 @@ def eigensolver(uplo: str, a: Matrix,
     fence = ((lambda x: x.block_until_ready()) if phases is not None
              else (lambda x: None))
     distributed = a.grid is not None and a.grid.num_devices > 1
-    dlaf_assert(band_size is None or band_size == nb or not distributed,
-                "eigensolver: band_size != block size is local-only (the "
-                "distributed bt_reduction_to_band needs band == block size)")
     with pt.phase("reduction_to_band"):
         ah = mops.hermitianize(a, uplo)
         red = reduction_to_band(ah, band_size=band_size)

@@ -46,7 +46,8 @@ from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
-from ..matrix.panel import DistContext, gather_col_panel_ordered
+from ..matrix.panel import (DistContext, gather_col_panel_ordered,
+                            gather_sub_panel, pad_sub_panel_to_tiles)
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft
@@ -59,7 +60,7 @@ class BandReduction:
     the bandwidth ``band`` (= block size unless band_size was given)."""
 
     matrix: Matrix
-    taus: jax.Array  # (nt-1, nb), zero-padded
+    taus: jax.Array  # (ceil(n/band)-1, band), zero-padded
     band: int
 
 
@@ -122,25 +123,15 @@ def _build_dist_red2band(dist, mesh, dtype, band):
         bdy = (p + 1) * b              # first eliminated element row
         tc = (p * b) // nb             # tile column holding the panel
         co = (p * b) % nb              # its in-tile column offset
-        tr0 = bdy // nb                # first tile row with panel rows
-        ro = bdy % nb                  # boundary's in-tile row offset
-        lu = ctx.row_start(tr0)
-        nrows = ctx.ltr - lu
-        if nrows <= 0:
-            return lt, taus_out
-        g_rows = ctx.g_rows(lu, nrows)
-        arange_nb = jnp.arange(nb)
-        g_erows = g_rows[:, None] * nb + arange_nb[None, :]
-        row_val_e = (g_erows >= bdy) & (g_erows < n)       # (nrows, nb)
 
         # -- gather the full sub-panel, factor redundantly ------------------
-        mine = lt[lu:, ctx.kc(tc), :, co:co + b]
-        mine = jnp.where(row_val_e[:, :, None], mine, jnp.zeros_like(mine))
-        mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
-        ptiles = gather_col_panel_ordered(ctx, mine, tr0, lu)  # (nt-tr0, nb, b)
-        m_full = (nt - tr0) * nb
-        pan = ptiles.reshape(m_full, b)[ro:]
-        m_p = m_full - ro
+        got = gather_sub_panel(ctx, lt, pb=p * b, b=b, n=n)
+        if got is None:
+            return lt, taus_out
+        pan, lu, tr0, ro, row_val_e, g_rows = got
+        nrows = ctx.ltr - lu
+        arange_nb = jnp.arange(nb)
+        m_p = (nt - tr0) * nb - ro
         vfull, taus = geqrf(pan)
         ntau = taus.shape[0]
         if ntau < b:
@@ -154,11 +145,7 @@ def _build_dist_red2band(dist, mesh, dtype, band):
         t = larft(v, taus)
 
         def tiles_of(mat):
-            """Align an (m_p, b) panel-row matrix to tile rows: pad the ro
-            above-boundary rows (masked out everywhere) and cut into tiles."""
-            return jnp.concatenate(
-                [jnp.zeros((ro, b), dtype=mat.dtype), mat]).reshape(
-                    nt - tr0, nb, b)
+            return pad_sub_panel_to_tiles(ctx, mat, tr0=tr0, ro=ro)
 
         # -- write the factored panel back (owner column, my rows) ----------
         vtiles = tiles_of(vfull)
@@ -202,12 +189,7 @@ def _build_dist_red2band(dist, mesh, dtype, band):
                                          t.conj().T @ m_mat,
                                          preferred_element_type=atr.dtype)
         # full X (ordered) for column-side updates
-        xfull = cc.all_gather(x_loc, ROW_AXIS).reshape(ctx.P * nrows, nb, b)
-        order = []
-        for g in range(tr0, nt):
-            pr = (dist.source_rank.row + g) % ctx.P
-            order.append(pr * nrows + (g // ctx.P - lu))
-        xfull = xfull[jnp.array(order, dtype=jnp.int32)]  # (nt-tr0, nb, b)
+        xfull = gather_col_panel_ordered(ctx, x_loc, tr0, lu)  # (nt-tr0, nb, b)
         xc = jnp.where(col_val_e[:, :, None], xfull[selc],
                        jnp.zeros((ncols, nb, b), dtype=pan.dtype))
         vc = jnp.where(col_val_e[:, :, None], v_tiles[selc],

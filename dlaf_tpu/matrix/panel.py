@@ -136,6 +136,54 @@ def gather_col_panel_ordered(ctx: DistContext, col_tiles, k1: int, lu: int):
     return full[jnp.array(order, dtype=jnp.int32)]       # (nt-k1, mb, nb)
 
 
+def gather_sub_panel(ctx: DistContext, lt, *, pb: int, b: int, n: int):
+    """Gather the width-``b`` reflector sub-panel at element columns
+    [pb, pb+b) acting below boundary row pb+b, replicated on every rank.
+
+    Shared by the generalized (band <= block size) distributed
+    reduction_to_band and bt_reduction_to_band: slices the panel's tile
+    column at its static in-tile offset, masks the above-boundary rows
+    elementwise, broadcasts along the column axis, gathers tile rows in
+    global order, and returns
+
+    ``(vfull, lu, tr0, ro, row_val_e, g_rows)`` where ``vfull`` is the
+    (m_full - ro, b) packed panel starting AT the boundary row (R in its
+    top b rows after factorization, reflectors below), ``lu``/``tr0``/``ro``
+    locate it in tile space, and ``row_val_e``/``g_rows`` are the caller's
+    element-level row masks for its local slots.
+    """
+    nb = ctx.mb
+    nt = ctx.nt.row
+    bdy = pb + b
+    tc = pb // nb
+    co = pb % nb
+    tr0 = bdy // nb
+    ro = bdy % nb
+    lu = ctx.row_start(tr0)
+    nrows = ctx.ltr - lu
+    if nrows <= 0:
+        return None
+    g_rows = ctx.g_rows(lu, nrows)
+    g_erows = g_rows[:, None] * nb + jnp.arange(nb)[None, :]
+    row_val_e = (g_erows >= bdy) & (g_erows < n)
+    mine = lt[lu:, ctx.kc(tc), :, co:co + b]
+    mine = jnp.where(row_val_e[:, :, None], mine, jnp.zeros_like(mine))
+    mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
+    ptiles = gather_col_panel_ordered(ctx, mine, tr0, lu)
+    vfull = ptiles.reshape((nt - tr0) * nb, b)[ro:]
+    return vfull, lu, tr0, ro, row_val_e, g_rows
+
+
+def pad_sub_panel_to_tiles(ctx: DistContext, mat, *, tr0: int, ro: int):
+    """Align an (m_full - ro, b) sub-panel row space to tile rows: zero-pad
+    the ``ro`` above-boundary rows (masked out everywhere by the callers'
+    element masks) and cut into (nt - tr0, mb, b) tiles."""
+    b = mat.shape[1]
+    return jnp.concatenate(
+        [jnp.zeros((ro, b), dtype=mat.dtype), mat]).reshape(
+            ctx.nt.row - tr0, ctx.mb, b)
+
+
 def transpose_col_to_rows(ctx: DistContext, col_tiles, lu_r: int, g_cols):
     """Transposed-panel exchange (reference ``panelT`` + transposed
     ``broadcast_panel``, ``broadcast_panel.h:101-193``): given each rank's

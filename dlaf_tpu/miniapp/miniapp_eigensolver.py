@@ -40,8 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="solve A x = lambda B x (miniapp_gen_eigensolver)")
     p.add_argument("--band-size", type=int, default=-1,
                    help="reduction bandwidth; negative = block-size "
-                        "(must divide block-size; local grids only — the "
-                        "distributed back-transform needs band == block)")
+                        "(must divide block-size; works local and "
+                        "distributed)")
     add_miniapp_arguments(p)
     return p
 

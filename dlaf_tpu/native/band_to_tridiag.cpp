@@ -67,44 +67,61 @@ template <typename T>
 struct BandChase {
   long n, b, ld;  // ld = 2b+1 rows of working band
   std::vector<T> wb;          // wb[r*n + j] = A[j+r, j]
-  std::vector<T> win, blk, u, w, tmp;
+  std::vector<T> u, w;
 
   BandChase(const T* band, long n_, long b_) : n(n_), b(b_), ld(2 * b_ + 1) {
     wb.assign(static_cast<size_t>(ld) * n, T(0));
     for (long r = 0; r <= b; ++r)
       std::memcpy(&wb[r * n], &band[r * n], sizeof(T) * n);
-    win.resize(b * b);
-    blk.resize(b * b);
     u.resize(b);
     w.resize(b);
   }
 
   T& at(long i, long j) { return wb[(i - j) * n + j]; }  // i >= j, i-j <= 2b
 
-  // S <- H S H^H on the Hermitian window A[j0:j0+m, j0:j0+m]
+  // S <- H S H^H on the Hermitian window A[j0:j0+m, j0:j0+m].
+  //
+  // All loops run DIAGONAL-major: for a fixed sub/super-diagonal d the
+  // window elements S[c+d, c] are the contiguous run wb[d*n + j0 .. j0+m-d)
+  // of the band storage, so both the band-matrix-vector product u = S v and
+  // the rank-2 update S -= w v^H + v w^H stream the band rows linearly
+  // (the previous dense-window copy strided by n on every element, which
+  // was the kernel's bottleneck, not the flops).
   void two_sided(long j0, long m, const T* v, T tau) {
-    // dense Hermitian window
-    for (long c = 0; c < m; ++c)
-      for (long r = 0; r < m; ++r)
-        win[r * m + c] = (r >= c) ? at(j0 + r, j0 + c)
-                                  : Traits<T>::conj(at(j0 + c, j0 + r));
-    for (long r = 0; r < m; ++r) win[r * m + r] = T(Traits<T>::real(win[r * m + r]));
-    // u = S v ; vhu = v^H u (real)
-    for (long r = 0; r < m; ++r) {
-      T acc = T(0);
-      for (long c = 0; c < m; ++c) acc += win[r * m + c] * v[c];
-      u[r] = acc;
+    // u = S v by diagonals: d = 0 uses the real diagonal; d > 0 adds the
+    // lower element to u[c+d] and its conjugate (upper) to u[c]
+    for (long r = 0; r < m; ++r) u[r] = T(0);
+    {
+      const T* row0 = &wb[0 * n + j0];
+      for (long c = 0; c < m; ++c) u[c] += T(Traits<T>::real(row0[c])) * v[c];
+    }
+    for (long d = 1; d < m; ++d) {
+      const T* row = &wb[d * n + j0];
+      const long len = m - d;
+      for (long c = 0; c < len; ++c) {
+        u[c + d] += row[c] * v[c];
+        u[c] += Traits<T>::conj(row[c]) * v[c + d];
+      }
     }
     T vhu = T(0);
     for (long r = 0; r < m; ++r) vhu += Traits<T>::conj(v[r]) * u[r];
     double a2 = Traits<T>::abs(tau);
     T half = T(a2 * a2 / 2.0) * vhu;
     for (long r = 0; r < m; ++r) w[r] = Traits<T>::conj(tau) * u[r] - half * v[r];
-    // S -= w v^H + v w^H  (write back lower triangle only)
-    for (long c = 0; c < m; ++c)
-      for (long r = c; r < m; ++r)
-        at(j0 + r, j0 + c) = win[r * m + c] - w[r] * Traits<T>::conj(v[c]) -
-                             v[r] * Traits<T>::conj(w[c]);
+    // S -= w v^H + v w^H by diagonals (lower triangle in band storage)
+    {
+      T* row0 = &wb[0 * n + j0];
+      for (long c = 0; c < m; ++c)
+        row0[c] = T(Traits<T>::real(row0[c]) -
+                    2.0 * Traits<T>::real(w[c] * Traits<T>::conj(v[c])));
+    }
+    for (long d = 1; d < m; ++d) {
+      T* row = &wb[d * n + j0];
+      const long len = m - d;
+      for (long c = 0; c < len; ++c)
+        row[c] -= w[c + d] * Traits<T>::conj(v[c]) +
+                  v[c + d] * Traits<T>::conj(w[c]);
+    }
   }
 
   void run(T* v_out, T* tau_out, long n_steps, double* d_out, T* e_out) {
@@ -127,45 +144,59 @@ struct BandChase {
       tau_out[s * n_steps + 0] = tau;
 
       long j0 = s + 1, t = 0;
-      std::vector<T> v2(b), xcol(b);
+      std::vector<T> v2(b), xcol(b), y(b), acc(b);
       while (true) {
         if (Traits<T>::abs(tau) != 0.0) two_sided(j0, l, v.data(), tau);
         long l2 = std::min(b, n - (j0 + l));
         if (l2 == 0) break;
-        // B = A[j0+l : j0+l+l2, j0 : j0+l];  B <- B H^H
-        // column c of B is at band offsets (j0+l - (j0+c)) .. in col j0+c
-        for (long r = 0; r < l2; ++r)
-          for (long c = 0; c < l; ++c)
-            blk[r * l + c] = at(j0 + l + r, j0 + c);
+        // B = A[j0+l : j0+l+l2, j0 : j0+l), worked on IN band storage:
+        // B[r, c] lives on band diagonal k2 = l + r - c, whose elements for
+        // fixed k2 are the contiguous run wb[k2*n + j0 + c] (c ascending) —
+        // all sweeps below stream those rows (no dense block copy)
+        const long k2lo = 1, k2hi = l + l2 - 1;
         if (Traits<T>::abs(tau) != 0.0) {
-          for (long r = 0; r < l2; ++r) {
-            T acc = T(0);
-            for (long c = 0; c < l; ++c) acc += blk[r * l + c] * v[c];
-            acc *= Traits<T>::conj(tau);
-            for (long c = 0; c < l; ++c)
-              blk[r * l + c] -= acc * Traits<T>::conj(v[c]);
+          // B <- B H^H = B - conj(tau) (B v) v^H
+          for (long r = 0; r < l2; ++r) y[r] = T(0);
+          for (long k2 = k2lo; k2 <= k2hi; ++k2) {
+            const T* row = &wb[k2 * n + j0];
+            const long clo = std::max<long>(0, l - k2);
+            const long chi = std::min<long>(l, l2 + l - k2);
+            for (long c = clo; c < chi; ++c) y[k2 - l + c] += row[c] * v[c];
+          }
+          const T ct = Traits<T>::conj(tau);
+          for (long k2 = k2lo; k2 <= k2hi; ++k2) {
+            T* row = &wb[k2 * n + j0];
+            const long clo = std::max<long>(0, l - k2);
+            const long chi = std::min<long>(l, l2 + l - k2);
+            for (long c = clo; c < chi; ++c)
+              row[c] -= ct * y[k2 - l + c] * Traits<T>::conj(v[c]);
           }
         }
-        // eliminate first column of B
-        for (long r = 0; r < l2; ++r) xcol[r] = blk[r * l + 0];
+        // eliminate first column of B (strided but only l2 elements)
+        for (long r = 0; r < l2; ++r) xcol[r] = wb[(l + r) * n + j0];
         T tau2;
         double beta2;
         larfg<T>(l2, xcol.data(), v2.data(), &tau2, &beta2);
-        for (long r = 0; r < l2; ++r) blk[r * l + 0] = T(0);
-        blk[0] = T(beta2);
-        // left-apply H2 to remaining columns
+        wb[l * n + j0] = T(beta2);
+        for (long r = 1; r < l2; ++r) wb[(l + r) * n + j0] = T(0);
+        // left-apply H2 to remaining columns: B -= tau2 v2 (v2^H B)
         if (Traits<T>::abs(tau2) != 0.0 && l > 1) {
-          for (long c = 1; c < l; ++c) {
-            T acc = T(0);
-            for (long r = 0; r < l2; ++r)
-              acc += Traits<T>::conj(v2[r]) * blk[r * l + c];
-            acc *= tau2;
-            for (long r = 0; r < l2; ++r) blk[r * l + c] -= v2[r] * acc;
+          for (long c = 0; c < l; ++c) acc[c] = T(0);
+          for (long k2 = k2lo; k2 <= k2hi; ++k2) {
+            const T* row = &wb[k2 * n + j0];
+            const long clo = std::max<long>(1, l - k2);
+            const long chi = std::min<long>(l, l2 + l - k2);
+            for (long c = clo; c < chi; ++c)
+              acc[c] += Traits<T>::conj(v2[k2 - l + c]) * row[c];
+          }
+          for (long k2 = k2lo; k2 <= k2hi; ++k2) {
+            T* row = &wb[k2 * n + j0];
+            const long clo = std::max<long>(1, l - k2);
+            const long chi = std::min<long>(l, l2 + l - k2);
+            for (long c = clo; c < chi; ++c)
+              row[c] -= tau2 * v2[k2 - l + c] * acc[c];
           }
         }
-        for (long r = 0; r < l2; ++r)
-          for (long c = 0; c < l; ++c)
-            at(j0 + l + r, j0 + c) = blk[r * l + c];
         ++t;
         T* vr2 = &v_out[(s * n_steps + t) * b];
         for (long r = 0; r < l2; ++r) vr2[r] = v2[r];

@@ -234,6 +234,31 @@ def test_eigensolver_band_size(n, nb, band, dtype):
 
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb,band,grid_shape,src",
+                         [(32, 8, 4, (2, 2), (0, 0)),
+                          (29, 8, 2, (2, 4), (1, 2)),
+                          (24, 8, 4, (4, 2), (3, 1))])
+def test_eigensolver_distributed_band_size(n, nb, band, grid_shape, src,
+                                           dtype, devices8):
+    """Distributed pipeline at band < block size (beyond-reference on both
+    the forward reduction and bt_reduction_to_band)."""
+    from dlaf_tpu.common.index2d import RankIndex2D
+
+    a = herm(n, dtype, seed=n + band)
+    grid = Grid(*grid_shape)
+    mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
+                             source_rank=RankIndex2D(src[0] % grid_shape[0],
+                                                     src[1] % grid_shape[1]))
+    res = eigensolver("L", mat, band_size=band)
+    q = res.eigenvectors.to_numpy()
+    lam = res.eigenvalues
+    assert np.linalg.norm(a @ q - q * lam[None, :]) < 1e-10 * n
+    assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
+    np.testing.assert_allclose(np.sort(lam), np.sort(sla.eigvalsh(a)),
+                               atol=1e-10 * n)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
 @pytest.mark.parametrize("uplo", ["L", "U"])
 def test_gen_eigensolver(uplo, dtype):
     n, nb = 16, 4
