@@ -168,3 +168,26 @@ def add(a, b, *, alpha=1.0):
 
 def scal(a, *, alpha):
     return alpha * a
+
+
+def axpy(x, y, *, alpha=1.0):
+    """``y = y + alpha x`` elementwise (reference GPU-internal ``tile::axpy``,
+    ``blas/tile.h``; used by reduction-to-band micro-kernels there — here the
+    algorithms fuse it into einsums, the op exists for tile-level use)."""
+    return y + alpha * x
+
+
+def gemv(a, x, y=None, *, alpha=1.0, beta=1.0, op_a: str = "N"):
+    """``y = alpha op(A) x + beta y`` (reference GPU-internal ``tile::gemv``).
+    ``x``/``y`` are vectors on the last axis; leading axes batch."""
+    ax = jnp.einsum("...ij,...j->...i", _op(a, op_a), x)
+    if y is None:
+        return alpha * ax
+    return alpha * ax + beta * y
+
+
+def trmv(uplo: str, op_a: str, diag: str, a, x):
+    """``x = op(T) x`` with triangular ``T`` (reference GPU-internal
+    ``tile::trmv``; the T-factor accumulation uses it)."""
+    t = _tri(a, uplo, diag)
+    return jnp.einsum("...ij,...j->...i", _op(t, op_a), x)

@@ -81,6 +81,41 @@ def potrf(uplo: str, a):
     return tri_mask(f, "U") + tri_mask(a, "L", k=-1)
 
 
+def potrf_info(uplo: str, a):
+    """``potrf`` plus an info value (reference ``tile::potrfInfo``, which
+    surfaces the LAPACK/cusolver info instead of asserting): returns
+    ``(factor, info)`` with info = 0 on success, nonzero on a failed
+    factorization. Unlike LAPACK, info's value does NOT identify the exact
+    failing column: XLA backends mark failures by NaN-ing the factor (CPU
+    NaNs all of it, TPU's blocked form NaNs from the failing block on), so
+    nonzero info is the 1-based index of the first non-finite diagonal —
+    a success/failure signal first, a column locator only as far as the
+    backend preserves the prefix."""
+    f = potrf(uplo, a)
+    diag = _diag_of(tri_mask(f, uplo) if uplo != "G" else f)
+    bad = ~jnp.isfinite(diag.real) if jnp.iscomplexobj(diag) else ~jnp.isfinite(diag)
+    idx = jnp.argmax(bad, axis=-1)
+    info = jnp.where(jnp.any(bad, axis=-1), idx + 1, 0)
+    return f, info
+
+
+def laed4(d, z, rho):
+    """Secular-equation roots of the rank-one update
+    ``D + rho z z^T`` (reference ``tile::laed4`` -> LAPACK ``dlaed4``, the
+    D&C merge's per-eigenvalue kernel). Host-side like the reference (it
+    keeps laed4 on the CPU even for the GPU backend); delegates to the
+    framework's secular solver (native C++ safeguarded Newton, numpy
+    bisection fallback — ``eigensolver/tridiag_solver.py``), which also
+    provides the device-fused variant for large merges. Returns the k
+    updated eigenvalues (ascending)."""
+    from ..eigensolver.tridiag_solver import _secular_roots_host
+
+    d = np.asarray(d, dtype=np.float64)
+    anchor, offset = _secular_roots_host(d, np.asarray(z, dtype=np.float64),
+                                         float(rho))
+    return d[anchor] + offset
+
+
 def hegst(itype: int, uplo: str, a, b):
     """Tile-level generalized-to-standard transform (reference
     ``tile::hegst`` / custom GPU port ``gpu/cusolver/hegst.h``):

@@ -275,3 +275,49 @@ def test_stedc_vs_scipy():
     tri = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
     np.testing.assert_allclose(v @ np.diag(w) @ v.T, tri, atol=1e-12)
     assert np.all(np.diff(w) >= 0)
+
+
+def test_axpy_gemv_trmv():
+    rng = np.random.default_rng(15)
+    a = rng.standard_normal((4, 4))
+    x = rng.standard_normal(4)
+    y = rng.standard_normal(4)
+    np.testing.assert_allclose(np.asarray(tb.axpy(x, y, alpha=2.5)),
+                               y + 2.5 * x, atol=1e-14)
+    np.testing.assert_allclose(np.asarray(tb.gemv(a, x, y, alpha=2.0, beta=-1.0)),
+                               2.0 * a @ x - y, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(tb.gemv(a, x, op_a="T", alpha=1.0)),
+                               a.T @ x, atol=1e-13)
+    t = np.tril(a)
+    np.testing.assert_allclose(np.asarray(tb.trmv("L", "N", "N", a, x)),
+                               t @ x, atol=1e-13)
+    tu = np.tril(a, -1) + np.eye(4)
+    np.testing.assert_allclose(np.asarray(tb.trmv("L", "C", "U", a, x)),
+                               tu.T @ x, atol=1e-13)
+
+
+def test_potrf_info():
+    rng = np.random.default_rng(16)
+    x = rng.standard_normal((5, 5))
+    spd = x @ x.T + 5 * np.eye(5)
+    f, info = tl.potrf_info("L", jnp.asarray(spd))
+    assert int(info) == 0
+    np.testing.assert_allclose(np.tril(np.asarray(f)) @ np.tril(np.asarray(f)).T,
+                               spd, atol=1e-10)
+    # indefinite input: info = 1-based first failing column, factor has NaNs
+    bad = np.diag([1.0, -1.0, 1.0, 1.0, 1.0])
+    f2, info2 = tl.potrf_info("L", jnp.asarray(bad))
+    assert int(info2) >= 1
+
+
+def test_laed4_secular_roots():
+    rng = np.random.default_rng(17)
+    k = 8
+    d = np.sort(rng.standard_normal(k))
+    z = rng.standard_normal(k)
+    z /= np.linalg.norm(z)
+    rho = 0.7
+    lam = tl.laed4(d, z, rho)
+    # roots of the rank-one-updated matrix == eigvals of D + rho z z^T
+    w = np.linalg.eigvalsh(np.diag(d) + rho * np.outer(z, z))
+    np.testing.assert_allclose(np.sort(lam), w, atol=1e-10)
