@@ -128,3 +128,39 @@ def test_tile_layout():
 def test_layout_empty():
     lay = li.tile_layout(LocalElementSize(0, 0), TileElementSize(4, 4))
     assert lay.min_mem_size() == 0
+
+
+def test_sharding_matches_distribution_ownership(devices8):
+    """The design's central invariant (DESIGN.md par.1): NamedSharding over the
+    cyclic-permuted 4D storage places on device (p, q) EXACTLY the tiles the
+    block-cyclic Distribution assigns to rank (p, q) — every algorithm's
+    shard_map masks assume it. Verified shard-by-shard against the
+    Distribution's own ownership math, with a source-rank offset."""
+    from dlaf_tpu.matrix.util_distribution import rank_global_tile
+
+    grid = Grid(2, 4)
+    P, Q = 2, 4
+    src = RankIndex2D(1, 2)
+    rng = np.random.default_rng(8)
+    n, nb = 28, 4                      # 7x7 tiles: uneven per-rank counts
+    a = rng.standard_normal((n, n))
+    mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
+                             source_rank=src)
+    nt = (n + nb - 1) // nb
+    mesh_devs = mat.grid.mesh.devices  # (P, Q) device array
+    dev_rank = {d: (p, q) for p in range(P) for q in range(Q)
+                for d in [mesh_devs[p, q]]}
+    for shard in mat.storage.addressable_shards:
+        p, q = dev_rank[shard.device]
+        owned = np.asarray(shard.data)   # (ltr, ltc, nb, nb) local tiles
+        # collect this rank's global tiles in cyclic (slot) order
+        g_rows = [g for g in range(nt) if rank_global_tile(g, P, src.row) == p]
+        g_cols = [g for g in range(nt) if rank_global_tile(g, Q, src.col) == q]
+        for li_r, g_r in enumerate(g_rows):
+            for li_c, g_c in enumerate(g_cols):
+                r0, c0 = g_r * nb, g_c * nb
+                expect = np.zeros((nb, nb))
+                blk = a[r0:min(r0 + nb, n), c0:min(c0 + nb, n)]
+                expect[:blk.shape[0], :blk.shape[1]] = blk
+                np.testing.assert_array_equal(owned[li_r, li_c], expect,
+                                              err_msg=f"tile ({g_r},{g_c}) on rank ({p},{q})")
