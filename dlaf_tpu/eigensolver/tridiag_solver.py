@@ -111,12 +111,20 @@ def _secular_roots_host(ds, zs, rho):
 
 
 @jax.jit
-def _secular_vcols_device(ds, zs, rho):
+def _secular_vcols_device(ds, zs, rho, live):
     """Device twin of :func:`_secular_roots` + the Gu-Eisenstat refinement +
     eigenvector-coefficient assembly: returns ``(lam_live, vcols)``. The pole
     differences ``m[i, j] = d_j - lambda_i`` are formed internally in the
     shifted (cancellation-free) representation. All f64; one fused HBM-bound
     program instead of ~90 numpy sweeps.
+
+    ``live`` marks real entries: the caller pads (ds, zs) to a shape bucket
+    (padded poles strictly above the root bound, z = 0) so the jit cache is
+    keyed by bucket instead of by the data-dependent deflated size k.
+    Padded z contribute nothing to the secular function; anchoring a live
+    root to a padded pole is still exact (the shifted representation needs
+    an ordered reference point, not a pole); only the log-product
+    z-refinement must exclude padded ROWS, via ``live``.
     """
     k = ds.shape[0]
     zsq = zs * zs
@@ -146,11 +154,12 @@ def _secular_vcols_device(ds, zs, rho):
     mu = 0.5 * (lo + hi)
     lam_live = danchor + mu
     m = delta - mu[:, None]
-    logm = jnp.log(jnp.abs(m))
+    logm = jnp.where(live[:, None], jnp.log(jnp.abs(m)), 0.0)
     dd = ds[None, :] - ds[:, None]
     dd = dd.at[idx, idx].set(1.0)
     logdd = jnp.log(jnp.abs(dd))
     logdd = logdd.at[idx, idx].set(0.0)
+    logdd = jnp.where(live[:, None], logdd, 0.0)
     log_zhat2 = logm.sum(0) - logdd.sum(0)
     zhat = jnp.sign(zs) * jnp.exp(0.5 * log_zhat2)
     vcols = zhat[None, :] / m
@@ -230,10 +239,28 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
             zsk = zs[idx_live]
             if (use_device and k >= _device_secular_min_k()
                     and jax.config.jax_enable_x64):
+                # bucket to the next power of two so the jit cache is keyed
+                # by bucket, not by the data-dependent deflated size k:
+                # padded poles sit strictly above the root bound with z = 0
+                kb = 1 << max(0, (k - 1).bit_length())
+                if kb > k:
+                    span = rho_n * float((zsk * zsk).sum()) + 1.0
+                    # scale-aware step: at |d| ~ 1e17 an absolute +1.0 would
+                    # round away, colliding a padded pole with a live one
+                    step = max(1.0, 16 * np.spacing(abs(dsk[-1]) + span))
+                    ds_b = np.concatenate(
+                        [dsk, dsk[-1] + span
+                         + step * np.arange(1.0, kb - k + 1)])
+                    zs_b = np.concatenate([zsk, np.zeros(kb - k)])
+                else:
+                    ds_b, zs_b = dsk, zsk
+                live_b = np.zeros(kb, dtype=bool)
+                live_b[:k] = True
                 lam_j, vcols_j = _secular_vcols_device(
-                    jnp.asarray(dsk), jnp.asarray(zsk), jnp.float64(rho_n))
-                lam_live = np.asarray(lam_j)
-                vcols = np.asarray(vcols_j)
+                    jnp.asarray(ds_b), jnp.asarray(zs_b), jnp.float64(rho_n),
+                    jnp.asarray(live_b))
+                lam_live = np.asarray(lam_j)[:k]
+                vcols = np.asarray(vcols_j)[:k, :k]
             else:
                 anchor, mu = _secular_roots_host(dsk, zsk, rho_n)
                 lam_live = dsk[anchor] + mu
