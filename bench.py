@@ -96,6 +96,16 @@ def run_bench() -> None:
 
     import dlaf_tpu.config as config
 
+    def timed_run(ref_mat, dt, n):
+        """One fenced factorization (the reference's miniapp protocol)."""
+        mat = ref_mat.with_storage(ref_mat.storage + 0)
+        mat.storage.block_until_ready()
+        t0 = time.perf_counter()
+        out = cholesky("L", mat)
+        out.storage.block_until_ready()
+        t = time.perf_counter() - t0
+        return t, total_ops(dt, n**3 / 6, n**3 / 6) / t / 1e9
+
     best, best_variant = 0.0, variants[0]
     sweep_t0 = time.perf_counter()
     for vi, variant in enumerate(variants):
@@ -106,13 +116,7 @@ def run_bench() -> None:
         config.initialize()
         try:
             for i in range(3):  # 1 warmup (compile) + 2 timed
-                mat = ref.with_storage(ref.storage + 0)
-                mat.storage.block_until_ready()
-                t0 = time.perf_counter()
-                out = cholesky("L", mat)
-                out.storage.block_until_ready()
-                t = time.perf_counter() - t0
-                gflops = total_ops(dtype, n**3 / 6, n**3 / 6) / t / 1e9
+                t, gflops = timed_run(ref, dtype, n)
                 log(f"[{variant}] run {i}: {t:.4f}s {gflops:.1f} GFlop/s")
                 if i > 0 and gflops > best:
                     best, best_variant = gflops, variant
@@ -124,6 +128,9 @@ def run_bench() -> None:
         log("all trailing variants failed; no measurement")
         sys.exit(1)
 
+    # the driver's JSON line goes out FIRST: anything after this (the f32
+    # info probe) can wedge on the accelerator without losing the landed
+    # f64 measurement
     result = {
         "metric": (f"miniapp_cholesky {np.dtype(dtype).name} N={n} nb={nb} "
                    f"local GFlop/s [{platform}] trailing={best_variant}"),
@@ -132,6 +139,24 @@ def run_bench() -> None:
         "vs_baseline": 1.0,
     }
     print(json.dumps(result), flush=True)
+
+    # informational MXU-tier number (stderr only — the headline metric stays
+    # f64 per BASELINE config #1): same fenced protocol at float32
+    if dtype == np.float64 and time.perf_counter() - sweep_t0 < budget_s:
+        try:
+            os.environ["DLAF_CHOLESKY_TRAILING"] = best_variant
+            config.initialize()
+            ref32 = Matrix.from_element_fn(hpd_element_fn(n, np.float32),
+                                           size, block, dtype=np.float32)
+            for i in range(3):  # run 0 = compile warmup, like the f64 sweep
+                t, g32 = timed_run(ref32, np.float32, n)
+                if i > 0:
+                    log(f"[info] float32 run {i}: {t:.4f}s {g32:.1f} GFlop/s")
+        except Exception as e:
+            log(f"[info] float32 probe failed: {e!r}")
+        finally:
+            os.environ.pop("DLAF_CHOLESKY_TRAILING", None)
+            config.initialize()
 
 
 def main() -> None:
