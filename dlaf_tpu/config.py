@@ -31,6 +31,11 @@ class Configuration:
     #: Implementation of the band->tridiag bulge chasing stage:
     #: "native" (C++ via ctypes) with automatic fallback to "numpy".
     band_to_tridiag_impl: str = "native"
+    #: Worker threads for the native chase's pipelined sweeps (the
+    #: reference's SweepWorker pipeline, band_to_tridiag/mc.h:362-380):
+    #: 0 = auto (CPU count), 1 = sequential. Any count gives bitwise
+    #: identical results (pipelined windows are disjoint).
+    chase_threads: int = 0
     #: Host secular-equation solver in the D&C merge: "native" (C++
     #: safeguarded Newton, the laed4 analog) with fallback to "numpy"
     #: (vectorized bisection).

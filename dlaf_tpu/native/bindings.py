@@ -116,9 +116,29 @@ def secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
     return anchor, mu
 
 
-def band_to_tridiag(band: np.ndarray, b: int):
+def _chase_threads() -> int:
+    """Worker count for the pipelined sweep chase: the config knob
+    ``chase_threads`` (0 = auto = CPU count; 1 = sequential). Results are
+    bitwise identical at any count (disjoint pipelined windows)."""
+    from ..config import get_configuration
+
+    t = get_configuration().chase_threads
+    if t <= 0:
+        # affinity-aware (cgroup/taskset-limited) count: oversubscribed
+        # spin-yield workers would thrash, not idle
+        try:
+            t = len(os.sched_getaffinity(0))
+        except AttributeError:  # non-Linux
+            t = os.cpu_count() or 1
+    return t
+
+
+def band_to_tridiag(band: np.ndarray, b: int, nthreads: int | None = None):
     """Native chase; same result contract as
-    :func:`dlaf_tpu.eigensolver.band_to_tridiag.band_to_tridiag_numpy`."""
+    :func:`dlaf_tpu.eigensolver.band_to_tridiag.band_to_tridiag_numpy`.
+
+    ``nthreads``: None or <= 0 means the config/auto policy (same as
+    ``chase_threads = 0``); 1 sequential; > 1 pipelined workers."""
     from ..eigensolver.band_to_tridiag import TridiagResult
 
     n = band.shape[1]
@@ -139,7 +159,9 @@ def band_to_tridiag(band: np.ndarray, b: int):
                 v.ctypes.data_as(ctypes.c_void_p),
                 tau.ctypes.data_as(ctypes.c_void_p),
                 d.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-                e_raw.ctypes.data_as(ctypes.c_void_p))
+                e_raw.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_long(nthreads if nthreads is not None and nthreads > 0
+                              else _chase_threads()))
         if rc != 0:
             raise RuntimeError(f"native band_to_tridiag failed rc={rc}")
     phase = np.ones(n, dtype=work_dtype)
