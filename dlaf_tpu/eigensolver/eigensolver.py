@@ -78,6 +78,7 @@ def eigensolver(uplo: str, a: Matrix,
         tri = band_to_tridiag(band, red.band)
     with pt.phase("tridiag_solver"):
         lam, z = tridiag_solver(tri.d, tri.e, nb)
+        fence(z)
     with pt.phase("bt_band_to_tridiag"):
         if distributed:
             zb = bt_band_to_tridiag(

@@ -302,12 +302,17 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
         qc = qc[:, fin]
 
     # -- eigenvector assembly: blkdiag(q1, q2) @ qc (device gemms) ----------
+    # Device path: Q stays DEVICE-RESIDENT across the whole merge tree —
+    # only the edge rows (z) and the small host-control vectors ever cross
+    # to the host; qc is pushed up once per merge. (The reference's
+    # host-mirror split moves whole matrices per merge; on TPU the PCIe
+    # round trips would dominate the stage.)
     if use_device:
-        top = np.asarray(jnp.matmul(jnp.asarray(q1), jnp.asarray(qc[:n1, :])))
-        bot = np.asarray(jnp.matmul(jnp.asarray(q2), jnp.asarray(qc[n1:, :])))
-    else:
-        top = q1 @ qc[:n1, :]
-        bot = q2 @ qc[n1:, :]
+        top = jnp.matmul(jnp.asarray(q1), jnp.asarray(qc[:n1, :]))
+        bot = jnp.matmul(jnp.asarray(q2), jnp.asarray(qc[n1:, :]))
+        return lam, jnp.concatenate([top, bot], axis=0)
+    top = q1 @ qc[:n1, :]
+    bot = q2 @ qc[n1:, :]
     return lam, np.vstack([top, bot])
 
 
@@ -315,14 +320,20 @@ def tridiag_solver(d: np.ndarray, e: np.ndarray, nb: int,
                    use_device: bool = True):
     """Eigendecomposition of the real symmetric tridiagonal (d, e): returns
     ``(eigenvalues, eigenvectors)`` ascending (reference
-    ``eigensolver::tridiagSolver``)."""
+    ``eigensolver::tridiagSolver``).
+
+    With ``use_device=True`` the eigenvector matrix is a DEVICE-RESIDENT
+    (immutable) ``jax.Array`` — Q never round-trips to the host across the
+    merge tree; use ``np.asarray`` for a host copy. ``use_device=False``
+    returns plain numpy arrays."""
     d = np.asarray(d, dtype=np.float64)
     e = np.asarray(e, dtype=np.float64)
     n = d.shape[0]
     if n == 0:
-        return d, np.zeros((0, 0))
+        return d, (jnp.zeros((0, 0)) if use_device else np.zeros((0, 0)))
     if n <= max(nb, 2):
-        return stedc(d, e)
+        lam, q = stedc(d, e)
+        return lam, (jnp.asarray(q) if use_device else q)
     # split at a tile boundary near the middle (reference impl.h:66-80 splits
     # at every tile boundary; binary recursion reaches the same leaves)
     m = (n // 2 // nb) * nb
