@@ -60,9 +60,10 @@ class Configuration:
     #: the reference's b x b HH re-tiling) or "sweeps" (one batched rank-1
     #: segment update per sweep).
     bt_b2t_impl: str = "blocked"
-    #: Sweeps per compact-WY group for bt_b2t_impl="blocked"; 0 = band size
-    #: (the reference's group shape). Clamped to [1, min(band+1, n_sweeps)]
-    #: — band+1 is the disjointness bound of the blocked level reordering.
+    #: Sweeps per compact-WY group for bt_b2t_impl="blocked"; 0 = auto
+    #: (band size on MXU hardware, min(band, 64) on CPU). Clamped to
+    #: [1, min(band+1, n_sweeps)] — band+1 is the disjointness bound of the
+    #: blocked level reordering.
     bt_b2t_group: int = 0
     #: Enable float64/complex128 support (sets jax_enable_x64).
     enable_x64: bool = True

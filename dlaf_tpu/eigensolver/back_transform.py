@@ -152,11 +152,21 @@ def _bt_b2t_params():
 
 
 def _effective_group(b: int, n_sweeps: int, group: int) -> int:
-    """Effective compact-WY group size: 0 means band size; values are
-    clamped to [1, min(band+1, n_sweeps)] (the disjointness bound of the
-    level reordering; see _bt_b2t_blocked)."""
-    g = group if group > 0 else b
-    return max(1, min(g, b + 1, n_sweeps))
+    """Effective compact-WY group size: 0 means auto — band size on MXU
+    hardware (big-gemm shaped), min(band, 64) on CPU hosts where the extra
+    (band+G)/band flops outweigh gemm width (measured: G=64 fastest at
+    band=256 on one core). Values are clamped to [1, min(band+1, n_sweeps)]
+    (the disjointness bound of the level reordering; see _bt_b2t_blocked)."""
+    if group <= 0:
+        from ..tpu_info import default_device
+        from ..types import Device
+
+        try:
+            on_cpu = default_device() == Device.CPU
+        except Exception:
+            on_cpu = True
+        group = min(b, 64) if on_cpu else b
+    return max(1, min(group, b + 1, n_sweeps))
 
 
 def _apply_chase_reflectors(v_all, tau_all, e, *, b: int, n: int,
