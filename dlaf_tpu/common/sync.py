@@ -1,0 +1,45 @@
+"""Real device synchronization for timing fences.
+
+``jax.Array.block_until_ready`` is the canonical fence, but on remote-tunnel
+PJRT platforms (device proxies) it has been observed returning before the
+producing computation actually executes — so enqueue time masquerades as run
+time and throughput numbers inflate by an order of magnitude. A device→host
+readback of a value that depends on the array is a reliable barrier on every
+platform. :func:`hard_fence` does both: ``block_until_ready`` (correct and
+sufficient on local backends) plus a one-element readback (forces completion
+through proxies). The readback cost is a single-element transfer — noise next
+to any timed region worth measuring.
+
+Reference analog: the fenced-timing protocol ``waitLocalTiles()`` +
+``MPI_Barrier`` around every benchmark region (miniapp_cholesky.cpp:134-146);
+this module is that fence made trustworthy on TPU tunnels.
+
+Note: on a sharded array the readback pulls one element from the first
+shard. All shards of one array are defined by the same launched program, so
+completion of any output buffer implies the program ran; per-device skew is
+bounded by the program itself.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["hard_fence"]
+
+
+def hard_fence(*arrays):
+    """Block until every given array's producing computation has really run.
+
+    Accepts jax Arrays (or anything with ``block_until_ready``); numpy
+    arrays and ``None`` pass through untouched. Returns the single argument
+    (or the tuple) for call-site chaining.
+    """
+    for x in arrays:
+        if x is None:
+            continue
+        if hasattr(x, "block_until_ready"):
+            x.block_until_ready()
+            if getattr(x, "size", 0):
+                # tiny readback: the only fence proxies cannot lie about
+                np.asarray(x[(0,) * x.ndim])
+    return arrays[0] if len(arrays) == 1 else arrays

@@ -22,6 +22,7 @@ import numpy as np
 
 from typing import Optional
 
+from ..common.sync import hard_fence
 from ..algorithms.cholesky import cholesky
 from ..algorithms.gen_to_std import gen_to_std
 from ..algorithms.triangular import triangular_solve
@@ -66,7 +67,7 @@ def eigensolver(uplo: str, a: Matrix,
     pt = phases if phases is not None else PhaseTimer()
     # per-phase device fences only when timing was requested — they would
     # otherwise serialize stage compile/dispatch against device execution
-    fence = ((lambda x: x.block_until_ready()) if phases is not None
+    fence = (hard_fence if phases is not None
              else (lambda x: None))
     distributed = a.grid is not None and a.grid.num_devices > 1
     with pt.phase("reduction_to_band"):
@@ -109,7 +110,7 @@ def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
     LOCAL-only in the reference — here every stage also runs distributed)."""
     dlaf_assert(a.size == b.size, "gen_eigensolver: A/B size mismatch")
     pt = phases if phases is not None else PhaseTimer()
-    fence = ((lambda x: x.block_until_ready()) if phases is not None
+    fence = (hard_fence if phases is not None
              else (lambda x: None))
     with pt.phase("cholesky"):
         bf = cholesky(uplo, b)

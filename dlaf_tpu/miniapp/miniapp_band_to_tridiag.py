@@ -87,5 +87,12 @@ def check(band, b, res, n) -> None:
         sys.exit(1)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

@@ -20,6 +20,7 @@ import time
 import numpy as np
 
 from .. import config
+from ..common.sync import hard_fence
 from ..common.index2d import TileElementSize
 from ..comm.grid import Grid
 from ..eigensolver.back_transform import bt_band_to_tridiag
@@ -63,10 +64,10 @@ def run(argv=None) -> list[dict]:
     results = []
     for run_i in range(-opts.nwarmups, opts.nruns):
         e_in = em.with_storage(em.storage + 0)
-        e_in.storage.block_until_ready()
+        hard_fence(e_in.storage)
         t0 = time.perf_counter()
         out = bt_band_to_tridiag(tri, e_in)
-        out.storage.block_until_ready()
+        hard_fence(out.storage)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, 2.0 * n * n * m, 2.0 * n * n * m) / t / 1e9
         if run_i < 0:
@@ -98,5 +99,12 @@ def check(tri, e0, out) -> None:
         sys.exit(1)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

@@ -21,6 +21,7 @@ import time
 import numpy as np
 
 from .. import config
+from ..common.sync import hard_fence
 from ..algorithms.cholesky import cholesky
 from ..comm.grid import Grid
 from ..common.index2d import GlobalElementSize, TileElementSize
@@ -72,11 +73,11 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
     n, nb = args.matrix_size, args.block_size
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)   # fresh copy per run (:127-128)
-        mat.storage.block_until_ready()           # start fence (:134-136)
+        hard_fence(mat.storage)                   # start fence (:134-136)
         t0 = time.perf_counter()
         with ptimer.phase(f"cholesky[{run_i}]"):
             out = cholesky(args.uplo, mat)
-            out.storage.block_until_ready()       # end fence (:142-144)
+            hard_fence(out.storage)               # end fence (:142-144)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, n**3 / 6, n**3 / 6) / t / 1e9
         if run_i < 0:
@@ -113,5 +114,12 @@ def check_cholesky(uplo: str, ref: Matrix, out: Matrix) -> None:
         sys.exit(1)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

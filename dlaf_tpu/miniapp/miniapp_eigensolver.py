@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from .. import config
+from ..common.sync import hard_fence
 from ..common.index2d import GlobalElementSize, TileElementSize
 from ..eigensolver.eigensolver import eigensolver, gen_eigensolver
 from ..matrix.matrix import Matrix
@@ -81,7 +82,7 @@ def run(argv=None) -> list[dict]:
         ptimer = PhaseTimer(config.get_configuration().profile_dir or None)
         phases = ptimer if profiling else None
         a_in = am.with_storage(am.storage + 0)
-        a_in.storage.block_until_ready()
+        hard_fence(a_in.storage)
         t0 = time.perf_counter()
         try:
             if args.generalized:
@@ -90,7 +91,7 @@ def run(argv=None) -> list[dict]:
             else:
                 res = eigensolver(args.uplo, a_in, phases=phases,
                                   band_size=band)
-            res.eigenvectors.storage.block_until_ready()
+            hard_fence(res.eigenvectors.storage)
         finally:
             ptimer.stop()
         t = time.perf_counter() - t0
@@ -135,5 +136,12 @@ def check(args, am, bm, res) -> None:
         sys.exit(1)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

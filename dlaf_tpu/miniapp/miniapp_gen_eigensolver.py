@@ -24,5 +24,12 @@ def run(argv=None) -> list[dict]:
     return _run_eigensolver(argv)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

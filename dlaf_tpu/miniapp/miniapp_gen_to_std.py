@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 from .. import config
+from ..common.sync import hard_fence
 from ..algorithms.cholesky import cholesky
 from ..algorithms.gen_to_std import gen_to_std
 from ..comm.grid import Grid
@@ -55,16 +56,16 @@ def run(argv=None) -> list[dict]:
     bm = Matrix.from_element_fn(hpd_element_fn(n, opts.dtype), size, block,
                                 grid=use_grid, dtype=opts.dtype)
     bf = cholesky(args.uplo, bm)
-    bf.storage.block_until_ready()
+    hard_fence(bf.storage)
 
     backend = devices[0].platform
     results = []
     for run_i in range(-opts.nwarmups, opts.nruns):
         a_in = am.with_storage(am.storage + 0)
-        a_in.storage.block_until_ready()
+        hard_fence(a_in.storage)
         t0 = time.perf_counter()
         out = gen_to_std(args.uplo, a_in, bf)
-        out.storage.block_until_ready()
+        hard_fence(out.storage)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, n**3 / 2, n**3 / 2) / t / 1e9
         if run_i < 0:
@@ -107,5 +108,12 @@ def _hermfull(a, uplo):
     return tri + tri.conj().T + np.diag(np.real(np.diag(a)))
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

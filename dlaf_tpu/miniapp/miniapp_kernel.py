@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import config
+from ..common.sync import hard_fence
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
 from ..types import total_ops, type_letter
@@ -61,7 +62,7 @@ def run(argv=None):
     for run_i in range(-opts.nwarmups, opts.nruns):
         t0 = time.perf_counter()
         out = jfn()
-        out.block_until_ready()
+        hard_fence(out)
         t = time.perf_counter() - t0
         gflops = total_ops(dtype, half_flops, half_flops) / t / 1e9
         if run_i < 0:
@@ -73,5 +74,12 @@ def run(argv=None):
     return results
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

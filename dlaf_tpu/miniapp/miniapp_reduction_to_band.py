@@ -19,6 +19,7 @@ import time
 import numpy as np
 
 from .. import config
+from ..common.sync import hard_fence
 from ..comm.grid import Grid
 from ..common.index2d import GlobalElementSize, TileElementSize
 from ..eigensolver.reduction_to_band import reduction_to_band
@@ -64,10 +65,10 @@ def run(argv=None) -> list[dict]:
     results = []
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)
-        mat.storage.block_until_ready()
+        hard_fence(mat.storage)
         t0 = time.perf_counter()
         red = reduction_to_band(mat, band_size=band)
-        red.matrix.storage.block_until_ready()
+        hard_fence(red.matrix.storage)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, 2 * n**3 / 3, 2 * n**3 / 3) / t / 1e9
         if run_i < 0:
@@ -104,5 +105,12 @@ def check(ref, red, n, band) -> None:
         sys.exit(1)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()

@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 from .. import config
+from ..common.sync import hard_fence
 from ..algorithms.triangular import triangular_solve
 from ..comm.grid import Grid
 from ..common.index2d import GlobalElementSize, TileElementSize
@@ -74,11 +75,11 @@ def run(argv=None) -> list[dict]:
     results = []
     for run_i in range(-opts.nwarmups, opts.nruns):
         b_in = bm.with_storage(bm.storage + 0)
-        b_in.storage.block_until_ready()
+        hard_fence(b_in.storage)
         t0 = time.perf_counter()
         out = triangular_solve(args.side, args.uplo, args.op, args.diag, 1.0,
                                am, b_in)
-        out.storage.block_until_ready()
+        hard_fence(out.storage)
         t = time.perf_counter() - t0
         gflops = trsm_flops(opts.dtype, args.side, m, n) / t / 1e9
         if run_i < 0:
@@ -112,5 +113,12 @@ def check(args, am: Matrix, bm: Matrix, out: Matrix) -> None:
         sys.exit(1)
 
 
+def main(argv=None) -> int:
+    """Console-script entry: run() returns per-run results for
+    library callers; exit status must not carry that list."""
+    run(argv)
+    return 0
+
+
 if __name__ == "__main__":
-    run()
+    main()
