@@ -86,13 +86,18 @@ def run_bench() -> None:
     from dlaf_tpu.algorithms.cholesky import VALID_TRAILING
 
     pinned = os.environ.get("DLAF_BENCH_TRAILING")
-    # measured winner first (loop beat biggemm/xla on the v5e tunnel): if the
-    # time budget runs out (or the accelerator tunnel wedges mid-sweep) the
-    # best measurement has already landed
-    order = ["loop", "biggemm", "xla", "invgemm"]
+    # measured winner first (ozaki 99 GF/s vs xla 47 / loop 43 on the v5e
+    # tunnel, honest hard_fence timing): if the time budget runs out (or the
+    # accelerator tunnel wedges mid-sweep) the best measurement has landed
+    order = ["ozaki", "xla", "loop", "biggemm", "invgemm"]
     variants = [pinned] if pinned else \
         [v for v in order if v in VALID_TRAILING] + \
         [v for v in VALID_TRAILING if v not in order]
+    if dtype != np.float64:
+        # "ozaki" is the emulated-f64 path; for other dtypes it statically
+        # falls back to biggemm — skip the duplicate (compile minutes) and
+        # keep the metric label truthful
+        variants = [v for v in variants if v != "ozaki"] or ["loop"]
     budget_s = float(os.environ.get("DLAF_BENCH_BUDGET", "1500"))
 
     import dlaf_tpu.config as config

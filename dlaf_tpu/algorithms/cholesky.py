@@ -45,6 +45,8 @@ from ..matrix.panel import DistContext, transpose_col_to_rows, transpose_row_to_
 from ..matrix.tiling import storage_tile_grid, tiles_to_global, global_to_tiles
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
+from ..tile_ops import mixed as mx
+from ..tile_ops import ozaki as oz
 from ..tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
 from ..types import ceil_div
 
@@ -55,11 +57,17 @@ from ..types import ceil_div
 
 #: Valid cholesky_trailing strategies (see config.Configuration); bench.py
 #: sweeps this set on the measured hardware.
-VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla")
+VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki")
 
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     n = a.shape[0]
+    # "ozaki": route the flops-dominant trailing update through int8 MXU
+    # passes (tile_ops.ozaki) — real f64 only; other dtypes keep the native
+    # whole-gemm form (static fallback, decided at trace time)
+    use_oz = trailing == "ozaki" and a.dtype == jnp.float64
+    if trailing == "ozaki" and not use_oz:
+        trailing = "biggemm"
     if trailing == "xla" and n:
         # whole-matrix XLA cholesky: the compiler's own fused/blocked
         # factorization (a TPU-native option the reference cannot take —
@@ -77,7 +85,17 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     nt = ceil_div(n, nb) if n else 0
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, n)
-        diag = tl.potrf(uplo, a[k0:k1, k0:k1])
+        blk = a[k0:k1, k0:k1]
+        if use_oz:
+            # latency-bound panel ops in mixed precision (f32 seed + Newton,
+            # tile_ops.mixed): emulated-f64 potrf/trsm are the wall-clock
+            # bottleneck on TPU, not the trailing flops
+            fac = mx.potrf_refined(uplo, blk)
+            other = "U" if uplo == "L" else "L"
+            diag = fac + tb.tri_mask(blk, other, k=-1)
+        else:
+            fac = None
+            diag = tl.potrf(uplo, blk)
         a = a.at[k0:k1, k0:k1].set(diag)
         if k1 == n:
             break
@@ -85,7 +103,12 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         if uplo == "L":
             # panel: A[k1:, k] <- A[k1:, k] Lkk^-H   (tile::trsm, high-prio
             # in the reference impl.h:147-156; here XLA schedules it)
-            if trailing == "invgemm":
+            if use_oz:
+                # refined explicit inverse -> the panel solve is one small
+                # f64 gemm (throughput-bound) instead of an emulated trsm
+                linv = mx.tri_inv_refined(fac, lower=True)
+                panel = a[k1:, k0:k1] @ linv.T
+            elif trailing == "invgemm":
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
@@ -106,13 +129,18 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                                         alpha=-1.0, beta=1.0, op_b="C")
                         a = a.at[j1:, j0:j1].set(below)
             else:
-                # ONE full trailing gemm, masked to the lower triangle
-                upd = panel @ jnp.conj(panel).T
+                # ONE full trailing update, masked to the lower triangle;
+                # "ozaki" forms it with int8 MXU passes instead of the
+                # software-emulated f64 gemm
+                upd = oz.syrk_f64(panel) if use_oz else panel @ jnp.conj(panel).T
                 mask = jnp.tril(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
         else:
             # upper: A = U^H U; panel is a block row
-            if trailing == "invgemm":
+            if use_oz:
+                uinv = mx.tri_inv_refined(fac, lower=False)
+                panel = uinv.T @ a[k0:k1, k1:]
+            elif trailing == "invgemm":
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
                 panel = jnp.conj(dinv).T @ a[k0:k1, k1:]
@@ -130,7 +158,8 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                                         alpha=-1.0, beta=1.0, op_a="C")
                         a = a.at[j0:j1, j1:].set(right)
             else:
-                upd = jnp.conj(panel).T @ panel
+                upd = (oz.syrk_f64(jnp.swapaxes(panel, -1, -2)) if use_oz
+                       else jnp.conj(panel).T @ panel)
                 mask = jnp.triu(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
     return a

