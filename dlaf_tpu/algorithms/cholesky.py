@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
@@ -59,6 +60,7 @@ from ..types import ceil_div
 #: sweeps this set on the measured hardware.
 VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki")
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     n = a.shape[0]
@@ -169,8 +171,15 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
 # Distributed — reference impl.h:174-276
 # ---------------------------------------------------------------------------
 
-def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
+def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
+                         use_mxu=False, use_mixed=False, cplx=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
+
+    ``use_mxu`` routes the trailing tile-pair contraction through the
+    error-free int8 MXU path (tile_ops.ozaki; ``cplx`` picks the complex128
+    composition); ``use_mixed`` (real f64 only) factors/solves the panel with
+    the f32-seed-plus-Newton helpers (tile_ops.mixed) instead of emulated-f64
+    potrf/trsm. Both follow the ``f64_gemm="mxu"`` config knob.
 
     The returned function maps tile storage -> tile storage. All index
     arithmetic below is trace-time (static per k); only data and the
@@ -214,7 +223,15 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
             pad = (jnp.arange(mb) >= ts)
             diag = jnp.where(pad[:, None] | pad[None, :], 0, diag) \
                 + jnp.diag(pad.astype(diag.dtype))
-        lkk = tl.potrf(uplo, diag)  # redundant tiny compute on every rank
+        # redundant tiny compute on every rank; mixed mode swaps the
+        # latency-bound emulated-f64 potrf for the f32-seed + Newton form
+        if use_mixed:
+            fac = mx.potrf_refined(uplo, diag)
+            other = "U" if uplo == "L" else "L"
+            lkk = fac + tb.tri_mask(diag, other, k=-1)
+        else:
+            fac = None
+            lkk = tl.potrf(uplo, diag)
 
         # owner writes the factored diagonal back
         upd_tile = jnp.where(is_owner_r & is_owner_c, lkk, lt[kr, kc])
@@ -222,7 +239,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
         if k == nt - 1:
             return lt
         if uplo == "U":
-            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk)
+            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk, fac)
 
         # -- panel trsm on owner column (reference impl.h:222-231) ----------
         # uniform local row start: every rank's rows >= k+1 live at slots
@@ -233,8 +250,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
             return lt
         g_rows = local_rows_global(lu_r, rr, nrows)
         row_valid = (g_rows > k) & (g_rows < nt)
-        pan = tb.trsm("R", "L", "C", "N",
-                      jnp.broadcast_to(lkk, (nrows,) + lkk.shape), lt[lu_r:, kc])
+        if use_mixed:
+            linv = mx.tri_inv_refined(fac, lower=True)
+            pan = lt[lu_r:, kc] @ linv.T
+        else:
+            pan = tb.trsm("R", "L", "C", "N",
+                          jnp.broadcast_to(lkk, (nrows,) + lkk.shape),
+                          lt[lu_r:, kc])
         pan = jnp.where(row_valid[:, None, None], pan, jnp.zeros_like(pan))
         # owner column keeps the factored panel (others keep their tiles)
         keep = (is_owner_c & row_valid)[:, None, None]
@@ -270,15 +292,23 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
                                                interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
         else:
-            upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
-                             preferred_element_type=vr.dtype)
+            if use_mxu:
+                # same contraction through int8 MXU passes: flatten the tile
+                # batch into one (nrows*mb) x mb by (ncols*mb) x mb product
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                full = mmfn(vr.reshape(nrows * mb, mb),
+                            jnp.conj(vc).reshape(ncols * mb, mb).T)
+                upd = full.reshape(nrows, mb, ncols, mb).transpose(0, 2, 1, 3)
+            else:
+                upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
+                                 preferred_element_type=vr.dtype)
             tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
             mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
             upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
             lt = lt.at[lu_r:, lu_c:].add(-upd)
         return lt
 
-    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk):
+    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk, fac=None):
         """Mirrored sweep for uplo='U' (reference ``call_U``): panel is the
         block row k, trailing update hits upper-triangle tile pairs."""
         is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
@@ -290,8 +320,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
             return lt
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
-        pan = tb.trsm("L", "U", "C", "N",
-                      jnp.broadcast_to(ukk, (ncols,) + ukk.shape), lt[kr, lu_c:])
+        if use_mixed:
+            uinv = mx.tri_inv_refined(fac, lower=False)
+            pan = jnp.matmul(uinv.T, lt[kr, lu_c:])
+        else:
+            pan = tb.trsm("L", "U", "C", "N",
+                          jnp.broadcast_to(ukk, (ncols,) + ukk.shape),
+                          lt[kr, lu_c:])
         pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
         keep = (is_owner_r & col_valid)[:, None, None]
         lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
@@ -321,8 +356,15 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
                 jnp.swapaxes(vc, -1, -2), mode, interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
         else:
-            upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vr), vc,
-                             preferred_element_type=vr.dtype)
+            if use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
+                bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
+                full = mmfn(ar, bc.T)
+                upd = full.reshape(nrows, mb, ncols, mb).transpose(0, 2, 1, 3)
+            else:
+                upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vr), vc,
+                                 preferred_element_type=vr.dtype)
             triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
             mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
             upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
@@ -338,12 +380,16 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=64)
-def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas, pallas_interpret):
+def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
+                          pallas_interpret, use_mxu, use_mixed):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
-                                        pallas_interpret))
+                                        pallas_interpret, use_mxu=use_mxu,
+                                        use_mixed=use_mixed,
+                                        cplx=dtype.startswith("complex")))
 
 
 # ---------------------------------------------------------------------------
@@ -373,7 +419,16 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
                               trailing=trailing)
         return mat.with_storage(global_to_tiles(out, mat.dist))
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
-    fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, np.dtype(mat.dtype).name,
-                               uplo, supports_pallas_update(mat.dtype, platform),
-                               platform != "tpu")
+    cfg = get_configuration()
+    dt = np.dtype(mat.dtype)
+    use_mxu = (cfg.f64_gemm == "mxu"
+               and dt in (np.dtype(np.float64), np.dtype(np.complex128))
+               and mat.block_size.row >= cfg.f64_gemm_min_dim)
+    # panel potrf/trsm follow the f64_trsm knob, independent of f64_gemm
+    # (config.py: f64_gemm affects contractions only)
+    use_mixed = cfg.f64_trsm == "mixed" and dt == np.dtype(np.float64)
+    fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, dt.name, uplo,
+                               supports_pallas_update(mat.dtype, platform)
+                               and not use_mxu,
+                               platform != "tpu", use_mxu, use_mixed)
     return mat.with_storage(fn(mat.storage))

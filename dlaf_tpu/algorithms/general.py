@@ -18,11 +18,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=128)
 def _gemm_cached(dist_a, dist_b, dist_c, sharding, a0, a1, alpha_beta_static=None):
     def prog(sa, sb, sc, alpha, beta):

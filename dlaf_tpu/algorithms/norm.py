@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..matrix.matrix import Matrix
@@ -58,6 +59,7 @@ def _build_dist_norm(dist, mesh, uplo: str):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=64)
 def _dist_norm_cached(dist, mesh, uplo):
     return jax.jit(_build_dist_norm(dist, mesh, uplo))

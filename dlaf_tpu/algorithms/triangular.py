@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..matrix.matrix import Matrix
@@ -48,11 +49,13 @@ def _tile_op(t, op: str):
 # Local: direct XLA lowering
 # ---------------------------------------------------------------------------
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"))
 def _solve_local(a, b, alpha, *, side, uplo, op, diag):
     return tb.trsm(side, uplo, op, diag, a, b, alpha=alpha)
 
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"))
 def _mult_local(a, b, alpha, *, side, uplo, op, diag):
     return tb.trmm(side, uplo, op, diag, a, b, alpha=alpha)
@@ -83,8 +86,7 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
             if side == "L":
                 # solve op(Akk) Xk = Bk for tile row k of B (all local cols)
                 bk = row_panel(ctx_b, ltb, k, 0)
-                xk = tb.trsm("L", uplo, op, diag,
-                             jnp.broadcast_to(akk, bk.shape[:1] + akk.shape), bk)
+                xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
                 own = ctx_b.rank_r == ctx_b.owner_r(k)
                 row = ctx_b.kr(k)
                 ltb = ltb.at[row].set(jnp.where(own, xk, ltb[row]))
@@ -113,8 +115,7 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
             else:
                 # solve Xk op(Akk) = Bk for tile col k of B (all local rows)
                 bk = col_panel(ctx_b, ltb, k, 0)
-                xk = tb.trsm("R", uplo, op, diag,
-                             jnp.broadcast_to(akk, bk.shape[:1] + akk.shape), bk)
+                xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
                 own = ctx_b.rank_c == ctx_b.owner_c(k)
                 col = ctx_b.kc(k)
                 ltb = ltb.at[:, col].set(jnp.where(own, xk, ltb[:, col]))
@@ -224,11 +225,13 @@ def _unit_diag(t, diag):
 # Public API (reference solver/triangular.h, multiplication/triangular.h)
 # ---------------------------------------------------------------------------
 
+@register_program_cache
 @functools.lru_cache(maxsize=128)
 def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
     return jax.jit(_build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=128)
 def _dist_mult_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
     return jax.jit(_build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))

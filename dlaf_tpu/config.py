@@ -65,6 +65,30 @@ class Configuration:
     #: [1, min(band+1, n_sweeps)] — band+1 is the disjointness bound of the
     #: blocked level reordering.
     bt_b2t_group: int = 0
+    #: Real-f64 level-3 contraction backend for the tile ops (gemm / herk /
+    #: her2k / hemm / trmm): "native" (XLA's dot — on TPU, compiler-emulated
+    #: double-double arithmetic) or "mxu" (error-free int8 slicing with exact
+    #: int32 accumulation, tile_ops/ozaki.py — ~2x native emulation on a v5e
+    #: and f64-grade accurate). Triangular *solves* are unaffected (they are
+    #: latency-, not throughput-bound; see tile_ops/mixed.py for that side).
+    f64_gemm: str = "native"
+    #: Smallest dimension for which f64_gemm="mxu" actually reroutes a
+    #: contraction; below it the slicing overhead outweighs the MXU win and
+    #: the native path is kept.
+    f64_gemm_min_dim: int = 128
+    #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
+    #: under TPU f64 emulation) or "mixed" (f32 seed + Newton refinement,
+    #: tile_ops/mixed.py: refined explicit inverse + matmul for per-tile
+    #: panel solves via tile_ops.blas.trsm_panel, and the distributed
+    #: cholesky's per-step panel potrf/trsm; the matmul application follows
+    #: f64_gemm, so with "mxu" it runs on the int8 path). Whole-matrix local
+    #: solves stay native either way.
+    f64_trsm: str = "native"
+    #: Conditioning guard for the "mixed" fast path, as a limit on the
+    #: squared diagonal ratio of the f32 seed factor (empirically
+    #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
+    #: worse take the native branch inside the compiled program).
+    mixed_cond_limit: float = 100.0
     #: Enable float64/complex128 support (sets jax_enable_x64).
     enable_x64: bool = True
     #: When non-empty, miniapps emit XLA/PJRT execution profiles
@@ -115,16 +139,42 @@ def update_configuration(
 
 _active: Optional[Configuration] = None
 
+#: Compiled-program caches (jitted fns / lru-cached program builders) whose
+#: traces bake in configuration decisions. Registered via
+#: :func:`register_program_cache`; cleared when initialize() lands a config
+#: that differs from the active one, so knob changes can never hit a stale
+#: trace. (The reference has no analog: its knobs steer a dynamic runtime;
+#: ours steer trace-time decisions that persist in compiled programs.)
+_PROGRAM_CACHES: list = []
+
+
+def register_program_cache(fn):
+    """Register a cache-bearing callable (``.cache_clear()`` from
+    functools.lru_cache or ``.clear_cache()`` from jax.jit) for invalidation
+    on configuration changes. Usable as a decorator; returns ``fn``."""
+    _PROGRAM_CACHES.append(fn)
+    return fn
+
+
+def _clear_program_caches() -> None:
+    for fn in _PROGRAM_CACHES:
+        clear = getattr(fn, "cache_clear", None) or getattr(fn, "clear_cache", None)
+        if clear is not None:
+            clear()
+
 
 def initialize(user: Optional[Configuration] = None,
                argv: Optional[Sequence[str]] = None) -> Configuration:
     """Bring up the runtime (analog of ``dlaf::initialize``, ``init.h:60-75``).
 
     Resolves configuration and applies process-wide JAX settings (x64). Safe
-    to call more than once; later calls re-resolve configuration.
+    to call more than once; later calls re-resolve configuration and drop
+    compiled-program caches if anything changed.
     """
     global _active
     cfg = update_configuration(user, argv)
+    if _active is not None and cfg != _active:
+        _clear_program_caches()
     if cfg.enable_x64:
         import jax
 

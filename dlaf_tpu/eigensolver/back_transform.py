@@ -46,6 +46,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
@@ -60,6 +61,7 @@ from .band_to_tridiag import TridiagResult
 from .reduction_to_band import BandReduction
 
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("b", "n", "group"))
 def _bt_b2t_blocked(v_all, tau_all, e, *, b: int, n: int, group: int):
     """E <- Q E via blocked compact-WY groups — the MXU form of the
@@ -116,6 +118,7 @@ def _bt_b2t_blocked(v_all, tau_all, e, *, b: int, n: int, group: int):
     return e_pad[:n]
 
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("b", "n"))
 def _bt_b2t_scan(v_all, tau_all, e, *, b: int, n: int):
     """E <- Q E with Q = prod over reflectors H^H in reverse sweep order."""
@@ -227,6 +230,7 @@ def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int,
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=32)
 def _dist_bt_b2t_cached(dist, mesh, b, cplx, n_sweeps, impl, group):
     return jax.jit(_build_dist_bt_b2t(dist, mesh, b=b, cplx=cplx,
@@ -281,6 +285,7 @@ def bt_band_to_tridiag(tri: TridiagResult, evecs):
     return Matrix(evecs.dist, out, evecs.grid)
 
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("nb",))
 def _bt_r2b_local(a_v, taus, e, *, nb: int):
     n = a_v.shape[0]
@@ -360,6 +365,7 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=32)
 def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band):
     return jax.jit(_build_dist_bt_r2b(dist_a, dist_c, mesh, band))

@@ -42,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from jax._src.lax.linalg import geqrf  # public in newer jax; stable primitive
 
+from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
@@ -68,6 +69,7 @@ class BandReduction:
 # Local
 # ---------------------------------------------------------------------------
 
+@register_program_cache
 @functools.partial(jax.jit, static_argnames=("nb",))
 def _red2band_local(a, *, nb: int):
     """Panels of width ``nb`` = the target bandwidth (any 1 <= nb <= n; the
@@ -216,6 +218,7 @@ def _build_dist_red2band(dist, mesh, dtype, band):
                      out_specs=(P(ROW_AXIS, COL_AXIS), P()), check_vma=False)
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=32)
 def _dist_red2band_cached(dist, mesh, dtype, band):
     return jax.jit(_build_dist_red2band(dist, mesh, dtype, band))

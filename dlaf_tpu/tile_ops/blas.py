@@ -34,6 +34,34 @@ def _op(a, op: str):
     raise ValueError(f"bad op {op!r}")
 
 
+def _mxu_f64(*arrs, dims) -> bool:
+    """Trace-time decision: route this f64/complex128 contraction through
+    the error-free int8 MXU path (config knob ``f64_gemm``; see
+    tile_ops/ozaki.py)? Programs caching this decision register with
+    ``config.register_program_cache`` so knob changes re-trace."""
+    from ..config import get_configuration
+
+    cfg = get_configuration()
+    if cfg.f64_gemm != "mxu":
+        return False
+    if any(x.dtype not in (jnp.float64, jnp.complex128) for x in arrs):
+        return False
+    return min(dims) >= cfg.f64_gemm_min_dim
+
+
+def _mm(a, b):
+    """Central matmul of the level-3 ops, with the f64_gemm="mxu" reroute."""
+    if _mxu_f64(a, b, dims=(a.shape[-2], a.shape[-1], b.shape[-1])):
+        from . import ozaki
+
+        if jnp.iscomplexobj(a) or jnp.iscomplexobj(b):
+            ac = a.astype(jnp.complex128)
+            bc = b.astype(jnp.complex128)
+            return ozaki.matmul_c128(ac, bc)
+        return ozaki.matmul_f64(a, b)
+    return a @ b
+
+
 def tri_mask(a, uplo: str, *, k: int = 0):
     """Keep the stored triangle of the last-two-dims block."""
     if uplo == "G":
@@ -82,7 +110,7 @@ def _tri(a, uplo: str, diag: str):
 
 def gemm(a, b, c=None, *, alpha=1.0, beta=0.0, op_a: str = "N", op_b: str = "N"):
     """``c = alpha op_a(a) op_b(b) + beta c`` (reference ``tile::gemm``)."""
-    prod = _op(a, op_a) @ _op(b, op_b)
+    prod = _mm(_op(a, op_a), _op(b, op_b))
     out = alpha * prod
     if c is not None and beta != 0.0:
         out = out + beta * c
@@ -93,7 +121,7 @@ def hemm(side: str, uplo: str, a, b, c=None, *, alpha=1.0, beta=0.0):
     """``c = alpha A b + beta c`` (side='L') with Hermitian ``A`` stored in
     ``uplo`` (reference ``tile::hemm``)."""
     af = hermitian_from(a, uplo)
-    prod = af @ b if side == "L" else b @ af
+    prod = _mm(af, b) if side == "L" else _mm(b, af)
     out = alpha * prod
     if c is not None and beta != 0.0:
         out = out + beta * c
@@ -109,7 +137,13 @@ def herk(uplo: str, op_a: str, a, c, *, alpha=1.0, beta=1.0):
     LAPACK update semantics so garbage triangles stay untouched.
     """
     oa = _op(a, op_a)
-    prod = oa @ jnp.conj(jnp.swapaxes(oa, -1, -2))
+    if _mxu_f64(oa, dims=(oa.shape[-2], oa.shape[-1])):
+        from . import ozaki
+
+        prod = (ozaki.herk_c128(oa) if jnp.iscomplexobj(oa)
+                else ozaki.syrk_f64(oa))
+    else:
+        prod = oa @ jnp.conj(jnp.swapaxes(oa, -1, -2))
     upd = alpha * prod + beta * c
     if jnp.iscomplexobj(c):  # herk guarantees a real diagonal
         d = _embed_diag(jnp.real(_diag_of(upd)) - _diag_of(upd), upd.shape, upd.dtype)
@@ -121,7 +155,7 @@ def her2k(uplo: str, op: str, a, b, c, *, alpha=1.0, beta=1.0):
     """``c = alpha op(a) op(b)^H + conj(alpha) op(b) op(a)^H + beta c`` on the
     ``uplo`` triangle (reference ``tile::her2k``; beta real)."""
     oa, ob = _op(a, op), _op(b, op)
-    prod = alpha * (oa @ jnp.conj(jnp.swapaxes(ob, -1, -2)))
+    prod = alpha * _mm(oa, jnp.conj(jnp.swapaxes(ob, -1, -2)))
     prod = prod + jnp.conj(jnp.swapaxes(prod, -1, -2))
     upd = prod + beta * c
     return _merge_triangle(upd, c, uplo)
@@ -137,7 +171,7 @@ def trmm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
     """``b = alpha op_a(A) b`` (side='L') with triangular ``A``
     (reference ``tile::trmm``)."""
     t = _op(_tri(a, uplo, diag), op_a)
-    prod = t @ b if side == "L" else b @ t
+    prod = _mm(t, b) if side == "L" else _mm(b, t)
     return (alpha * prod).astype(b.dtype)
 
 
@@ -155,6 +189,31 @@ def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
         conjugate_a=(op_a == "C"),
         unit_diagonal=(diag == "U"))
     return out.astype(b.dtype)
+
+
+def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
+    """``trsm`` with ONE (2D) triangular block ``a`` against a possibly
+    batched rhs ``b`` — the per-tile panel-solve pattern of the distributed
+    algorithms. Under config ``f64_trsm="mixed"`` (real f64) the solve
+    becomes refined-explicit-inverse (tile_ops.mixed, computed once, not per
+    batch entry) times matmul (which follows ``f64_gemm``, so "mxu" puts the
+    application on the int8 path); otherwise ``a`` broadcasts into the
+    native solve. Whole-matrix local solves should call :func:`trsm` — the
+    explicit-inverse route is for block-sized panels."""
+    from ..config import get_configuration
+
+    cfg = get_configuration()
+    if (cfg.f64_trsm == "mixed" and a.ndim == 2
+            and a.dtype == jnp.float64 and b.dtype == jnp.float64):
+        from . import mixed as mx
+
+        inv = mx.tri_inv_refined(_tri(a, uplo, diag), lower=(uplo == "L"))
+        ti = _op(inv, op_a)
+        prod = _mm(ti, b) if side == "L" else _mm(b, ti)
+        return (alpha * prod).astype(b.dtype)
+    if b.ndim > a.ndim:
+        a = jnp.broadcast_to(a, b.shape[:b.ndim - 2] + a.shape)
+    return trsm(side, uplo, op_a, diag, a, b, alpha=alpha)
 
 
 # ---------------------------------------------------------------------------

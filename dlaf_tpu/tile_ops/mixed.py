@@ -31,8 +31,6 @@ together with :mod:`dlaf_tpu.tile_ops.ozaki`.
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 from jax import lax
 
@@ -45,9 +43,15 @@ def cond_limit() -> float:
     condition estimate of the block: empirically ``residual ~ 3.5e-14 *
     estimate`` for one Newton step, so the default 100 keeps residuals at
     the ``60 n eps`` budget for tile-sized blocks). Blocks estimated worse
-    than this take the native emulated-f64 branch. Env override:
-    ``DLAF_MIXED_COND_LIMIT``."""
-    return float(os.environ.get("DLAF_MIXED_COND_LIMIT", "100.0"))
+    than this take the native emulated-f64 branch.
+
+    Config field ``mixed_cond_limit`` (env ``DLAF_MIXED_COND_LIMIT``,
+    CLI ``--dlaf:mixed-cond-limit``) — a real Configuration field so a
+    change invalidates registered program caches (the limit is baked into
+    compiled ``lax.cond`` guards at trace time)."""
+    from ..config import get_configuration
+
+    return float(get_configuration().mixed_cond_limit)
 
 
 def _phi_lower(m):

@@ -7,8 +7,9 @@ transformations of matrix multiplication", 2012; int8-tensor-core variants in
 recent GPU literature) recovers f64-accurate GEMM from fast low-precision
 hardware:
 
-1. scale each row of ``A`` (column of ``B``) by ``2*max|row|`` so it lies
-   in ``[-1/2, 1/2]``,
+1. normalize each row of ``A`` (column of ``B``) to ``[-1/2, 1/2]`` by its
+   max (halving folded back in at recombine, so nothing overflows even at
+   ``max ~ DBL_MAX``),
 2. peel ``s`` slices of ``q=7`` mantissa bits each: every slice is a small
    integer in ``[-64, 64]`` — exactly representable in int8,
 3. contract slice pairs on the MXU with **exact** int32 accumulation
@@ -31,8 +32,9 @@ stage of BASELINE config #1) behind ``cholesky_trailing = "ozaki"`` and
 available as ``tile_ops.ozaki.{matmul_f64,syrk_f64}``.
 
 Scope/caveats (documented, asserted where cheap): finite inputs only (no
-inf/nan propagation guarantees); real f64 (complex128 composes from 3-4 real
-products at the call site if ever needed); accumulation exactness needs
+inf/nan propagation guarantees); real f64 directly, complex128 via the
+3-real-product composition (:func:`matmul_c128`/:func:`herk_c128`);
+accumulation exactness needs
 ``k * 2^12 * min(s, d+1) < 2^31`` per grouped sum — beyond that the group sum
 switches to f64. On TPU, XLA's X64 rewrite emulates f64 with f32 pairs, so
 *every* f64 op there (this module included) is limited to f32's exponent
@@ -48,23 +50,31 @@ import functools
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["matmul_f64", "syrk_f64", "DEFAULT_SLICES", "SLICE_BITS"]
+__all__ = ["matmul_f64", "syrk_f64", "matmul_c128", "herk_c128",
+           "DEFAULT_SLICES", "SLICE_BITS"]
 
 SLICE_BITS = 7          # q: mantissa bits per slice; int8 holds +-64 exactly
 DEFAULT_SLICES = 8      # s: 8 * 7 = 56 bits >= f64's 53-bit mantissa
 
 
 def _scale(x, axis):
-    """Per-row/col scale ``2*max|x|`` so ``x / scale`` is in ``[-1/2, 1/2]``;
-    zero rows scale by 1 (their slices are all zero).
+    """Per-row/col max ``M = max|x|`` (zero rows map to 1). The normalized
+    block is ``(x / M) * 0.5`` — in ``[-1/2, 1/2]`` — and :func:`_recombine`
+    folds the two implicit factors of 2 back in as an exact constant, so no
+    intermediate (like ``2*M``) can overflow even at ``M ~ DBL_MAX``.
 
     The scale need not be a power of two: slices stay integer-exact either
-    way, and the one rounding the normalize/rescale pair introduces is a
-    ~1-ulp relative error — the same order as native f64 gemm rounding.
-    (A power-of-two scale would need ``frexp``/``ldexp``, whose 64-bit
+    way, and the one rounding of the normalize/rescale pair is a ~1-ulp
+    relative error — the same order as native f64 gemm rounding. (A
+    power-of-two scale would need ``frexp``/``ldexp``, whose 64-bit
     bit-twiddling the TPU X64-emulation pipeline does not implement.)"""
     m = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
-    return jnp.where(m > 0, 2.0 * m, 1.0)
+    return jnp.where(m > 0, m, 1.0)
+
+
+def _normalize(x, scale):
+    """``(x / scale) * 0.5`` — in ``[-1/2, 1/2]``; the *0.5 is exact."""
+    return (x / scale) * 0.5
 
 
 def _peel_slices(xn, s: int):
@@ -96,7 +106,9 @@ def _recombine(groups, sa, sb):
         # power-of-two constant multiply: exact, and avoids ldexp (s64 ops)
         term = p.astype(jnp.float64) * float(2.0 ** (-SLICE_BITS * (d + 2)))
         acc = term if acc is None else acc + term
-    return acc * sa * sb
+    # *4 = the two deferred halvings of _normalize; multiply the scales in
+    # last so nothing overflows unless the true result does
+    return ((acc * 4.0) * sa) * sb
 
 
 @functools.partial(jnp.vectorize, signature="(m,k),(k,n)->(m,n)",
@@ -106,8 +118,8 @@ def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
     k = a.shape[-1]
     sa = _scale(a, axis=-1)           # (m, 1)
     sb = _scale(b, axis=-2)           # (1, n)
-    ia = _peel_slices(a / sa, s)
-    ib = _peel_slices(b / sb, s)
+    ia = _peel_slices(_normalize(a, sa), s)
+    ib = _peel_slices(_normalize(b, sb), s)
     # int32 group sums stay exact while (d+1) * k * 2^12 < 2^31
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     groups = []
@@ -143,7 +155,7 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
     s = int(slices)
     k = a.shape[-1]
     sa = _scale(a, axis=-1)           # (m, 1)
-    ia = _peel_slices(a / sa, s)
+    ia = _peel_slices(_normalize(a, sa), s)
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     cast = (lambda x: x) if exact_i32 else (lambda x: x.astype(jnp.float64))
     groups = []
@@ -166,3 +178,30 @@ def syrk_f64(a, *, slices: int = DEFAULT_SLICES):
     int8 MXU passes; slices of ``a`` are peeled once and pair symmetry halves
     the gemm count vs :func:`matmul_f64`."""
     return _syrk_f64_2d(a, slices=slices)
+
+
+# ---------------------------------------------------------------------------
+# complex128: composed from real products (3-multiplication Karatsuba form)
+# ---------------------------------------------------------------------------
+
+def matmul_c128(a, b, *, slices: int = DEFAULT_SLICES):
+    """``a @ b`` for complex128 inputs via three real :func:`matmul_f64`
+    products (Karatsuba: ``p3 - p1 - p2`` recovers the cross term), each on
+    the int8 MXU path. The operand sums at most double the row/col scales,
+    costing one mantissa bit of the ``7*slices`` budget."""
+    ar, ai = jnp.real(a), jnp.imag(a)
+    br, bi = jnp.real(b), jnp.imag(b)
+    p1 = matmul_f64(ar, br, slices=slices)
+    p2 = matmul_f64(ai, bi, slices=slices)
+    p3 = matmul_f64(ar + ai, br + bi, slices=slices)
+    return lax.complex(p1 - p2, p3 - p1 - p2)
+
+
+def herk_c128(a, *, slices: int = DEFAULT_SLICES):
+    """``a @ a^H`` (Hermitian gram block) for complex128 ``a``: two real
+    syrks for the real part, one real matmul (plus its transpose, free) for
+    the imaginary part — 2 peels + ~1.5x one real product's gemm count."""
+    ar, ai = jnp.real(a), jnp.imag(a)
+    re = syrk_f64(ar, slices=slices) + syrk_f64(ai, slices=slices)
+    m = matmul_f64(ai, jnp.swapaxes(ar, -1, -2), slices=slices)
+    return lax.complex(re, m - jnp.swapaxes(m, -1, -2))

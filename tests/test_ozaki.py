@@ -48,6 +48,15 @@ class TestOzakiMatmul:
         got = np.asarray(matmul_f64(a, b))
         assert _scaled_err(got, a @ b, a, b) < 4 * EPS
 
+    def test_near_dbl_max_rows_stay_finite(self):
+        # scale handling must not overflow on its own: finite inputs with
+        # near-DBL_MAX magnitudes give finite, correct results as long as
+        # the true product is representable
+        a = np.full((4, 4), 1e308)
+        got = np.asarray(matmul_f64(a, np.eye(4)))
+        assert np.isfinite(got).all()
+        np.testing.assert_allclose(got, a, rtol=1e-15)
+
     def test_zero_rows_and_batch(self):
         rng = np.random.default_rng(9)
         a = rng.standard_normal((2, 3, 24, 40))
@@ -72,6 +81,154 @@ class TestOzakiMatmul:
         got = np.asarray(syrk_f64(a))
         assert _scaled_err(got, a @ a.T, a, np.swapaxes(a, -1, -2)) < 4 * EPS
         assert np.allclose(got, got.T)  # symmetry by construction
+
+
+class TestComplex128:
+    def test_matmul_c128(self):
+        from dlaf_tpu.tile_ops.ozaki import matmul_c128
+        rng = np.random.default_rng(13)
+        a = rng.standard_normal((48, 80)) + 1j * rng.standard_normal((48, 80))
+        b = rng.standard_normal((80, 32)) + 1j * rng.standard_normal((80, 32))
+        got = np.asarray(matmul_c128(a, b))
+        err = np.abs(got - a @ b).max()
+        scale = np.abs(a).max() * np.abs(b).max() * 80
+        assert err / scale < 8 * EPS
+
+    def test_herk_c128(self):
+        from dlaf_tpu.tile_ops.ozaki import herk_c128
+        rng = np.random.default_rng(14)
+        a = rng.standard_normal((40, 64)) + 1j * rng.standard_normal((40, 64))
+        got = np.asarray(herk_c128(a))
+        ref = a @ a.conj().T
+        assert np.abs(got - ref).max() / (np.abs(a).max() ** 2 * 64) < 8 * EPS
+        # Hermitian with exactly-real diagonal by construction
+        assert np.abs(np.imag(np.diagonal(got))).max() == 0.0
+
+    def test_blas_herk_complex_under_knob(self, monkeypatch):
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "8")
+        import dlaf_tpu.config as config
+        config.initialize()
+        try:
+            from dlaf_tpu.tile_ops import blas as tb
+            rng = np.random.default_rng(15)
+            a = rng.standard_normal((32, 48)) + 1j * rng.standard_normal((32, 48))
+            c = rng.standard_normal((32, 32)) + 1j * rng.standard_normal((32, 32))
+            got = np.asarray(tb.herk("L", "N", a, c, alpha=-1.0))
+            full = -a @ a.conj().T + c
+            ref = np.tril(full) + np.triu(c, 1)
+            ref = ref - np.diag(1j * np.imag(np.diag(ref)))
+            np.testing.assert_allclose(got, ref, rtol=1e-12, atol=1e-11)
+        finally:
+            monkeypatch.delenv("DLAF_F64_GEMM")
+            monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+            config.initialize()
+
+
+class TestF64GemmKnob:
+    """f64_gemm="mxu" reroutes the level-3 tile ops through the int8 path
+    framework-wide; config changes must invalidate cached programs."""
+
+    def _with_knob(self, monkeypatch, min_dim="8"):
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", min_dim)
+        import dlaf_tpu.config as config
+        config.initialize()
+        return config
+
+    def test_blas_ops_route_and_match(self, monkeypatch):
+        config = self._with_knob(monkeypatch)
+        try:
+            from dlaf_tpu.tile_ops import blas as tb
+            rng = np.random.default_rng(5)
+            a = rng.standard_normal((64, 48))
+            b = rng.standard_normal((48, 32))
+            c = rng.standard_normal((64, 32))
+            got = np.asarray(tb.gemm(a, b, c, alpha=2.0, beta=1.0))
+            np.testing.assert_allclose(got, 2.0 * (a @ b) + c,
+                                       rtol=1e-13, atol=1e-12)
+            h = rng.standard_normal((64, 64))
+            got = np.asarray(tb.herk("L", "N", a, h, alpha=-1.0))
+            ref = np.tril(-a @ a.T + h) + np.triu(h, 1)
+            np.testing.assert_allclose(got, ref, rtol=1e-13, atol=1e-12)
+        finally:
+            monkeypatch.delenv("DLAF_F64_GEMM")
+            monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+            config.initialize()
+
+    def test_small_dims_stay_native(self, monkeypatch):
+        config = self._with_knob(monkeypatch, min_dim="128")
+        try:
+            from dlaf_tpu.tile_ops.blas import _mxu_f64
+            import jax.numpy as jnp2
+            a = jnp2.zeros((64, 64), jnp2.float64)
+            assert not _mxu_f64(a, a, dims=(64, 64, 64))
+            b = jnp2.zeros((256, 256), jnp2.float64)
+            assert _mxu_f64(b, b, dims=(256, 256, 256))
+            f = jnp2.zeros((256, 256), jnp2.float32)
+            assert not _mxu_f64(f, f, dims=(256, 256, 256))
+        finally:
+            monkeypatch.delenv("DLAF_F64_GEMM")
+            monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+            config.initialize()
+
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+    def test_distributed_cholesky_under_knob(self, uplo, dtype, monkeypatch,
+                                             devices8):
+        """Distributed path: int8-MXU trailing contraction (real AND complex
+        compositions) + mixed-precision panels (real, via f64_trsm)."""
+        monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+        config = self._with_knob(monkeypatch)
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.comm.grid import Grid
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            n, nb = 64, 16
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, dtype), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=dtype, grid=Grid(2, 4))
+            out = cholesky(uplo, mat)
+            f = out.to_numpy()
+            a = mat.to_numpy()
+            tri = np.tril(f) if uplo == "L" else np.triu(f)
+            rec = tri @ tri.conj().T if uplo == "L" else tri.conj().T @ tri
+            resid = np.linalg.norm(rec - a) / np.linalg.norm(a)
+            assert resid < 60 * n * EPS
+        finally:
+            monkeypatch.delenv("DLAF_F64_GEMM")
+            monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+            monkeypatch.delenv("DLAF_F64_TRSM")
+            config.initialize()
+
+    def test_config_change_clears_registered_caches(self):
+        import dlaf_tpu.config as config
+
+        calls = []
+
+        class FakeCached:
+            def cache_clear(self):
+                calls.append("cleared")
+
+        fake = FakeCached()
+        config.register_program_cache(fake)
+        try:
+            config.initialize()
+            base = len(calls)
+            cfg = config.Configuration(f64_gemm="mxu")
+            config.initialize(cfg)      # differs -> must clear
+            assert len(calls) == base + 1
+            config.initialize(cfg)      # identical -> no clear
+            assert len(calls) == base + 1
+            config.initialize()         # back to defaults -> clear again
+            assert len(calls) == base + 2
+        finally:
+            config._PROGRAM_CACHES.remove(fake)
+            config.initialize()
 
 
 class TestMixedPanel:

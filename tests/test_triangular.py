@@ -90,6 +90,32 @@ def test_solve_distributed(side, uplo, op, diag, grid_shape, devices8):
     np.testing.assert_allclose(out, expect, **_tol(dtype))
 
 
+@pytest.mark.parametrize("side,uplo,op,diag", SOLVE_COMBOS_SMALL)
+def test_solve_distributed_mixed_trsm_knob(side, uplo, op, diag, devices8,
+                                           monkeypatch):
+    """f64_trsm="mixed" + f64_gemm="mxu": panel solves via refined inverse,
+    applications and updates on the int8 path — results must stay f64-grade
+    (reference accuracy budget)."""
+    monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+    monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+    monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "4")
+    import dlaf_tpu.config as config
+    config.initialize()
+    try:
+        dtype = np.float64
+        n, m, nb = 16, 12, 4
+        a, b = make_ab(n, m, dtype, side, seed=7)
+        am, bm = mats(a, b, nb, nb, grid=Grid(2, 4), src=RankIndex2D(1, 1))
+        out = triangular_solve(side, uplo, op, diag, 1.0, am, bm).to_numpy()
+        t = np_op(np_tri(a, uplo, diag), op)
+        expect = np.linalg.solve(t, b) if side == "L" else b @ np.linalg.inv(t)
+        np.testing.assert_allclose(out, expect, **_tol(dtype))
+    finally:
+        for v in ("DLAF_F64_TRSM", "DLAF_F64_GEMM", "DLAF_F64_GEMM_MIN_DIM"):
+            monkeypatch.delenv(v)
+        config.initialize()
+
+
 def test_solve_distributed_edge_tiles(devices8):
     # non-divisible sizes: short edge tiles on both A and B
     dtype = np.float64
