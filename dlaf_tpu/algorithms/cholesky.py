@@ -134,7 +134,8 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                 # ONE full trailing update, masked to the lower triangle;
                 # "ozaki" forms it with int8 MXU passes instead of the
                 # software-emulated f64 gemm
-                upd = oz.syrk_f64(panel) if use_oz else panel @ jnp.conj(panel).T
+                upd = (oz.syrk_f64(panel, slices=tb._oz_slices()) if use_oz
+                       else panel @ jnp.conj(panel).T)
                 mask = jnp.tril(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
         else:
@@ -160,7 +161,8 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                                         alpha=-1.0, beta=1.0, op_a="C")
                         a = a.at[j0:j1, j1:].set(right)
             else:
-                upd = (oz.syrk_f64(jnp.swapaxes(panel, -1, -2)) if use_oz
+                upd = (oz.syrk_f64(jnp.swapaxes(panel, -1, -2),
+                                   slices=tb._oz_slices()) if use_oz
                        else jnp.conj(panel).T @ panel)
                 mask = jnp.triu(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
@@ -226,11 +228,9 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         # redundant tiny compute on every rank; mixed mode swaps the
         # latency-bound emulated-f64 potrf for the f32-seed + Newton form
         if use_mixed:
-            fac = mx.potrf_refined(uplo, diag)
             other = "U" if uplo == "L" else "L"
-            lkk = fac + tb.tri_mask(diag, other, k=-1)
+            lkk = mx.potrf_refined(uplo, diag) + tb.tri_mask(diag, other, k=-1)
         else:
-            fac = None
             lkk = tl.potrf(uplo, diag)
 
         # owner writes the factored diagonal back
@@ -239,7 +239,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         if k == nt - 1:
             return lt
         if uplo == "U":
-            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk, fac)
+            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk)
 
         # -- panel trsm on owner column (reference impl.h:222-231) ----------
         # uniform local row start: every rank's rows >= k+1 live at slots
@@ -250,13 +250,9 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             return lt
         g_rows = local_rows_global(lu_r, rr, nrows)
         row_valid = (g_rows > k) & (g_rows < nt)
-        if use_mixed:
-            linv = mx.tri_inv_refined(fac, lower=True)
-            pan = lt[lu_r:, kc] @ linv.T
-        else:
-            pan = tb.trsm("R", "L", "C", "N",
-                          jnp.broadcast_to(lkk, (nrows,) + lkk.shape),
-                          lt[lu_r:, kc])
+        # trsm_panel: native batched solve, or (f64_trsm="mixed") refined
+        # inverse + matmul that follows the f64_gemm routing
+        pan = tb.trsm_panel("R", "L", "C", "N", lkk, lt[lu_r:, kc])
         pan = jnp.where(row_valid[:, None, None], pan, jnp.zeros_like(pan))
         # owner column keeps the factored panel (others keep their tiles)
         keep = (is_owner_c & row_valid)[:, None, None]
@@ -297,7 +293,8 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 # batch into one (nrows*mb) x mb by (ncols*mb) x mb product
                 mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
                 full = mmfn(vr.reshape(nrows * mb, mb),
-                            jnp.conj(vc).reshape(ncols * mb, mb).T)
+                            jnp.conj(vc).reshape(ncols * mb, mb).T,
+                            slices=tb._oz_slices())
                 upd = full.reshape(nrows, mb, ncols, mb).transpose(0, 2, 1, 3)
             else:
                 upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
@@ -308,7 +305,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             lt = lt.at[lu_r:, lu_c:].add(-upd)
         return lt
 
-    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk, fac=None):
+    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk):
         """Mirrored sweep for uplo='U' (reference ``call_U``): panel is the
         block row k, trailing update hits upper-triangle tile pairs."""
         is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
@@ -320,13 +317,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             return lt
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
-        if use_mixed:
-            uinv = mx.tri_inv_refined(fac, lower=False)
-            pan = jnp.matmul(uinv.T, lt[kr, lu_c:])
-        else:
-            pan = tb.trsm("L", "U", "C", "N",
-                          jnp.broadcast_to(ukk, (ncols,) + ukk.shape),
-                          lt[kr, lu_c:])
+        pan = tb.trsm_panel("L", "U", "C", "N", ukk, lt[kr, lu_c:])
         pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
         keep = (is_owner_r & col_valid)[:, None, None]
         lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
@@ -360,7 +351,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
                 ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
                 bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
-                full = mmfn(ar, bc.T)
+                full = mmfn(ar, bc.T, slices=tb._oz_slices())
                 upd = full.reshape(nrows, mb, ncols, mb).transpose(0, 2, 1, 3)
             else:
                 upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vr), vc,

@@ -18,6 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..tile_ops import blas as tb
 from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
@@ -32,7 +33,7 @@ def _gemm_cached(dist_a, dist_b, dist_c, sharding, a0, a1, alpha_beta_static=Non
         gb = tiles_to_global(sb, dist_b)
         gc = tiles_to_global(sc, dist_c)
         sl = slice(a0, a1)
-        prod = ga[sl, sl] @ gb[sl, sl]
+        prod = tb.mm(ga[sl, sl], gb[sl, sl])
         gc = gc.at[sl, sl].set(alpha * prod + beta * gc[sl, sl])
         return global_to_tiles(gc, dist_c)
 

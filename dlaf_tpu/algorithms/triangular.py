@@ -109,8 +109,7 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                     rk = row_panel(ctx_a, lta, k, 0)      # A[k,j] my cols
                     e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
                 e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
-                upd = jnp.einsum("rab,cbd->rcad", e, xk,
-                                 preferred_element_type=e.dtype)
+                upd = tb.contract("rab,cbd->rcad", e, xk)
                 ltb = ltb.at[sl].add(-upd)
             else:
                 # solve Xk op(Akk) = Bk for tile col k of B (all local rows)
@@ -137,8 +136,7 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                     ck = col_panel(ctx_a, lta, k, 0)      # A[i,k] my rows
                     e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
                 e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
-                upd = jnp.einsum("rab,cbd->rcad", xk, e,
-                                 preferred_element_type=e.dtype)
+                upd = tb.contract("rab,cbd->rcad", xk, e)
                 ltb = ltb.at[:, sl].add(-upd)
         return ltb
 
@@ -181,8 +179,7 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                 e = jnp.where(ondiag[:, None, None], dt,
                               jnp.where(strict[:, None, None] & (g < nt)[:, None, None],
                                         e, jnp.zeros_like(e)))
-                upd = jnp.einsum("rab,cbd->rcad", e, bk,
-                                 preferred_element_type=e.dtype)
+                upd = tb.contract("rab,cbd->rcad", e, bk)
                 out = out + upd
             else:
                 bk = col_panel(ctx_b, ltb, k, 0)          # B[:,k] my rows
@@ -200,8 +197,7 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                 e = jnp.where(ondiag[:, None, None], dt,
                               jnp.where(strict[:, None, None] & (g < nt)[:, None, None],
                                         e, jnp.zeros_like(e)))
-                upd = jnp.einsum("rab,cbd->rcad", bk, e,
-                                 preferred_element_type=e.dtype)
+                upd = tb.contract("rab,cbd->rcad", bk, e)
                 out = out + upd
         return out
 

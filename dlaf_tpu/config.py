@@ -76,6 +76,10 @@ class Configuration:
     #: contraction; below it the slicing overhead outweighs the MXU win and
     #: the native path is kept.
     f64_gemm_min_dim: int = 128
+    #: int8 slices per operand on the MXU f64 path (tile_ops/ozaki.py):
+    #: 8 (56 mantissa bits, f64-grade, 36 gemms per product) down to e.g.
+    #: 7 (49 bits, 28 gemms) when the application's accuracy budget allows.
+    f64_gemm_slices: int = 8
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
     #: under TPU f64 emulation) or "mixed" (f32 seed + Newton refinement,
     #: tile_ops/mixed.py: refined explicit inverse + matmul for per-tile
@@ -137,6 +141,30 @@ def update_configuration(
     return cfg
 
 
+#: Allowed values for enum-like knobs, checked at initialize() — a typo'd
+#: value must fail loudly, not silently take the other branch (the literal
+#: string comparisons at the use sites would otherwise just pick "native").
+_VALID_CHOICES = {
+    "grid_ordering": ("row-major", "col-major"),
+    "band_to_tridiag_impl": ("native", "numpy"),
+    "secular_impl": ("native", "numpy"),
+    "bt_b2t_impl": ("blocked", "sweeps"),
+    "f64_gemm": ("native", "mxu"),
+    "f64_trsm": ("native", "mixed"),
+}
+
+
+def _validate(cfg: Configuration) -> None:
+    for name, allowed in _VALID_CHOICES.items():
+        v = getattr(cfg, name)
+        if v not in allowed:
+            raise ValueError(f"configuration {name}={v!r}: must be one of {allowed}")
+    if not 1 <= cfg.f64_gemm_slices <= 9:
+        raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in [1, 9]")
+    # cholesky_trailing is validated against VALID_TRAILING at the use site
+    # (algorithms/cholesky.py) to keep the list next to the implementations
+
+
 _active: Optional[Configuration] = None
 
 #: Compiled-program caches (jitted fns / lru-cached program builders) whose
@@ -173,6 +201,7 @@ def initialize(user: Optional[Configuration] = None,
     """
     global _active
     cfg = update_configuration(user, argv)
+    _validate(cfg)
     if _active is not None and cfg != _active:
         _clear_program_caches()
     if cfg.enable_x64:

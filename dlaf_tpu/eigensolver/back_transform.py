@@ -46,6 +46,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..tile_ops import blas as tb
 from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
@@ -110,8 +111,8 @@ def _bt_b2t_blocked(v_all, tau_all, e, *, b: int, n: int, group: int):
                 jnp.zeros((L,), vcols.dtype), vj, (j,)))(vcols, col_off).T
         t_mat = larft(stair, jnp.conj(taus))
         seg = lax.dynamic_slice(e_pad, (base, 0), (L, m))
-        w = t_mat @ (jnp.conj(stair).T @ seg)
-        seg = seg - stair @ w
+        w = t_mat @ tb.mm(jnp.conj(stair).T, seg)
+        seg = seg - tb.mm(stair, w)
         return lax.dynamic_update_slice(e_pad, seg, (base, 0)), None
 
     e_pad, _ = lax.scan(body, e_pad, (v_seq, tau_seq, base_seq))
@@ -133,7 +134,7 @@ def _bt_b2t_scan(v_all, tau_all, e, *, b: int, n: int):
         start = s + 1
         seg = lax.dynamic_slice(e_pad, (start, 0), (seg_len, m))
         seg = seg.reshape(n_steps, b, m)
-        w = jnp.einsum("tb,tbm->tm", jnp.conj(v_s), seg)
+        w = tb.contract("tb,tbm->tm", jnp.conj(v_s), seg)
         seg = seg - jnp.conj(tau_s)[:, None, None] * v_s[..., None] * w[:, None, :]
         e_pad = lax.dynamic_update_slice(e_pad, seg.reshape(seg_len, m), (start, 0))
         return e_pad, None
@@ -298,8 +299,8 @@ def _bt_r2b_local(a_v, taus, e, *, nb: int):
         vf = a_v[k1:, k * nb: k * nb + nb]
         v = jnp.tril(vf, -1) + jnp.eye(m_p, nb, dtype=a_v.dtype)
         t = larft(v, taus[k])
-        w = t @ (jnp.conj(v).T @ e[k1:])
-        e = e.at[k1:].add(-v @ w)
+        w = t @ tb.mm(jnp.conj(v).T, e[k1:])
+        e = e.at[k1:].add(-tb.mm(v, w))
     return e
 
 
@@ -348,15 +349,12 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
             v_my = jnp.where(rv_c_e[:, :, None], vt[sel],
                              jnp.zeros((nrows_c, nb, b), dtype=vfull.dtype))
             cpart = lt_c[luc:]
-            w2 = jnp.einsum("rab,rcad->cbd", jnp.conj(v_my), cpart,
-                            preferred_element_type=cpart.dtype)
+            w2 = tb.contract("rab,rcad->cbd", jnp.conj(v_my), cpart)
             w2 = cc.all_reduce(w2, ROW_AXIS)         # (ltc_c, b, nb_c) = V^H C
-            w2 = jnp.einsum("xb,cbd->cxd", t, w2,
-                            preferred_element_type=cpart.dtype)
+            w2 = tb.contract("xb,cbd->cxd", t, w2)
 
             # -- C -= V W2 (local rows x local cols) -------------------------
-            upd = jnp.einsum("rab,cbd->rcad", v_my, w2,
-                             preferred_element_type=cpart.dtype)
+            upd = tb.contract("rab,cbd->rcad", v_my, w2)
             lt_c = lt_c.at[luc:].add(-upd)
         return lt_c
 

@@ -91,10 +91,11 @@ def _red2band_local(a, *, nb: int):
             taus = jnp.pad(taus, (0, nb - ntau))
         t = larft(v, taus)
         trail = a[k1:, k1:]                       # full Hermitian
-        w = trail @ (v @ t)                       # A V T
-        m = v.conj().T @ w                        # V^H W  (pw x pw)
+        w = tb.mm(trail, v @ t)                   # A V T
+        m = tb.mm(v.conj().T, w)                  # V^H W  (pw x pw)
         x = w - 0.5 * v @ (t.conj().T @ m)
-        a = a.at[k1:, k1:].set(trail - x @ v.conj().T - v @ x.conj().T)
+        a = a.at[k1:, k1:].set(trail - tb.mm(x, v.conj().T)
+                               - tb.mm(v, x.conj().T))
     return a, taus_out
 
 
@@ -178,14 +179,12 @@ def _build_dist_red2band(dist, mesh, dtype, band):
                         jnp.zeros_like(atr))
         # W partial over my local cols -> psum along 'col' (replicates W rows
         # across each grid row)
-        w_loc = jnp.einsum("rcab,cbd->rad", atr, vtl,
-                           preferred_element_type=atr.dtype)
+        w_loc = tb.contract("rcab,cbd->rad", atr, vtl)
         w_loc = cc.all_reduce(w_loc, COL_AXIS)           # (nrows, nb, b)
         # M = V^H W partial over my rows -> psum along 'row'
         vr = jnp.where(row_val_e[:, :, None], v_tiles[sel],
                        jnp.zeros((nrows, nb, b), dtype=pan.dtype))
-        m_mat = jnp.einsum("rab,rad->bd", jnp.conj(vr), w_loc,
-                           preferred_element_type=atr.dtype)
+        m_mat = tb.contract("rab,rad->bd", jnp.conj(vr), w_loc)
         m_mat = cc.all_reduce(m_mat, ROW_AXIS)           # replicated everywhere
         x_loc = w_loc - 0.5 * jnp.einsum("rab,bd->rad", vr,
                                          t.conj().T @ m_mat,
@@ -197,10 +196,8 @@ def _build_dist_red2band(dist, mesh, dtype, band):
         vc = jnp.where(col_val_e[:, :, None], v_tiles[selc],
                        jnp.zeros((ncols, nb, b), dtype=pan.dtype))
         xr = jnp.where(row_val_e[:, :, None], x_loc, jnp.zeros_like(x_loc))
-        upd = (jnp.einsum("rad,cbd->rcab", xr, jnp.conj(vc),
-                          preferred_element_type=atr.dtype)
-               + jnp.einsum("rad,cbd->rcab", vr, jnp.conj(xc),
-                            preferred_element_type=atr.dtype))
+        upd = (tb.contract("rad,cbd->rcab", xr, jnp.conj(vc))
+               + tb.contract("rad,cbd->rcab", vr, jnp.conj(xc)))
         lt = lt.at[lu:, luc:].add(-upd)
         return lt, taus_out
 

@@ -20,6 +20,8 @@ Conventions:
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -49,6 +51,12 @@ def _mxu_f64(*arrs, dims) -> bool:
     return min(dims) >= cfg.f64_gemm_min_dim
 
 
+def _oz_slices() -> int:
+    from ..config import get_configuration
+
+    return int(get_configuration().f64_gemm_slices)
+
+
 def _mm(a, b):
     """Central matmul of the level-3 ops, with the f64_gemm="mxu" reroute."""
     if _mxu_f64(a, b, dims=(a.shape[-2], a.shape[-1], b.shape[-1])):
@@ -57,9 +65,68 @@ def _mm(a, b):
         if jnp.iscomplexobj(a) or jnp.iscomplexobj(b):
             ac = a.astype(jnp.complex128)
             bc = b.astype(jnp.complex128)
-            return ozaki.matmul_c128(ac, bc)
-        return ozaki.matmul_f64(a, b)
+            return ozaki.matmul_c128(ac, bc, slices=_oz_slices())
+        return ozaki.matmul_f64(a, b, slices=_oz_slices())
     return a @ b
+
+
+def mm(a, b):
+    """Public matmul with the ``f64_gemm="mxu"`` reroute — for algorithm code
+    whose products don't fit a named BLAS op (whole-panel compositions,
+    gathered blocks). Native path is exactly ``a @ b``."""
+    return _mm(a, b)
+
+
+def contract(subscripts: str, x, y):
+    """Two-operand einsum with the ``f64_gemm="mxu"`` reroute.
+
+    Native path: ``jnp.einsum(subscripts, x, y, preferred_element_type=...)``
+    — bit-identical to the raw einsums the distributed algorithms used. On
+    the mxu path the contraction is factored into (transpose → flatten →
+    ozaki matmul → unflatten → transpose), which is exactly how XLA lowers
+    einsum to dot_general, so the int8 path sees one large product.
+
+    Supported: no repeated labels within an operand, every label of each
+    operand present in the other operand and/or the output (no implicit
+    broadcasting). Labels shared by both operands and the output batch;
+    shared labels absent from the output contract.
+    """
+    lhs, out = subscripts.split("->")
+    s1, s2 = lhs.split(",")
+    assert len(set(s1)) == len(s1) and len(set(s2)) == len(s2), subscripts
+    batch = [c for c in s1 if c in s2 and c in out]
+    contracted = [c for c in s1 if c in s2 and c not in out]
+    free1 = [c for c in s1 if c not in s2]
+    free2 = [c for c in s2 if c not in s1]
+    assert all(c in out for c in free1 + free2), subscripts
+    assert set(out) == set(batch + free1 + free2), subscripts
+
+    dims1 = dict(zip(s1, x.shape))
+    dims2 = dict(zip(s2, y.shape))
+    if _mxu_f64(x, y, dims=(max(int(np.prod([dims1[c] for c in free1], dtype=np.int64)), 1),
+                            max(int(np.prod([dims1[c] for c in contracted], dtype=np.int64)), 1),
+                            max(int(np.prod([dims2[c] for c in free2], dtype=np.int64)), 1))):
+        from . import ozaki
+
+        xt = jnp.transpose(x, [s1.index(c) for c in batch + free1 + contracted])
+        yt = jnp.transpose(y, [s2.index(c) for c in batch + contracted + free2])
+        bshape = tuple(dims1[c] for c in batch)
+        f1 = int(np.prod([dims1[c] for c in free1], dtype=np.int64)) if free1 else 1
+        f2 = int(np.prod([dims2[c] for c in free2], dtype=np.int64)) if free2 else 1
+        kk = int(np.prod([dims1[c] for c in contracted], dtype=np.int64)) if contracted else 1
+        mmfn = (ozaki.matmul_c128 if jnp.iscomplexobj(x) or jnp.iscomplexobj(y)
+                else ozaki.matmul_f64)
+        xf = xt.reshape(bshape + (f1, kk))
+        yf = yt.reshape(bshape + (kk, f2))
+        if jnp.iscomplexobj(xf) != jnp.iscomplexobj(yf):
+            xf = xf.astype(jnp.complex128)
+            yf = yf.astype(jnp.complex128)
+        full = mmfn(xf, yf, slices=_oz_slices())
+        full = full.reshape(bshape + tuple(dims1[c] for c in free1)
+                            + tuple(dims2[c] for c in free2))
+        order = batch + free1 + free2
+        return jnp.transpose(full, [order.index(c) for c in out])
+    return jnp.einsum(subscripts, x, y, preferred_element_type=x.dtype)
 
 
 def tri_mask(a, uplo: str, *, k: int = 0):
@@ -140,8 +207,9 @@ def herk(uplo: str, op_a: str, a, c, *, alpha=1.0, beta=1.0):
     if _mxu_f64(oa, dims=(oa.shape[-2], oa.shape[-1])):
         from . import ozaki
 
-        prod = (ozaki.herk_c128(oa) if jnp.iscomplexobj(oa)
-                else ozaki.syrk_f64(oa))
+        prod = (ozaki.herk_c128(oa, slices=_oz_slices())
+                if jnp.iscomplexobj(oa)
+                else ozaki.syrk_f64(oa, slices=_oz_slices()))
     else:
         prod = oa @ jnp.conj(jnp.swapaxes(oa, -1, -2))
     upd = alpha * prod + beta * c

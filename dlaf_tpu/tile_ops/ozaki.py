@@ -185,16 +185,20 @@ def syrk_f64(a, *, slices: int = DEFAULT_SLICES):
 # ---------------------------------------------------------------------------
 
 def matmul_c128(a, b, *, slices: int = DEFAULT_SLICES):
-    """``a @ b`` for complex128 inputs via three real :func:`matmul_f64`
-    products (Karatsuba: ``p3 - p1 - p2`` recovers the cross term), each on
-    the int8 MXU path. The operand sums at most double the row/col scales,
-    costing one mantissa bit of the ``7*slices`` budget."""
+    """``a @ b`` for complex128 inputs via four real :func:`matmul_f64`
+    products, each on the int8 MXU path.
+
+    The 3-product Karatsuba form (``(ar+ai)(br+bi) - p1 - p2``) is NOT used:
+    its operand sums overflow for component magnitudes above ``DBL_MAX/2``
+    and its intermediates grow ~2x beyond what a native complex product
+    forms — the 4-product form has exactly the native overflow and error
+    profile, and ozaki gemms are cheap enough that the extra product is the
+    right trade."""
     ar, ai = jnp.real(a), jnp.imag(a)
     br, bi = jnp.real(b), jnp.imag(b)
-    p1 = matmul_f64(ar, br, slices=slices)
-    p2 = matmul_f64(ai, bi, slices=slices)
-    p3 = matmul_f64(ar + ai, br + bi, slices=slices)
-    return lax.complex(p1 - p2, p3 - p1 - p2)
+    re = matmul_f64(ar, br, slices=slices) - matmul_f64(ai, bi, slices=slices)
+    im = matmul_f64(ar, bi, slices=slices) + matmul_f64(ai, br, slices=slices)
+    return lax.complex(re, im)
 
 
 def herk_c128(a, *, slices: int = DEFAULT_SLICES):

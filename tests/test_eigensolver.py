@@ -184,6 +184,33 @@ def test_eigensolver(n, nb, uplo, dtype):
 
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_eigensolver_under_mxu_knobs(dtype, monkeypatch):
+    """Full pipeline with f64_gemm="mxu" + f64_trsm="mixed": every level-3
+    tile op in reduction_to_band / back-transforms / D&C gemms goes through
+    the int8 path (min_dim lowered to touch it at test sizes) — residuals
+    must stay f64-grade."""
+    monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+    monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "4")
+    monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+    import dlaf_tpu.config as config
+    config.initialize()
+    try:
+        n, nb = 24, 8
+        a = herm(n, dtype, 5)
+        res = eigensolver("L", M(a, nb))
+        lam, q = res.eigenvalues, res.eigenvectors.to_numpy()
+        afull = np.tril(a) + np.tril(a, -1).conj().T
+        np.fill_diagonal(afull, np.real(np.diag(afull)))
+        np.testing.assert_allclose(lam, np.linalg.eigvalsh(afull), atol=1e-11 * n)
+        assert np.linalg.norm(afull @ q - q * lam[None, :]) < 1e-10 * n
+        assert np.linalg.norm(q.conj().T @ q - np.eye(n)) < 1e-11 * n
+    finally:
+        for v in ("DLAF_F64_GEMM", "DLAF_F64_GEMM_MIN_DIM", "DLAF_F64_TRSM"):
+            monkeypatch.delenv(v)
+        config.initialize()
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
 @pytest.mark.parametrize("grid_shape,src", [((2, 2), (0, 0)), ((2, 4), (1, 1))])
 @pytest.mark.parametrize("n,nb", [(24, 4), (21, 4)])
 def test_eigensolver_distributed(n, nb, grid_shape, src, dtype, devices8):

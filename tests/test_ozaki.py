@@ -83,6 +83,51 @@ class TestOzakiMatmul:
         assert np.allclose(got, got.T)  # symmetry by construction
 
 
+class TestContract:
+    """blas.contract: the einsum->slice-product factorization must equal
+    jnp.einsum for every pattern the algorithms use, real and complex."""
+
+    PATTERNS = [
+        ("rab,cbd->rcad", (3, 4, 5), (2, 5, 6)),    # triangular/bt trailing
+        ("rcab,cbd->rad", (3, 2, 4, 5), (2, 5, 6)),  # red2band W partial
+        ("rab,rad->bd", (3, 4, 5), (3, 4, 6)),       # red2band M partial
+        ("rad,cbd->rcab", (3, 4, 6), (2, 5, 6)),     # red2band her2k-like
+        ("tb,tbm->tm", (4, 5), (4, 5, 6)),           # bt sweeps (batched)
+        ("rab,rcad->cbd", (3, 4, 5), (3, 2, 4, 6)),  # bt_b2t W2 partial
+        ("xb,cbd->cxd", (4, 5), (2, 5, 6)),          # bt_b2t T apply
+    ]
+
+    @pytest.mark.parametrize("sub,shx,shy", PATTERNS)
+    @pytest.mark.parametrize("cplx", [False, True])
+    def test_matches_einsum_on_mxu_path(self, sub, shx, shy, cplx,
+                                        monkeypatch):
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "2")
+        import dlaf_tpu.config as config
+        config.initialize()
+        try:
+            from dlaf_tpu.tile_ops.blas import contract
+            rng = np.random.default_rng(hash(sub) % 2**31)
+            x = rng.standard_normal(shx)
+            y = rng.standard_normal(shy)
+            if cplx:
+                x = x + 1j * rng.standard_normal(shx)
+                y = y + 1j * rng.standard_normal(shy)
+            got = np.asarray(contract(sub, x, y))
+            np.testing.assert_allclose(got, np.einsum(sub, x, y),
+                                       rtol=1e-12, atol=1e-12)
+        finally:
+            monkeypatch.delenv("DLAF_F64_GEMM")
+            monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+            config.initialize()
+
+    def test_knob_validation_rejects_typo(self):
+        import dlaf_tpu.config as config
+        with pytest.raises(ValueError, match="f64_gemm"):
+            config.initialize(config.Configuration(f64_gemm="MXU"))
+        config.initialize()
+
+
 class TestComplex128:
     def test_matmul_c128(self):
         from dlaf_tpu.tile_ops.ozaki import matmul_c128
