@@ -2,5 +2,6 @@
 CommunicatorGrid + collective verbs over mesh axes)."""
 
 from .grid import COL_AXIS, ROW_AXIS, Grid
+from .multihost import initialize_multihost, multihost_grid, process_info
 
 __all__ = ["COL_AXIS", "ROW_AXIS", "Grid"]

@@ -1,0 +1,119 @@
+"""Multi-host bring-up: ICI+DCN grids spanning TPU pods/slices.
+
+The reference scales past one node with MPI: ``mpi_init`` establishes the
+process world (``communication/init.h:14-44``) and ``CommunicatorGrid``
+spans it. The TPU-native equivalents:
+
+* process world      -> ``jax.distributed.initialize`` (one controller
+  process per host; coordinator address/process-id discovery is automatic
+  on Cloud TPU and explicit elsewhere) — :func:`initialize_multihost`.
+* rank               -> ``jax.process_index()``.
+* grid over the world -> a 2D mesh over ``jax.devices()`` (ALL processes'
+  devices, in a topology-aware order) — :func:`multihost_grid`.
+
+Physics of the axes: within a slice, neighboring devices talk over ICI
+(fast); across slices/pods the boundary is DCN (slow). ``multihost_grid``
+keeps the *contiguous-minor* axis of the device order inside a slice, so for
+the 2D block-cyclic algorithms the high-traffic panel broadcasts along one
+mesh axis ride ICI and only the coarse axis crosses DCN —
+``jax.experimental.mesh_utils.create_hybrid_device_mesh`` is used when the
+topology spans slices (it groups by slice_index), with a plain device-order
+reshape fallback for single-slice or CPU worlds.
+
+Data loading in the multi-controller model: each process creates ONLY its
+addressable shards; :func:`dlaf_tpu.matrix.matrix.Matrix.from_element_fn`
+evaluates the element function per local tile, so no host ever materializes
+the global matrix — the analog of the reference's per-rank tile allocation.
+
+This module is glue, not magic: on a single-process run every function is a
+cheap no-op/alias, which is also how it is exercised in CI (the logic that
+*can* be tested without a pod — axis assignment, ordering, shard-count
+math — is; the ``jax.distributed`` call itself is a pass-through).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from ..common.asserts import dlaf_assert
+from .grid import COL_AXIS, ROW_AXIS, Grid
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Establish the cross-host process world (the ``mpi_init`` analog).
+
+    On Cloud TPU all arguments are auto-discovered; elsewhere pass the
+    coordinator's ``host:port``, the world size, and this process's id.
+    Must run before any other JAX call in the process (same rule as the
+    reference's "MPI_Init before everything", ``communication/init.h``).
+    No-op when the world has a single process and no coordinator is given.
+    """
+    if coordinator_address is None and num_processes in (None, 1):
+        return  # single-controller run — nothing to establish
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def slice_groups(devices: Sequence) -> dict:
+    """Group devices by their slice/granule (``slice_index`` where the
+    platform exposes it; one group otherwise) — the ICI islands."""
+    groups: dict = {}
+    for d in devices:
+        key = getattr(d, "slice_index", 0)
+        groups.setdefault(key, []).append(d)
+    return groups
+
+
+def multihost_grid(rows: Optional[int] = None, cols: Optional[int] = None,
+                   *, devices: Optional[Sequence] = None) -> Grid:
+    """A 2D grid over every device of every process, topology-aware.
+
+    Axis policy (the scaling-relevant decision): the 'col' axis is laid out
+    inside ICI islands wherever the factorization allows, so panel
+    broadcasts along rows of the matrix (the hot collective of the
+    right-looking algorithms) stay on ICI; the 'row' axis absorbs the
+    DCN boundary. With ``rows``/``cols`` omitted, the squarest
+    factorization of the world size with that property is chosen.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if rows is None or cols is None:
+        rows = int(np.sqrt(n))
+        while n % rows:
+            rows -= 1
+        cols = n // rows
+    dlaf_assert(rows * cols == n,
+                f"multihost grid {rows}x{cols} must use all {n} devices")
+
+    groups = slice_groups(devs)
+    if len(groups) > 1:
+        sizes = {len(g) for g in groups.values()}
+        dlaf_assert(len(sizes) == 1, "hetero slice sizes unsupported")
+        per = sizes.pop()
+        if cols % per == 0 or per % cols == 0:
+            # slice-major order: consecutive 'col' neighbors share a slice
+            ordered = [d for k in sorted(groups) for d in groups[k]]
+        else:
+            ordered = devs
+    else:
+        ordered = devs
+    dev2d = np.array(ordered, dtype=object).reshape(rows, cols)
+    g = Grid.__new__(Grid)
+    from jax.sharding import Mesh
+
+    g._mesh = Mesh(dev2d, (ROW_AXIS, COL_AXIS))
+    g._ordering = "row-major"
+    return g
+
+
+def process_info() -> tuple:
+    """(process_index, process_count) — the reference's (rank, size) at the
+    host level."""
+    return jax.process_index(), jax.process_count()

@@ -129,3 +129,43 @@ def test_this_rank_axis_size(devices8):
     out = np.asarray(_shmap(g, f, (), P("row", "col"))())
     expect = np.array([[204, 205, 206, 207], [214, 215, 216, 217]])
     np.testing.assert_array_equal(out, expect)
+
+
+# -- multihost glue (single-process testable surface) ------------------------
+
+def test_multihost_grid_shapes_and_axes(devices8):
+    from dlaf_tpu.comm.multihost import multihost_grid, process_info, slice_groups
+    import jax
+
+    g = multihost_grid()
+    assert g.num_devices == 8
+    assert g.size.row * g.size.col == 8
+    assert set(g.mesh.axis_names) == {"row", "col"}
+    g2 = multihost_grid(2, 4)
+    assert (g2.size.row, g2.size.col) == (2, 4)
+    pi, pc = process_info()
+    assert pi == 0 and pc == 1
+    # all virtual CPU devices sit in one ICI island
+    assert len(slice_groups(jax.devices())) == 1
+
+
+def test_multihost_grid_runs_algorithms(devices8):
+    import numpy as np
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.comm.multihost import multihost_grid
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((24, 24))
+    a = x @ x.T + 24 * np.eye(24)
+    mat = Matrix.from_global(a, TileElementSize(4, 4), grid=multihost_grid())
+    out = cholesky("L", mat)
+    f = np.tril(out.to_numpy())
+    assert np.linalg.norm(f @ f.T - a) / np.linalg.norm(a) < 1e-13
+
+
+def test_initialize_multihost_single_process_noop():
+    from dlaf_tpu.comm.multihost import initialize_multihost
+
+    initialize_multihost()  # must not raise or disturb the backend
