@@ -4,6 +4,7 @@
 ``permutations::permute``, ``auxiliary::norm``)."""
 
 from .cholesky import cholesky
+from .qr import t_factor
 from .gen_to_std import gen_to_std
 from .general import general_sub_multiply
 from .norm import max_norm
@@ -12,6 +13,7 @@ from .triangular import triangular_multiply, triangular_solve
 
 __all__ = [
     "cholesky",
+    "t_factor",
     "gen_to_std",
     "general_sub_multiply",
     "max_norm",
