@@ -99,6 +99,14 @@ class Configuration:
     #: (jax.profiler traces with named phases) into this directory
     #: (the green-field tracing hook SURVEY §5 calls for).
     profile_dir: str = ""
+    #: When non-empty, compiled XLA programs persist here across processes
+    #: (jax persistent compilation cache). The unrolled factorizations cost
+    #: minutes to compile and seconds to run — a disk cache turns every
+    #: re-run (benchmark sweeps included) into a cache hit. Empty turns the
+    #: cache off (including un-setting it on a later initialize()).
+    compilation_cache_dir: str = ""
+    #: Only compiles at least this long (seconds) are persisted.
+    compilation_cache_min_secs: float = 5.0
 
     def _fields(self):
         return {f.name: f for f in dataclasses.fields(self)}
@@ -208,6 +216,16 @@ def initialize(user: Optional[Configuration] = None,
         import jax
 
         jax.config.update("jax_enable_x64", True)
+    if _active is None or cfg.compilation_cache_dir != _active.compilation_cache_dir \
+            or cfg.compilation_cache_min_secs != _active.compilation_cache_min_secs:
+        import jax
+
+        # always applied so an empty value really turns the cache OFF on a
+        # later initialize() (state must track the active Configuration)
+        jax.config.update("jax_compilation_cache_dir",
+                          cfg.compilation_cache_dir or None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(cfg.compilation_cache_min_secs))
     if cfg.print_config:
         print(cfg)
     _active = cfg
