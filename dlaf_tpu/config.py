@@ -80,6 +80,11 @@ class Configuration:
     #: 8 (56 mantissa bits, f64-grade, 36 gemms per product) down to e.g.
     #: 7 (49 bits, 28 gemms) when the application's accuracy budget allows.
     f64_gemm_slices: int = 8
+    #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
+    #: full-f64 combine — exactly f64-grade) or "pallas" (fused per-tile
+    #: kernel, double-f32 fold: ~48 mantissa bits, no intermediate HBM
+    #: traffic; see tile_ops/pallas_ozaki.py).
+    ozaki_impl: str = "jnp"
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
     #: under TPU f64 emulation) or "mixed" (f32 seed + Newton refinement,
     #: tile_ops/mixed.py: refined explicit inverse + matmul for per-tile
@@ -159,6 +164,7 @@ _VALID_CHOICES = {
     "bt_b2t_impl": ("blocked", "sweeps"),
     "f64_gemm": ("native", "mxu"),
     "f64_trsm": ("native", "mixed"),
+    "ozaki_impl": ("jnp", "pallas"),
 }
 
 
@@ -216,16 +222,21 @@ def initialize(user: Optional[Configuration] = None,
         import jax
 
         jax.config.update("jax_enable_x64", True)
-    if _active is None or cfg.compilation_cache_dir != _active.compilation_cache_dir \
-            or cfg.compilation_cache_min_secs != _active.compilation_cache_min_secs:
+    prev_cache = _active.compilation_cache_dir if _active is not None else ""
+    if cfg.compilation_cache_dir:
         import jax
 
-        # always applied so an empty value really turns the cache OFF on a
-        # later initialize() (state must track the active Configuration)
         jax.config.update("jax_compilation_cache_dir",
-                          cfg.compilation_cache_dir or None)
+                          cfg.compilation_cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(cfg.compilation_cache_min_secs))
+    elif prev_cache:
+        # OUR previously-set dir is being cleared; never touched when the
+        # knob was never used, so a cache configured through JAX's own
+        # JAX_COMPILATION_CACHE_DIR mechanism stays intact
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
     if cfg.print_config:
         print(cfg)
     _active = cfg

@@ -111,6 +111,20 @@ def _recombine(groups, sa, sb):
     return ((acc * 4.0) * sa) * sb
 
 
+def _use_fused_pallas(k: int) -> bool:
+    """Trace-time: route the slice reduction through the fused Pallas kernel
+    (config ``ozaki_impl="pallas"``)? Interpret mode keeps it testable off
+    TPU; contraction depth is VMEM-bounded. The config check comes first so
+    the default jnp path never imports pallas at all."""
+    from ..config import get_configuration
+
+    if get_configuration().ozaki_impl != "pallas":
+        return False
+    from .pallas_ozaki import K_MAX
+
+    return k <= K_MAX
+
+
 @functools.partial(jnp.vectorize, signature="(m,k),(k,n)->(m,n)",
                    excluded=frozenset({"slices"}))
 def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
@@ -120,6 +134,15 @@ def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
     sb = _scale(b, axis=-2)           # (1, n)
     ia = _peel_slices(_normalize(a, sa), s)
     ib = _peel_slices(_normalize(b, sb), s)
+    if _use_fused_pallas(k):
+        import jax
+
+        from .pallas_ozaki import fused_slice_product
+
+        hi, lo = fused_slice_product(jnp.stack(ia), jnp.stack(ib),
+                                     interpret=jax.default_backend() == "cpu")
+        acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)
+        return ((acc * 4.0) * sa) * sb
     # int32 group sums stay exact while (d+1) * k * 2^12 < 2^31
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     groups = []
@@ -156,6 +179,16 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
     k = a.shape[-1]
     sa = _scale(a, axis=-1)           # (m, 1)
     ia = _peel_slices(_normalize(a, sa), s)
+    if _use_fused_pallas(k):
+        import jax
+
+        from .pallas_ozaki import fused_slice_product
+
+        st = jnp.stack(ia)
+        hi, lo = fused_slice_product(st, jnp.swapaxes(st, -1, -2),
+                                     interpret=jax.default_backend() == "cpu")
+        acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)
+        return ((acc * 4.0) * sa) * jnp.swapaxes(sa, -1, -2)
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     cast = (lambda x: x) if exact_i32 else (lambda x: x.astype(jnp.float64))
     groups = []

@@ -1,0 +1,117 @@
+"""Fused Pallas kernel for the Ozaki slice products (opt-in).
+
+The jnp path of :mod:`.ozaki` materializes every per-shift int32 group
+(``s`` arrays of the full output shape) before the f64 combine — for a
+3840x3840 trailing update that is ~0.5 GB of intermediate HBM traffic per
+product. This kernel keeps the whole reduction in VMEM: for each output
+tile it runs all ``s(s+1)/2`` int8 MXU dots, accumulates each shift group
+exactly in int32, and folds the groups into a double-f32 accumulator
+(Knuth two-sum), writing ONE (hi, lo) pair to HBM.
+
+Accuracy: the int8 dots and int32 group sums are exact (same argument as
+ozaki.py); the double-f32 fold carries ~48 mantissa bits vs the jnp path's
+full f64 combine (~53) — a few bits under native f64, far inside the
+``60 n eps`` algorithm budgets, and documented at the knob
+(``Configuration.ozaki_impl``, default "jnp" = full accuracy).
+
+VMEM budget: ``s*(BM + BN)*K`` int8 + ``BM*BN`` int32 + 2 f32 — with the
+default 256-blocks and s=8 that is 4 MiB of slices + ~0.75 MiB accumulators
+at K=1024 (~4.75 MiB total); the wrapper falls back to the jnp path beyond
+``K_MAX``.
+
+Known follow-up (documented, not yet implemented): the syrk use does not
+exploit symmetry — all ``s(s+1)/2`` dots run for every output tile including
+both (i,j) and (j,i); a triangular-grid mirrored variant would halve the MXU
+work for the Cholesky trailing update.
+
+Status: validated in interpret mode (CPU CI); MXU-hardware timing pending —
+this is the designated next perf lever for the trailing update (the int8
+dots run at ~4.5 TF/s standalone while the jnp ozaki syrk lands at ~650
+GF/s effective; the gap is intermediate traffic this kernel removes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .ozaki import SLICE_BITS
+
+#: Largest contraction depth the fused kernel accepts (VMEM bound).
+K_MAX = 1024
+
+
+def _two_sum(a, b):
+    """Knuth two-sum: s + err == a + b exactly (f32)."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _make_kernel(s: int):
+    def kernel(ia_ref, ib_ref, hi_ref, lo_ref):
+        bm = hi_ref.shape[0]
+        bn = hi_ref.shape[1]
+        hi = jnp.zeros((bm, bn), jnp.float32)
+        lo = jnp.zeros((bm, bn), jnp.float32)
+        for d in range(s):
+            p = jnp.zeros((bm, bn), jnp.int32)
+            for t in range(d + 1):
+                p = p + jax.lax.dot_general(
+                    ia_ref[t], ib_ref[d - t],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+            # exact int32 -> double-f32 split: |p| <= s*k*2^12 < 2^27, so
+            # the residual after the f32 round fits f32 exactly
+            phi = p.astype(jnp.float32)
+            plo = (p - phi.astype(jnp.int32)).astype(jnp.float32)
+            scale = float(2.0 ** (-SLICE_BITS * (d + 2)))  # exact pow2 mult
+            hi, err = _two_sum(hi, phi * scale)
+            lo = lo + (err + plo * scale)
+        hi_ref[:] = hi
+        lo_ref[:] = lo
+
+    return kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_m", "block_n", "interpret"))
+def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
+                        interpret: bool = False):
+    """All-shift Ozaki reduction of stacked int8 slices, fused per tile.
+
+    ``ia``: (s, M, K) int8 slices of the normalized A; ``ib``: (s, K, N) of
+    B. Returns ``(hi, lo)`` float32 arrays with
+    ``hi + lo ~= sum_{t+u=d<s} 2^(-q(d+2)) IA_t @ IB_u``
+    (the caller applies ``*4*sa*sb`` in f64, as :func:`ozaki._recombine`).
+    M/N are padded to block multiples internally.
+    """
+    s, m, k = ia.shape
+    n = ib.shape[-1]
+    assert k <= K_MAX, f"fused kernel contraction depth {k} > {K_MAX}"
+    pm = (-m) % block_m
+    pn = (-n) % block_n
+    if pm:
+        ia = jnp.pad(ia, ((0, 0), (0, pm), (0, 0)))
+    if pn:
+        ib = jnp.pad(ib, ((0, 0), (0, 0), (0, pn)))
+    mp, np_ = m + pm, n + pn
+    grid = (mp // block_m, np_ // block_n)
+    hi, lo = pl.pallas_call(
+        _make_kernel(s),
+        out_shape=(jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+                   jax.ShapeDtypeStruct((mp, np_), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((s, block_m, k), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((s, k, block_n), lambda i, j: (0, 0, j)),
+        ],
+        out_specs=(pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
+                   pl.BlockSpec((block_m, block_n), lambda i, j: (i, j))),
+        interpret=interpret,
+    )(ia, ib)
+    return hi[:m, :n], lo[:m, :n]

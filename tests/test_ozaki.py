@@ -83,6 +83,58 @@ class TestOzakiMatmul:
         assert np.allclose(got, got.T)  # symmetry by construction
 
 
+class TestPallasFused:
+    """ozaki_impl="pallas": the fused per-tile slice reduction (interpret
+    mode on CPU) must agree with the jnp path to the double-f32 fold's
+    documented accuracy (~2^-48 relative to row/col scales)."""
+
+    def _knob(self, monkeypatch):
+        monkeypatch.setenv("DLAF_OZAKI_IMPL", "pallas")
+        import dlaf_tpu.config as config
+        config.initialize()
+        return config
+
+    def test_matmul_and_syrk_match(self, monkeypatch):
+        config = self._knob(monkeypatch)
+        try:
+            rng = np.random.default_rng(21)
+            a = rng.standard_normal((100, 200))
+            b = rng.standard_normal((200, 70))
+            a[0] *= 2.0**120
+            b[:, 3] *= 2.0**-90
+            got = np.asarray(matmul_f64(a, b))
+            assert _scaled_err(got, a @ b, a, b) < 16 * EPS
+            gs = np.asarray(syrk_f64(a))
+            assert _scaled_err(gs, a @ a.T, a, np.swapaxes(a, -1, -2)) < 16 * EPS
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_IMPL")
+            config.initialize()
+
+    def test_cholesky_ozaki_under_pallas_impl(self, monkeypatch):
+        monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "ozaki")
+        config = self._knob(monkeypatch)
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            n, nb = 256, 64
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.float64)
+            out = cholesky("L", mat)
+            f = np.tril(out.to_numpy())
+            resid = np.linalg.norm(f @ f.T - mat.to_numpy()) \
+                / np.linalg.norm(mat.to_numpy())
+            assert resid < 60 * n * EPS
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_IMPL")
+            monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+            config.initialize()
+
+
 class TestContract:
     """blas.contract: the einsum->slice-product factorization must equal
     jnp.einsum for every pattern the algorithms use, real and complex."""
