@@ -65,9 +65,9 @@ VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki")
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     n = a.shape[0]
     # "ozaki": route the flops-dominant trailing update through int8 MXU
-    # passes (tile_ops.ozaki) — real f64 only; other dtypes keep the native
-    # whole-gemm form (static fallback, decided at trace time)
-    use_oz = trailing == "ozaki" and a.dtype == jnp.float64
+    # passes (tile_ops.ozaki) — f64 and complex128 (4-real-product form);
+    # other dtypes keep the native whole-gemm form (static, trace time)
+    use_oz = trailing == "ozaki" and a.dtype in (jnp.float64, jnp.complex128)
     if trailing == "ozaki" and not use_oz:
         trailing = "biggemm"
     if trailing == "xla" and n:
@@ -109,7 +109,7 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                 # refined explicit inverse -> the panel solve is one small
                 # f64 gemm (throughput-bound) instead of an emulated trsm
                 linv = mx.tri_inv_refined(fac, lower=True)
-                panel = a[k1:, k0:k1] @ linv.T
+                panel = a[k1:, k0:k1] @ jnp.conj(linv).T
             elif trailing == "invgemm":
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
@@ -134,15 +134,19 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                 # ONE full trailing update, masked to the lower triangle;
                 # "ozaki" forms it with int8 MXU passes instead of the
                 # software-emulated f64 gemm
-                upd = (oz.syrk_f64(panel, slices=tb._oz_slices()) if use_oz
-                       else panel @ jnp.conj(panel).T)
+                if use_oz:
+                    upd = (oz.herk_c128(panel, slices=tb._oz_slices())
+                           if jnp.iscomplexobj(panel)
+                           else oz.syrk_f64(panel, slices=tb._oz_slices()))
+                else:
+                    upd = panel @ jnp.conj(panel).T
                 mask = jnp.tril(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
         else:
             # upper: A = U^H U; panel is a block row
             if use_oz:
                 uinv = mx.tri_inv_refined(fac, lower=False)
-                panel = uinv.T @ a[k0:k1, k1:]
+                panel = jnp.conj(uinv).T @ a[k0:k1, k1:]
             elif trailing == "invgemm":
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
@@ -161,9 +165,13 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                                         alpha=-1.0, beta=1.0, op_a="C")
                         a = a.at[j0:j1, j1:].set(right)
             else:
-                upd = (oz.syrk_f64(jnp.swapaxes(panel, -1, -2),
-                                   slices=tb._oz_slices()) if use_oz
-                       else jnp.conj(panel).T @ panel)
+                if use_oz:
+                    pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
+                    upd = (oz.herk_c128(pt, slices=tb._oz_slices())
+                           if jnp.iscomplexobj(panel)
+                           else oz.syrk_f64(pt, slices=tb._oz_slices()))
+                else:
+                    upd = jnp.conj(panel).T @ panel
                 mask = jnp.triu(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
     return a
@@ -179,9 +187,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
     error-free int8 MXU path (tile_ops.ozaki; ``cplx`` picks the complex128
-    composition); ``use_mixed`` (real f64 only) factors/solves the panel with
-    the f32-seed-plus-Newton helpers (tile_ops.mixed) instead of emulated-f64
-    potrf/trsm. Both follow the ``f64_gemm="mxu"`` config knob.
+    composition), following the ``f64_gemm="mxu"`` knob; ``use_mixed`` (f64
+    AND complex128, following ``f64_trsm="mixed"``) factors/solves the panel
+    with the half-precision-seed-plus-Newton helpers (tile_ops.mixed,
+    Hermitian-correct) instead of emulated potrf/trsm.
 
     The returned function maps tile storage -> tile storage. All index
     arithmetic below is trace-time (static per k); only data and the
@@ -417,7 +426,8 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
                and mat.block_size.row >= cfg.f64_gemm_min_dim)
     # panel potrf/trsm follow the f64_trsm knob, independent of f64_gemm
     # (config.py: f64_gemm affects contractions only)
-    use_mixed = cfg.f64_trsm == "mixed" and dt == np.dtype(np.float64)
+    use_mixed = cfg.f64_trsm == "mixed" and dt in (np.dtype(np.float64),
+                                                   np.dtype(np.complex128))
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, dt.name, uplo,
                                supports_pallas_update(mat.dtype, platform)
                                and not use_mxu,

@@ -262,7 +262,7 @@ def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
 def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
     """``trsm`` with ONE (2D) triangular block ``a`` against a possibly
     batched rhs ``b`` — the per-tile panel-solve pattern of the distributed
-    algorithms. Under config ``f64_trsm="mixed"`` (real f64) the solve
+    algorithms. Under config ``f64_trsm="mixed"`` (f64 / complex128) the solve
     becomes refined-explicit-inverse (tile_ops.mixed, computed once, not per
     batch entry) times matmul (which follows ``f64_gemm``, so "mxu" puts the
     application on the int8 path); otherwise ``a`` broadcasts into the
@@ -272,7 +272,8 @@ def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
 
     cfg = get_configuration()
     if (cfg.f64_trsm == "mixed" and a.ndim == 2
-            and a.dtype == jnp.float64 and b.dtype == jnp.float64):
+            and a.dtype in (jnp.float64, jnp.complex128)
+            and b.dtype == a.dtype):
         from . import mixed as mx
 
         inv = mx.tri_inv_refined(_tri(a, uplo, diag), lower=(uplo == "L"))

@@ -1,4 +1,5 @@
-"""Mixed-precision f64 panel factorization for TPU: f32 seed + Newton step.
+"""Mixed-precision f64/c128 panel factorization for TPU: half-precision seed
+plus one Newton step.
 
 On TPU, f64 is compiler-emulated (double-double over f32), which makes the
 *latency-bound* panel ops of a blocked factorization disproportionately slow:
@@ -54,10 +55,31 @@ def cond_limit() -> float:
     return float(get_configuration().mixed_cond_limit)
 
 
+def _seed_dtype(dtype):
+    """Half-precision seed dtype: f32 for f64, c64 for c128."""
+    return jnp.complex64 if jnp.dtype(dtype).kind == "c" else jnp.float32
+
+
 def _phi_lower(m):
     """Strict lower triangle plus half the diagonal — the projector that
-    maps the symmetrized correction equation onto lower-triangular space."""
-    return jnp.tril(m, -1) + 0.5 * jnp.tril(jnp.triu(m))
+    maps the Hermitian correction equation onto lower-triangular space. The
+    diagonal of the (Hermitian) correction is real up to rounding; its real
+    part is taken so the factor's diagonal stays exactly real."""
+    d = jnp.diagonal(m, axis1=-2, axis2=-1)
+    d = jnp.real(d) if jnp.iscomplexobj(m) else d
+    n = m.shape[-1]
+    return jnp.tril(m, -1) + 0.5 * d[..., None] * jnp.eye(n, dtype=m.dtype)
+
+
+def _herm_from_tril(a):
+    """Full Hermitian block from its stored lower triangle (real
+    diagonal enforced for complex dtypes)."""
+    lo = jnp.tril(a, -1)
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    d = jnp.real(d).astype(a.dtype) if jnp.iscomplexobj(a) else d
+    n = a.shape[-1]
+    return lo + jnp.conj(jnp.swapaxes(lo, -1, -2)) \
+        + d[..., None] * jnp.eye(n, dtype=a.dtype)
 
 
 def _diag_ratio_sq(tri32):
@@ -71,15 +93,17 @@ def _diag_ratio_sq(tri32):
 
 
 def _potrf_refined_l(a):
-    """Lower-Cholesky of an f64 block via f32 seed + one Newton step."""
-    l32 = lax.linalg.cholesky(a.astype(jnp.float32))
-    l0 = jnp.tril(l32).astype(jnp.float64)
+    """Lower-Cholesky of an f64/c128 block via half-precision seed + one
+    Newton step (Hermitian-correct: conjugate transposes throughout)."""
+    sd = _seed_dtype(a.dtype)
+    l32 = lax.linalg.cholesky(a.astype(sd))
+    l0 = jnp.tril(l32).astype(a.dtype)
     linv32 = lax.linalg.triangular_solve(
-        l32, jnp.eye(a.shape[-1], dtype=jnp.float32), left_side=True,
+        l32, jnp.eye(a.shape[-1], dtype=sd), left_side=True,
         lower=True)
-    linv0 = jnp.tril(linv32).astype(jnp.float64)
-    e = a - l0 @ l0.T
-    m = (linv0 @ e) @ linv0.T
+    linv0 = jnp.tril(linv32).astype(a.dtype)
+    e = a - l0 @ jnp.conj(l0).T
+    m = (linv0 @ e) @ jnp.conj(linv0).T
     refined = l0 + l0 @ _phi_lower(m)
 
     def native(_):
@@ -94,18 +118,21 @@ def _potrf_refined_l(a):
 
 
 def potrf_refined(uplo: str, a):
-    """f64 Cholesky factor of the HPD block ``a`` (``uplo`` triangle read,
-    other triangle of the *result* zeroed). Real f64, 2D blocks.
+    """f64/complex128 Cholesky factor of the HPD block ``a`` (``uplo``
+    triangle read, other triangle of the *result* zeroed). 2D blocks; the
+    seed runs at f32/c64 and one Hermitian Newton step recovers full
+    precision.
 
-    uplo='L': returns lower ``L`` with ``L L^T = tril+tril^T-sym(a)``;
-    uplo='U': returns upper ``U`` with ``U^T U = a`` (computed on the
-    transposed problem).
+    uplo='L': returns lower ``L`` with ``L L^H`` = the Hermitian matrix
+    rebuilt from the stored lower triangle; uplo='U': returns upper ``U``
+    with ``U^H U = a`` (``U = conj(L).T`` of the factorization of the
+    Hermitian rebuild of ``conj(a).T``'s lower storage).
     """
     if uplo == "L":
-        sym = jnp.tril(a) + jnp.tril(a, -1).T
+        sym = _herm_from_tril(a)
         return _potrf_refined_l(sym)
-    sym = jnp.triu(a) + jnp.triu(a, 1).T
-    return _potrf_refined_l(sym.T).T
+    sym = _herm_from_tril(jnp.conj(a).T)   # upper storage, transposed problem
+    return jnp.conj(_potrf_refined_l(sym)).T
 
 
 def tri_inv_refined(l, *, lower: bool = True):
@@ -113,11 +140,12 @@ def tri_inv_refined(l, *, lower: bool = True):
     step ``X <- X + X(I - L X)`` (two small f64 gemms). Non-finite f32 seed
     falls back to the native emulated-f64 triangular solve."""
     n = l.shape[-1]
-    eye32 = jnp.eye(n, dtype=jnp.float32)
-    l32 = l.astype(jnp.float32)
+    sd = _seed_dtype(l.dtype)
+    eye32 = jnp.eye(n, dtype=sd)
+    l32 = l.astype(sd)
     x32 = lax.linalg.triangular_solve(l32, eye32, left_side=True, lower=lower)
     tri = jnp.tril if lower else jnp.triu
-    x0 = tri(x32).astype(jnp.float64)
+    x0 = tri(x32).astype(l.dtype)
     lt = tri(l)
     refined = x0 + x0 @ (jnp.eye(n, dtype=l.dtype) - lt @ x0)
 

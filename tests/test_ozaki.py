@@ -360,6 +360,25 @@ class TestMixedPanel:
         resid = np.linalg.norm(fac @ fac.T - a) / np.linalg.norm(a)
         assert resid < 60 * n * EPS
 
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    def test_potrf_refined_complex128(self, uplo):
+        rng = np.random.default_rng(17)
+        x = rng.standard_normal((80, 80)) + 1j * rng.standard_normal((80, 80))
+        a = x @ x.conj().T + 80 * np.eye(80)
+        fac = np.asarray(potrf_refined(uplo, jnp.asarray(a)))
+        rec = fac @ fac.conj().T if uplo == "L" else fac.conj().T @ fac
+        assert np.linalg.norm(rec - a) / np.linalg.norm(a) < 80 * 8 * EPS
+        d = np.diagonal(fac)
+        assert np.abs(np.imag(d)).max() == 0.0   # factor diagonal stays real
+
+    def test_tri_inv_refined_complex128(self):
+        rng = np.random.default_rng(18)
+        l = np.tril(rng.standard_normal((64, 64))
+                    + 1j * rng.standard_normal((64, 64))) + 8 * np.eye(64)
+        inv = np.asarray(tri_inv_refined(jnp.asarray(l), lower=True))
+        # complex rounding carries a ~2x larger constant than the real case
+        assert np.linalg.norm(inv @ l - np.eye(64)) < 64 * 32 * EPS
+
     def test_potrf_refined_fallback_on_f32_failure(self):
         # PD in f64 but singular at f32: the off-diagonal rounds to 1.0
         a = np.array([[1.0, 1.0 - 5e-9], [1.0 - 5e-9, 1.0]])
@@ -378,6 +397,35 @@ class TestMixedPanel:
 
 
 class TestCholeskyOzakiPath:
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    def test_local_complex128(self, uplo, monkeypatch):
+        """trailing='ozaki' with complex128: herk_c128 trailing + complex
+        mixed panels (c64 seed)."""
+        monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "ozaki")
+        import dlaf_tpu.config as config
+        config.initialize()
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            n, nb = 192, 64
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, np.complex128), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.complex128)
+            out = cholesky(uplo, mat)
+            f = out.to_numpy()
+            a = mat.to_numpy()
+            tri = np.tril(f) if uplo == "L" else np.triu(f)
+            rec = tri @ tri.conj().T if uplo == "L" else tri.conj().T @ tri
+            resid = np.linalg.norm(rec - a) / np.linalg.norm(a)
+            assert resid < 60 * n * EPS
+        finally:
+            monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+            config.initialize()
+
     @pytest.mark.parametrize("n,nb,uplo", [(256, 64, "L"), (256, 64, "U"),
                                            (150, 64, "L")])
     def test_local_residual(self, n, nb, uplo, monkeypatch):
