@@ -207,7 +207,10 @@ def main() -> None:
         os.environ["DLAF_BENCH_CHILD"] = "1"
         run_bench()
         return
-    log("accelerator unavailable/wedged; re-running on pure-CPU platform")
+    log("accelerator unavailable/wedged; re-running on pure-CPU platform. "
+        "NOTE: a '[cpu]' metric is the fallback, not the framework's TPU "
+        "result — BASELINE.md records the measured v5e number for this "
+        "exact config; re-run on a healthy tunnel.")
     rc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                         env=cpu_env()).returncode
     sys.exit(rc)

@@ -19,10 +19,11 @@ default 256-blocks and s=8 that is 4 MiB of slices + ~0.75 MiB accumulators
 at K=1024 (~4.75 MiB total); the wrapper falls back to the jnp path beyond
 ``K_MAX``.
 
-Known follow-up (documented, not yet implemented): the syrk use does not
-exploit symmetry — all ``s(s+1)/2`` dots run for every output tile including
-both (i,j) and (j,i); a triangular-grid mirrored variant would halve the MXU
-work for the Cholesky trailing update.
+:func:`fused_slice_syrk` is the symmetric variant: a *triangular* grid
+(linear pair index decoded through scalar-prefetched (i, j) lookup tables,
+``pltpu.PrefetchScalarGridSpec``) computes only the lower-triangle output
+tiles — halving the MXU work of the general kernel for the Cholesky
+trailing update; the caller mirrors the strict lower triangle.
 
 Status: validated in interpret mode (CPU CI); MXU-hardware timing pending —
 this is the designated next perf lever for the trailing update (the int8
@@ -34,9 +35,12 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .ozaki import SLICE_BITS
 
@@ -52,28 +56,35 @@ def _two_sum(a, b):
     return s, err
 
 
+def _fold_body(s: int, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract: int):
+    """Shared numerical body: per-shift int32 group accumulation, exact
+    int32 -> double-f32 split (|p| <= s*k*2^12 < 2^27, so the residual
+    after the f32 round fits f32 exactly), and the two-sum fold.
+    ``rhs_contract`` picks the rhs contraction axis (0: (K, BN) blocks;
+    1: (BN, K) blocks as in the syrk form, contracting K against K)."""
+    bm = hi_ref.shape[0]
+    bn = hi_ref.shape[1]
+    hi = jnp.zeros((bm, bn), jnp.float32)
+    lo = jnp.zeros((bm, bn), jnp.float32)
+    for d in range(s):
+        p = jnp.zeros((bm, bn), jnp.int32)
+        for t in range(d + 1):
+            p = p + jax.lax.dot_general(
+                ia_ref[t], ib_ref[d - t],
+                dimension_numbers=(((1,), (rhs_contract,)), ((), ())),
+                preferred_element_type=jnp.int32)
+        phi = p.astype(jnp.float32)
+        plo = (p - phi.astype(jnp.int32)).astype(jnp.float32)
+        scale = float(2.0 ** (-SLICE_BITS * (d + 2)))  # exact pow2 mult
+        hi, err = _two_sum(hi, phi * scale)
+        lo = lo + (err + plo * scale)
+    hi_ref[:] = hi
+    lo_ref[:] = lo
+
+
 def _make_kernel(s: int):
     def kernel(ia_ref, ib_ref, hi_ref, lo_ref):
-        bm = hi_ref.shape[0]
-        bn = hi_ref.shape[1]
-        hi = jnp.zeros((bm, bn), jnp.float32)
-        lo = jnp.zeros((bm, bn), jnp.float32)
-        for d in range(s):
-            p = jnp.zeros((bm, bn), jnp.int32)
-            for t in range(d + 1):
-                p = p + jax.lax.dot_general(
-                    ia_ref[t], ib_ref[d - t],
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.int32)
-            # exact int32 -> double-f32 split: |p| <= s*k*2^12 < 2^27, so
-            # the residual after the f32 round fits f32 exactly
-            phi = p.astype(jnp.float32)
-            plo = (p - phi.astype(jnp.int32)).astype(jnp.float32)
-            scale = float(2.0 ** (-SLICE_BITS * (d + 2)))  # exact pow2 mult
-            hi, err = _two_sum(hi, phi * scale)
-            lo = lo + (err + plo * scale)
-        hi_ref[:] = hi
-        lo_ref[:] = lo
+        _fold_body(s, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract=0)
 
     return kernel
 
@@ -115,3 +126,58 @@ def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
         interpret=interpret,
     )(ia, ib)
     return hi[:m, :n], lo[:m, :n]
+
+
+def _make_syrk_kernel(s: int):
+    def kernel(i_idx, j_idx, ia_ref, ja_ref, hi_ref, lo_ref):
+        del i_idx, j_idx  # consumed by the index maps
+        # rhs blocks are (BN, K) row blocks of the SAME operand: contract
+        # the K axes directly (no transposed copy)
+        _fold_body(s, ia_ref, ja_ref, hi_ref, lo_ref, rhs_contract=1)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def fused_slice_syrk(ia, *, block: int = 256, interpret: bool = False):
+    """Symmetric fused reduction: lower-triangle tiles of the gram product
+    of the stacked slices ``ia`` (s, M, K) with themselves.
+
+    Returns ``(hi, lo)`` float32 (M, M) pairs whose LOWER triangle (block
+    diagonal included, full blocks) is valid; tiles strictly above the
+    block diagonal are never computed — the caller mirrors:
+    ``C = tril(H) + tril(H, -1).T``. Halves the MXU work of
+    :func:`fused_slice_product` for syrk-shaped uses.
+    """
+    s, m, k = ia.shape
+    assert k <= K_MAX, f"fused kernel contraction depth {k} > {K_MAX}"
+    pm = (-m) % block
+    if pm:
+        ia = jnp.pad(ia, ((0, 0), (0, pm), (0, 0)))
+    mp = m + pm
+    nt = mp // block
+    # linear lower-triangle pair index -> (i, j), scalar-prefetched so the
+    # block index maps can look it up per grid step
+    ii, jj = np.tril_indices(nt)
+    i_idx = jnp.asarray(ii, dtype=jnp.int32)
+    j_idx = jnp.asarray(jj, dtype=jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(ii),),
+        in_specs=[
+            pl.BlockSpec((s, block, k), lambda p, i_r, j_r: (0, i_r[p], 0)),
+            pl.BlockSpec((s, block, k), lambda p, i_r, j_r: (0, j_r[p], 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((block, block), lambda p, i_r, j_r: (i_r[p], j_r[p])),
+            pl.BlockSpec((block, block), lambda p, i_r, j_r: (i_r[p], j_r[p])),
+        ),
+    )
+    hi, lo = pl.pallas_call(
+        _make_syrk_kernel(s),
+        out_shape=(jax.ShapeDtypeStruct((mp, mp), jnp.float32),
+                   jax.ShapeDtypeStruct((mp, mp), jnp.float32)),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(i_idx, j_idx, ia, ia)
+    return hi[:m, :m], lo[:m, :m]

@@ -110,6 +110,23 @@ class TestPallasFused:
             monkeypatch.delenv("DLAF_OZAKI_IMPL")
             config.initialize()
 
+    @pytest.mark.parametrize("m,k", [(100, 200), (513, 64)])
+    def test_syrk_triangular_grid(self, m, k, monkeypatch):
+        """The symmetric kernel computes only lower-triangle tiles (scalar-
+        prefetched pair index); the mirrored result must match numpy at
+        ragged sizes (padding + edge tiles)."""
+        config = self._knob(monkeypatch)
+        try:
+            rng = np.random.default_rng(m)
+            a = rng.standard_normal((m, k))
+            a[0] *= 2.0**90
+            got = np.asarray(syrk_f64(a))
+            ss = np.abs(a).max(1)[:, None] * np.abs(a).max(1)[None, :] * k
+            assert (np.abs(got - a @ a.T) / ss).max() < 16 * EPS
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_IMPL")
+            config.initialize()
+
     def test_cholesky_ozaki_under_pallas_impl(self, monkeypatch):
         monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "ozaki")
         config = self._knob(monkeypatch)
