@@ -13,12 +13,15 @@ spans it. The TPU-native equivalents:
 
 Physics of the axes: within a slice, neighboring devices talk over ICI
 (fast); across slices/pods the boundary is DCN (slow). ``multihost_grid``
-keeps the *contiguous-minor* axis of the device order inside a slice, so for
-the 2D block-cyclic algorithms the high-traffic panel broadcasts along one
-mesh axis ride ICI and only the coarse axis crosses DCN —
-``jax.experimental.mesh_utils.create_hybrid_device_mesh`` is used when the
-topology spans slices (it groups by slice_index), with a plain device-order
-reshape fallback for single-slice or CPU worlds.
+keeps the *contiguous-minor* axis of the device order inside a slice where
+the grid shape allows: when the per-slice device count is a multiple of
+``cols``, ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` lays
+the 'col' axis (and the minor rows) entirely inside each slice, so the
+high-traffic panel broadcasts ride ICI and only the outer 'row' axis
+crosses DCN. Otherwise a slice-major reshape heuristic is used — in that
+regime a 'col' axis wider than one slice necessarily crosses DCN at slice
+boundaries (there is no layout that avoids it). Single-slice or CPU worlds
+use a plain device-order reshape.
 
 Data loading in the multi-controller model: each process creates ONLY its
 addressable shards; :func:`dlaf_tpu.matrix.matrix.Matrix.from_element_fn`
@@ -93,18 +96,33 @@ def multihost_grid(rows: Optional[int] = None, cols: Optional[int] = None,
                 f"multihost grid {rows}x{cols} must use all {n} devices")
 
     groups = slice_groups(devs)
+    dev2d = None
     if len(groups) > 1:
         sizes = {len(g) for g in groups.values()}
         dlaf_assert(len(sizes) == 1, "hetero slice sizes unsupported")
         per = sizes.pop()
-        if cols % per == 0 or per % cols == 0:
-            # slice-major order: consecutive 'col' neighbors share a slice
-            ordered = [d for k in sorted(groups) for d in groups[k]]
-        else:
-            ordered = devs
+        if per % cols == 0:
+            # grid factors over the slice size: route through the canonical
+            # helper so the 'col' axis (and the minor rows) sit entirely
+            # inside each slice — the documented ICI guarantee
+            try:
+                from jax.experimental import mesh_utils
+
+                dev2d = np.asarray(mesh_utils.create_hybrid_device_mesh(
+                    (per // cols, cols), (len(groups), 1), devices=devs))
+            except Exception:
+                dev2d = None  # helper unavailable/unhappy: reshape heuristic
+        if dev2d is None:
+            if cols % per == 0 or per % cols == 0:
+                # slice-major order: consecutive 'col' neighbors share a
+                # slice where possible; a col axis spanning whole slices
+                # DOES cross DCN at slice boundaries
+                ordered = [d for k in sorted(groups) for d in groups[k]]
+            else:
+                ordered = devs
+            dev2d = np.array(ordered, dtype=object).reshape(rows, cols)
     else:
-        ordered = devs
-    dev2d = np.array(ordered, dtype=object).reshape(rows, cols)
+        dev2d = np.array(devs, dtype=object).reshape(rows, cols)
     g = Grid.__new__(Grid)
     from jax.sharding import Mesh
 

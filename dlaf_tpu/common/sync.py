@@ -40,6 +40,14 @@ def hard_fence(*arrays):
         if hasattr(x, "block_until_ready"):
             x.block_until_ready()
             if getattr(x, "size", 0):
-                # tiny readback: the only fence proxies cannot lie about
-                np.asarray(x[(0,) * x.ndim])
+                # tiny readback: the only fence proxies cannot lie about.
+                # On multi-controller runs the global element (0,..,0) may
+                # live on a non-addressable device — read back from a local
+                # shard instead (completion of any output buffer implies the
+                # launched program ran).
+                if getattr(x, "is_fully_addressable", True):
+                    np.asarray(x[(0,) * x.ndim])
+                else:
+                    shard = x.addressable_shards[0].data
+                    np.asarray(shard[(0,) * shard.ndim])
     return arrays[0] if len(arrays) == 1 else arrays

@@ -126,7 +126,8 @@ def contract(subscripts: str, x, y):
                             + tuple(dims2[c] for c in free2))
         order = batch + free1 + free2
         return jnp.transpose(full, [order.index(c) for c in out])
-    return jnp.einsum(subscripts, x, y, preferred_element_type=x.dtype)
+    return jnp.einsum(subscripts, x, y,
+                      preferred_element_type=jnp.result_type(x, y))
 
 
 def tri_mask(a, uplo: str, *, k: int = 0):

@@ -153,10 +153,17 @@ def larft(v, tau):
     ``v``: (m, k) reflectors (unit lower trapezoidal, implicit ones NOT
     required — v's upper triangle is ignored); ``tau``: (k,).
     Uses ``T^{-1} = diag(1/tau) + strict_upper(V^H V)``; zero taus produce
-    zero rows/cols in T (null reflectors), as LAPACK does.
+    zero rows/cols in T (null reflectors), as LAPACK does. A zero-tau
+    column's stored sub-diagonal is ignored (treated as the null reflector
+    it represents) so the closed form matches LAPACK dlarft even when the
+    caller left stale data in that column.
     """
     k = tau.shape[-1]
-    vv = tri_mask(v, "L", k=-1) + jnp.eye(v.shape[-2], k, dtype=v.dtype)
+    vlow = tri_mask(v, "L", k=-1)
+    # null reflectors (tau==0) must not route cross terms through the Gram:
+    # zero their stored sub-diagonal before forming V^H V
+    vlow = jnp.where((tau == 0)[..., None, :], jnp.zeros_like(vlow), vlow)
+    vv = vlow + jnp.eye(v.shape[-2], k, dtype=v.dtype)
     s = jnp.conj(jnp.swapaxes(vv, -1, -2)) @ vv            # V^H V, one gemm
     tau_safe = jnp.where(tau == 0, jnp.ones_like(tau), tau)
     tinv = tri_mask(s, "U", k=-1) + _embed_diag(1.0 / tau_safe, s.shape, s.dtype)

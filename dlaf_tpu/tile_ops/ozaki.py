@@ -92,10 +92,31 @@ def _peel_slices(xn, s: int):
     return out
 
 
+# int32 accumulation of int8 x int8 products (each |p| <= 2^12) is provably
+# exact while k * 2^12 < 2^31, i.e. k < 2^19; deeper contractions are chunked
+_K_I32_EXACT = 1 << 19
+_K_CHUNK = 1 << 18
+
+
 def _dot_i8(ia, ib):
     """Batched int8 x int8 -> int32 contraction (last axis of ``ia`` with
-    second-to-last of ``ib``), the MXU-native exact product."""
-    return jnp.matmul(ia, ib, preferred_element_type=jnp.int32)
+    second-to-last of ``ib``), the MXU-native exact product.
+
+    For contraction depth ``k >= 2^19`` a single int32 accumulation could
+    wrap (``k * 2^12 >= 2^31`` — reachable through ``blas.contract``, which
+    flattens multiple contracted dims into one k), so the axis is chunked
+    into exact int32 partials summed in f64 (the caller's group-sum path is
+    already f64 in that regime, since ``s*k*2^12 >= 2^31`` too)."""
+    k = ia.shape[-1]
+    if k < _K_I32_EXACT:
+        return jnp.matmul(ia, ib, preferred_element_type=jnp.int32)
+    acc = None
+    for s0 in range(0, k, _K_CHUNK):
+        p = jnp.matmul(ia[..., s0:s0 + _K_CHUNK],
+                       ib[..., s0:s0 + _K_CHUNK, :],
+                       preferred_element_type=jnp.int32).astype(jnp.float64)
+        acc = p if acc is None else acc + p
+    return acc
 
 
 def _recombine(groups, sa, sb):

@@ -75,6 +75,17 @@ class TestOzakiMatmul:
         assert err8 < err6          # more slices -> strictly more mantissa
         assert err6 < 48 * 2.0**-40  # ~2^-42 relative to ~unit row scales
 
+    def test_deep_contraction_chunks_exactly(self):
+        # k * 2^12 == 2^31 at k = 2^19: a single int32 dot accumulation
+        # would wrap (round-1 advisor finding — reachable via blas.contract
+        # flattening several contracted dims); the chunked _dot_i8 path
+        # must stay exact
+        k = 1 << 19
+        a = np.ones((1, k))
+        b = np.ones((k, 1))
+        got = np.asarray(matmul_f64(a, b))
+        np.testing.assert_allclose(got, [[float(k)]], rtol=1e-15)
+
     def test_syrk_matches_matmul(self):
         rng = np.random.default_rng(11)
         a = rng.standard_normal((56, 72))
@@ -189,6 +200,23 @@ class TestContract:
             monkeypatch.delenv("DLAF_F64_GEMM")
             monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
             config.initialize()
+
+    @pytest.mark.parametrize("which", ["x", "y"])
+    def test_mixed_real_complex_native_fallback(self, which):
+        # native (non-mxu) branch with one real and one complex operand:
+        # preferred_element_type must follow result_type, not x.dtype
+        # (round-1 advisor finding — f64 preferred type on a complex
+        # contraction is invalid/lossy)
+        from dlaf_tpu.tile_ops.blas import contract
+        rng = np.random.default_rng(99)
+        x = rng.standard_normal((4, 5))
+        y = rng.standard_normal((5, 6))
+        if which == "x":
+            x = x + 1j * rng.standard_normal((4, 5))
+        else:
+            y = y + 1j * rng.standard_normal((5, 6))
+        got = np.asarray(contract("ab,bd->ad", jnp.asarray(x), jnp.asarray(y)))
+        np.testing.assert_allclose(got, x @ y, rtol=1e-12, atol=1e-12)
 
     def test_knob_validation_rejects_typo(self):
         import dlaf_tpu.config as config

@@ -266,6 +266,24 @@ def test_larft_zero_tau():
     assert np.isfinite(t).all()
 
 
+def test_larft_zero_tau_stale_column_wy_identity():
+    """Interior tau==0 with a NONZERO stored sub-diagonal in that column:
+    LAPACK dlarft treats the column as a null reflector; the closed form
+    must not route cross terms through it (round-1 advisor finding). The
+    check is the full WY identity against the explicit reflector product."""
+    rng = np.random.default_rng(113)
+    m, k = 8, 4
+    v = np.tril(rng.standard_normal((m, k)), -1) + np.eye(m, k)
+    taus = np.array([2.0 / np.dot(v[:, i], v[:, i]) for i in range(k)])
+    taus[1] = 0.0  # interior null reflector, stale column data left in v
+    t = np.asarray(tl.larft(jnp.asarray(v), jnp.asarray(taus)))
+    q_block = np.eye(m) - v @ t @ v.T
+    q_prod = np.eye(m)
+    for i in range(k):
+        q_prod = q_prod @ (np.eye(m) - taus[i] * np.outer(v[:, i], v[:, i]))
+    np.testing.assert_allclose(q_block, q_prod, rtol=1e-12, atol=1e-12)
+
+
 def test_stedc_vs_scipy():
     rng = np.random.default_rng(14)
     n = 12
