@@ -8,12 +8,19 @@ Headline config (BASELINE.md #1): miniapp_cholesky, double, N=4096, nb=256,
 No absolute baseline exists (the reference publishes no numbers —
 BASELINE.md), so ``vs_baseline`` is 1.0 for the first recorded round.
 
-Robustness: TPU plugin/tunnel initialization can wedge (observed: PJRT
-client creation blocking indefinitely). The benchmark therefore first probes
-device init in a subprocess with a timeout; if the accelerator path is
-unavailable it re-runs itself on the pure-CPU platform (plugin registration
-disabled) and reports the platform in the metric, rather than hanging the
-driver. All progress goes to stderr; stdout carries exactly one JSON line.
+Robustness (round-2 redesign after two distinct wedge modes):
+
+* TPU plugin/tunnel init can hang (round 1: the probe timed out 3x and the
+  round's artifact recorded a CPU fallback). The probe runs in a subprocess
+  with a timeout and retries with pauses; if the accelerator never comes up
+  the bench re-runs on the pure-CPU platform, clearly labeled.
+* A single variant's XLA compile can hang (observed: the 'biggemm'
+  emulated-f64 compile ran >45 min on the v5e tunnel). Every variant
+  therefore runs in its OWN subprocess with a wall-clock timeout — a
+  pathological variant is killed without losing the measurements that
+  already landed.
+
+All progress goes to stderr; stdout carries exactly one JSON line.
 """
 
 import json
@@ -28,184 +35,215 @@ import numpy as np
 # worst case (wedged tunnel: full probe + 2 short retries + pauses, then
 # the CPU fallback) inside a driver-friendly total
 PROBE_TIMEOUT_S = int(os.environ.get("DLAF_BENCH_PROBE_TIMEOUT", "240"))
+#: wall-clock cap per variant subprocess: device init (~25 s) + compile
+#: (minutes cold, seconds warm via the persistent cache) + 5 timed runs
+VARIANT_TIMEOUT_S = int(os.environ.get("DLAF_BENCH_VARIANT_TIMEOUT", "900"))
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def probe_devices() -> bool:
-    """Can a jax device backend come up in this environment? (subprocess,
-    timed out rather than hanging forever). The accelerator tunnel has been
-    observed to wedge transiently, so a failed probe is retried a couple of
-    times with a pause before giving up on the accelerator."""
-    code = ("import jax, sys; d = jax.devices(); "
-            "print(d[0].platform, file=sys.stderr)")
+def probe_devices():
+    """Which jax platform comes up in this environment? Returns the platform
+    string, or None if nothing initializes (subprocess, timed out rather
+    than hanging forever). The accelerator tunnel has been observed to
+    wedge transiently, so a failed probe is retried a couple of times with
+    a pause before giving up on the accelerator."""
+    code = "import jax; print(jax.devices()[0].platform)"
     retries = int(os.environ.get("DLAF_BENCH_PROBE_RETRIES", "2"))
     for attempt in range(retries + 1):
         try:
             # full timeout once (cold plugin init is slow); a wedged tunnel
             # hangs rather than erroring, so retries get a short leash to
             # bound the worst case before the CPU fallback kicks in
-            subprocess.run([sys.executable, "-c", code], check=True,
-                           timeout=PROBE_TIMEOUT_S if attempt == 0 else 120,
-                           stdout=subprocess.DEVNULL)
-            return True
+            out = subprocess.run(
+                [sys.executable, "-c", code], check=True,
+                timeout=PROBE_TIMEOUT_S if attempt == 0 else 120,
+                stdout=subprocess.PIPE).stdout.decode().strip()
+            platform = out.splitlines()[-1] if out else "unknown"
+            log(f"device probe: platform {platform!r}")
+            return platform
         except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
             log(f"device probe attempt {attempt + 1}/{retries + 1} failed: "
                 f"{type(e).__name__}")
             if attempt < retries:
                 time.sleep(int(os.environ.get("DLAF_BENCH_PROBE_PAUSE", "60")))
-    return False
+    return None
 
 
 def cpu_env() -> dict:
     from dlaf_tpu.tpu_info import cpu_subprocess_env
 
     env = cpu_subprocess_env()
-    env["DLAF_BENCH_CHILD"] = "1"
+    env["DLAF_BENCH_CPU_FALLBACK"] = "1"
     return env
 
 
-def run_bench() -> None:
+def _cache_dir() -> str:
+    # persist compiled programs across runs/rounds: the unrolled
+    # factorizations compile in minutes and run in milliseconds, so a warm
+    # cache frees nearly the whole sweep budget for measurement
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".jax_cache")
+
+
+def run_variant() -> None:
+    """Child: measure ONE trailing variant (env DLAF_BENCH_VARIANT), print
+    one JSON line {variant, platform, dtype, gflops, t} on stdout."""
+    variant = os.environ["DLAF_BENCH_VARIANT"]
+    dtype_name = os.environ.get("DLAF_BENCH_DTYPE", "float64")
     t_start = time.time()
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    # persist compiled programs across runs/rounds: the unrolled
-    # factorizations compile in minutes and run in milliseconds, so a warm
-    # cache frees nearly the whole sweep budget for measurement. Routed
-    # through the ordinary config knob (the per-variant config.initialize()
-    # calls below apply it before the first compile); an existing env
-    # setting wins, like any DLAF_* override.
-    os.environ.setdefault(
-        "DLAF_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
-    devs = jax.devices()
-    platform = devs[0].platform
-    log(f"devices: {devs} ({time.time() - t_start:.1f}s)")
+    os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR", _cache_dir())
+    os.environ["DLAF_CHOLESKY_TRAILING"] = variant
+
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    platform = jax.devices()[0].platform
+    log(f"[{variant}] devices: {jax.devices()} ({time.time() - t_start:.1f}s)")
 
     from dlaf_tpu.algorithms.cholesky import cholesky
     from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.common.sync import hard_fence
     from dlaf_tpu.matrix.matrix import Matrix
     from dlaf_tpu.miniapp.generators import hpd_element_fn
     from dlaf_tpu.types import total_ops
 
-    n, nb = 4096, 256
-    dtype = np.float64
+    n = int(os.environ.get("DLAF_BENCH_N", "4096"))
+    nb = int(os.environ.get("DLAF_BENCH_NB", "256"))
+    dtype = np.dtype(dtype_name).type
     try:
-        jax.jit(lambda x: x * 2)(jax.numpy.ones((2,), dtype=dtype)).block_until_ready()
+        jax.jit(lambda x: x * 2)(jax.numpy.ones((2,), dtype=dtype)
+                                 ).block_until_ready()
     except Exception as e:  # platform without f64 support
-        log(f"float64 unavailable ({e}); falling back to float32")
+        log(f"[{variant}] {dtype_name} unavailable ({e}); using float32")
         dtype = np.float32
-
-    size = GlobalElementSize(n, n)
-    block = TileElementSize(nb, nb)
-    ref = Matrix.from_element_fn(hpd_element_fn(n, dtype), size, block, dtype=dtype)
-
-    # Trailing-update strategy A/B (config knob cholesky_trailing): measure
-    # each on the actual hardware, report the best. DLAF_BENCH_TRAILING pins
-    # a single variant (skips the sweep).
-    from dlaf_tpu.algorithms.cholesky import VALID_TRAILING
-
-    pinned = os.environ.get("DLAF_BENCH_TRAILING")
-    # measured winner first (ozaki 99 GF/s vs xla 47 / loop 43 on the v5e
-    # tunnel, honest hard_fence timing): if the time budget runs out (or the
-    # accelerator tunnel wedges mid-sweep) the best measurement has landed
-    order = ["ozaki", "xla", "loop", "biggemm", "invgemm"]
-    variants = [pinned] if pinned else \
-        [v for v in order if v in VALID_TRAILING] + \
-        [v for v in VALID_TRAILING if v not in order]
-    if platform == "cpu" and not pinned:
-        # the CPU fallback has fast native f64 — the int8-emulation variant
-        # has no hardware to win on there and would eat the sweep budget;
-        # accelerators (tpu or otherwise) keep it, leading
-        variants = [v for v in variants if v != "ozaki"]
-        variants = sorted(variants, key=lambda v: v != "xla")
-    if dtype != np.float64:
+    if dtype != np.float64 and variant == "ozaki":
         # "ozaki" is the emulated-f64 path; for other dtypes it statically
-        # falls back to biggemm — skip the duplicate (compile minutes) and
-        # keep the metric label truthful
-        variants = [v for v in variants if v != "ozaki"] or ["loop"]
-    budget_s = float(os.environ.get("DLAF_BENCH_BUDGET", "1500"))
-
-    import dlaf_tpu.config as config
-
-    def timed_run(ref_mat, dt, n):
-        """One fenced factorization (the reference's miniapp protocol)."""
-        from dlaf_tpu.common.sync import hard_fence
-
-        mat = ref_mat.with_storage(ref_mat.storage + 0)
+        # falls back to biggemm — keep the label truthful
+        os.environ["DLAF_CHOLESKY_TRAILING"] = variant = "biggemm"
+        config.initialize()
+    ref = Matrix.from_element_fn(hpd_element_fn(n, dtype),
+                                 GlobalElementSize(n, n),
+                                 TileElementSize(nb, nb), dtype=dtype)
+    best_g, best_t = 0.0, float("inf")
+    # 1 warmup (compile) + 4 timed: compiles cost minutes, timed runs cost
+    # milliseconds — extra repetitions capture the fast tail for free
+    for i in range(5):
+        mat = ref.with_storage(ref.storage + 0)
         hard_fence(mat.storage)
         t0 = time.perf_counter()
         out = cholesky("L", mat)
         hard_fence(out.storage)
         t = time.perf_counter() - t0
-        return t, total_ops(dt, n**3 / 6, n**3 / 6) / t / 1e9
+        g = total_ops(dtype, n**3 / 6, n**3 / 6) / t / 1e9
+        log(f"[{variant}] run {i}: {t:.4f}s {g:.1f} GFlop/s")
+        if i > 0 and g > best_g:
+            best_g, best_t = g, t
+    print(json.dumps({"variant": variant, "platform": platform,
+                      "dtype": np.dtype(dtype).name,
+                      "gflops": round(best_g, 2), "t": best_t}), flush=True)
 
-    best, best_variant = 0.0, variants[0]
+
+def sweep(platform: str) -> None:
+    """Parent: run the variant sweep, each variant in a timeout-guarded
+    subprocess; print the driver's single JSON line from the best result."""
+    from dlaf_tpu.algorithms.cholesky import VALID_TRAILING
+
+    # CPU regime either way: explicit fallback re-exec, or a plugin-less
+    # environment whose only platform IS cpu (the int8-emulation variant
+    # has no hardware to win on there)
+    on_cpu = bool(os.environ.get("DLAF_BENCH_CPU_FALLBACK")) \
+        or platform == "cpu"
+    pinned = os.environ.get("DLAF_BENCH_TRAILING")
+    # measured winner first (ozaki 91-99 GF/s vs xla 37-47 on the v5e
+    # tunnel, honest hard_fence timing): if the time budget runs out or a
+    # later variant wedges, the best measurement has already landed
+    order = ["ozaki", "xla", "loop", "biggemm", "invgemm"]
+    variants = [pinned] if pinned else \
+        [v for v in order if v in VALID_TRAILING] + \
+        [v for v in VALID_TRAILING if v not in order]
+    if on_cpu and not pinned:
+        # the CPU fallback has fast native f64 — the int8-emulation variant
+        # has no hardware to win on there; accelerators keep it leading
+        variants = [v for v in variants if v != "ozaki"]
+        variants = sorted(variants, key=lambda v: v != "xla")
+
+    budget_s = float(os.environ.get("DLAF_BENCH_BUDGET", "1800"))
     sweep_t0 = time.perf_counter()
+    results = []
     for vi, variant in enumerate(variants):
         if vi > 0 and time.perf_counter() - sweep_t0 > budget_s:
             log(f"budget {budget_s}s exhausted; skipping {variants[vi:]}")
             break
-        os.environ["DLAF_CHOLESKY_TRAILING"] = variant
-        config.initialize()
+        if any(r["variant"] == variant for r in results):
+            # a child may relabel itself (ozaki -> biggemm when f64 is
+            # unavailable); don't re-measure the identical configuration
+            log(f"[{variant}] already measured (child relabel); skipping")
+            continue
+        env = dict(os.environ)
+        env["DLAF_BENCH_VARIANT"] = variant
         try:
-            # 1 warmup (compile) + 4 timed: compiles cost minutes, timed runs
-            # cost milliseconds — extra repetitions capture the fast tail of
-            # the run-to-run spread at zero budget cost
-            for i in range(5):
-                t, gflops = timed_run(ref, dtype, n)
-                log(f"[{variant}] run {i}: {t:.4f}s {gflops:.1f} GFlop/s")
-                if i > 0 and gflops > best:
-                    best, best_variant = gflops, variant
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, timeout=VARIANT_TIMEOUT_S,
+                                  stdout=subprocess.PIPE)
+            line = proc.stdout.decode().strip().splitlines()[-1:]
+            if proc.returncode == 0 and line:
+                results.append(json.loads(line[0]))
+            else:
+                log(f"[{variant}] child rc={proc.returncode}, no result")
+        except subprocess.TimeoutExpired:
+            log(f"[{variant}] timed out after {VARIANT_TIMEOUT_S}s; killed "
+                "(measurements from other variants are unaffected)")
         except Exception as e:
             log(f"[{variant}] failed: {e!r}")
-    os.environ.pop("DLAF_CHOLESKY_TRAILING", None)
-    config.initialize()
-    if best == 0.0:
-        log("all trailing variants failed; no measurement")
+    if not results:
+        log("no variant produced a measurement")
         sys.exit(1)
-
-    # the driver's JSON line goes out FIRST: anything after this (the f32
-    # info probe) can wedge on the accelerator without losing the landed
-    # f64 measurement
+    best = max(results, key=lambda r: r["gflops"])
+    n = int(os.environ.get("DLAF_BENCH_N", "4096"))
+    nb = int(os.environ.get("DLAF_BENCH_NB", "256"))
     result = {
-        "metric": (f"miniapp_cholesky {np.dtype(dtype).name} N={n} nb={nb} "
-                   f"local GFlop/s [{platform}] trailing={best_variant}"),
-        "value": round(best, 2),
+        "metric": (f"miniapp_cholesky {best['dtype']} N={n} nb={nb} "
+                   f"local GFlop/s [{best['platform']}] "
+                   f"trailing={best['variant']}"),
+        "value": best["gflops"],
         "unit": "GFlop/s",
         "vs_baseline": 1.0,
     }
     print(json.dumps(result), flush=True)
 
-    # informational MXU-tier number (stderr only — the headline metric stays
-    # f64 per BASELINE config #1): same fenced protocol at float32
-    if dtype == np.float64 and time.perf_counter() - sweep_t0 < budget_s:
+    # informational MXU-tier number (stderr only — the headline metric
+    # stays f64 per BASELINE config #1)
+    if best["dtype"] == "float64" and time.perf_counter() - sweep_t0 < budget_s:
+        env = dict(os.environ)
+        env["DLAF_BENCH_VARIANT"] = best["variant"]
+        env["DLAF_BENCH_DTYPE"] = "float32"
         try:
-            os.environ["DLAF_CHOLESKY_TRAILING"] = best_variant
-            config.initialize()
-            ref32 = Matrix.from_element_fn(hpd_element_fn(n, np.float32),
-                                           size, block, dtype=np.float32)
-            for i in range(3):  # run 0 = compile warmup, like the f64 sweep
-                t, g32 = timed_run(ref32, np.float32, n)
-                if i > 0:
-                    log(f"[info] float32 run {i}: {t:.4f}s {g32:.1f} GFlop/s")
+            proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                  env=env, timeout=VARIANT_TIMEOUT_S,
+                                  stdout=subprocess.PIPE)
+            line = proc.stdout.decode().strip().splitlines()[-1:]
+            if line:
+                log(f"[info] float32: {json.loads(line[0])['gflops']} GFlop/s")
         except Exception as e:
             log(f"[info] float32 probe failed: {e!r}")
-        finally:
-            os.environ.pop("DLAF_CHOLESKY_TRAILING", None)
-            config.initialize()
 
 
 def main() -> None:
-    if os.environ.get("DLAF_BENCH_CHILD"):
-        run_bench()
+    if os.environ.get("DLAF_BENCH_VARIANT"):
+        run_variant()
         return
-    if probe_devices():
-        os.environ["DLAF_BENCH_CHILD"] = "1"
-        run_bench()
+    if os.environ.get("DLAF_BENCH_CPU_FALLBACK"):
+        sweep("cpu")
+        return
+    platform = probe_devices()
+    if platform is not None:
+        sweep(platform)
         return
     log("accelerator unavailable/wedged; re-running on pure-CPU platform. "
         "NOTE: a '[cpu]' metric is the fallback, not the framework's TPU "

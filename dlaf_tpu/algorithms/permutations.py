@@ -16,6 +16,16 @@ from ..matrix.matrix import Matrix
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 
 
+def permute_array(coord: str, perm, arr):
+    """``out[i] = in[perm[i]]`` along rows ('Row') or columns ('Col') of a
+    plain (device) array — the gather primitive shared by the Matrix-level
+    :func:`permute` and the D&C merge assembly (the reference's two callers
+    of its permutation kernel, ``perms.cu:58-120``: workspace index sorts
+    inside the merge, and matrix-level permutes)."""
+    dlaf_assert(coord in ("Row", "Col"), f"bad coord {coord!r}")
+    return jnp.take(arr, jnp.asarray(perm), axis=0 if coord == "Row" else 1)
+
+
 def permute(coord: str, perm, mat: Matrix, tile_begin: int = 0,
             tile_end: int | None = None) -> Matrix:
     """Permute rows (coord='Row') or columns ('Col') of the element range
@@ -28,9 +38,9 @@ def permute(coord: str, perm, mat: Matrix, tile_begin: int = 0,
     g = tiles_to_global(mat.storage, mat.dist)
     idx = jnp.asarray(perm) + a0
     if coord == "Row":
-        sub = jnp.take(g, idx, axis=0)
+        sub = permute_array("Row", idx, g)
         g = g.at[a0:a1, :].set(sub)
     else:
-        sub = jnp.take(g, idx, axis=1)
+        sub = permute_array("Col", idx, g)
         g = g.at[:, a0:a1].set(sub)
     return mat.with_storage(global_to_tiles(g, mat.dist))

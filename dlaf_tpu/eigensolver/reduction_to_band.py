@@ -255,15 +255,59 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
     return BandReduction(a.with_storage(storage), taus, band)
 
 
+@register_program_cache
+@functools.lru_cache(maxsize=32)
+def _band_extract_cached(dist, b: int):
+    """Device program gathering ONLY the band diagonals from tile storage.
+
+    The reference copies the band tile by tile into compact storage
+    (``band_to_tridiag/mc.h:91-270`` ``BandBlock::copyDiag/copyOffDiag``)
+    instead of materializing the full matrix; this is the TPU analog — the
+    band lives in the diagonal tiles plus the first sub-diagonal tiles, so
+    one small gather program produces the (b+1, n) 'sb' panel and the
+    host transfer is O(n*b), not O(n^2)."""
+    from ..matrix.tiling import global_tile_to_storage_index
+
+    nt = dist.nr_tiles.row
+    nb = dist.block_size.row
+    n = dist.size.row
+    di = np.array([global_tile_to_storage_index(dist, i, i)
+                   for i in range(nt)], dtype=np.int32)
+    si = np.array([global_tile_to_storage_index(dist, i + 1, i)
+                   for i in range(nt - 1)], dtype=np.int32).reshape(-1, 2)
+    rr = np.arange(b + 1)[:, None] + np.arange(nb)[None, :]   # row = c + r
+    cc = np.broadcast_to(np.arange(nb), (b + 1, nb))
+    in_diag = rr < nb       # else the entry lives in the sub-diagonal tile
+    rd = np.where(in_diag, rr, 0)
+    rs = np.where(in_diag, 0, rr - nb)
+
+    def fn(storage):
+        diag = storage[di[:, 0], di[:, 1]]                    # (nt, nb, nb)
+        if nt > 1:
+            sub = storage[si[:, 0], si[:, 1]]                 # (nt-1, nb, nb)
+            sub = jnp.concatenate([sub, jnp.zeros_like(sub[:1])], axis=0)
+        else:
+            sub = jnp.zeros_like(diag)
+        fd = diag[:, rd, cc]                                  # (nt, b+1, nb)
+        fs = sub[:, rs, cc]
+        tiles = jnp.where(jnp.asarray(in_diag)[None], fd, fs)
+        return jnp.moveaxis(tiles, 0, 1).reshape(b + 1, nt * nb)[:, :n]
+
+    return jax.jit(fn)
+
+
 def extract_band(red: BandReduction) -> np.ndarray:
     """Host-side compact band storage from the reduced matrix:
     ``band[r, j] = A[j+r, j]`` for r = 0..band (lower band, LAPACK 'sb'
     layout, shape (band+1, n)). Only band diagonals are read — the V
-    reflectors stored below the band are not part of the band matrix."""
-    a = red.matrix.to_numpy()
-    n = a.shape[0]
+    reflectors stored below the band are not part of the band matrix.
+
+    The gather runs on device (:func:`_band_extract_cached`), so only the
+    O(n*band) band panel crosses to the host — never the O(n^2) matrix
+    (round-1 review item; reference ``band_to_tridiag/mc.h:91-270``)."""
+    n = red.matrix.size.row
     b = red.band
-    band = np.zeros((b + 1, n), dtype=a.dtype)
-    for r in range(b + 1):
-        band[r, : n - r] = np.diagonal(a, -r)
-    return band
+    if n == 0:
+        return np.zeros((b + 1, 0), dtype=red.matrix.dtype)
+    fn = _band_extract_cached(red.matrix.dist, b)
+    return np.asarray(fn(red.matrix.storage))

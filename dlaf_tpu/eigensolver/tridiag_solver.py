@@ -19,6 +19,8 @@ suffer cancellation.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -167,8 +169,93 @@ def _secular_vcols_device(ds, zs, rho, live):
     return lam_live, vcols
 
 
+def _deflation_scan(ds, zs, live, tol):
+    """Near-equal-pole deflation scan (reference ``merge.h:443-508``):
+    rotate the z weight of pole pairs closer than ``tol`` onto the earlier
+    live pole, deflating the later one. Mutates ``zs``/``live`` in place;
+    returns the Givens rotations as arrays ``(i, j, c, s)`` in application
+    order. Native C++ single pass (``native/deflate.cpp``) with a
+    transparent numpy/Python fallback — the scan is sequential (each
+    rotation feeds the running anchor's weight into later decisions), so
+    the interpreter loop is the fallback, not the product path."""
+    from ..config import get_configuration
+
+    if get_configuration().secular_impl == "native":
+        try:
+            from ..native import bindings
+
+            return bindings.deflate_scan(ds, zs, live, tol)
+        except Exception:
+            pass
+    gi, gj, gc, gs = [], [], [], []
+    prev = -1
+    for j in range(ds.shape[0]):
+        if not live[j]:
+            continue
+        if prev >= 0 and ds[j] - ds[prev] <= tol:
+            r = np.hypot(zs[prev], zs[j])
+            if r == 0:
+                prev = j
+                continue
+            gi.append(prev)
+            gj.append(j)
+            gc.append(zs[prev] / r)
+            gs.append(zs[j] / r)
+            # rotating makes the two poles share d ~ equal; eigenvalue at
+            # ds[j] deflates exactly
+            zs[prev], zs[j] = r, 0.0
+            live[j] = False
+        else:
+            prev = j
+    return (np.asarray(gi, np.int64), np.asarray(gj, np.int64),
+            np.asarray(gc, np.float64), np.asarray(gs, np.float64))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _assemble_qc_device(vcols, live_b, rows_live, rows_d, cols_d, giv,
+                        inv_order, fin, *, n: int):
+    """Device-side assembly of the merge's eigenvector-coefficient matrix
+    ``qc`` (n, n) from O(n)-sized host control data + the (kb, kb) secular
+    output — the TPU analog of the reference's device merge workspaces
+    (``merge.h:45-118``, ``kernels.cu``). The host never holds an (n, n)
+    array: scatters place the live coefficient columns and the deflated
+    unit columns, a ``lax.scan`` undoes the Givens rotations (identity
+    padding makes the rotation count a static bucket), and gathers undo the
+    pole sort and apply the final eigenvalue ordering."""
+    kb = vcols.shape[0]
+    w = max(n, kb)
+    vm = jnp.where(live_b[:, None] & live_b[None, :], vcols, 0.0)
+    u = jnp.zeros((n, w), vcols.dtype)
+    # live columns: root i's coefficients scattered to the live poles' rows
+    u = u.at[rows_live, :kb].add(vm.T, mode="drop")
+    # deflated columns: unit vectors (pad rows point past n -> dropped)
+    u = u.at[rows_d, cols_d].add(1.0, mode="drop")
+
+    def rot(uu, p):
+        i = p[0].astype(jnp.int32)
+        j = p[1].astype(jnp.int32)
+        c, s = p[2], p[3]
+        ri, rj = uu[i], uu[j]
+        uu = uu.at[i].set(c * ri - s * rj)
+        uu = uu.at[j].set(s * ri + c * rj)
+        return uu, None
+
+    u, _ = lax.scan(rot, u, giv)
+    # undo the pole sort (rows), apply the final eigenvalue order (cols) —
+    # the reference's permutation-kernel call sites inside the merge
+    from ..algorithms.permutations import permute_array
+
+    return permute_array("Col", fin, permute_array("Row", inv_order, u))
+
+
 def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
-    """One Cuppen merge (reference ``merge.h:790-887``)."""
+    """One Cuppen merge (reference ``merge.h:790-887``).
+
+    Division of labor (device path): O(n) control work (sort, deflation
+    scan, liveness) on host; the secular solve on host (small k) or device
+    (large k, bucketed); and ALL O(n^2) workspace assembly on device
+    (:func:`_assemble_qc_device`) — host memory stays O(n + k^2_small) per
+    merge, against the round-1 review's O(n^2) host ``u_sorted``/``qc``."""
     n1, n2 = lam1.shape[0], lam2.shape[0]
     n = n1 + n2
     dtype = q1.dtype
@@ -183,137 +270,153 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool):
     if neg:
         d = -d
 
+    def apply_qc(lam, qc_dev=None, qc_host=None):
+        """blkdiag(q1, q2) @ qc — device gemms keep Q device-resident
+        across the whole merge tree; only O(n) vectors cross to the host."""
+        if use_device:
+            top = jnp.matmul(jnp.asarray(q1), qc_dev[:n1, :])
+            bot = jnp.matmul(jnp.asarray(q2), qc_dev[n1:, :])
+            return lam, jnp.concatenate([top, bot], axis=0)
+        return lam, np.vstack([q1 @ qc_host[:n1, :], q2 @ qc_host[n1:, :]])
+
     znorm2 = float(z @ z)
     if rho * znorm2 <= 1e-300:  # fully decoupled
         lam = -d if neg else d
-        qc = np.eye(n, dtype=dtype)
         fin = np.argsort(lam, kind="stable")
         lam = lam[fin]
-        qc = qc[:, fin]
+        if use_device:
+            qc = jnp.eye(n, dtype=dtype)[:, jnp.asarray(fin)]
+            return apply_qc(lam, qc_dev=qc)
+        return apply_qc(lam, qc_host=np.eye(n, dtype=dtype)[:, fin])
+
+    zn = z / np.sqrt(znorm2)
+    rho_n = rho * znorm2
+    # sort poles
+    order = np.argsort(d, kind="stable")
+    ds, zs = d[order].copy(), zn[order].copy()
+
+    # -- deflation (reference merge.h:443-508) ------------------------------
+    dmax = np.abs(ds).max(initial=0.0)
+    tol = 8 * _EPS * max(dmax, 1.0)
+    # dropping z_j perturbs the matrix by ~rho_n*|z_j|; deflate when that
+    # is below eps * ||T|| (LAPACK dlaed2 criterion)
+    live = rho_n * np.abs(zs) > 8 * _EPS * max(dmax, rho_n)
+    gi, gj, gc, gs = _deflation_scan(ds, zs, live, tol)
+    idx_live = np.nonzero(live)[0]
+    idx_defl = np.nonzero(~live)[0]
+    k = idx_live.shape[0]
+
+    lam = np.empty(n)
+    vcols_dev = None          # (kb, kb) device secular output (large k)
+    vcols = None              # (k, k) host secular output (small k)
+    kb = 1 << max(0, (k - 1).bit_length())
+    if k == 0:
+        lam[:] = ds
     else:
-        zn = z / np.sqrt(znorm2)
-        rho_n = rho * znorm2
-        # sort poles
-        order = np.argsort(d, kind="stable")
-        ds, zs = d[order], zn[order]
-
-        # -- deflation (reference merge.h:443-508) --------------------------
-        dmax = np.abs(ds).max(initial=0.0)
-        tol = 8 * _EPS * max(dmax, 1.0)
-        givens = []   # (i, j, c, s): rotate rows i,j
-        zs = zs.copy()
-        ds = ds.copy()
-        # dropping z_j perturbs the matrix by ~rho_n*|z_j|; deflate when that
-        # is below eps * ||T|| (LAPACK dlaed2 criterion)
-        live = rho_n * np.abs(zs) > 8 * _EPS * max(dmax, rho_n)
-        # near-equal poles: rotate z weight onto the first of the pair
-        for j in range(1, n):
-            if not live[j]:
-                continue
-            i = j - 1
-            while i >= 0 and not live[i]:
-                i -= 1
-            if i < 0:
-                continue
-            if ds[j] - ds[i] <= tol:
-                r = np.hypot(zs[i], zs[j])
-                if r == 0:
-                    continue
-                c, s = zs[i] / r, zs[j] / r
-                zs[i], zs[j] = r, 0.0
-                # rotating makes the two poles share d ~ equal; eigenvalue at
-                # ds[j] deflates exactly
-                givens.append((i, j, c, s))
-                live[j] = False
-        idx_live = np.nonzero(live)[0]
-        idx_defl = np.nonzero(~live)[0]
-        k = idx_live.shape[0]
-
-        lam = np.empty(n)
-        u_sorted = np.zeros((n, n), dtype=dtype)
-        if k == 0:
-            lam[:] = ds
-            u_sorted[:] = np.eye(n, dtype=dtype)
-        else:
-            dsk = ds[idx_live]
-            zsk = zs[idx_live]
-            if (use_device and k >= _device_secular_min_k()
-                    and jax.config.jax_enable_x64):
-                # bucket to the next power of two so the jit cache is keyed
-                # by bucket, not by the data-dependent deflated size k:
-                # padded poles sit strictly above the root bound with z = 0
-                kb = 1 << max(0, (k - 1).bit_length())
-                if kb > k:
-                    span = rho_n * float((zsk * zsk).sum()) + 1.0
-                    # scale-aware step: at |d| ~ 1e17 an absolute +1.0 would
-                    # round away, colliding a padded pole with a live one
-                    step = max(1.0, 16 * np.spacing(abs(dsk[-1]) + span))
-                    ds_b = np.concatenate(
-                        [dsk, dsk[-1] + span
-                         + step * np.arange(1.0, kb - k + 1)])
-                    zs_b = np.concatenate([zsk, np.zeros(kb - k)])
-                else:
-                    ds_b, zs_b = dsk, zsk
-                live_b = np.zeros(kb, dtype=bool)
-                live_b[:k] = True
-                lam_j, vcols_j = _secular_vcols_device(
-                    jnp.asarray(ds_b), jnp.asarray(zs_b), jnp.float64(rho_n),
-                    jnp.asarray(live_b))
-                lam_live = np.asarray(lam_j)[:k]
-                vcols = np.asarray(vcols_j)[:k, :k]
+        dsk = ds[idx_live]
+        zsk = zs[idx_live]
+        if (use_device and k >= _device_secular_min_k()
+                and jax.config.jax_enable_x64):
+            # bucket to the next power of two so the jit cache is keyed
+            # by bucket, not by the data-dependent deflated size k:
+            # padded poles sit strictly above the root bound with z = 0
+            if kb > k:
+                span = rho_n * float((zsk * zsk).sum()) + 1.0
+                # scale-aware step: at |d| ~ 1e17 an absolute +1.0 would
+                # round away, colliding a padded pole with a live one
+                step = max(1.0, 16 * np.spacing(abs(dsk[-1]) + span))
+                ds_b = np.concatenate(
+                    [dsk, dsk[-1] + span
+                     + step * np.arange(1.0, kb - k + 1)])
+                zs_b = np.concatenate([zsk, np.zeros(kb - k)])
             else:
-                anchor, mu = _secular_roots_host(dsk, zsk, rho_n)
-                lam_live = dsk[anchor] + mu
-                # accurate pole-root differences: m[i, j] = d_j - lambda_i
-                m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]
-                # Gu-Eisenstat z refinement (reference laed4/dlaed3 step)
-                logm = np.log(np.abs(m))
-                dd = dsk[None, :] - dsk[:, None]
-                np.fill_diagonal(dd, 1.0)
-                logdd = np.log(np.abs(dd))
-                np.fill_diagonal(logdd, 0.0)
-                log_zhat2 = logm.sum(0) - logdd.sum(0)
-                zhat = np.sign(zsk) * np.exp(0.5 * log_zhat2)
-                # eigenvector coefficients: v_i[j] = zhat_j / (d_j - lambda_i)
-                vcols = (zhat[None, :] / m)
-                vcols /= np.linalg.norm(vcols, axis=1, keepdims=True)
-            u_live = np.zeros((n, k), dtype=dtype)
-            u_live[idx_live, :] = vcols.T.astype(dtype)
-            # deflated eigenpairs: unit vectors
-            u_sorted[:, :k] = u_live
-            for t, j in enumerate(idx_defl):
-                u_sorted[j, k + t] = 1.0
-            lam[:k] = lam_live
-            lam[k:] = ds[idx_defl]
-        # undo the Givens rotations (rows, reverse order)
-        for i, j, c, s in reversed(givens):
-            ri = u_sorted[i].copy()
-            rj = u_sorted[j].copy()
-            u_sorted[i] = c * ri - s * rj
-            u_sorted[j] = s * ri + c * rj
-        # undo the sort (rows back to pre-sort coordinates)
-        qc = np.empty_like(u_sorted)
-        qc[order, :] = u_sorted
-        if neg:
-            lam = -lam
-        # final ascending eigenvalue order
-        fin = np.argsort(lam, kind="stable")
-        lam = lam[fin]
-        qc = qc[:, fin]
+                ds_b, zs_b = dsk, zsk
+            live_kb = np.zeros(kb, dtype=bool)
+            live_kb[:k] = True
+            lam_j, vcols_dev = _secular_vcols_device(
+                jnp.asarray(ds_b), jnp.asarray(zs_b), jnp.float64(rho_n),
+                jnp.asarray(live_kb))
+            # only the O(kb) eigenvalues cross to the host; the (kb, kb)
+            # coefficient matrix stays device-resident
+            lam_live = np.asarray(lam_j)[:k]
+        else:
+            anchor, mu = _secular_roots_host(dsk, zsk, rho_n)
+            lam_live = dsk[anchor] + mu
+            # accurate pole-root differences: m[i, j] = d_j - lambda_i
+            m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]
+            # Gu-Eisenstat z refinement (reference laed4/dlaed3 step)
+            logm = np.log(np.abs(m))
+            dd = dsk[None, :] - dsk[:, None]
+            np.fill_diagonal(dd, 1.0)
+            logdd = np.log(np.abs(dd))
+            np.fill_diagonal(logdd, 0.0)
+            log_zhat2 = logm.sum(0) - logdd.sum(0)
+            zhat = np.sign(zsk) * np.exp(0.5 * log_zhat2)
+            # eigenvector coefficients: v_i[j] = zhat_j / (d_j - lambda_i)
+            vcols = (zhat[None, :] / m)
+            vcols /= np.linalg.norm(vcols, axis=1, keepdims=True)
+        lam[:k] = lam_live
+        lam[k:] = ds[idx_defl]
+    if neg:
+        lam = -lam
+    # final ascending eigenvalue order
+    fin = np.argsort(lam, kind="stable")
+    lam = lam[fin]
+    # undo of the pole sort, as a row gather
+    inv_order = np.empty(n, dtype=np.int64)
+    inv_order[order] = np.arange(n)
 
-    # -- eigenvector assembly: blkdiag(q1, q2) @ qc (device gemms) ----------
-    # Device path: Q stays DEVICE-RESIDENT across the whole merge tree —
-    # only the edge rows (z) and the small host-control vectors ever cross
-    # to the host; qc is pushed up once per merge. (The reference's
-    # host-mirror split moves whole matrices per merge; on TPU the PCIe
-    # round trips would dominate the stage.)
     if use_device:
-        top = jnp.matmul(jnp.asarray(q1), jnp.asarray(qc[:n1, :]))
-        bot = jnp.matmul(jnp.asarray(q2), jnp.asarray(qc[n1:, :]))
-        return lam, jnp.concatenate([top, bot], axis=0)
-    top = q1 @ qc[:n1, :]
-    bot = q2 @ qc[n1:, :]
-    return lam, np.vstack([top, bot])
+        # O(n)-sized control arrays; shapes bucketed so the jit cache is
+        # keyed by (n, kb, givens bucket), not by data-dependent counts
+        if vcols_dev is None:
+            vpad = np.zeros((kb, kb), dtype=np.float64)
+            if k:
+                vpad[:k, :k] = vcols
+            vcols_dev = jnp.asarray(vpad)
+        live_b = np.zeros(kb, dtype=bool)
+        live_b[:k] = True
+        rows_live = np.full(kb, n, dtype=np.int64)
+        rows_live[:k] = idx_live
+        nd = n - k
+        rows_d = np.full(n, n, dtype=np.int64)
+        rows_d[:nd] = idx_defl
+        cols_d = np.full(n, n, dtype=np.int64)
+        cols_d[:nd] = k + np.arange(nd)
+        g = gi.shape[0]
+        gb = (1 << max(0, (g - 1).bit_length())) if g else 0
+        giv = np.zeros((gb, 4))
+        giv[:, 2] = 1.0                     # identity-rotation padding
+        # reverse order: the undo applies rotations last-to-first
+        giv[:g, 0] = gi[::-1]
+        giv[:g, 1] = gj[::-1]
+        giv[:g, 2] = gc[::-1]
+        giv[:g, 3] = gs[::-1]
+        qc = _assemble_qc_device(vcols_dev, jnp.asarray(live_b),
+                                 jnp.asarray(rows_live), jnp.asarray(rows_d),
+                                 jnp.asarray(cols_d), jnp.asarray(giv),
+                                 jnp.asarray(inv_order), jnp.asarray(fin),
+                                 n=n)
+        return apply_qc(lam, qc_dev=qc)
+
+    # host assembly (use_device=False twin, kept as the numpy reference)
+    u_sorted = np.zeros((n, n), dtype=dtype)
+    if k == 0:
+        u_sorted[:] = np.eye(n, dtype=dtype)
+    else:
+        u_live = np.zeros((n, k), dtype=dtype)
+        u_live[idx_live, :] = vcols.T.astype(dtype)
+        u_sorted[:, :k] = u_live
+        for t, j in enumerate(idx_defl):
+            u_sorted[j, k + t] = 1.0
+    # undo the Givens rotations (rows, reverse order)
+    for i, j, c, s in zip(gi[::-1], gj[::-1], gc[::-1], gs[::-1]):
+        ri = u_sorted[i].copy()
+        rj = u_sorted[j].copy()
+        u_sorted[i] = c * ri - s * rj
+        u_sorted[j] = s * ri + c * rj
+    qc = u_sorted[inv_order][:, fin]
+    return apply_qc(lam, qc_host=qc)
 
 
 def tridiag_solver(d: np.ndarray, e: np.ndarray, nb: int,

@@ -67,7 +67,7 @@ def load(path: str, grid: Optional[Grid] = None) -> Matrix:
     dist = _make_dist(size, block, grid, src)
     storage = tree["storage"]
     if grid is not None and grid.num_devices > 1:
-        import jax
+        from .memory import place
 
-        storage = jax.device_put(storage, grid.tile_sharding())
+        storage = place(storage, grid.tile_sharding())
     return Matrix(dist, storage, grid)

@@ -131,6 +131,8 @@ def _make_dist(size, block_size, grid: Optional[Grid], source_rank) -> Distribut
 
 
 def _shard(storage, grid: Optional[Grid]):
+    from .memory import place
+
     if grid is None or grid.num_devices == 1:
         return storage
-    return jax.device_put(storage, grid.tile_sharding())
+    return place(storage, grid.tile_sharding())

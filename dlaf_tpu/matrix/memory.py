@@ -1,16 +1,17 @@
-"""Device-memory placement helpers.
+"""Device-memory placement.
 
 TPU-native counterpart of the reference's ``memory/`` layer
 (``MemoryChunk``/``MemoryView`` over umpire host/device pools,
-``memory/memory_chunk.h:38-165``): PJRT owns allocation, pooling and
-pinning, so what remains is placement (host→HBM with a sharding), donation
-(the in-place story for functional updates), and wrapping user-provided
-buffers without copies where possible.
+``memory/memory_chunk.h:38-165``): PJRT owns allocation, pooling, pinning,
+and non-owning host wraps (numpy views), so the one placement decision left
+to the framework is host→HBM transfer with a sharding — :func:`place`, the
+H2D path of every :class:`~dlaf_tpu.matrix.matrix.Matrix` construction and
+checkpoint restore. In-place reuse (the reference's tile writes into pooled
+chunks) is expressed per jit boundary via buffer donation where an
+algorithm needs it, not as a pool API.
 """
 
 from __future__ import annotations
-
-import numpy as np
 
 import jax
 
@@ -21,19 +22,3 @@ def place(array, sharding=None):
     if sharding is None:
         return jax.device_put(array)
     return jax.device_put(array, sharding)
-
-
-def donate_wrapper(fn):
-    """jit with first-argument donation: the functional-update analog of the
-    reference's in-place tile writes — XLA reuses the input buffer."""
-    return jax.jit(fn, donate_argnums=(0,))
-
-
-def wrap_host(array: np.ndarray) -> np.ndarray:
-    """Non-owning host wrap (reference MemoryChunk user-pointer ctor): numpy
-    views are already non-owning; returned as-is, documented for parity."""
-    return np.asarray(array)
-
-
-def nbytes(x) -> int:
-    return int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize

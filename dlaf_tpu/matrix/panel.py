@@ -34,6 +34,7 @@ class DistContext:
     """
 
     def __init__(self, dist):
+        self.dist = dist
         self.nt = dist.nr_tiles
         self.mb = dist.block_size.row
         self.nb = dist.block_size.col
@@ -152,13 +153,22 @@ def gather_sub_panel(ctx: DistContext, lt, *, pb: int, b: int, n: int):
     locate it in tile space, and ``row_val_e``/``g_rows`` are the caller's
     element-level row masks for its local slots.
     """
+    from ..common.index2d import GlobalElementIndex
+    from .views import SubMatrixView, SubPanelView
+
     nb = ctx.mb
     nt = ctx.nt.row
     bdy = pb + b
-    tc = pb // nb
-    co = pb % nb
-    tr0 = bdy // nb
-    ro = bdy % nb
+    # static offset bookkeeping via the view types (reference
+    # SubPanelView/SubMatrixView, matrix/views.h:85,129): the panel's tile
+    # column + in-tile column offset, and the below-boundary sub-matrix's
+    # first tile row + in-tile row offset
+    pan = SubPanelView(ctx.dist, GlobalElementIndex(pb, pb), width=b)
+    body = SubMatrixView(ctx.dist, GlobalElementIndex(bdy, pb))
+    tc = pan.begin_tile.col
+    co = pan.origin_in_tile.col
+    tr0 = body.begin_tile.row
+    ro = body.origin_in_tile.row
     lu = ctx.row_start(tr0)
     nrows = ctx.ltr - lu
     if nrows <= 0:

@@ -46,6 +46,12 @@ class SubMatrixView:
     def begin_tile(self) -> GlobalTileIndex:
         return self.dist.global_tile_index(self.offset)
 
+    @property
+    def origin_in_tile(self):
+        """In-tile element offset of the view's origin (the static slice
+        offsets the sub-panel algorithms cut tiles at)."""
+        return self.dist.tile_element_index(self.offset)
+
     def tile_spec(self, index: GlobalTileIndex) -> SubTileSpec:
         """Portion of global tile ``index`` inside the view."""
         ts = self.dist.tile_size_of(index)

@@ -20,7 +20,8 @@ from ..types import ceil_div
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRCS = [os.path.join(_HERE, "band_to_tridiag.cpp"),
-         os.path.join(_HERE, "secular.cpp")]
+         os.path.join(_HERE, "secular.cpp"),
+         os.path.join(_HERE, "deflate.cpp")]
 
 
 def _cpu_tag() -> str:
@@ -82,6 +83,7 @@ def get_lib():
                          "dlaf_secular_roots_d"):
                 fn = getattr(lib, name)
                 fn.restype = ctypes.c_int
+            lib.dlaf_deflate_scan_d.restype = ctypes.c_int64
         except Exception as e:
             _load_error = e
             import sys
@@ -114,6 +116,37 @@ def secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
     if rc != 0:
         raise RuntimeError(f"native secular_roots failed rc={rc}")
     return anchor, mu
+
+
+def deflate_scan(ds: np.ndarray, zs: np.ndarray, live: np.ndarray,
+                 tol: float):
+    """Native near-equal-pole deflation scan (``deflate.cpp``; reference
+    ``merge.h:443-508``). Mutates ``zs``/``live`` in place (both must be
+    contiguous arrays owned by the caller) and returns the applied Givens
+    rotations as arrays ``(i, j, c, s)`` in application order."""
+    n = ds.shape[0]
+    if n == 0:
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                np.zeros(0), np.zeros(0))
+    assert zs.flags.c_contiguous and live.flags.c_contiguous
+    lib = get_lib()
+    gi = np.zeros(n, dtype=np.int64)
+    gj = np.zeros(n, dtype=np.int64)
+    gc = np.zeros(n, dtype=np.float64)
+    gs = np.zeros(n, dtype=np.float64)
+    g = lib.dlaf_deflate_scan_d(
+        np.ascontiguousarray(ds, dtype=np.float64).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)),
+        zs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        live.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_int64(n), ctypes.c_double(tol),
+        gi.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        gj.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        gc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        gs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    if g < 0:
+        raise RuntimeError(f"native deflate_scan failed rc={g}")
+    return gi[:g], gj[:g], gc[:g], gs[:g]
 
 
 def _chase_threads() -> int:

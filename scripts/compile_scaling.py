@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Compile-time scaling of the trace-unrolled distributed factorization.
+
+Round-1 review item 5: all distributed algorithms unroll the per-k loop at
+trace time, so program size grows with the tile count nt; nothing showed
+XLA compile time stays sane at BASELINE-scale tile counts (nt = 64-128).
+This script AOT-compiles (``jax.jit(...).lower().compile()`` — no
+execution) distributed Cholesky on the 8-virtual-device CPU mesh at a
+sweep of nt, with and without the persistent compilation cache, and
+reports trace time, compile time, and compiled program size.
+
+Run:  python scripts/compile_scaling.py [--nt 16,32,64,128]
+(self-configures the virtual CPU platform; results to stderr + one JSON
+line to stdout for DESIGN.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nt", default="16,32,64,128")
+    ap.add_argument("--nb", type=int, default=8,
+                    help="tile size (compile cost depends on tile COUNT, "
+                         "not tile size; small tiles keep tracing cheap)")
+    ap.add_argument("--cache", default="")
+    args = ap.parse_args()
+
+    if not os.environ.get("_DLAF_COMPILE_SCALING_CHILD"):
+        import subprocess
+
+        from dlaf_tpu.tpu_info import cpu_subprocess_env
+
+        env = cpu_subprocess_env(n_virtual_devices=8)
+        env["_DLAF_COMPILE_SCALING_CHILD"] = "1"
+        rc = subprocess.run([sys.executable] + sys.argv, env=env).returncode
+        sys.exit(rc)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    if args.cache:
+        os.environ["DLAF_COMPILATION_CACHE_DIR"] = args.cache
+
+    import numpy as np
+
+    import dlaf_tpu.config as config
+    from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index2d import (GlobalElementSize, GridSize2D,
+                                         RankIndex2D, TileElementSize)
+    from dlaf_tpu.matrix.distribution import Distribution
+    from dlaf_tpu.matrix.tiling import storage_tile_grid
+
+    config.initialize()
+    grid = Grid(2, 4)
+    results = []
+    for nt in [int(x) for x in args.nt.split(",")]:
+        nb = args.nb
+        n = nt * nb
+        dist = Distribution(size=GlobalElementSize(n, n),
+                            block_size=TileElementSize(nb, nb),
+                            grid_size=GridSize2D(2, 4),
+                            rank=RankIndex2D(0, 0),
+                            source_rank=RankIndex2D(0, 0))
+        sr, sc, _, _ = storage_tile_grid(dist)
+        fn = _build_dist_cholesky(dist, grid.mesh, "L", use_pallas=False,
+                                  pallas_interpret=True)
+        x = jax.ShapeDtypeStruct((sr, sc, nb, nb), np.float64)
+        t0 = time.perf_counter()
+        lowered = jax.jit(fn).lower(x)
+        t_trace = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        t_compile = time.perf_counter() - t0
+        try:
+            size = compiled.memory_analysis().generated_code_size_in_bytes
+        except Exception:
+            size = -1
+        row = {"nt": nt, "trace_s": round(t_trace, 2),
+               "compile_s": round(t_compile, 2), "code_bytes": size}
+        results.append(row)
+        log(f"nt={nt}: trace {t_trace:.1f}s, compile {t_compile:.1f}s, "
+            f"code {size / 1e6 if size > 0 else -1:.1f} MB")
+    print(json.dumps({"platform": "cpu-mesh8", "nb": args.nb,
+                      "cache": bool(args.cache), "rows": results}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Hardware knob sweep for the emulated-f64 fast path (round-2 perf push).
+
+Measures, on the real accelerator with the fenced protocol
+(``dlaf_tpu/common/sync.py``):
+
+1. trailing-update microkernels at the N=4096 hot shape (m=3840, k=256):
+   jnp ozaki syrk vs the fused Pallas triangular-grid syrk, matmul forms,
+   and the slice-count knob (8 vs 7);
+2. full miniapp_cholesky (N=4096 nb=256, BASELINE config #1) across the
+   knob grid {ozaki_impl: jnp|pallas} x {f64_gemm_slices: 8|7};
+3. an N-sweep (4096 / 8192) of the winning configuration so amortization
+   of the panel-latency chain is visible;
+4. the panel-latency chain itself: potrf_refined / tri_inv_refined /
+   native emulated-f64 potrf / f32 potrf at nb=256.
+
+Writes one JSON document (stdout) and a human table (stderr). Each phase
+is independently guarded so a mid-sweep wedge still reports what landed.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPS = int(os.environ.get("DLAF_SWEEP_REPS", "4"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    os.environ.setdefault(
+        "DLAF_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"))
+
+    import dlaf_tpu.config as config
+    from dlaf_tpu.common.sync import hard_fence
+
+    config.initialize()
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}, devices: {jax.devices()}")
+    results = {"platform": platform, "micro": {}, "cholesky": {},
+               "nsweep": {}, "panel": {}}
+
+    def best_time(fn, *args):
+        out = fn(*args)
+        hard_fence(*(out if isinstance(out, tuple) else (out,)))
+        times = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            hard_fence(*(out if isinstance(out, tuple) else (out,)))
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    # -- 1. trailing-update microkernels -----------------------------------
+    try:
+        from dlaf_tpu.tile_ops import ozaki as oz
+        from dlaf_tpu.tile_ops.pallas_ozaki import (fused_slice_product,
+                                                    fused_slice_syrk)
+
+        m, k = 3840, 256
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.standard_normal((m, k)))
+        b = jnp.asarray(rng.standard_normal((k, m)))
+        flops_syrk = m * m * k          # lower-triangle-useful convention
+        flops_mm = 2 * m * m * k
+
+        for s in (8, 7):
+            t = best_time(lambda x: oz.syrk_f64(x, slices=s), a)
+            results["micro"][f"syrk_jnp_s{s}"] = {
+                "t": t, "gflops": flops_syrk / t / 1e9}
+            t = best_time(lambda x, y: oz.matmul_f64(x, y, slices=s), a, b)
+            results["micro"][f"matmul_jnp_s{s}"] = {
+                "t": t, "gflops": flops_mm / t / 1e9}
+
+        # pallas fused kernels on pre-peeled slices (isolates kernel cost)
+        def peel(x, s):
+            sa = oz._scale(x, axis=-1)
+            return jnp.stack(oz._peel_slices(oz._normalize(x, sa), s)), sa
+
+        for s in (8, 7):
+            ia, _ = peel(a, s)
+            ib, _ = peel(b.T, s)  # (s, m, k); product form wants (s,k,n)
+            ibt = jnp.swapaxes(ib, -1, -2)
+            t = best_time(lambda x: fused_slice_syrk(x), ia)
+            results["micro"][f"syrk_pallas_s{s}"] = {
+                "t": t, "gflops": flops_syrk / t / 1e9}
+            t = best_time(lambda x, y: fused_slice_product(x, y), ia, ibt)
+            results["micro"][f"matmul_pallas_s{s}"] = {
+                "t": t, "gflops": flops_mm / t / 1e9}
+        # end-to-end syrk through the config knob (peel + kernel + mirror)
+        os.environ["DLAF_OZAKI_IMPL"] = "pallas"
+        config.initialize()
+        t = best_time(lambda x: oz.syrk_f64(x), a)
+        results["micro"]["syrk_e2e_pallas_s8"] = {
+            "t": t, "gflops": flops_syrk / t / 1e9}
+        os.environ.pop("DLAF_OZAKI_IMPL")
+        config.initialize()
+    except Exception as e:
+        log(f"micro phase failed: {e!r}")
+    log(f"micro: {json.dumps(results['micro'], default=float)}")
+
+    # -- 2. full cholesky knob grid ----------------------------------------
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.types import total_ops
+
+    def chol_time(n, nb, impl, slices):
+        os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
+        os.environ["DLAF_OZAKI_IMPL"] = impl
+        os.environ["DLAF_F64_GEMM_SLICES"] = str(slices)
+        config.initialize()
+        try:
+            ref = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.float64)
+
+            def run(mat_storage):
+                mat = ref.with_storage(mat_storage)
+                out = cholesky("L", mat)
+                return out.storage
+
+            t = best_time(run, ref.storage + 0)
+            return t, total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
+        finally:
+            for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",
+                       "DLAF_F64_GEMM_SLICES"):
+                os.environ.pop(k_, None)
+            config.initialize()
+
+    n, nb = 4096, 256
+    best_cfg, best_g = None, 0.0
+    for impl in ("jnp", "pallas"):
+        for s in (8, 7):
+            key = f"impl={impl},slices={s}"
+            try:
+                t, g = chol_time(n, nb, impl, s)
+                results["cholesky"][key] = {"t": t, "gflops": g}
+                log(f"cholesky N={n} {key}: {t:.4f}s {g:.1f} GF/s")
+                if g > best_g:
+                    best_g, best_cfg = g, (impl, s)
+            except Exception as e:
+                log(f"cholesky {key} failed: {e!r}")
+    results["cholesky"]["best"] = (
+        {"impl": best_cfg[0], "slices": best_cfg[1], "gflops": best_g}
+        if best_cfg else None)
+
+    # -- 3. N-sweep of the winner ------------------------------------------
+    if best_cfg:
+        for nn in (4096, 8192):
+            try:
+                t, g = chol_time(nn, nb, *best_cfg)
+                results["nsweep"][str(nn)] = {"t": t, "gflops": g}
+                log(f"nsweep N={nn}: {t:.4f}s {g:.1f} GF/s")
+            except Exception as e:
+                log(f"nsweep N={nn} failed: {e!r}")
+
+    # -- 4. panel-latency chain --------------------------------------------
+    try:
+        from jax import lax
+
+        from dlaf_tpu.tile_ops import mixed as mx
+
+        nb_ = 256
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((nb_, nb_))
+        spd = jnp.asarray(x @ x.T + nb_ * np.eye(nb_))
+        l64 = jnp.linalg.cholesky(spd)
+
+        f_refined = jax.jit(lambda m: mx.potrf_refined("L", m))
+        f_native = jax.jit(lambda m: jnp.tril(lax.linalg.cholesky(m)))
+        f_f32 = jax.jit(
+            lambda m: lax.linalg.cholesky(m.astype(jnp.float32)))
+        f_inv = jax.jit(lambda m: mx.tri_inv_refined(m, lower=True))
+        f_inv_native = jax.jit(lambda m: lax.linalg.triangular_solve(
+            m, jnp.eye(nb_, dtype=m.dtype), left_side=True, lower=True))
+        for name, fn, arg in [("potrf_refined", f_refined, spd),
+                              ("potrf_native_f64", f_native, spd),
+                              ("potrf_f32", f_f32, spd),
+                              ("tri_inv_refined", f_inv, l64),
+                              ("tri_inv_native", f_inv_native, l64)]:
+            t = best_time(fn, arg)
+            results["panel"][name] = {"t_ms": t * 1e3}
+            log(f"panel {name}: {t*1e3:.3f} ms")
+    except Exception as e:
+        log(f"panel phase failed: {e!r}")
+
+    print(json.dumps(results, default=float), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,16 +49,11 @@ def test_printing(capsys):
     assert c.splitlines()[0] == "0.0,1.0"
 
 
-def test_memory_helpers():
+def test_memory_place():
     from dlaf_tpu.matrix import memory as mem
 
     x = mem.place(np.ones((4, 4)))
-    assert x.shape == (4, 4)
-    assert mem.nbytes(x) == 16 * 8
-
-    fn = mem.donate_wrapper(lambda a: a * 2)
-    out = fn(x)
-    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones((4, 4)))
+    assert x.shape == (4, 4) and hasattr(x, "devices")
 
 
 def test_tpu_info():
