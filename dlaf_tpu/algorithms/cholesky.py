@@ -181,8 +181,30 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
 # Distributed — reference impl.h:174-276
 # ---------------------------------------------------------------------------
 
+def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
+    """Exact-flop f64 trailing contraction: peel Ozaki slices of the
+    flattened row/column operands (both contracting their last axis) and
+    run the PREDICATED fused kernel — tile pairs outside ``pairmask`` skip
+    their int8 MXU dots entirely (reference's herk-vs-gemm flop discipline,
+    ``cholesky/impl.h:242-271``). Returns the (nrows, ncols, mb, mb) f64
+    update, unmasked at element level (caller applies its triangle mask)."""
+    from ..tile_ops.pallas_ozaki import masked_slice_product
+
+    s = tb._oz_slices()
+    sa = oz._scale(afl, axis=-1)
+    sb = oz._scale(bfl, axis=-1)
+    ia = jnp.stack(oz._peel_slices(oz._normalize(afl, sa), s))
+    ib = jnp.stack(oz._peel_slices(oz._normalize(bfl, sb), s))
+    hi, lo = masked_slice_product(
+        ia.reshape(s, nrows, mb, mb), ib.reshape(s, ncols, mb, mb),
+        pairmask.astype(jnp.int32), interpret=interpret)
+    acc = (hi.astype(jnp.float64) + lo.astype(jnp.float64)) * 4.0
+    return (acc * sa.reshape(nrows, 1, mb, 1)) * sb.reshape(1, ncols, 1, mb)
+
+
 def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
-                         use_mxu=False, use_mixed=False, cplx=False):
+                         use_mxu=False, use_mixed=False, cplx=False,
+                         use_oz_pallas=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
@@ -190,7 +212,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
     composition), following the ``f64_gemm="mxu"`` knob; ``use_mixed`` (f64
     AND complex128, following ``f64_trsm="mixed"``) factors/solves the panel
     with the half-precision-seed-plus-Newton helpers (tile_ops.mixed,
-    Hermitian-correct) instead of emulated potrf/trsm.
+    Hermitian-correct) instead of emulated potrf/trsm. ``use_oz_pallas``
+    (real f64, ``ozaki_impl="pallas"``) further predicates the mxu
+    contraction per tile pair so masked-out pairs skip the MXU work —
+    exact flops instead of rectangle-then-mask.
 
     The returned function maps tile storage -> tile storage. All index
     arithmetic below is trace-time (static per k); only data and the
@@ -297,7 +322,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                                                interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
         else:
-            if use_mxu:
+            if use_mxu and use_oz_pallas:
+                # predicated fused kernel: dead tile pairs skip the MXU work
+                upd = _masked_oz_update(
+                    vr.reshape(nrows * mb, mb),
+                    jnp.conj(vc).reshape(ncols * mb, mb),
+                    below | ondiag, nrows, ncols, mb, pallas_interpret)
+            elif use_mxu:
                 # same contraction through int8 MXU passes: flatten the tile
                 # batch into one (nrows*mb) x mb by (ncols*mb) x mb product
                 mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
@@ -356,7 +387,12 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 jnp.swapaxes(vc, -1, -2), mode, interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
         else:
-            if use_mxu:
+            if use_mxu and use_oz_pallas:
+                ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
+                bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
+                upd = _masked_oz_update(ar, bc, above | ondiag,
+                                        nrows, ncols, mb, pallas_interpret)
+            elif use_mxu:
                 mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
                 ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
                 bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
@@ -383,13 +419,15 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
 @register_program_cache
 @functools.lru_cache(maxsize=64)
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
-                          pallas_interpret, use_mxu, use_mixed):
+                          pallas_interpret, use_mxu, use_mixed,
+                          use_oz_pallas=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
-                                        cplx=dtype.startswith("complex")))
+                                        cplx=dtype.startswith("complex"),
+                                        use_oz_pallas=use_oz_pallas))
 
 
 # ---------------------------------------------------------------------------
@@ -428,8 +466,17 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     # (config.py: f64_gemm affects contractions only)
     use_mixed = cfg.f64_trsm == "mixed" and dt in (np.dtype(np.float64),
                                                    np.dtype(np.complex128))
+    # exact-flop predicated contraction (ozaki_impl="pallas"): real f64
+    # only (complex keeps the 4-real-product composition), within the
+    # masked kernel's per-cell VMEM bound
+    from ..tile_ops.pallas_ozaki import MASKED_MB_MAX
+
+    use_oz_pallas = (use_mxu and cfg.ozaki_impl == "pallas"
+                     and dt == np.dtype(np.float64)
+                     and mat.block_size.row <= MASKED_MB_MAX)
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, dt.name, uplo,
                                supports_pallas_update(mat.dtype, platform)
                                and not use_mxu,
-                               platform != "tpu", use_mxu, use_mixed)
+                               platform != "tpu", use_mxu, use_mixed,
+                               use_oz_pallas)
     return mat.with_storage(fn(mat.storage))

@@ -82,9 +82,12 @@ def eigensolver(uplo: str, a: Matrix,
         fence(z)
     with pt.phase("bt_band_to_tridiag"):
         if distributed:
+            # z is a device-resident jax.Array (tridiag_solver keeps Q on
+            # device across the merge tree); from_global re-tiles it ON
+            # DEVICE — no host materialization between stages (round-1
+            # review weak item 4)
             zb = bt_band_to_tridiag(
-                tri, Matrix.from_global(np.asarray(z), a.block_size,
-                                        grid=a.grid,
+                tri, Matrix.from_global(z, a.block_size, grid=a.grid,
                                         source_rank=a.dist.source_rank))
             fence(zb.storage)
         else:
@@ -96,8 +99,7 @@ def eigensolver(uplo: str, a: Matrix,
             vecs = out
             fence(vecs.storage)
         else:
-            vecs = Matrix.from_global(np.asarray(out), a.block_size,
-                                      grid=a.grid,
+            vecs = Matrix.from_global(out, a.block_size, grid=a.grid,
                                       source_rank=a.dist.source_rank)
     return EigensolverResult(lam, vecs)
 

@@ -128,6 +128,75 @@ def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
     return hi[:m, :n], lo[:m, :n]
 
 
+#: Largest tile edge the predicated per-tile-pair kernel accepts: its
+#: per-cell VMEM is ~2*s*mb^2 int8 slice blocks + int32/f32 accumulators
+#: + two mb^2 f32 outputs — ~1.8 MiB at mb=256 (safe with pipelining),
+#: ~14 MiB at mb=512 (over budget with double buffering). Distinct from
+#: K_MAX, which budgets the fixed-256-block matmul/syrk kernels' depth.
+MASKED_MB_MAX = 256
+
+
+def _make_masked_kernel(s: int):
+    def kernel(mode_ref, ia_ref, ib_ref, hi_ref, lo_ref):
+        r = pl.program_id(0)
+        c = pl.program_id(1)
+        mode = mode_ref[r, c]
+
+        @pl.when(mode == 0)
+        def _():
+            hi_ref[...] = jnp.zeros_like(hi_ref)
+            lo_ref[...] = jnp.zeros_like(lo_ref)
+
+        @pl.when(mode > 0)
+        def _():
+            # both operands are row blocks contracting k against k — the
+            # syrk rhs layout, so the shared fold applies unchanged
+            _fold_body(s, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract=1)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
+    """Per-tile-pair Ozaki slice reduction, PREDICATED on ``mode``: pairs
+    with ``mode[r, c] == 0`` skip the MXU work entirely (outputs zero).
+
+    The exact-flop form of the distributed Cholesky trailing update
+    (reference hot loop ``factorization/cholesky/impl.h:242-271``): only
+    trailing lower-triangle tile pairs run their ``s(s+1)/2`` int8 dots,
+    instead of computing the full rectangle and masking (~2x the flops).
+
+    ``ia``: (s, R, bm, k) int8 slices of the row-side tiles; ``ib``:
+    (s, C, bn, k) of the column-side tiles (both contract their LAST axis);
+    ``mode``: (R, C) int32. Returns ``(hi, lo)`` float32 (R, C, bm, bn)
+    with ``hi + lo ~= sum_d 2^(-q(d+2)) IA_t @ IB_u^T``; the caller applies
+    ``*4*sa*sb`` in f64 and its element masks, as :func:`ozaki._recombine`.
+    """
+    s, R, bm, k = ia.shape
+    C, bn = ib.shape[1], ib.shape[2]
+    assert max(bm, bn, k) <= MASKED_MB_MAX, \
+        f"masked kernel tile edge {max(bm, bn, k)} > {MASKED_MB_MAX}"
+    # None block dims squeeze the R/C axes away, so the kernel sees the
+    # same (s, b, k)/(b, b) refs as the matmul/syrk kernels and shares
+    # their _fold_body
+    hi, lo = pl.pallas_call(
+        _make_masked_kernel(s),
+        grid=(R, C),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),                   # mode
+            pl.BlockSpec((s, None, bm, k), lambda r, c: (0, r, 0, 0)),
+            pl.BlockSpec((s, None, bn, k), lambda r, c: (0, c, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((None, None, bm, bn), lambda r, c: (r, c, 0, 0)),
+            pl.BlockSpec((None, None, bm, bn), lambda r, c: (r, c, 0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((R, C, bm, bn), jnp.float32),
+                   jax.ShapeDtypeStruct((R, C, bm, bn), jnp.float32)),
+        interpret=interpret,
+    )(mode, ia, ib)
+    return hi, lo
+
+
 def _make_syrk_kernel(s: int):
     def kernel(i_idx, j_idx, ia_ref, ja_ref, hi_ref, lo_ref):
         del i_idx, j_idx  # consumed by the index maps

@@ -138,6 +138,77 @@ class TestPallasFused:
             monkeypatch.delenv("DLAF_OZAKI_IMPL")
             config.initialize()
 
+    def test_masked_slice_product_predication(self):
+        """The predicated kernel must equal the plain product on live tile
+        pairs and produce exact zeros on dead ones."""
+        from dlaf_tpu.tile_ops import ozaki as oz
+        from dlaf_tpu.tile_ops.pallas_ozaki import masked_slice_product
+
+        rng = np.random.default_rng(31)
+        R, C, mb = 3, 2, 16
+        s = 8
+        a = rng.standard_normal((R * mb, mb))
+        b = rng.standard_normal((C * mb, mb))
+        sa = np.asarray(oz._scale(jnp.asarray(a), axis=-1))
+        sb = np.asarray(oz._scale(jnp.asarray(b), axis=-1))
+        ia = jnp.stack(oz._peel_slices(jnp.asarray(a / sa * 0.5), s))
+        ib = jnp.stack(oz._peel_slices(jnp.asarray(b / sb * 0.5), s))
+        mode = np.array([[1, 0], [0, 1], [1, 1]], dtype=np.int32)
+        hi, lo = masked_slice_product(ia.reshape(s, R, mb, mb),
+                                      ib.reshape(s, C, mb, mb),
+                                      jnp.asarray(mode), interpret=True)
+        acc = (np.asarray(hi, np.float64) + np.asarray(lo, np.float64)) * 4.0
+        acc = acc * sa.reshape(R, 1, mb, 1) * sb.reshape(1, C, 1, mb)
+        full = a @ b.T
+        for r in range(R):
+            for c in range(C):
+                blk = full[r * mb:(r + 1) * mb, c * mb:(c + 1) * mb]
+                if mode[r, c]:
+                    scale = (np.abs(a).max() * np.abs(b).max() * mb)
+                    assert np.abs(acc[r, c] - blk).max() / scale < 2**-40
+                else:
+                    assert np.all(acc[r, c] == 0.0)
+
+    def test_dist_cholesky_exact_flop_oz_pallas(self, monkeypatch, devices8):
+        """f64_gemm="mxu" + ozaki_impl="pallas" distributed: the predicated
+        trailing kernel (dead tile pairs skipped) must reproduce the plain
+        mxu path's factorization."""
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "8")
+        import dlaf_tpu.config as config
+        config.initialize()
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.comm.grid import Grid
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            n, nb = 64, 8
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.float64, grid=Grid(2, 4))
+            a = mat.to_numpy()
+            for uplo in ("L", "U"):
+                monkeypatch.setenv("DLAF_OZAKI_IMPL", "pallas")
+                config.initialize()
+                got = cholesky(uplo, mat).to_numpy()
+                monkeypatch.setenv("DLAF_OZAKI_IMPL", "jnp")
+                config.initialize()
+                ref = cholesky(uplo, mat).to_numpy()
+                tri = np.tril if uplo == "L" else np.triu
+                f = tri(got)
+                resid = (np.linalg.norm(f @ f.T - a) if uplo == "L"
+                         else np.linalg.norm(f.T @ f - a)) / np.linalg.norm(a)
+                assert resid < 60 * n * EPS, (uplo, resid)
+                assert np.abs(tri(got) - tri(ref)).max() < 1e-10
+        finally:
+            monkeypatch.delenv("DLAF_F64_GEMM")
+            monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+            monkeypatch.delenv("DLAF_OZAKI_IMPL", raising=False)
+            config.initialize()
+
     def test_cholesky_ozaki_under_pallas_impl(self, monkeypatch):
         monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "ozaki")
         config = self._knob(monkeypatch)
