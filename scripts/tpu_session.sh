@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-shot hardware measurement session (run when the TPU tunnel is healthy).
+#
+# Runs, in order of value-per-minute, with per-step wall-clock caps so a
+# mid-session tunnel wedge still leaves the earlier results on disk:
+#   1. bench.py             — the official headline artifact path
+#   2. scripts/tpu_sweep.py — ozaki knob grid + panel-latency probes
+#   3. single-chip locals of BASELINE configs #2-#4 (round-1 review item 6)
+# Results land in $OUT (default /tmp/tpu_session_<ts>/).
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-/tmp/tpu_session_$(date +%H%M)}
+mkdir -p "$OUT"
+echo "results -> $OUT" >&2
+
+run() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%T)) ===" >&2
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  echo "=== $name rc=$? ===" >&2
+}
+
+run bench 2700 python bench.py
+run sweep 2700 python scripts/tpu_sweep.py
+
+# BASELINE configs #2-#4, single-chip local forms (the multi-chip grids in
+# BASELINE.json need hardware this environment does not expose; the local
+# runs put first-ever GFLOPS numbers on these code paths — reference
+# miniapp_triangular_solver.cpp / miniapp_gen_to_std.cpp /
+# miniapp_reduction_to_band.cpp)
+run trsm_d_8192 1800 python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -n 8192 -b 256 --nruns 3 --nwarmups 1
+run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+run red2band_d_16384 2400 python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
+
+echo "session done ($(date +%T)); summary:" >&2
+grep -h "GFlop/s\|metric" "$OUT"/*.out 2>/dev/null | tail -20 >&2
