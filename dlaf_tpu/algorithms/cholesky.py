@@ -91,12 +91,14 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         if use_oz:
             # latency-bound panel ops in mixed precision (f32 seed + Newton,
             # tile_ops.mixed): emulated-f64 potrf/trsm are the wall-clock
-            # bottleneck on TPU, not the trailing flops
-            fac = mx.potrf_refined(uplo, blk)
+            # bottleneck on TPU, not the trailing flops. The fused form
+            # shares the f32 seed solves between factor and inverse — one
+            # f32 cholesky + one f32 solve per step instead of two solves
+            fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
             other = "U" if uplo == "L" else "L"
             diag = fac + tb.tri_mask(blk, other, k=-1)
         else:
-            fac = None
+            fac_inv = None
             diag = tl.potrf(uplo, blk)
         a = a.at[k0:k1, k0:k1].set(diag)
         if k1 == n:
@@ -106,10 +108,10 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
             # panel: A[k1:, k] <- A[k1:, k] Lkk^-H   (tile::trsm, high-prio
             # in the reference impl.h:147-156; here XLA schedules it)
             if use_oz:
-                # refined explicit inverse -> the panel solve is one small
-                # f64 gemm (throughput-bound) instead of an emulated trsm
-                linv = mx.tri_inv_refined(fac, lower=True)
-                panel = a[k1:, k0:k1] @ jnp.conj(linv).T
+                # refined explicit inverse (from the fused step above) ->
+                # the panel solve is one small f64 gemm (throughput-bound)
+                # instead of an emulated trsm
+                panel = a[k1:, k0:k1] @ jnp.conj(fac_inv).T
             elif trailing == "invgemm":
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
@@ -145,8 +147,7 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         else:
             # upper: A = U^H U; panel is a block row
             if use_oz:
-                uinv = mx.tri_inv_refined(fac, lower=False)
-                panel = jnp.conj(uinv).T @ a[k0:k1, k1:]
+                panel = jnp.conj(fac_inv).T @ a[k0:k1, k1:]
             elif trailing == "invgemm":
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
@@ -261,9 +262,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 + jnp.diag(pad.astype(diag.dtype))
         # redundant tiny compute on every rank; mixed mode swaps the
         # latency-bound emulated-f64 potrf for the f32-seed + Newton form
+        # (fused with the explicit inverse the panel solve consumes, so
+        # each step pays one f32 cholesky + ONE f32 solve, not two)
+        lkk_inv = None
         if use_mixed:
             other = "U" if uplo == "L" else "L"
-            lkk = mx.potrf_refined(uplo, diag) + tb.tri_mask(diag, other, k=-1)
+            fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
+            lkk = fac + tb.tri_mask(diag, other, k=-1)
         else:
             lkk = tl.potrf(uplo, diag)
 
@@ -273,7 +278,8 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         if k == nt - 1:
             return lt
         if uplo == "U":
-            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk)
+            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk,
+                                   lkk_inv)
 
         # -- panel trsm on owner column (reference impl.h:222-231) ----------
         # uniform local row start: every rank's rows >= k+1 live at slots
@@ -285,8 +291,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         g_rows = local_rows_global(lu_r, rr, nrows)
         row_valid = (g_rows > k) & (g_rows < nt)
         # trsm_panel: native batched solve, or (f64_trsm="mixed") refined
-        # inverse + matmul that follows the f64_gemm routing
-        pan = tb.trsm_panel("R", "L", "C", "N", lkk, lt[lu_r:, kc])
+        # inverse + matmul that follows the f64_gemm routing (inverse
+        # precomputed by the fused potrf step)
+        pan = tb.trsm_panel("R", "L", "C", "N", lkk, lt[lu_r:, kc],
+                            inv_a=lkk_inv)
         pan = jnp.where(row_valid[:, None, None], pan, jnp.zeros_like(pan))
         # owner column keeps the factored panel (others keep their tiles)
         keep = (is_owner_c & row_valid)[:, None, None]
@@ -345,7 +353,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             lt = lt.at[lu_r:, lu_c:].add(-upd)
         return lt
 
-    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk):
+    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk, ukk_inv=None):
         """Mirrored sweep for uplo='U' (reference ``call_U``): panel is the
         block row k, trailing update hits upper-triangle tile pairs."""
         is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
@@ -357,7 +365,8 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             return lt
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
-        pan = tb.trsm_panel("L", "U", "C", "N", ukk, lt[kr, lu_c:])
+        pan = tb.trsm_panel("L", "U", "C", "N", ukk, lt[kr, lu_c:],
+                            inv_a=ukk_inv)
         pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
         keep = (is_owner_r & col_valid)[:, None, None]
         lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))

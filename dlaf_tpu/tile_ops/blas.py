@@ -260,7 +260,8 @@ def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
     return out.astype(b.dtype)
 
 
-def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
+def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *,
+               alpha=1.0, inv_a=None):
     """``trsm`` with ONE (2D) triangular block ``a`` against a possibly
     batched rhs ``b`` — the per-tile panel-solve pattern of the distributed
     algorithms. Under config ``f64_trsm="mixed"`` (f64 / complex128) the solve
@@ -268,7 +269,11 @@ def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
     batch entry) times matmul (which follows ``f64_gemm``, so "mxu" puts the
     application on the int8 path); otherwise ``a`` broadcasts into the
     native solve. Whole-matrix local solves should call :func:`trsm` — the
-    explicit-inverse route is for block-sized panels."""
+    explicit-inverse route is for block-sized panels.
+
+    ``inv_a``: optional precomputed refined inverse of ``a``'s triangle
+    (from ``mixed.potrf_inv_refined`` — the fused factor+inverse step),
+    consumed only on the mixed path; saves re-deriving the f32 seed solve."""
     from ..config import get_configuration
 
     cfg = get_configuration()
@@ -277,7 +282,8 @@ def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
             and b.dtype == a.dtype):
         from . import mixed as mx
 
-        inv = mx.tri_inv_refined(_tri(a, uplo, diag), lower=(uplo == "L"))
+        inv = inv_a if inv_a is not None else \
+            mx.tri_inv_refined(_tri(a, uplo, diag), lower=(uplo == "L"))
         ti = _op(inv, op_a)
         prod = _mm(ti, b) if side == "L" else _mm(b, ti)
         return (alpha * prod).astype(b.dtype)

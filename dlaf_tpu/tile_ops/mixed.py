@@ -35,7 +35,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["potrf_refined", "tri_inv_refined", "cond_limit"]
+__all__ = ["potrf_refined", "potrf_inv_refined", "tri_inv_refined",
+           "cond_limit"]
 
 
 def cond_limit() -> float:
@@ -92,29 +93,33 @@ def _diag_ratio_sq(tri32):
     return jnp.where(good, est, jnp.inf)
 
 
-def _potrf_refined_l(a):
-    """Lower-Cholesky of an f64/c128 block via half-precision seed + one
-    Newton step (Hermitian-correct: conjugate transposes throughout)."""
+def _refined_seed(a):
+    """Shared seed+Newton factor body: f32/c64 cholesky seed, its seed
+    inverse, and the one-Newton-step refined f64 factor. Returns
+    ``(refined_l, linv0, l32)`` — the fused and non-fused entry points
+    build on the same refinement so they cannot diverge."""
     sd = _seed_dtype(a.dtype)
     l32 = lax.linalg.cholesky(a.astype(sd))
     l0 = jnp.tril(l32).astype(a.dtype)
     linv32 = lax.linalg.triangular_solve(
-        l32, jnp.eye(a.shape[-1], dtype=sd), left_side=True,
-        lower=True)
+        l32, jnp.eye(a.shape[-1], dtype=sd), left_side=True, lower=True)
     linv0 = jnp.tril(linv32).astype(a.dtype)
     e = a - l0 @ jnp.conj(l0).T
     m = (linv0 @ e) @ jnp.conj(linv0).T
-    refined = l0 + l0 @ _phi_lower(m)
+    return l0 + l0 @ _phi_lower(m), linv0, l32
+
+
+def _potrf_refined_l(a):
+    """Lower-Cholesky of an f64/c128 block via half-precision seed + one
+    Newton step (Hermitian-correct: conjugate transposes throughout)."""
+    refined, _, l32 = _refined_seed(a)
 
     def native(_):
         return jnp.tril(lax.linalg.cholesky(a))
 
-    def fast(r):
-        return r
-
     ok = (jnp.all(jnp.isfinite(refined))
           & (_diag_ratio_sq(l32) <= cond_limit()))
-    return lax.cond(ok, fast, native, refined)
+    return lax.cond(ok, lambda r: r, native, refined)
 
 
 def potrf_refined(uplo: str, a):
@@ -133,6 +138,40 @@ def potrf_refined(uplo: str, a):
         return _potrf_refined_l(sym)
     sym = _herm_from_tril(jnp.conj(a).T)   # upper storage, transposed problem
     return jnp.conj(_potrf_refined_l(sym)).T
+
+
+def _potrf_inv_refined_l(a):
+    """(L, L^-1) fused: the f32 seed solves are shared, so one panel step
+    pays ONE latency-bound f32 cholesky + ONE f32 triangular solve instead
+    of two solves (potrf_refined already computes the f32 inverse for its
+    Newton step; the separate tri_inv_refined re-solved it)."""
+    n = a.shape[-1]
+    l, linv0, l32 = _refined_seed(a)
+    eye = jnp.eye(n, dtype=a.dtype)
+    # Newton inverse of the REFINED factor, seeded by the f32 inverse:
+    # seed error is f32-rounding + the l0 -> l drift (~f64-grade), so one
+    # step lands at the same residual tri_inv_refined reaches
+    x = linv0 + linv0 @ (eye - l @ linv0)
+
+    def native(_):
+        ln = jnp.tril(lax.linalg.cholesky(a))
+        return ln, lax.linalg.triangular_solve(ln, eye, left_side=True,
+                                               lower=True)
+
+    ok = (jnp.all(jnp.isfinite(l)) & jnp.all(jnp.isfinite(x))
+          & (_diag_ratio_sq(l32) <= cond_limit()))
+    return lax.cond(ok, lambda lx: lx, native, (l, x))
+
+
+def potrf_inv_refined(uplo: str, a):
+    """Fused (factor, explicit inverse) of the HPD block ``a`` — same
+    contracts as :func:`potrf_refined` + :func:`tri_inv_refined` of its
+    result, sharing the half-precision seed solves. uplo='L': ``(L, L^-1)``
+    lower; uplo='U': ``(U, U^-1)`` upper (transposed problem)."""
+    if uplo == "L":
+        return _potrf_inv_refined_l(_herm_from_tril(a))
+    l, linv = _potrf_inv_refined_l(_herm_from_tril(jnp.conj(a).T))
+    return jnp.conj(l).T, jnp.conj(linv).T
 
 
 def tri_inv_refined(l, *, lower: bool = True):

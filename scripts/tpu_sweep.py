@@ -180,6 +180,7 @@ def main():
         l64 = jnp.linalg.cholesky(spd)
 
         f_refined = jax.jit(lambda m: mx.potrf_refined("L", m))
+        f_fused = jax.jit(lambda m: mx.potrf_inv_refined("L", m))
         f_native = jax.jit(lambda m: jnp.tril(lax.linalg.cholesky(m)))
         f_f32 = jax.jit(
             lambda m: lax.linalg.cholesky(m.astype(jnp.float32)))
@@ -187,6 +188,9 @@ def main():
         f_inv_native = jax.jit(lambda m: lax.linalg.triangular_solve(
             m, jnp.eye(nb_, dtype=m.dtype), left_side=True, lower=True))
         for name, fn, arg in [("potrf_refined", f_refined, spd),
+                              # the op the mixed cholesky panel ACTUALLY
+                              # runs per step (fused factor+inverse)
+                              ("potrf_inv_refined", f_fused, spd),
                               ("potrf_native_f64", f_native, spd),
                               ("potrf_f32", f_f32, spd),
                               ("tri_inv_refined", f_inv, l64),

@@ -511,6 +511,42 @@ class TestMixedPanel:
         invu = np.asarray(tri_inv_refined(jnp.asarray(u), lower=False))
         assert np.linalg.norm(invu @ u - np.eye(64)) < 64 * 8 * EPS
 
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    @pytest.mark.parametrize("cplx", [False, True])
+    def test_potrf_inv_refined_fused(self, uplo, cplx):
+        """The fused (factor, inverse) step must match potrf_refined's
+        factor contract AND deliver an f64-grade explicit inverse."""
+        from dlaf_tpu.tile_ops.mixed import potrf_inv_refined
+
+        n = 96
+        if cplx:
+            rng = np.random.default_rng(23)
+            x = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+            a = x @ x.conj().T + n * np.eye(n)
+        else:
+            a = self._spd(n, 7)
+        fac, inv = (np.asarray(z)
+                    for z in potrf_inv_refined(uplo, jnp.asarray(a)))
+        rec = fac @ fac.conj().T if uplo == "L" else fac.conj().T @ fac
+        assert np.linalg.norm(rec - a) / np.linalg.norm(a) < n * 8 * EPS
+        assert np.linalg.norm(inv @ fac - np.eye(n)) < n * 32 * EPS
+        tri = np.tril if uplo == "L" else np.triu
+        assert np.all(fac == tri(fac)) and np.all(inv == tri(inv))
+
+    def test_potrf_inv_refined_cond_fallback(self):
+        from dlaf_tpu.tile_ops.mixed import potrf_inv_refined
+
+        n = 128
+        rng = np.random.default_rng(29)
+        q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        ev = np.geomspace(1e-8, 1.0, n)
+        a = (q * ev) @ q.T
+        a = (a + a.T) / 2
+        fac, inv = (np.asarray(z)
+                    for z in potrf_inv_refined("L", jnp.asarray(a)))
+        assert np.linalg.norm(fac @ fac.T - a) / np.linalg.norm(a) < 60 * n * EPS
+        assert np.isfinite(inv).all()
+
 
 class TestCholeskyOzakiPath:
     @pytest.mark.parametrize("uplo", ["L", "U"])
