@@ -60,6 +60,14 @@ from ..types import ceil_div
 #: sweeps this set on the measured hardware.
 VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki")
 
+
+def _oz_mm(x, y):
+    """f64/c128 product on the int8 MXU path (the local "ozaki" sweep's
+    gemm primitive for panel applications)."""
+    if jnp.iscomplexobj(x) or jnp.iscomplexobj(y):
+        return oz.matmul_c128(x, y, slices=tb._oz_slices())
+    return oz.matmul_f64(x, y, slices=tb._oz_slices())
+
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
@@ -109,9 +117,10 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
             # in the reference impl.h:147-156; here XLA schedules it)
             if use_oz:
                 # refined explicit inverse (from the fused step above) ->
-                # the panel solve is one small f64 gemm (throughput-bound)
-                # instead of an emulated trsm
-                panel = a[k1:, k0:k1] @ jnp.conj(fac_inv).T
+                # the panel solve is one gemm instead of an emulated trsm;
+                # the gemm itself rides the int8 MXU path like the trailing
+                # update (native emulated-f64 gemm is ~3x slower)
+                panel = _oz_mm(a[k1:, k0:k1], jnp.conj(fac_inv).T)
             elif trailing == "invgemm":
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
@@ -147,7 +156,7 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         else:
             # upper: A = U^H U; panel is a block row
             if use_oz:
-                panel = jnp.conj(fac_inv).T @ a[k0:k1, k1:]
+                panel = _oz_mm(jnp.conj(fac_inv).T, a[k0:k1, k1:])
             elif trailing == "invgemm":
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))

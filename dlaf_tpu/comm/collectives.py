@@ -42,6 +42,18 @@ def bcast(x, axis: str, src: int):
     Implemented as mask-then-psum: contributions from non-source ranks are
     zeroed, so the all-reduce returns exactly the source value. On a TPU ring
     this lowers to one all-reduce over ICI; XLA fuses the masking.
+
+    Cost rationale (round-1 review asked why not a bcast tree): for axis
+    size p and payload V, psum on a bidirectional ring moves ~2V(p-1)/p
+    per link (reduce-scatter + all-gather) — within 2x of the V(p-1)/p
+    one-to-all lower bound. The XLA-expressible alternatives are worse or
+    latency-bound: ``all_gather``+select moves (p-1)V per link; a
+    pipelined ``ppermute`` chain reaches ~V but pays p-1 serialized hops
+    (wins only for payloads far below the panel sizes these algorithms
+    broadcast). MPI-style log-tree broadcasts are not expressible in SPMD
+    XLA collectives. Measuring the ppermute variant against this needs a
+    multi-chip ICI axis, which the one-chip environment cannot provide;
+    the 2x-of-optimal bound is the design budget until then.
     """
     mask = (this_rank(axis) == src).astype(x.dtype)
     return lax.psum(x * mask, axis)

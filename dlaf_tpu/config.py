@@ -98,6 +98,14 @@ class Configuration:
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
     #: worse take the native branch inside the compiled program).
     mixed_cond_limit: float = 100.0
+    #: Half-precision seed kernel for the mixed panel path: "xla" (native
+    #: loop-based cholesky + triangular solve) or "recursive" (trace-time
+    #: recursive block decomposition producing factor AND inverse from
+    #: gemms + small leaf kernels — trades program size for the XLA loop
+    #: dispatch latency that dominates panel steps; tile_ops/mixed.py).
+    mixed_seed: str = "xla"
+    #: Leaf size of the recursive seed (power of two recommended).
+    mixed_seed_base: int = 64
     #: Enable float64/complex128 support (sets jax_enable_x64).
     enable_x64: bool = True
     #: When non-empty, miniapps emit XLA/PJRT execution profiles
@@ -165,6 +173,7 @@ _VALID_CHOICES = {
     "f64_gemm": ("native", "mxu"),
     "f64_trsm": ("native", "mixed"),
     "ozaki_impl": ("jnp", "pallas"),
+    "mixed_seed": ("xla", "recursive"),
 }
 
 
@@ -175,6 +184,9 @@ def _validate(cfg: Configuration) -> None:
             raise ValueError(f"configuration {name}={v!r}: must be one of {allowed}")
     if not 1 <= cfg.f64_gemm_slices <= 9:
         raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in [1, 9]")
+    if cfg.mixed_seed_base < 1:
+        raise ValueError(f"mixed_seed_base={cfg.mixed_seed_base}: must be >= 1"
+                         " (the recursive seed's leaf size)")
     # cholesky_trailing is validated against VALID_TRAILING at the use site
     # (algorithms/cholesky.py) to keep the list next to the implementations
 

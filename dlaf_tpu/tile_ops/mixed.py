@@ -93,16 +93,55 @@ def _diag_ratio_sq(tri32):
     return jnp.where(good, est, jnp.inf)
 
 
+def _chol_inv_seed_recursive(a, base: int):
+    """(chol(a), inv(chol(a))) in the seed dtype via TRACE-TIME recursive
+    block decomposition: leaves call the native kernels at ``base`` size;
+    every upper level composes with gemms only —
+
+        L = [[L11, 0], [A21 L11^-H, chol(A22 - L21 L21^H)]]
+        L^-1 = [[L11^-1, 0], [-L22^-1 L21 L11^-1, L22^-1]]
+
+    — so the loop-based XLA triangular solves disappear above the leaves
+    and the sequential latency is leaf chols + MXU gemms (config
+    ``mixed_seed="recursive"``; the latency attack docs/ROADMAP.md item 4
+    proposes)."""
+    n = a.shape[-1]
+    if n <= base:
+        l = lax.linalg.cholesky(a)
+        linv = lax.linalg.triangular_solve(
+            l, jnp.eye(n, dtype=a.dtype), left_side=True, lower=True)
+        return l, linv
+    h = n // 2
+    l11, i11 = _chol_inv_seed_recursive(a[:h, :h], base)
+    l21 = a[h:, :h] @ jnp.conj(i11).T
+    s = a[h:, h:] - l21 @ jnp.conj(l21).T
+    l22, i22 = _chol_inv_seed_recursive(s, base)
+    i21 = -(i22 @ l21) @ i11
+    ztop = jnp.zeros((h, n - h), dtype=a.dtype)
+    l = jnp.concatenate([jnp.concatenate([l11, ztop], axis=1),
+                         jnp.concatenate([l21, l22], axis=1)], axis=0)
+    linv = jnp.concatenate([jnp.concatenate([i11, ztop], axis=1),
+                            jnp.concatenate([i21, i22], axis=1)], axis=0)
+    return l, linv
+
+
 def _refined_seed(a):
     """Shared seed+Newton factor body: f32/c64 cholesky seed, its seed
     inverse, and the one-Newton-step refined f64 factor. Returns
     ``(refined_l, linv0, l32)`` — the fused and non-fused entry points
     build on the same refinement so they cannot diverge."""
+    from ..config import get_configuration
+
+    cfg = get_configuration()
     sd = _seed_dtype(a.dtype)
-    l32 = lax.linalg.cholesky(a.astype(sd))
+    if cfg.mixed_seed == "recursive":
+        l32, linv32 = _chol_inv_seed_recursive(a.astype(sd),
+                                               int(cfg.mixed_seed_base))
+    else:
+        l32 = lax.linalg.cholesky(a.astype(sd))
+        linv32 = lax.linalg.triangular_solve(
+            l32, jnp.eye(a.shape[-1], dtype=sd), left_side=True, lower=True)
     l0 = jnp.tril(l32).astype(a.dtype)
-    linv32 = lax.linalg.triangular_solve(
-        l32, jnp.eye(a.shape[-1], dtype=sd), left_side=True, lower=True)
     linv0 = jnp.tril(linv32).astype(a.dtype)
     e = a - l0 @ jnp.conj(l0).T
     m = (linv0 @ e) @ jnp.conj(linv0).T

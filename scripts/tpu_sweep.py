@@ -198,6 +198,24 @@ def main():
             t = best_time(fn, arg)
             results["panel"][name] = {"t_ms": t * 1e3}
             log(f"panel {name}: {t*1e3:.3f} ms")
+        # recursive trace-time seed (mixed_seed="recursive"): the latency
+        # candidate of ROADMAP item 4 — time the fused op under each base
+        for base in (32, 64, 128):
+            os.environ["DLAF_MIXED_SEED"] = "recursive"
+            os.environ["DLAF_MIXED_SEED_BASE"] = str(base)
+            config.initialize()
+            try:
+                f_rec = jax.jit(lambda m: mx.potrf_inv_refined("L", m))
+                t = best_time(f_rec, spd)
+                results["panel"][f"potrf_inv_recursive_b{base}"] = {
+                    "t_ms": t * 1e3}
+                log(f"panel potrf_inv_recursive_b{base}: {t*1e3:.3f} ms")
+            except Exception as e:
+                log(f"panel recursive b{base} failed: {e!r}")
+            finally:
+                os.environ.pop("DLAF_MIXED_SEED", None)
+                os.environ.pop("DLAF_MIXED_SEED_BASE", None)
+                config.initialize()
     except Exception as e:
         log(f"panel phase failed: {e!r}")
 

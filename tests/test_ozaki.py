@@ -533,6 +533,59 @@ class TestMixedPanel:
         tri = np.tril if uplo == "L" else np.triu
         assert np.all(fac == tri(fac)) and np.all(inv == tri(inv))
 
+    @pytest.mark.parametrize("uplo", ["L", "U"])
+    @pytest.mark.parametrize("n", [96, 256, 100])  # incl. odd split sizes
+    def test_recursive_seed_matches_xla_seed(self, uplo, n, monkeypatch):
+        """mixed_seed="recursive" (trace-time block recursion, gemm-only
+        above the leaves) must deliver the same f64-grade contracts as the
+        native XLA seed."""
+        import dlaf_tpu.config as config
+        from dlaf_tpu.tile_ops.mixed import potrf_inv_refined
+
+        a = self._spd(n, n + 5)
+        monkeypatch.setenv("DLAF_MIXED_SEED", "recursive")
+        monkeypatch.setenv("DLAF_MIXED_SEED_BASE", "32")
+        config.initialize()
+        try:
+            fac, inv = (np.asarray(z)
+                        for z in potrf_inv_refined(uplo, jnp.asarray(a)))
+        finally:
+            monkeypatch.delenv("DLAF_MIXED_SEED")
+            monkeypatch.delenv("DLAF_MIXED_SEED_BASE")
+            config.initialize()
+        rec = fac @ fac.T if uplo == "L" else fac.T @ fac
+        assert np.linalg.norm(rec - a) / np.linalg.norm(a) < n * 8 * EPS
+        assert np.linalg.norm(inv @ fac - np.eye(n)) < n * 32 * EPS
+
+    def test_recursive_seed_complex_and_fallback(self, monkeypatch):
+        import dlaf_tpu.config as config
+        from dlaf_tpu.tile_ops.mixed import potrf_inv_refined
+
+        monkeypatch.setenv("DLAF_MIXED_SEED", "recursive")
+        config.initialize()
+        try:
+            n = 80
+            rng = np.random.default_rng(41)
+            x = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+            a = x @ x.conj().T + n * np.eye(n)
+            fac, inv = (np.asarray(z)
+                        for z in potrf_inv_refined("L", jnp.asarray(a)))
+            assert (np.linalg.norm(fac @ fac.conj().T - a)
+                    / np.linalg.norm(a) < n * 8 * EPS)
+            assert np.linalg.norm(inv @ fac - np.eye(n)) < n * 64 * EPS
+            # ill-conditioned block: guard must still route to native
+            q, _ = np.linalg.qr(rng.standard_normal((128, 128)))
+            ev = np.geomspace(1e-8, 1.0, 128)
+            b = (q * ev) @ q.T
+            b = (b + b.T) / 2
+            fb, _ = (np.asarray(z)
+                     for z in potrf_inv_refined("L", jnp.asarray(b)))
+            assert (np.linalg.norm(fb @ fb.T - b) / np.linalg.norm(b)
+                    < 60 * 128 * EPS)
+        finally:
+            monkeypatch.delenv("DLAF_MIXED_SEED")
+            config.initialize()
+
     def test_potrf_inv_refined_cond_fallback(self):
         from dlaf_tpu.tile_ops.mixed import potrf_inv_refined
 
