@@ -61,12 +61,6 @@ from ..types import ceil_div
 VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki")
 
 
-def _oz_mm(x, y):
-    """f64/c128 product on the int8 MXU path (the local "ozaki" sweep's
-    gemm primitive for panel applications)."""
-    if jnp.iscomplexobj(x) or jnp.iscomplexobj(y):
-        return oz.matmul_c128(x, y, slices=tb._oz_slices())
-    return oz.matmul_f64(x, y, slices=tb._oz_slices())
 
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
@@ -120,7 +114,7 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                 # the panel solve is one gemm instead of an emulated trsm;
                 # the gemm itself rides the int8 MXU path like the trailing
                 # update (native emulated-f64 gemm is ~3x slower)
-                panel = _oz_mm(a[k1:, k0:k1], jnp.conj(fac_inv).T)
+                panel = tb.mm_mxu(a[k1:, k0:k1], jnp.conj(fac_inv).T)
             elif trailing == "invgemm":
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
@@ -156,7 +150,7 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         else:
             # upper: A = U^H U; panel is a block row
             if use_oz:
-                panel = _oz_mm(jnp.conj(fac_inv).T, a[k0:k1, k1:])
+                panel = tb.mm_mxu(jnp.conj(fac_inv).T, a[k0:k1, k1:])
             elif trailing == "invgemm":
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))

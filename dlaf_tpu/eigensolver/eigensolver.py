@@ -78,7 +78,12 @@ def eigensolver(uplo: str, a: Matrix,
         band = extract_band(red)
         tri = band_to_tridiag(band, red.band)
     with pt.phase("tridiag_solver"):
-        lam, z = tridiag_solver(tri.d, tri.e, nb)
+        # distributed: the merge-tree gemms, qc workspaces, and Q run
+        # sharded over the grid's mesh (beyond the local-only reference) —
+        # the (n, n) merge arrays never have to fit one device's HBM
+        # (remaining single-device term: the deflated secular workspace)
+        lam, z = tridiag_solver(tri.d, tri.e, nb,
+                                mesh=a.grid.mesh if distributed else None)
         fence(z)
     with pt.phase("bt_band_to_tridiag"):
         if distributed:

@@ -57,16 +57,24 @@ def _oz_slices() -> int:
     return int(get_configuration().f64_gemm_slices)
 
 
+def mm_mxu(a, b):
+    """``a @ b`` FORCED onto the int8 MXU path (tile_ops.ozaki), regardless
+    of the ``f64_gemm`` knob — the gemm primitive of algorithm paths that
+    are themselves MXU-routed by their own knob (the local "ozaki" cholesky
+    sweep's panel application). Complex operands promote like :func:`mm`."""
+    from . import ozaki
+
+    if jnp.iscomplexobj(a) or jnp.iscomplexobj(b):
+        ac = a.astype(jnp.complex128)
+        bc = b.astype(jnp.complex128)
+        return ozaki.matmul_c128(ac, bc, slices=_oz_slices())
+    return ozaki.matmul_f64(a, b, slices=_oz_slices())
+
+
 def _mm(a, b):
     """Central matmul of the level-3 ops, with the f64_gemm="mxu" reroute."""
     if _mxu_f64(a, b, dims=(a.shape[-2], a.shape[-1], b.shape[-1])):
-        from . import ozaki
-
-        if jnp.iscomplexobj(a) or jnp.iscomplexobj(b):
-            ac = a.astype(jnp.complex128)
-            bc = b.astype(jnp.complex128)
-            return ozaki.matmul_c128(ac, bc, slices=_oz_slices())
-        return ozaki.matmul_f64(a, b, slices=_oz_slices())
+        return mm_mxu(a, b)
     return a @ b
 
 

@@ -80,6 +80,33 @@ def test_device_path_matches():
     np.testing.assert_allclose(np.abs(q1), np.abs(q2), atol=1e-10)
 
 
+def test_mesh_sharded_merge_tree(monkeypatch, devices8):
+    """tridiag_solver(mesh=...): merge gemms run sharded over the 2D mesh
+    and the returned eigenvector matrix is 2D-sharded (the beyond-reference
+    scaling path for Q past one device's HBM); results match the host
+    reference twin."""
+    from jax.sharding import NamedSharding
+
+    import importlib
+
+    ts_mod = importlib.import_module("dlaf_tpu.eigensolver.tridiag_solver")
+    from dlaf_tpu.comm.grid import Grid
+
+    rng = np.random.default_rng(77)
+    n = 96
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    # drop the threshold so several tree levels actually shard in-test
+    monkeypatch.setattr(ts_mod, "_SHARD_MERGE_MIN_N", 48)
+    mesh = Grid(2, 4).mesh
+    lam, q = ts_mod.tridiag_solver(d, e, 16, use_device=True, mesh=mesh)
+    assert isinstance(q.sharding, NamedSharding)
+    assert q.sharding.mesh == mesh
+    l_ref, _ = ts_mod.tridiag_solver(d, e, 16, use_device=False)
+    np.testing.assert_allclose(lam, l_ref, atol=1e-11)
+    check(d, e, lam, np.asarray(q))
+
+
 def test_native_secular_matches_numpy():
     """C++ safeguarded-Newton secular solver vs the numpy bisection: same
     anchors, same roots, and the roots actually satisfy the secular eq."""
