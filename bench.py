@@ -90,7 +90,8 @@ def _cache_dir() -> str:
 
 def run_variant() -> None:
     """Child: measure ONE trailing variant (env DLAF_BENCH_VARIANT), print
-    one JSON line {variant, platform, dtype, gflops, t} on stdout."""
+    one JSON line {variant, platform, dtype, n, nb, gflops, t, ts} on
+    stdout (same schema as the .bench_history.jsonl append)."""
     variant = os.environ["DLAF_BENCH_VARIANT"]
     dtype_name = os.environ.get("DLAF_BENCH_DTYPE", "float64")
     t_start = time.time()
@@ -144,9 +145,19 @@ def run_variant() -> None:
         log(f"[{variant}] run {i}: {t:.4f}s {g:.1f} GFlop/s")
         if i > 0 and g > best_g:
             best_g, best_t = g, t
-    print(json.dumps({"variant": variant, "platform": platform,
-                      "dtype": np.dtype(dtype).name,
-                      "gflops": round(best_g, 2), "t": best_t}), flush=True)
+    line = {"variant": variant, "platform": platform,
+            "dtype": np.dtype(dtype).name, "n": n, "nb": nb,
+            "gflops": round(best_g, 2), "t": best_t,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    try:
+        # append-only measurement log: tunnel wedges must never cost an
+        # already-landed hardware number (BASELINE.md cites this file)
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".bench_history.jsonl"), "a") as f:
+            f.write(json.dumps(line) + "\n")
+    except OSError as e:
+        log(f"history append failed: {e!r}")
+    print(json.dumps(line), flush=True)
 
 
 def sweep(platform: str) -> None:
