@@ -36,5 +36,10 @@ run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
 run red2band_d_16384 2400 python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
     -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
 
+# full local eigensolver pipeline on hardware (phase timers exercise every
+# stage: red2band, device band gather, native chase, D&C, back-transforms)
+run eig_d_4096 2400 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 4096 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
 echo "session done ($(date +%T)); summary:" >&2
 grep -h "GFlop/s\|metric" "$OUT"/*.out 2>/dev/null | tail -20 >&2
