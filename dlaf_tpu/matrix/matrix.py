@@ -18,6 +18,7 @@ miniapp convenience, mirroring the reference's analytic matrix setters
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import numpy as np
@@ -64,10 +65,24 @@ class Matrix:
     @classmethod
     def from_global(cls, a, block_size: TileElementSize, grid: Optional[Grid] = None,
                     source_rank: RankIndex2D = RankIndex2D(0, 0)) -> "Matrix":
-        """Wrap a host/device global array (reference ``Matrix(layout, ptr)``)."""
+        """Wrap a host/device global array (reference ``Matrix(layout, ptr)``).
+
+        A device-resident (possibly already-sharded) ``jax.Array`` input is
+        re-tiled inside ONE compiled program whose output carries the tile
+        sharding — the global matrix is never materialized on a single
+        device (the handoff path from the mesh-sharded D&C eigenvectors
+        into the distributed back-transforms)."""
         a = np.asarray(a) if not isinstance(a, jax.Array) else a
         size = GlobalElementSize(a.shape[0], a.shape[1])
         dist = _make_dist(size, block_size, grid, source_rank)
+        if (grid is not None and grid.num_devices > 1
+                and isinstance(a, jax.Array)
+                # the compiled fast path needs the input on the grid's
+                # devices; arrays committed elsewhere (a single device, a
+                # different mesh) take the eager re-tile + reshard below
+                and set(a.devices()) == set(grid.mesh.devices.flat)):
+            return cls(dist, _retile_sharded(dist, grid.tile_sharding())(a),
+                       grid)
         storage = tiling.global_to_tiles(a, dist)
         return cls(dist, _shard(storage, grid), grid)
 
@@ -136,3 +151,13 @@ def _shard(storage, grid: Optional[Grid]):
     if grid is None or grid.num_devices == 1:
         return storage
     return place(storage, grid.tile_sharding())
+
+
+@functools.lru_cache(maxsize=64)
+def _retile_sharded(dist: Distribution, sharding):
+    """Compiled global->tile-storage re-tile with the block-cyclic output
+    ``sharding`` (the grid's ``tile_sharding()``, hashable) baked in; for
+    device-array inputs XLA moves shards directly to their owners instead
+    of staging the full matrix anywhere."""
+    return jax.jit(lambda a: tiling.global_to_tiles(a, dist),
+                   out_shardings=sharding)
