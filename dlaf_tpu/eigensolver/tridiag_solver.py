@@ -116,7 +116,6 @@ def _secular_roots_host(ds, zs, rho):
     return _secular_roots(ds, zs, rho)
 
 
-@jax.jit
 def _secular_vcols_device(ds, zs, rho, live):
     """Device twin of :func:`_secular_roots` + the Gu-Eisenstat refinement +
     eigenvector-coefficient assembly: returns ``(lam_live, vcols)``. The pole
@@ -171,6 +170,26 @@ def _secular_vcols_device(ds, zs, rho, live):
     vcols = zhat[None, :] / m
     vcols = vcols / jnp.linalg.norm(vcols, axis=1, keepdims=True)
     return lam_live, vcols
+
+
+@functools.lru_cache(maxsize=None)
+def _secular_vcols_jit(mesh):
+    """Compiled device secular solve. With a mesh, the (kb, kb) bisection
+    and refinement run ROW-sharded over all mesh devices (each root's
+    bisection is independent; only the log-product column reductions
+    cross shards) and the coefficient matrix comes out row-sharded — the
+    last (n, n)-class single-device workspace of the sharded merge path."""
+    if mesh is None:
+        return jax.jit(_secular_vcols_device)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..comm.grid import COL_AXIS, ROW_AXIS
+
+    rows = PartitionSpec((ROW_AXIS, COL_AXIS))
+    return jax.jit(_secular_vcols_device,
+                   out_shardings=(NamedSharding(mesh, rows),
+                                  NamedSharding(mesh, PartitionSpec(
+                                      (ROW_AXIS, COL_AXIS), None))))
 
 
 def _deflation_scan(ds, zs, live, tol):
@@ -409,11 +428,12 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool, mesh=None):
                 ds_b, zs_b = dsk, zsk
             live_kb = np.zeros(kb, dtype=bool)
             live_kb[:k] = True
-            lam_j, vcols_dev = _secular_vcols_device(
+            lam_j, vcols_dev = _secular_vcols_jit(mesh)(
                 jnp.asarray(ds_b), jnp.asarray(zs_b), jnp.float64(rho_n),
                 jnp.asarray(live_kb))
             # only the O(kb) eigenvalues cross to the host; the (kb, kb)
-            # coefficient matrix stays device-resident
+            # coefficient matrix stays device-resident (row-sharded over
+            # the mesh when one is given)
             lam_live = np.asarray(lam_j)[:k]
         else:
             anchor, mu = _secular_roots_host(dsk, zsk, rho_n)

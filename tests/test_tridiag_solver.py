@@ -105,6 +105,18 @@ def test_mesh_sharded_merge_tree(monkeypatch, devices8):
     l_ref, _ = ts_mod.tridiag_solver(d, e, 16, use_device=False)
     np.testing.assert_allclose(lam, l_ref, atol=1e-11)
     check(d, e, lam, np.asarray(q))
+    # sharded merge + sharded DEVICE secular branch together
+    import dlaf_tpu.config as config
+
+    monkeypatch.setenv("DLAF_SECULAR_DEVICE_MIN_K", "1")
+    config.initialize()
+    try:
+        lam2, q2 = ts_mod.tridiag_solver(d, e, 16, use_device=True, mesh=mesh)
+    finally:
+        monkeypatch.delenv("DLAF_SECULAR_DEVICE_MIN_K")
+        config.initialize()
+    np.testing.assert_allclose(lam2, l_ref, atol=1e-11)
+    check(d, e, lam2, np.asarray(q2))
 
 
 def test_native_secular_matches_numpy():
