@@ -160,6 +160,31 @@ def run_variant() -> None:
     print(json.dumps(line), flush=True)
 
 
+def best_recorded(platform: str, n: int, nb: int):
+    """Best same-config measurement from the append-only history log
+    (``.bench_history.jsonl``), or None. f64 entries only — the headline
+    metric is BASELINE config #1's double precision."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        ".bench_history.jsonl")
+    best = None
+    try:
+        with open(path) as f:
+            for raw in f:
+                try:
+                    r = json.loads(raw)
+                except ValueError:
+                    continue
+                g = r.get("gflops")
+                if (isinstance(g, (int, float))
+                        and r.get("platform") == platform and r.get("n") == n
+                        and r.get("nb") == nb and r.get("dtype") == "float64"
+                        and (best is None or g > best["gflops"])):
+                    best = r
+    except OSError:
+        return None
+    return best
+
+
 def sweep(platform: str) -> None:
     """Parent: run the variant sweep, each variant in a timeout-guarded
     subprocess; print the driver's single JSON line from the best result."""
@@ -226,6 +251,15 @@ def sweep(platform: str) -> None:
         "unit": "GFlop/s",
         "vs_baseline": 1.0,
     }
+    if best["platform"] != "tpu":
+        # a fallback run must not hide that real TPU measurements exist:
+        # surface the best recorded same-config TPU number from the
+        # append-only history (clearly labeled as recorded, not live)
+        hist = best_recorded(platform="tpu", n=n, nb=nb)
+        if hist:
+            result["tpu_best_recorded"] = {
+                k: hist[k] for k in ("variant", "dtype", "gflops", "ts")
+                if k in hist}
     print(json.dumps(result), flush=True)
 
     # informational MXU-tier number (stderr only — the headline metric

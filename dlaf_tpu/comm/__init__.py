@@ -1,7 +1,9 @@
 """L4 communication — public API (reference ``communication/``:
-CommunicatorGrid + collective verbs over mesh axes)."""
+CommunicatorGrid + collective verbs over mesh axes + the blocking
+``sync`` tier for tests/checks)."""
 
+from . import sync
 from .grid import COL_AXIS, ROW_AXIS, Grid
 from .multihost import initialize_multihost, multihost_grid, process_info
 
-__all__ = ["COL_AXIS", "ROW_AXIS", "Grid"]
+__all__ = ["COL_AXIS", "ROW_AXIS", "Grid", "sync"]

@@ -1,0 +1,51 @@
+"""Round-robin resource rotation (reference ``common/round_robin.h:10-35``).
+
+The reference rotates pre-allocated workspaces — look-ahead panel pairs in
+the factorizations (``factorization/cholesky/impl.h:187-189``) and the
+kernel microbenchmark's work tiles (``miniapp/kernel/work_tiles.h``) — so
+that in-flight tasks never share a buffer. Under XLA the look-ahead use
+disappears (the compiler owns buffer lifetimes inside a traced step), but
+the *measurement* use survives: rotating independent input sets between
+timed runs keeps a microbenchmark from re-reading the exact buffers the
+previous run just touched. :mod:`dlaf_tpu.miniapp.miniapp_kernel` is the
+consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Iterable, Sequence, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["RoundRobin"]
+
+
+class RoundRobin(Generic[T]):
+    """Cycle through a fixed pool of resources.
+
+    ``next_resource()`` returns pool items in order, wrapping around
+    (reference ``RoundRobin::nextResource``, ``common/round_robin.h:24-30``).
+    ``current_resource()`` re-reads the last item handed out without
+    advancing (reference ``currentResource``).
+    """
+
+    def __init__(self, items: Iterable[T]):
+        self._items: Sequence[T] = tuple(items)
+        if not self._items:
+            raise ValueError("RoundRobin needs at least one resource")
+        self._index = len(self._items) - 1  # first next_resource() -> items[0]
+
+    def next_resource(self) -> T:
+        self._index = (self._index + 1) % len(self._items)
+        return self._items[self._index]
+
+    def current_resource(self) -> T:
+        return self._items[self._index]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self):
+        """Iterate the pool once in storage order (does not advance the
+        rotation); lets callers touch every resource, e.g. to pre-compile."""
+        return iter(self._items)

@@ -119,9 +119,11 @@ class Matrix:
     # -- host access (tests / debugging) ------------------------------------
 
     def to_numpy(self) -> np.ndarray:
-        """Gather the global matrix to host (reference test helper
-        ``matrix_local.h`` gather)."""
-        return np.asarray(tiling.tiles_to_global(jax.device_get(self.storage), self.dist))
+        """Gather the global matrix to host via the blocking ``sync`` comm
+        tier (reference test helper ``matrix_local.h`` gather)."""
+        from ..comm import sync as comm_sync
+
+        return comm_sync.gather(self)
 
     def tile(self, index: GlobalTileIndex) -> np.ndarray:
         """Read one global tile (its actual, possibly short, extent)."""

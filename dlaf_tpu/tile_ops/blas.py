@@ -252,20 +252,79 @@ def trmm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
     return (alpha * prod).astype(b.dtype)
 
 
-def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
-    """Solve ``op_a(A) x = alpha b`` (side='L') / ``x op_a(A) = alpha b``
-    (side='R') with triangular ``A`` (reference ``tile::trsm``).
+#: Triangle sizes above this split recursively instead of lowering to one
+#: XLA TriangularSolve. Two reasons (both measured on the v5e tunnel,
+#: 2026-07-31 session): (1) memory — XLA's blocked substitution under the
+#: f64→f32-pair X64 rewrite keeps O(n/128) prefix-shaped update temps
+#: alive simultaneously (observed: f64 n=8192 against an 8192-wide rhs
+#: wants ~13 GB of HLO temps and OOMs a 16 GB chip); (2) perf — the
+#: recursion turns the bulk of the flops into large gemms, which ride
+#: ``_mm``'s f64_gemm="mxu" reroute onto the int8 MXU path, while the
+#: native solve is always software-emulated f64.
+TRSM_RECURSE_MIN = 2048
 
-    Lowers to XLA ``TriangularSolve`` (blocked forward substitution on TPU).
-    """
-    out = lax.linalg.triangular_solve(
-        a, alpha * b,
+
+def _trsm_native(side, uplo, op_a, diag, a, b):
+    return lax.linalg.triangular_solve(
+        a, b,
         left_side=(side == "L"),
         lower=(uplo == "L"),
         transpose_a=(op_a in ("T", "C")),
         conjugate_a=(op_a == "C"),
         unit_diagonal=(diag == "U"))
-    return out.astype(b.dtype)
+
+
+def _trsm_rec(side, uplo, op_a, diag, a, b):
+    """Recursive blocked solve: split A 2x2, solve the halves, connect with
+    one gemm (the standard blocked substitution the reference hand-tiles at
+    ``nb`` granularity — here at halving granularity so the connecting gemm
+    is as large as possible for the MXU)."""
+    n = a.shape[-1]
+    if n <= TRSM_RECURSE_MIN:
+        return _trsm_native(side, uplo, op_a, diag, a, b)
+    h = max(TRSM_RECURSE_MIN // 2, (n // 2) // 256 * 256)  # MXU-aligned split
+    a11, a22 = a[:h, :h], a[h:, h:]
+    # off-diagonal block of op(A): for op='N' the stored block on the
+    # ``eff_lower`` side; otherwise the transpose of the other one
+    eff_lower = (uplo == "L") == (op_a == "N")
+    if eff_lower:
+        s = a[h:, :h] if op_a == "N" else _op(a[:h, h:], op_a)
+    else:
+        s = a[:h, h:] if op_a == "N" else _op(a[h:, :h], op_a)
+    if side == "L":
+        if eff_lower:       # forward: op(A) = [[T11, 0], [S, T22]]
+            x1 = _trsm_rec(side, uplo, op_a, diag, a11, b[:h])
+            x2 = _trsm_rec(side, uplo, op_a, diag, a22,
+                           b[h:] - _mm(s, x1))
+        else:               # backward: op(A) = [[T11, S], [0, T22]]
+            x2 = _trsm_rec(side, uplo, op_a, diag, a22, b[h:])
+            x1 = _trsm_rec(side, uplo, op_a, diag, a11,
+                           b[:h] - _mm(s, x2))
+        return jnp.concatenate([x1, x2], axis=0)
+    if eff_lower:           # X [[T11, 0], [S, T22]] = [B1, B2]
+        x2 = _trsm_rec(side, uplo, op_a, diag, a22, b[..., h:])
+        x1 = _trsm_rec(side, uplo, op_a, diag, a11,
+                       b[..., :h] - _mm(x2, s))
+    else:                   # X [[T11, S], [0, T22]] = [B1, B2]
+        x1 = _trsm_rec(side, uplo, op_a, diag, a11, b[..., :h])
+        x2 = _trsm_rec(side, uplo, op_a, diag, a22,
+                       b[..., h:] - _mm(x1, s))
+    return jnp.concatenate([x1, x2], axis=-1)
+
+
+def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
+    """Solve ``op_a(A) x = alpha b`` (side='L') / ``x op_a(A) = alpha b``
+    (side='R') with triangular ``A`` (reference ``tile::trsm``).
+
+    Small/batched triangles lower to XLA ``TriangularSolve`` (blocked
+    forward substitution on TPU); 2D triangles above ``TRSM_RECURSE_MIN``
+    use the recursive blocked form (see there for why).
+    """
+    out_dtype = b.dtype
+    b = alpha * b
+    if a.ndim == 2 and b.ndim == 2 and a.shape[-1] > TRSM_RECURSE_MIN:
+        return _trsm_rec(side, uplo, op_a, diag, a, b).astype(out_dtype)
+    return _trsm_native(side, uplo, op_a, diag, a, b).astype(out_dtype)
 
 
 def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *,

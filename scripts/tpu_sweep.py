@@ -25,6 +25,10 @@ import time
 
 import numpy as np
 
+# run as `python scripts/tpu_sweep.py`: sys.path[0] is scripts/, not the
+# repo root — put the package dir on the path before any dlaf_tpu import
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 REPS = int(os.environ.get("DLAF_SWEEP_REPS", "4"))
 
 

@@ -162,3 +162,19 @@ def test_plot_bench_parses(tmp_path):
         [sys.executable, "scripts/plot_bench.py", str(log)],
         capture_output=True, text=True, check=True, cwd="/root/repo").stdout
     assert "best=150.0GF/s" in out and "median=1.5" in out.replace("median=1.5000", "median=1.5")
+
+
+def test_round_robin():
+    from dlaf_tpu.common.round_robin import RoundRobin
+
+    rr = RoundRobin(["a", "b", "c"])
+    assert len(rr) == 3
+    # nextResource cycles in order, wrapping (common/round_robin.h:24-30)
+    assert [rr.next_resource() for _ in range(5)] == ["a", "b", "c", "a", "b"]
+    assert rr.current_resource() == "b"  # re-read without advancing
+    assert rr.current_resource() == "b"
+    assert list(rr) == ["a", "b", "c"]  # pool iteration does not advance
+    assert rr.next_resource() == "c"
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        RoundRobin([])

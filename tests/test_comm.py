@@ -169,3 +169,51 @@ def test_initialize_multihost_single_process_noop():
     from dlaf_tpu.comm.multihost import initialize_multihost
 
     initialize_multihost()  # must not raise or disturb the backend
+
+
+# -- blocking sync tier (reference communication/sync/*.h) --------------------
+
+
+def test_sync_gather_matches_to_numpy(devices8):
+    from dlaf_tpu.comm import sync as cs
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((20, 12))
+    mat = Matrix.from_global(a, TileElementSize(4, 4), grid=Grid(2, 4))
+    np.testing.assert_array_equal(cs.gather(mat), a)
+    # to_numpy IS the sync tier (the reference's tests go through sync:: too)
+    np.testing.assert_array_equal(mat.to_numpy(), a)
+
+
+def test_sync_gather_shards_covers_every_device(devices8):
+    from dlaf_tpu.comm import sync as cs
+
+    g = Grid(2, 4)
+    x = jax.device_put(np.arange(16.0).reshape(2, 4, 2),
+                       g.tile_sharding())
+    shards = cs.gather_shards(x)
+    assert len(shards) == 8
+    assert sum(s.size for s in shards) == x.size
+    assert cs.gather_shards(np.ones(3))[0].shape == (3,)
+
+
+def test_sync_reduce_ops(devices8):
+    from dlaf_tpu.comm import sync as cs
+
+    parts = [np.array([1.0, -2.0]), np.array([3.0, 5.0])]
+    np.testing.assert_array_equal(cs.all_reduce(parts, "sum"), [4.0, 3.0])
+    np.testing.assert_array_equal(cs.all_reduce(parts, "max"), [3.0, 5.0])
+    np.testing.assert_array_equal(cs.all_reduce(parts, "min"), [1.0, -2.0])
+    # root is a parity argument: the host plays every rank
+    np.testing.assert_array_equal(cs.reduce(parts, root=1, op="sum"), [4.0, 3.0])
+    with pytest.raises(ValueError):
+        cs.all_reduce(parts, "xor")
+
+
+def test_sync_barrier_is_hard_fence():
+    from dlaf_tpu.comm import sync as cs
+    from dlaf_tpu.common.sync import hard_fence
+
+    assert cs.barrier is hard_fence

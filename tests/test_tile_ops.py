@@ -157,6 +157,28 @@ def test_trsm(dtype, side, uplo, op, diag):
     np.testing.assert_allclose(residual, np.zeros_like(b), **_tol(dtype))
 
 
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("side", ["L", "R"])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("op", ["N", "T", "C"])
+def test_trsm_recursive_matches_native(monkeypatch, dtype, side, uplo, op):
+    """The recursive blocked solve (large-n memory/MXU path) must agree with
+    the native lowering on every side/uplo/op combo."""
+    monkeypatch.setattr(tb, "TRSM_RECURSE_MIN", 48)
+    rng = np.random.default_rng(11)
+    n, m = 160, 96  # non-power-of-two, crosses several recursion levels
+    adim = n if side == "L" else m
+    a = rand(rng, (adim, adim), dtype)
+    a = a + adim * np.eye(adim, dtype=dtype)
+    b = rand(rng, (n, m), dtype)
+    out = np.asarray(tb.trsm(side, uplo, op, "N", jnp.asarray(a),
+                             jnp.asarray(b), alpha=0.5))
+    t = np.tril(a) if uplo == "L" else np.triu(a)
+    ot = np_op(t, op)
+    residual = (ot @ out if side == "L" else out @ ot) - 0.5 * b
+    np.testing.assert_allclose(residual, np.zeros_like(b), **_tol(dtype))
+
+
 # -- lapack tile ops --------------------------------------------------------
 
 @pytest.mark.parametrize("dtype", DTYPES)
