@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the accelerator every PERIOD seconds; the moment a
+# probe succeeds, run the one-shot measurement session (scripts/tpu_session.sh)
+# and exit. The v5e tunnel has shown short healthy windows between long
+# wedges (docs/BENCH_LOG_r2.md); this catches the next window unattended.
+#
+#   OUT=/tmp/tpu_session_X PERIOD=600 MAX_HOURS=10 bash scripts/tpu_watch.sh
+
+set -u
+cd "$(dirname "$0")/.."
+PERIOD=${PERIOD:-600}
+MAX_HOURS=${MAX_HOURS:-10}
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  echo "probe $(date -u +%H:%M:%S)" >&2
+  if timeout 150 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "tunnel healthy at $(date -u +%H:%M:%S); starting session" >&2
+    exec bash scripts/tpu_session.sh
+  fi
+  # kill any probe leftovers so wedged inits don't pile up
+  sleep "$PERIOD"
+done
+echo "watcher deadline reached without a healthy probe" >&2
+exit 1
