@@ -24,7 +24,7 @@ run() { # name timeout_s cmd...
 # sweep first: the knob grid + kernel micro numbers are the round's
 # decision data; the bench headline (99.8 GF/s ozaki, 2026-07-31 01:05)
 # is already recorded in .bench_history.jsonl so bench re-runs last
-run sweep 2700 python scripts/tpu_sweep.py
+run sweep 3600 python scripts/tpu_sweep.py
 
 # BASELINE configs #2-#4, single-chip local forms (the multi-chip grids in
 # BASELINE.json need hardware this environment does not expose; the local
@@ -33,6 +33,12 @@ run sweep 2700 python scripts/tpu_sweep.py
 # miniapp_reduction_to_band.cpp)
 run trsm_d_8192 1800 python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
     -m 8192 -n 8192 -b 256 --nruns 3 --nwarmups 1
+# same solve with the bulk gemms of the recursive blocked trsm routed
+# through the error-free int8 MXU path (f64-grade accuracy — see
+# config.f64_gemm; --check-result verifies on hardware)
+run trsm_d_8192_mxu 1800 env DLAF_F64_GEMM=mxu \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -n 8192 -b 256 --nruns 3 --nwarmups 1 --check-result last
 run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
     -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
 run red2band_d_16384 2400 python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \

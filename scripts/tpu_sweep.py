@@ -9,13 +9,16 @@ Measures, on the real accelerator with the fenced protocol
    and the slice-count knob (8 vs 7);
 2. full miniapp_cholesky (N=4096 nb=256, BASELINE config #1) across the
    knob grid {ozaki_impl: jnp|pallas} x {f64_gemm_slices: 8|7};
-3. an N-sweep (4096 / 8192) of the winning configuration so amortization
-   of the panel-latency chain is visible;
-4. the panel-latency chain itself: potrf_refined / tri_inv_refined /
-   native emulated-f64 potrf / f32 potrf at nb=256.
+3. the panel-latency chain: potrf_refined / tri_inv_refined /
+   native emulated-f64 potrf / f32 potrf at nb=256;
+4. an N-sweep (4096 / 8192 / 16384, run LAST — the 16384 point compiles a
+   64-step unrolled program) of the winning configuration so amortization
+   of the panel-latency chain is visible.
 
-Writes one JSON document (stdout) and a human table (stderr). Each phase
-is independently guarded so a mid-sweep wedge still reports what landed.
+Stdout gets the full JSON results document re-printed after every
+completed phase (consumers take the LAST line), so a wedge or wall-clock
+kill mid-sweep still leaves everything already measured in the artifact;
+a human table goes to stderr. Each phase is independently guarded.
 """
 
 import json
@@ -113,6 +116,7 @@ def main():
     except Exception as e:
         log(f"micro phase failed: {e!r}")
     log(f"micro: {json.dumps(results['micro'], default=float)}")
+    print(json.dumps(results, default=float), flush=True)
 
     # -- 2. full cholesky knob grid ----------------------------------------
     from dlaf_tpu.algorithms.cholesky import cholesky
@@ -160,18 +164,9 @@ def main():
     results["cholesky"]["best"] = (
         {"impl": best_cfg[0], "slices": best_cfg[1], "gflops": best_g}
         if best_cfg else None)
+    print(json.dumps(results, default=float), flush=True)
 
-    # -- 3. N-sweep of the winner ------------------------------------------
-    if best_cfg:
-        for nn in (4096, 8192):
-            try:
-                t, g = chol_time(nn, nb, *best_cfg)
-                results["nsweep"][str(nn)] = {"t": t, "gflops": g}
-                log(f"nsweep N={nn}: {t:.4f}s {g:.1f} GF/s")
-            except Exception as e:
-                log(f"nsweep N={nn} failed: {e!r}")
-
-    # -- 4. panel-latency chain --------------------------------------------
+    # -- 3. panel-latency chain --------------------------------------------
     try:
         from jax import lax
 
@@ -222,8 +217,19 @@ def main():
                 config.initialize()
     except Exception as e:
         log(f"panel phase failed: {e!r}")
-
     print(json.dumps(results, default=float), flush=True)
+
+    # -- 4. N-sweep of the winner (LAST: the 16384 point compiles a
+    # 64-step unrolled program and may eat the remaining wall-clock) ------
+    if best_cfg:
+        for nn in (4096, 8192, 16384):
+            try:
+                t, g = chol_time(nn, nb, *best_cfg)
+                results["nsweep"][str(nn)] = {"t": t, "gflops": g}
+                log(f"nsweep N={nn}: {t:.4f}s {g:.1f} GF/s")
+            except Exception as e:
+                log(f"nsweep N={nn} failed: {e!r}")
+            print(json.dumps(results, default=float), flush=True)
 
 
 if __name__ == "__main__":

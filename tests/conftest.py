@@ -24,6 +24,16 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# The suite is XLA-compile-dominated (the 30 slowest tests are 5-30 s of
+# compile each); persist compiled programs across test sessions like the
+# bench/product path does (bench.py _cache_dir -> the
+# config.compilation_cache_dir knob). Cache key includes platform +
+# device count, so TPU/product entries never collide with these.
+_cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 
