@@ -205,8 +205,8 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
 
         from .pallas_ozaki import fused_slice_syrk
 
-        # triangular-grid kernel: only lower-triangle tiles computed,
-        # mirrored here (halves the MXU work vs the general kernel)
+        # predicated square grid: strictly-upper tiles skip their MXU
+        # dots, mirrored here (halves the MXU work vs the general kernel)
         hi, lo = fused_slice_syrk(jnp.stack(ia),
                                   interpret=jax.default_backend() == "cpu")
         acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)

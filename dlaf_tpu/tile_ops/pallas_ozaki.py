@@ -19,11 +19,17 @@ default 256-blocks and s=8 that is 4 MiB of slices + ~0.75 MiB accumulators
 at K=1024 (~4.75 MiB total); the wrapper falls back to the jnp path beyond
 ``K_MAX``.
 
-:func:`fused_slice_syrk` is the symmetric variant: a *triangular* grid
-(linear pair index decoded through scalar-prefetched (i, j) lookup tables,
-``pltpu.PrefetchScalarGridSpec``) computes only the lower-triangle output
-tiles — halving the MXU work of the general kernel for the Cholesky
-trailing update; the caller mirrors the strict lower triangle.
+:func:`fused_slice_syrk` is the symmetric variant: a square tile grid
+whose strictly-upper cells are predicated off (``pl.when`` on the program
+ids) so only lower-triangle output tiles run their MXU dots — halving the
+MXU work of the general kernel for the Cholesky trailing update; the
+caller mirrors the strict lower triangle. (An earlier triangular-grid
+form drove the block index maps through scalar-prefetched (i, j) lookup
+tables; the v5e tunnel's chipless AOT Mosaic compiler cannot legalize
+SMEM loads inside index-map functions — observed 2026-07-31 — so the
+predicated square grid, whose index maps are pure program-id arithmetic,
+is the portable design. Dead cells still pay their block fetch, not
+their dots.)
 
 Status: validated in interpret mode (CPU CI); MXU-hardware timing pending —
 this is the designated next perf lever for the trailing update (the int8
@@ -34,8 +40,6 @@ GF/s effective; the gap is intermediate traffic this kernel removes).
 from __future__ import annotations
 
 import functools
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -138,9 +142,10 @@ MASKED_MB_MAX = 256
 
 def _make_masked_kernel(s: int):
     def kernel(mode_ref, ia_ref, ib_ref, hi_ref, lo_ref):
-        r = pl.program_id(0)
-        c = pl.program_id(1)
-        mode = mode_ref[r, c]
+        # (1, 1) SMEM block selected by the grid step: the load is at a
+        # static index (dynamic SMEM indexing does not legalize on the
+        # chipless AOT Mosaic path)
+        mode = mode_ref[0, 0]
 
         @pl.when(mode == 0)
         def _():
@@ -183,7 +188,8 @@ def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
         _make_masked_kernel(s),
         grid=(R, C),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                   # mode
+            pl.BlockSpec((1, 1), lambda r, c: (r, c),
+                         memory_space=pltpu.SMEM),                   # mode
             pl.BlockSpec((s, None, bm, k), lambda r, c: (0, r, 0, 0)),
             pl.BlockSpec((s, None, bn, k), lambda r, c: (0, c, 0, 0)),
         ],
@@ -198,11 +204,21 @@ def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
 
 
 def _make_syrk_kernel(s: int):
-    def kernel(i_idx, j_idx, ia_ref, ja_ref, hi_ref, lo_ref):
-        del i_idx, j_idx  # consumed by the index maps
-        # rhs blocks are (BN, K) row blocks of the SAME operand: contract
-        # the K axes directly (no transposed copy)
-        _fold_body(s, ia_ref, ja_ref, hi_ref, lo_ref, rhs_contract=1)
+    def kernel(ia_ref, ja_ref, hi_ref, lo_ref):
+        r = pl.program_id(0)
+        c = pl.program_id(1)
+
+        @pl.when(c > r)
+        def _():
+            # strictly-upper tile: mirrored by the caller, never computed
+            hi_ref[...] = jnp.zeros_like(hi_ref)
+            lo_ref[...] = jnp.zeros_like(lo_ref)
+
+        @pl.when(c <= r)
+        def _():
+            # rhs blocks are (BN, K) row blocks of the SAME operand:
+            # contract the K axes directly (no transposed copy)
+            _fold_body(s, ia_ref, ja_ref, hi_ref, lo_ref, rhs_contract=1)
 
     return kernel
 
@@ -214,9 +230,11 @@ def fused_slice_syrk(ia, *, block: int = 256, interpret: bool = False):
 
     Returns ``(hi, lo)`` float32 (M, M) pairs whose LOWER triangle (block
     diagonal included, full blocks) is valid; tiles strictly above the
-    block diagonal are never computed — the caller mirrors:
-    ``C = tril(H) + tril(H, -1).T``. Halves the MXU work of
-    :func:`fused_slice_product` for syrk-shaped uses.
+    block diagonal skip their MXU dots (``pl.when`` predication on the
+    program ids) — the caller mirrors: ``C = tril(H) + tril(H, -1).T``.
+    Halves the MXU work of :func:`fused_slice_product` for syrk-shaped
+    uses; see the module docstring for why the grid is a predicated
+    square rather than a scalar-prefetched triangle.
     """
     s, m, k = ia.shape
     assert k <= K_MAX, f"fused kernel contraction depth {k} > {K_MAX}"
@@ -225,28 +243,17 @@ def fused_slice_syrk(ia, *, block: int = 256, interpret: bool = False):
         ia = jnp.pad(ia, ((0, 0), (0, pm), (0, 0)))
     mp = m + pm
     nt = mp // block
-    # linear lower-triangle pair index -> (i, j), scalar-prefetched so the
-    # block index maps can look it up per grid step
-    ii, jj = np.tril_indices(nt)
-    i_idx = jnp.asarray(ii, dtype=jnp.int32)
-    j_idx = jnp.asarray(jj, dtype=jnp.int32)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(len(ii),),
-        in_specs=[
-            pl.BlockSpec((s, block, k), lambda p, i_r, j_r: (0, i_r[p], 0)),
-            pl.BlockSpec((s, block, k), lambda p, i_r, j_r: (0, j_r[p], 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((block, block), lambda p, i_r, j_r: (i_r[p], j_r[p])),
-            pl.BlockSpec((block, block), lambda p, i_r, j_r: (i_r[p], j_r[p])),
-        ),
-    )
     hi, lo = pl.pallas_call(
         _make_syrk_kernel(s),
         out_shape=(jax.ShapeDtypeStruct((mp, mp), jnp.float32),
                    jax.ShapeDtypeStruct((mp, mp), jnp.float32)),
-        grid_spec=grid_spec,
+        grid=(nt, nt),
+        in_specs=[
+            pl.BlockSpec((s, block, k), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((s, block, k), lambda i, j: (0, j, 0)),
+        ],
+        out_specs=(pl.BlockSpec((block, block), lambda i, j: (i, j)),
+                   pl.BlockSpec((block, block), lambda i, j: (i, j))),
         interpret=interpret,
-    )(i_idx, j_idx, ia, ia)
+    )(ia, ia)
     return hi[:m, :m], lo[:m, :m]

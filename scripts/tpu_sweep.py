@@ -5,8 +5,8 @@ Measures, on the real accelerator with the fenced protocol
 (``dlaf_tpu/common/sync.py``):
 
 1. trailing-update microkernels at the N=4096 hot shape (m=3840, k=256):
-   jnp ozaki syrk vs the fused Pallas triangular-grid syrk, matmul forms,
-   and the slice-count knob (8 vs 7);
+   jnp ozaki syrk vs the fused Pallas predicated-square-grid syrk, matmul
+   forms, and the slice-count knob (8 vs 7);
 2. full miniapp_cholesky (N=4096 nb=256, BASELINE config #1) across the
    knob grid {ozaki_impl: jnp|pallas} x {f64_gemm_slices: 8|7};
 3. the panel-latency chain: potrf_refined / tri_inv_refined /
@@ -95,24 +95,38 @@ def main():
             sa = oz._scale(x, axis=-1)
             return jnp.stack(oz._peel_slices(oz._normalize(x, sa), s)), sa
 
+        # each pallas kernel timed under its own guard: a Mosaic
+        # legalization failure in one form must not cost the others'
+        # measurements (observed 2026-07-31: the scalar-prefetch syrk
+        # failed AOT compile and took the whole phase down with it)
         for s in (8, 7):
             ia, _ = peel(a, s)
             ib, _ = peel(b.T, s)  # (s, m, k); product form wants (s,k,n)
             ibt = jnp.swapaxes(ib, -1, -2)
-            t = best_time(lambda x: fused_slice_syrk(x), ia)
-            results["micro"][f"syrk_pallas_s{s}"] = {
-                "t": t, "gflops": flops_syrk / t / 1e9}
-            t = best_time(lambda x, y: fused_slice_product(x, y), ia, ibt)
-            results["micro"][f"matmul_pallas_s{s}"] = {
-                "t": t, "gflops": flops_mm / t / 1e9}
+            try:
+                t = best_time(lambda x: fused_slice_syrk(x), ia)
+                results["micro"][f"syrk_pallas_s{s}"] = {
+                    "t": t, "gflops": flops_syrk / t / 1e9}
+            except Exception as e:
+                log(f"micro syrk_pallas_s{s} failed: {e!r}"[:500])
+            try:
+                t = best_time(lambda x, y: fused_slice_product(x, y), ia, ibt)
+                results["micro"][f"matmul_pallas_s{s}"] = {
+                    "t": t, "gflops": flops_mm / t / 1e9}
+            except Exception as e:
+                log(f"micro matmul_pallas_s{s} failed: {e!r}"[:500])
         # end-to-end syrk through the config knob (peel + kernel + mirror)
-        os.environ["DLAF_OZAKI_IMPL"] = "pallas"
-        config.initialize()
-        t = best_time(lambda x: oz.syrk_f64(x), a)
-        results["micro"]["syrk_e2e_pallas_s8"] = {
-            "t": t, "gflops": flops_syrk / t / 1e9}
-        os.environ.pop("DLAF_OZAKI_IMPL")
-        config.initialize()
+        try:
+            os.environ["DLAF_OZAKI_IMPL"] = "pallas"
+            config.initialize()
+            t = best_time(lambda x: oz.syrk_f64(x), a)
+            results["micro"]["syrk_e2e_pallas_s8"] = {
+                "t": t, "gflops": flops_syrk / t / 1e9}
+        except Exception as e:
+            log(f"micro syrk_e2e_pallas_s8 failed: {e!r}"[:500])
+        finally:
+            os.environ.pop("DLAF_OZAKI_IMPL", None)
+            config.initialize()
     except Exception as e:
         log(f"micro phase failed: {e!r}")
     log(f"micro: {json.dumps(results['micro'], default=float)}")
