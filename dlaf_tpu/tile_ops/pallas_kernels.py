@@ -30,9 +30,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _update_kernel(mode_ref, vr_ref, vc_ref, a_ref, out_ref):
-    r = pl.program_id(0)
-    c = pl.program_id(1)
-    mode = mode_ref[r, c]
+    # (1, 1) SMEM block selected by the grid step: the load is at a static
+    # index (dynamic SMEM indexing does not legalize on the chipless AOT
+    # Mosaic path — same fix as pallas_ozaki._make_masked_kernel)
+    mode = mode_ref[0, 0]
 
     @pl.when(mode == 0)
     def _():
@@ -65,7 +66,8 @@ def masked_trailing_update(a, vr, vc, mode, *, interpret: bool = False):
         _update_kernel,
         grid=(R, C),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),                 # mode
+            pl.BlockSpec((1, 1), lambda r, c: (r, c),
+                         memory_space=pltpu.SMEM),                 # mode
             pl.BlockSpec((1, nb, nb), lambda r, c: (r, 0, 0)),     # vr
             pl.BlockSpec((1, nb, nb), lambda r, c: (c, 0, 0)),     # vc
             pl.BlockSpec((1, 1, nb, nb), lambda r, c: (r, c, 0, 0)),

@@ -102,7 +102,7 @@ def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
     ``ia``: (s, M, K) int8 slices of the normalized A; ``ib``: (s, K, N) of
     B. Returns ``(hi, lo)`` float32 arrays with
     ``hi + lo ~= sum_{t+u=d<s} 2^(-q(d+2)) IA_t @ IB_u``
-    (the caller applies ``*4*sa*sb`` in f64, as :func:`ozaki._recombine`).
+    (the caller applies ``*4*sa*sb`` in f64, as :func:`ozaki._apply_scales`).
     M/N are padded to block multiples internally.
     """
     s, m, k = ia.shape
@@ -175,7 +175,7 @@ def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
     (s, C, bn, k) of the column-side tiles (both contract their LAST axis);
     ``mode``: (R, C) int32. Returns ``(hi, lo)`` float32 (R, C, bm, bn)
     with ``hi + lo ~= sum_d 2^(-q(d+2)) IA_t @ IB_u^T``; the caller applies
-    ``*4*sa*sb`` in f64 and its element masks, as :func:`ozaki._recombine`.
+    ``*4*sa*sb`` in f64 and its element masks, as :func:`ozaki._apply_scales`.
     """
     s, R, bm, k = ia.shape
     C, bn = ib.shape[1], ib.shape[2]

@@ -1,0 +1,60 @@
+"""Shared measurement protocol for the hardware scripts.
+
+The fenced ``best_time`` here is the measurement contract the bench
+artifacts cite (BASELINE.md): 1 warmup (compile) + ``REPS`` timed
+iterations, each bounded by :func:`dlaf_tpu.common.sync.hard_fence`
+(``block_until_ready`` alone is not a reliable barrier through
+tunnel-proxied PJRT backends). Scripts must share this module rather
+than copying it so the protocol cannot drift between artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPS = int(os.environ.get("DLAF_SWEEP_REPS", "4"))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def setup_env():
+    """x64 + persistent compile cache; returns the jax module."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR",
+                          os.path.join(repo_root(), ".jax_cache"))
+    return jax
+
+
+def best_time(fn, *args, reps: int = None):
+    """min over ``reps`` fenced timings after one warmup call."""
+    from dlaf_tpu.common.sync import hard_fence
+
+    out = fn(*args)
+    hard_fence(*(out if isinstance(out, tuple) else (out,)))
+    times = []
+    for _ in range(REPS if reps is None else reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        hard_fence(*(out if isinstance(out, tuple) else (out,)))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def peel(x, s: int):
+    """Stacked int8 Ozaki slices + the row scale (micro-kernel input)."""
+    import jax.numpy as jnp
+
+    from dlaf_tpu.tile_ops import ozaki as oz
+
+    sa = oz._scale(x, axis=-1)
+    return jnp.stack(oz._peel_slices(oz._normalize(x, sa), s)), sa

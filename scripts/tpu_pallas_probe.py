@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Targeted hardware probe for the FIXED Pallas Ozaki kernels.
+
+The 2026-07-31 sweep session ran the pre-fix kernels: the scalar-prefetch
+syrk failed Mosaic AOT legalization and took the pallas cholesky variants
+down with it. This probe times the rewritten kernels (predicated square
+grid; static-index SMEM mode blocks) in isolation and then the full
+config-#1 cholesky under ``ozaki_impl=pallas`` — the designated lever for
+the trailing update, whose jnp form is bound by the per-shift int32
+intermediates it writes to HBM.
+
+Run only on an otherwise-idle container: host contention inflates the
+fenced timings (observed: a concurrent pytest run cost config #1 ~8%).
+
+Usage: python scripts/tpu_pallas_probe.py [out.json]
+Each step is guarded; the results document is re-printed to stdout after
+every step so a wedge keeps everything already measured.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from measure_common import best_time, log, peel, setup_env  # noqa: E402
+
+
+def main():
+    jax = setup_env()
+    import jax.numpy as jnp
+
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    log(f"platform: {jax.devices()[0].platform}, devices: {jax.devices()}")
+    results = {"platform": jax.devices()[0].platform, "kernels": {},
+               "cholesky": {}}
+
+    def emit():
+        print(json.dumps(results, default=float), flush=True)
+
+    from dlaf_tpu.tile_ops.pallas_ozaki import (fused_slice_product,
+                                                fused_slice_syrk,
+                                                masked_slice_product)
+
+    m, k = 3840, 256
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((m, k)))
+    b = jnp.asarray(rng.standard_normal((k, m)))
+    flops_syrk = m * m * k
+    flops_mm = 2 * m * m * k
+
+    for s in (8, 7):
+        ia, _ = peel(a, s)
+        ib, _ = peel(b.T, s)
+        ibt = jnp.swapaxes(ib, -1, -2)
+        for name, fn, args, fl in [
+                (f"syrk_pallas_s{s}", lambda x: fused_slice_syrk(x), (ia,),
+                 flops_syrk),
+                (f"matmul_pallas_s{s}",
+                 lambda x, y: fused_slice_product(x, y), (ia, ibt), flops_mm)]:
+            try:
+                t = best_time(fn, *args)
+                results["kernels"][name] = {"t": t, "gflops": fl / t / 1e9}
+                log(f"{name}: {t:.4f}s {fl / t / 1e9:.1f} GF/s")
+            except Exception as e:
+                log(f"{name} FAILED: {e!r}"[:600])
+            emit()
+
+    # the distributed trailing form (per-tile-pair predication)
+    try:
+        s = 8
+        R = m // k
+        ia, _ = peel(a, s)
+        iat = ia.reshape(s, R, k, k)
+        mode = jnp.asarray(np.tril(np.ones((R, R), np.int32)))
+        t = best_time(lambda x, md: masked_slice_product(x, x, md), iat, mode)
+        useful = (R * (R + 1) // 2) * (2 * k**3)
+        results["kernels"]["masked_pallas_s8"] = {
+            "t": t, "gflops": useful / t / 1e9}
+        log(f"masked_pallas_s8: {t:.4f}s {useful / t / 1e9:.1f} GF/s")
+    except Exception as e:
+        log(f"masked_pallas_s8 FAILED: {e!r}"[:600])
+    emit()
+
+    # full config #1 under the pallas impl, with the miniapp's residual
+    # check (the pallas fold carries ~48 bits; hardware must confirm the
+    # factorization still meets the f64 algorithm budget before the knob
+    # can be promoted)
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.types import total_ops
+
+    n, nb = 4096, 256
+    for impl, s in (("pallas", 8), ("pallas", 7), ("jnp", 7)):
+        key = f"impl={impl},slices={s}"
+        os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
+        os.environ["DLAF_OZAKI_IMPL"] = impl
+        os.environ["DLAF_F64_GEMM_SLICES"] = str(s)
+        config.initialize()
+        try:
+            ref = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.float64)
+
+            def run(st):
+                return cholesky("L", ref.with_storage(st)).storage
+
+            t = best_time(run, ref.storage + 0)
+            g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
+            # residual check |A - L L^H| / |A| on the last result (same
+            # criterion as miniapp_cholesky --check-result)
+            lfac = np.tril(np.asarray(
+                ref.with_storage(run(ref.storage + 0)).to_numpy()))
+            aref = np.asarray(ref.to_numpy())
+            ah = np.tril(aref) + np.tril(aref, -1).T
+            resid = (np.linalg.norm(lfac @ lfac.T - ah)
+                     / np.linalg.norm(ah))
+            tol = 60 * n * np.finfo(np.float64).eps
+            ok = bool(resid < tol)
+            results["cholesky"][key] = {"t": t, "gflops": g,
+                                        "residual": resid, "check": ok}
+            log(f"cholesky N={n} {key}: {t:.4f}s {g:.1f} GF/s "
+                f"residual={resid:.3e} ({'PASS' if ok else 'FAIL'})")
+        except Exception as e:
+            log(f"cholesky {key} FAILED: {e!r}"[:600])
+        finally:
+            for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",
+                       "DLAF_F64_GEMM_SLICES"):
+                os.environ.pop(k_, None)
+            config.initialize()
+        emit()
+
+    path = sys.argv[1] if len(sys.argv) > 1 else None
+    if path:
+        with open(path, "w") as f:
+            json.dump(results, f, default=float)
+        log(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,50 +24,29 @@ a human table goes to stderr. Each phase is independently guarded.
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 # run as `python scripts/tpu_sweep.py`: sys.path[0] is scripts/, not the
 # repo root — put the package dir on the path before any dlaf_tpu import
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-REPS = int(os.environ.get("DLAF_SWEEP_REPS", "4"))
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+from measure_common import best_time, log, peel  # noqa: E402
+from measure_common import setup_env  # noqa: E402
 
 
 def main():
-    import jax
+    jax = setup_env()
     import jax.numpy as jnp
 
-    jax.config.update("jax_enable_x64", True)
-    os.environ.setdefault(
-        "DLAF_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".jax_cache"))
-
     import dlaf_tpu.config as config
-    from dlaf_tpu.common.sync import hard_fence
 
     config.initialize()
     platform = jax.devices()[0].platform
     log(f"platform: {platform}, devices: {jax.devices()}")
     results = {"platform": platform, "micro": {}, "cholesky": {},
                "nsweep": {}, "panel": {}}
-
-    def best_time(fn, *args):
-        out = fn(*args)
-        hard_fence(*(out if isinstance(out, tuple) else (out,)))
-        times = []
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            out = fn(*args)
-            hard_fence(*(out if isinstance(out, tuple) else (out,)))
-            times.append(time.perf_counter() - t0)
-        return min(times)
 
     # -- 1. trailing-update microkernels -----------------------------------
     try:
@@ -91,10 +70,6 @@ def main():
                 "t": t, "gflops": flops_mm / t / 1e9}
 
         # pallas fused kernels on pre-peeled slices (isolates kernel cost)
-        def peel(x, s):
-            sa = oz._scale(x, axis=-1)
-            return jnp.stack(oz._peel_slices(oz._normalize(x, sa), s)), sa
-
         # each pallas kernel timed under its own guard: a Mosaic
         # legalization failure in one form must not cost the others'
         # measurements (observed 2026-07-31: the scalar-prefetch syrk
