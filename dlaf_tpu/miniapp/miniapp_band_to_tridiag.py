@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..eigensolver.band_to_tridiag import band_to_tridiag
 from ..types import total_ops, type_letter
@@ -79,10 +80,10 @@ def check(band, b, res, n) -> None:
     w_ref = np.linalg.eigvalsh(a)
     w_tri = sla.eigvalsh_tridiagonal(res.d, res.e)
     resid = np.abs(w_ref - w_tri).max() / max(np.abs(w_ref).max(), 1e-30)
-    eps = np.finfo(np.float64).eps
+    eps, eps_label = checks.effective_eps(np.float64)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

@@ -19,6 +19,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..common.sync import hard_fence
 from ..common.index2d import TileElementSize
@@ -91,10 +92,10 @@ def check(tri, e0, out) -> None:
     qe = qmat @ np.asarray(e0, dtype=out.dtype)
     got = out.to_numpy()
     resid = np.linalg.norm(got - qe) / max(np.linalg.norm(qe), 1e-30)
-    eps = np.finfo(np.dtype(out.dtype).type(0).real.dtype).eps
+    eps, eps_label = checks.effective_eps(out.dtype)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

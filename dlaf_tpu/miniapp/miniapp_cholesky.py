@@ -20,6 +20,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..common.sync import hard_fence
 from ..algorithms.cholesky import cholesky
@@ -100,7 +101,7 @@ def check_cholesky(uplo: str, ref: Matrix, out: Matrix) -> None:
     a = ref.to_numpy()
     f = out.to_numpy()
     n = a.shape[0]
-    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    eps, eps_label = checks.effective_eps(a.dtype)
     if uplo == "L":
         l = np.tril(f)
         resid = np.linalg.norm(l @ l.conj().T - a) / np.linalg.norm(a)
@@ -109,7 +110,7 @@ def check_cholesky(uplo: str, ref: Matrix, out: Matrix) -> None:
         resid = np.linalg.norm(u.conj().T @ u - a) / np.linalg.norm(a)
     tol = 60 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..common.sync import hard_fence
 from ..common.index2d import GlobalElementSize, TileElementSize
@@ -128,10 +129,10 @@ def check(args, am, bm, res) -> None:
     else:
         resid = np.linalg.norm(afull @ q - q * lam[None, :])
         resid /= max(np.linalg.norm(afull), 1e-30)
-    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    eps, eps_label = checks.effective_eps(a.dtype)
     tol = 200 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..common.sync import hard_fence
 from ..algorithms.cholesky import cholesky
@@ -95,10 +96,10 @@ def check(uplo, am, bf, out) -> None:
         cf = np.triu(c) + np.triu(c, 1).conj().T
         resid = np.linalg.norm(u.conj().T @ cf @ u - _hermfull(a, "U"))
     resid /= max(np.linalg.norm(a), 1e-30)
-    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    eps, eps_label = checks.effective_eps(a.dtype)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

@@ -18,6 +18,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..common.sync import hard_fence
 from ..comm.grid import Grid
@@ -97,10 +98,10 @@ def check(ref, red, n, band) -> None:
     w1 = np.linalg.eigvalsh(bd)
     w2 = np.linalg.eigvalsh(a)
     resid = np.abs(w1 - w2).max() / max(np.abs(w2).max(), 1e-30)
-    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    eps, eps_label = checks.effective_eps(a.dtype)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from . import checks
 from .. import config
 from ..common.sync import hard_fence
 from ..algorithms.triangular import triangular_solve
@@ -105,10 +106,10 @@ def check(args, am: Matrix, bm: Matrix, out: Matrix) -> None:
     b = bm.to_numpy()
     resid = np.linalg.norm((t @ x if args.side == "L" else x @ t) - b) \
         / max(np.linalg.norm(b), 1e-30)
-    eps = np.finfo(np.dtype(a.dtype).type(0).real.dtype).eps
+    eps, eps_label = checks.effective_eps(a.dtype)
     tol = 60 * max(args.m, args.n) * eps
     status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}", flush=True)
+    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
     if resid >= tol:
         sys.exit(1)
 

@@ -169,7 +169,7 @@ def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
         hi, lo = fused_slice_product(jnp.stack(ia), jnp.stack(ib),
                                      interpret=jax.default_backend() == "cpu")
         acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)
-        return ((acc * 4.0) * sa) * sb
+        return _apply_scales(acc, sa, sb)
     # int32 group sums stay exact while (d+1) * k * 2^12 < 2^31
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     acc = None
@@ -216,7 +216,7 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
                                   interpret=jax.default_backend() == "cpu")
         acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)
         acc = jnp.tril(acc) + jnp.swapaxes(jnp.tril(acc, -1), -1, -2)
-        return ((acc * 4.0) * sa) * jnp.swapaxes(sa, -1, -2)
+        return _apply_scales(acc, sa, jnp.swapaxes(sa, -1, -2))
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     cast = (lambda x: x) if exact_i32 else (lambda x: x.astype(jnp.float64))
     acc = None

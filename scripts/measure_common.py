@@ -35,8 +35,10 @@ def setup_env():
     return jax
 
 
-def best_time(fn, *args, reps: int = None):
-    """min over ``reps`` fenced timings after one warmup call."""
+def best_time(fn, *args, reps: int = None, return_last: bool = False):
+    """min over ``reps`` fenced timings after one warmup call.
+    ``return_last=True`` returns ``(t, out)`` with the last run's output,
+    so callers that also validate the result don't pay an extra run."""
     from dlaf_tpu.common.sync import hard_fence
 
     out = fn(*args)
@@ -47,7 +49,7 @@ def best_time(fn, *args, reps: int = None):
         out = fn(*args)
         hard_fence(*(out if isinstance(out, tuple) else (out,)))
         times.append(time.perf_counter() - t0)
-    return min(times)
+    return (min(times), out) if return_last else min(times)
 
 
 def peel(x, s: int):

@@ -112,17 +112,19 @@ def main():
             def run(st):
                 return cholesky("L", ref.with_storage(st)).storage
 
-            t = best_time(run, ref.storage + 0)
+            t, last = best_time(run, ref.storage + 0, return_last=True)
             g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
-            # residual check |A - L L^H| / |A| on the last result (same
-            # criterion as miniapp_cholesky --check-result)
+            # residual check |A - L L^H| / |A| on the last timed result
+            # (same criterion as miniapp_cholesky --check-result)
             lfac = np.tril(np.asarray(
-                ref.with_storage(run(ref.storage + 0)).to_numpy()))
+                ref.with_storage(last).to_numpy()))
             aref = np.asarray(ref.to_numpy())
             ah = np.tril(aref) + np.tril(aref, -1).T
             resid = (np.linalg.norm(lfac @ lfac.T - ah)
                      / np.linalg.norm(ah))
-            tol = 60 * n * np.finfo(np.float64).eps
+            from dlaf_tpu.miniapp.checks import effective_eps
+            eps, _ = effective_eps(np.float64)
+            tol = 60 * n * eps
             ok = bool(resid < tol)
             results["cholesky"][key] = {"t": t, "gflops": g,
                                         "residual": resid, "check": ok}

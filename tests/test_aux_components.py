@@ -64,6 +64,28 @@ def test_tpu_info():
     assert all(d.platform == "cpu" for d in devs)
 
 
+def test_effective_eps_platform_calibration(monkeypatch):
+    """Residual-check eps: true dtype eps off-TPU; the double-f32
+    emulation eps (2^-47, labeled) for 64-bit dtypes on TPU, where no
+    code path can deliver 2^-53-grade results (miniapp/checks.py)."""
+    from dlaf_tpu.miniapp import checks
+
+    # CPU backend (this suite): nothing widened, no label
+    for dt in (np.float32, np.float64, np.complex128):
+        eps, label = checks.effective_eps(dt)
+        assert eps == np.finfo(np.dtype(dt).type(0).real.dtype).eps
+        assert label == ""
+
+    monkeypatch.setattr(checks, "f64_is_emulated", lambda: True)
+    eps, label = checks.effective_eps(np.float64)
+    assert eps == checks.EMULATED_F64_EPS and "2^-47" in label
+    eps_c, label_c = checks.effective_eps(np.complex128)
+    assert eps_c == checks.EMULATED_F64_EPS and label_c == label
+    # f32 is native on TPU: untouched even when f64 is emulated
+    eps32, label32 = checks.effective_eps(np.float32)
+    assert eps32 == np.finfo(np.float32).eps and label32 == ""
+
+
 def test_miniapp_kernel_and_band():
     from dlaf_tpu.miniapp.miniapp_kernel import run as krun
 
