@@ -149,14 +149,15 @@ def run_variant() -> None:
             "dtype": np.dtype(dtype).name, "n": n, "nb": nb,
             "gflops": round(best_g, 2), "t": best_t,
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
-    try:
-        # append-only measurement log: tunnel wedges must never cost an
-        # already-landed hardware number (BASELINE.md cites this file)
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               ".bench_history.jsonl"), "a") as f:
-            f.write(json.dumps(line) + "\n")
-    except OSError as e:
-        log(f"history append failed: {e!r}")
+    # append-only measurement log: tunnel wedges must never cost an
+    # already-landed hardware number (BASELINE.md cites this file).
+    # measure_common.append_history is the single schema owner.
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from measure_common import append_history
+
+    append_history(platform, n, nb, best_g, best_t, source="bench.py",
+                   variant=variant, dtype=np.dtype(dtype).name)
     print(json.dumps(line), flush=True)
 
 

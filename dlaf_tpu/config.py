@@ -77,13 +77,17 @@ class Configuration:
     #: the native path is kept.
     f64_gemm_min_dim: int = 128
     #: int8 slices per operand on the MXU f64 path (tile_ops/ozaki.py):
-    #: 8 (56 mantissa bits, f64-grade, 36 gemms per product) down to e.g.
-    #: 7 (49 bits, 28 gemms) when the application's accuracy budget allows.
-    f64_gemm_slices: int = 8
+    #: 8 (56 mantissa bits, 36 gemms per product) down to e.g. 7 (49 bits,
+    #: 28 gemms). 0 = auto: 7 on backends whose f64 is the double-f32
+    #: emulation (TPU — its ~47-48-bit arithmetic already bounds every
+    #: combine/panel op, so the 49-bit dot sacrifices nothing and saves
+    #: ~22% of the MXU work; measured 103.9 vs 95.5 GF/s on config #1,
+    #: 2026-07-31 v5e session), 8 where f64 is native (f64-grade dots).
+    f64_gemm_slices: int = 0
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
-    #: full-f64 combine — exactly f64-grade) or "pallas" (fused per-tile
-    #: kernel, double-f32 fold: ~48 mantissa bits, no intermediate HBM
-    #: traffic; see tile_ops/pallas_ozaki.py).
+    #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
+    #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
+    #: no intermediate HBM traffic; see tile_ops/pallas_ozaki.py).
     ozaki_impl: str = "jnp"
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
     #: under TPU f64 emulation) or "mixed" (f32 seed + Newton refinement,
@@ -182,8 +186,9 @@ def _validate(cfg: Configuration) -> None:
         v = getattr(cfg, name)
         if v not in allowed:
             raise ValueError(f"configuration {name}={v!r}: must be one of {allowed}")
-    if not 1 <= cfg.f64_gemm_slices <= 9:
-        raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in [1, 9]")
+    if not 0 <= cfg.f64_gemm_slices <= 9:
+        raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in "
+                         "[1, 9], or 0 for the platform-adaptive default")
     if cfg.mixed_seed_base < 1:
         raise ValueError(f"mixed_seed_base={cfg.mixed_seed_base}: must be >= 1"
                          " (the recursive seed's leaf size)")

@@ -52,9 +52,22 @@ def _mxu_f64(*arrs, dims) -> bool:
 
 
 def _oz_slices() -> int:
+    """Resolved slice count: the configured value, or — for the 0 "auto"
+    default — 7 on f64-emulating backends (TPU: the platform's ~47-48-bit
+    double-f32 arithmetic already bounds every surrounding op, so the
+    49-bit dot loses nothing and drops 8 of 36 gemms) and 8 (f64-grade
+    dots) where f64 is native. Keyed on the PROCESS default backend: a
+    trace explicitly placed on a non-default backend (jax.default_device)
+    inherits the process tier — set the knob explicitly for that case.
+    See Configuration.f64_gemm_slices."""
     from ..config import get_configuration
 
-    return int(get_configuration().f64_gemm_slices)
+    s = int(get_configuration().f64_gemm_slices)
+    if s:
+        return s
+    import jax
+
+    return 7 if jax.default_backend() == "tpu" else 8
 
 
 def mm_mxu(a, b):

@@ -32,7 +32,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from measure_common import best_time, log, peel  # noqa: E402
+from measure_common import append_history, best_time, log, peel  # noqa: E402
 from measure_common import setup_env  # noqa: E402
 
 
@@ -146,6 +146,9 @@ def main():
                 t, g = chol_time(n, nb, impl, s)
                 results["cholesky"][key] = {"t": t, "gflops": g}
                 log(f"cholesky N={n} {key}: {t:.4f}s {g:.1f} GF/s")
+                if platform == "tpu":
+                    append_history(platform, n, nb, g, t,
+                                   f"tpu_sweep knob grid {key}")
                 if g > best_g:
                     best_g, best_cfg = g, (impl, s)
             except Exception as e:
@@ -216,6 +219,9 @@ def main():
                 t, g = chol_time(nn, nb, *best_cfg)
                 results["nsweep"][str(nn)] = {"t": t, "gflops": g}
                 log(f"nsweep N={nn}: {t:.4f}s {g:.1f} GF/s")
+                if platform == "tpu":
+                    append_history(platform, nn, nb, g, t,
+                                   "tpu_sweep N-sweep (best knobs)")
             except Exception as e:
                 log(f"nsweep N={nn} failed: {e!r}")
             print(json.dumps(results, default=float), flush=True)

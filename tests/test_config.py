@@ -39,3 +39,29 @@ def test_initialize_get_finalize():
     assert C.get_configuration() is cfg
     C.finalize()
     assert C.get_configuration() is not cfg  # re-initialized with defaults
+
+
+def test_slices_auto_default(monkeypatch):
+    """f64_gemm_slices=0 (the default) resolves per platform: 7 where f64
+    is the double-f32 emulation (TPU), 8 where it is native. Explicit
+    values are honored verbatim (config.py / blas._oz_slices)."""
+    from dlaf_tpu.tile_ops import blas
+
+    C.initialize()
+    assert C.get_configuration().f64_gemm_slices == 0
+    assert blas._oz_slices() == 8  # this suite runs on the CPU backend
+
+    import jax
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert blas._oz_slices() == 7
+
+    monkeypatch.setenv("DLAF_F64_GEMM_SLICES", "8")
+    C.initialize()
+    assert blas._oz_slices() == 8  # explicit wins on any platform
+
+    monkeypatch.setenv("DLAF_F64_GEMM_SLICES", "10")
+    import pytest
+    with pytest.raises(ValueError):
+        C.initialize()
+    monkeypatch.delenv("DLAF_F64_GEMM_SLICES")
+    C.initialize()
