@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Follow-up hardware session (2026-07-31): what the first session did not
+# land before the ~04:30 UTC tunnel wedge, reordered by value-per-minute.
+# Results land in $OUT (default /tmp/tpu_session2_<ts>/).
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-/tmp/tpu_session2_$(date +%H%M)}
+mkdir -p "$OUT"
+echo "results -> $OUT" >&2
+
+run() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%T)) ===" >&2
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  echo "=== $name rc=$? ===" >&2
+}
+
+# 1. official headline (warm cache; auto slices=7 since 2d38671)
+run bench 2700 python bench.py
+
+# 2. is complex128 usable on this backend at all? (the hegst_z failure
+# at 04:09 was concurrent with the wedge — this separates platform
+# capability from tunnel health)
+run c128_diag 300 python -c "
+import jax, numpy as np
+jax.config.update('jax_enable_x64', True)
+import jax.numpy as jnp
+print('devices:', jax.devices())
+for dt in (np.complex64, np.complex128):
+    try:
+        x = jnp.asarray(np.full((8, 8), 1 + 1j, dt))
+        y = (x @ x).block_until_ready()
+        print(dt.__name__, 'ok ->', y.dtype, np.asarray(y)[0, 0])
+    except Exception as e:
+        print(dt.__name__, 'FAIL:', repr(e)[:200])
+"
+
+# 3. fixed pallas kernels (predicated square grid, static SMEM loads)
+run pallas_probe 2400 python scripts/tpu_pallas_probe.py
+
+# 4. N=16384 cholesky after the incremental-fold liveness fix
+run chol_16384 2400 python - <<'EOF'
+import os, sys
+sys.path.insert(0, "scripts")  # cwd is the repo root (session script cd's)
+sys.path.insert(0, ".")
+from measure_common import append_history, best_time, log, setup_env
+jax = setup_env()
+import numpy as np
+import dlaf_tpu.config as config
+config.initialize()
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.miniapp.generators import hpd_element_fn
+from dlaf_tpu.types import total_ops
+os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
+config.initialize()
+n, nb = 16384, 256
+ref = Matrix.from_element_fn(hpd_element_fn(n, np.float64),
+                             GlobalElementSize(n, n),
+                             TileElementSize(nb, nb), dtype=np.float64)
+t = best_time(lambda st: cholesky("L", ref.with_storage(st)).storage,
+              ref.storage + 0)
+g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
+log(f"cholesky N={n}: {t:.4f}s {g:.1f} GF/s")
+if jax.devices()[0].platform == "tpu":
+    append_history("tpu", n, nb, g, t, "post-fix N=16384 (incremental fold)")
+EOF
+
+# 5-7. the configs the wedge ate (hegst depends on the c128 diagnosis)
+run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+run red2band_d_16384 2400 python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
+run eig_d_4096 2400 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 4096 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+echo "session2 done ($(date +%T)); summary:" >&2
+grep -h "GFlop/s\|metric\|ok ->\|FAIL" "$OUT"/*.out 2>/dev/null | tail -25 >&2

@@ -4,19 +4,21 @@
 # and exit. The v5e tunnel has shown short healthy windows between long
 # wedges (docs/BENCH_LOG_r2.md); this catches the next window unattended.
 #
-#   OUT=/tmp/tpu_session_X PERIOD=600 MAX_HOURS=10 bash scripts/tpu_watch.sh
+#   OUT=/tmp/tpu_session_X PERIOD=600 MAX_HOURS=10 \
+#     SESSION=scripts/tpu_session2.sh bash scripts/tpu_watch.sh
 
 set -u
 cd "$(dirname "$0")/.."
 PERIOD=${PERIOD:-600}
 MAX_HOURS=${MAX_HOURS:-10}
+SESSION=${SESSION:-scripts/tpu_session.sh}
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
   echo "probe $(date -u +%H:%M:%S)" >&2
   if timeout 150 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
     echo "tunnel healthy at $(date -u +%H:%M:%S); starting session" >&2
-    exec bash scripts/tpu_session.sh
+    exec bash "$SESSION"
   fi
   # kill any probe leftovers so wedged inits don't pile up
   sleep "$PERIOD"
