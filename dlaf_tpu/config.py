@@ -84,6 +84,13 @@ class Configuration:
     #: ~22% of the MXU work; measured 103.9 vs 95.5 GF/s on config #1,
     #: 2026-07-31 v5e session), 8 where f64 is native (f64-grade dots).
     f64_gemm_slices: int = 0
+    #: Slice contraction route of the jnp ozaki path: "int8" (s8 x s8 ->
+    #: s32 dot) or "bf16" (slices cast to bf16 — exact for 7-bit integers —
+    #: contracted on the MXU's native bf16 path with f32 accumulation,
+    #: integer-exact while k*2^12 <= 2^24, chunked beyond; bit-identical
+    #: results). Exists because XLA's int8 dot measured ~1% of MXU peak on
+    #: v5e while bf16 matmul is the hardware's first-class path.
+    ozaki_dot: str = "int8"
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
@@ -177,6 +184,7 @@ _VALID_CHOICES = {
     "f64_gemm": ("native", "mxu"),
     "f64_trsm": ("native", "mixed"),
     "ozaki_impl": ("jnp", "pallas"),
+    "ozaki_dot": ("int8", "bf16"),
     "mixed_seed": ("xla", "recursive"),
 }
 

@@ -96,18 +96,56 @@ def _peel_slices(xn, s: int):
 # exact while k * 2^12 < 2^31, i.e. k < 2^19; deeper contractions are chunked
 _K_I32_EXACT = 1 << 19
 _K_CHUNK = 1 << 18
+# f32 accumulation of the same products is integer-exact while
+# k * 2^12 <= 2^24, i.e. k <= 2^12 — the bound of the bf16-dot route
+_K_F32_EXACT = 1 << 12
+
+
+def _slice_dot_impl() -> str:
+    """"int8" (s8 x s8 -> s32 dot) or "bf16": cast the slices to bf16 —
+    every value is a small integer in [-2^6, 2^6], exactly representable —
+    and contract on the MXU's native bf16 path with f32 accumulation,
+    which is integer-exact while ``k * 2^12 <= 2^24`` (deeper
+    contractions are chunked). Same bits out either way; the knob exists
+    because XLA's HLO-level int8 dot has measured far below MXU peak on
+    v5e (~1-4.5 TF/s-int8) while bf16 matmul is the hardware's first-class
+    path (config ``ozaki_dot``)."""
+    from ..config import get_configuration
+
+    return get_configuration().ozaki_dot
+
+
+def _dot_bf16(ia, ib):
+    """Exact slice contraction over the native bf16 MXU path: bf16
+    operands (exact for 7-bit slices), f32 accumulation (exact while
+    ``k * 2^12 <= 2^24``), int32 result (each f32 partial is an integer
+    below 2^24, so the cast is exact)."""
+    k = ia.shape[-1]
+    # single chunk for k <= 2^12; int32 chunk sums stay exact up to
+    # 2^31 / 2^24 = 128 chunks, i.e. k < 2^19 — callers route deeper
+    # contractions to the int8 path
+    acc = None
+    for s0 in range(0, k, _K_F32_EXACT):
+        p = jnp.matmul(ia[..., s0:s0 + _K_F32_EXACT].astype(jnp.bfloat16),
+                       ib[..., s0:s0 + _K_F32_EXACT, :].astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32)
+        acc = p.astype(jnp.int32) if acc is None else acc + p.astype(jnp.int32)
+    return acc
 
 
 def _dot_i8(ia, ib):
-    """Batched int8 x int8 -> int32 contraction (last axis of ``ia`` with
-    second-to-last of ``ib``), the MXU-native exact product.
+    """Batched exact slice contraction (last axis of ``ia`` with
+    second-to-last of ``ib``); route per ``config.ozaki_dot``.
 
-    For contraction depth ``k >= 2^19`` a single int32 accumulation could
-    wrap (``k * 2^12 >= 2^31`` — reachable through ``blas.contract``, which
-    flattens multiple contracted dims into one k), so the axis is chunked
-    into exact int32 partials summed in f64 (the caller's group-sum path is
-    already f64 in that regime, since ``s*k*2^12 >= 2^31`` too)."""
+    int8 route: s8 x s8 -> s32. For contraction depth ``k >= 2^19`` a
+    single int32 accumulation could wrap (``k * 2^12 >= 2^31`` —
+    reachable through ``blas.contract``, which flattens multiple
+    contracted dims into one k), so the axis is chunked into exact int32
+    partials summed in f64 (the caller's group-sum path is already f64 in
+    that regime, since ``s*k*2^12 >= 2^31`` too)."""
     k = ia.shape[-1]
+    if _slice_dot_impl() == "bf16" and k < _K_I32_EXACT:
+        return _dot_bf16(ia, ib)
     if k < _K_I32_EXACT:
         return jnp.matmul(ia, ib, preferred_element_type=jnp.int32)
     acc = None

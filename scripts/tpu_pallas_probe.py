@@ -47,6 +47,31 @@ def main():
                                                 fused_slice_syrk,
                                                 masked_slice_product)
 
+    # raw dot-route micro: is XLA's s8 dot actually MXU-native on this
+    # hardware, or does the bf16 route (exact for 7-bit slices) win?
+    import jax.numpy as jnp
+
+    rngd = np.random.default_rng(3)
+    i8a = jnp.asarray(rngd.integers(-64, 65, (3840, 256)), jnp.int8)
+    i8b = jnp.asarray(rngd.integers(-64, 65, (256, 3840)), jnp.int8)
+    fl = 2 * 3840 * 3840 * 256
+    for name, fn in [
+            ("dot_s8", lambda x, y: jnp.matmul(
+                x, y, preferred_element_type=jnp.int32)),
+            ("dot_bf16", lambda x, y: jnp.matmul(
+                x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32).astype(jnp.int32)),
+            ("dot_bf16_native", lambda x, y: jnp.matmul(
+                x.astype(jnp.bfloat16), y.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32))]:
+        try:
+            t = best_time(fn, i8a, i8b)
+            results["kernels"][name] = {"t": t, "gflops": fl / t / 1e9}
+            log(f"{name}: {t:.5f}s {fl / t / 1e9:.1f} GF/s")
+        except Exception as e:
+            log(f"{name} FAILED: {e!r}"[:300])
+    emit()
+
     m, k = 3840, 256
     rng = np.random.default_rng(0)
     a = jnp.asarray(rng.standard_normal((m, k)))
@@ -98,11 +123,13 @@ def main():
     from dlaf_tpu.types import total_ops
 
     n, nb = 4096, 256
-    for impl, s in (("pallas", 8), ("pallas", 7), ("jnp", 7)):
-        key = f"impl={impl},slices={s}"
+    for impl, s, dot in (("pallas", 8, "int8"), ("pallas", 7, "int8"),
+                         ("jnp", 7, "bf16"), ("jnp", 8, "bf16")):
+        key = f"impl={impl},slices={s},dot={dot}"
         os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
         os.environ["DLAF_OZAKI_IMPL"] = impl
         os.environ["DLAF_F64_GEMM_SLICES"] = str(s)
+        os.environ["DLAF_OZAKI_DOT"] = dot
         config.initialize()
         try:
             ref = Matrix.from_element_fn(
@@ -130,11 +157,15 @@ def main():
                                         "residual": resid, "check": ok}
             log(f"cholesky N={n} {key}: {t:.4f}s {g:.1f} GF/s "
                 f"residual={resid:.3e} ({'PASS' if ok else 'FAIL'})")
+            if results["platform"] == "tpu" and ok:
+                from measure_common import append_history
+                append_history("tpu", n, nb, g, t,
+                               f"tpu_pallas_probe {key}")
         except Exception as e:
             log(f"cholesky {key} FAILED: {e!r}"[:600])
         finally:
             for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",
-                       "DLAF_F64_GEMM_SLICES"):
+                       "DLAF_F64_GEMM_SLICES", "DLAF_OZAKI_DOT"):
                 os.environ.pop(k_, None)
             config.initialize()
         emit()

@@ -77,4 +77,4 @@ run eig_d_4096 2400 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
     -m 4096 -b 256 --nruns 2 --nwarmups 1 --check-result last
 
 echo "session2 done ($(date +%T)); summary:" >&2
-grep -h "GFlop/s\|metric\|ok ->\|FAIL" "$OUT"/*.out 2>/dev/null | tail -25 >&2
+grep -h "GFlop/s\|metric\|ok ->\|FAIL" "$OUT"/*.out "$OUT"/*.log 2>/dev/null | tail -30 >&2

@@ -12,6 +12,7 @@ cd "$(dirname "$0")/.."
 PERIOD=${PERIOD:-600}
 MAX_HOURS=${MAX_HOURS:-10}
 SESSION=${SESSION:-scripts/tpu_session.sh}
+[ -f "$SESSION" ] || { echo "SESSION $SESSION: no such file" >&2; exit 1; }
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 
 while [ "$(date +%s)" -lt "$deadline" ]; do

@@ -686,3 +686,42 @@ class TestCholeskyOzakiPath:
         finally:
             monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
             config.initialize()
+
+
+class TestBf16DotRoute:
+    """ozaki_dot="bf16": slice contractions over the native bf16 MXU path
+    must be BIT-IDENTICAL to the int8 route (7-bit slices are exact in
+    bf16; f32 accumulation is integer-exact while k*2^12 <= 2^24, int32
+    chunk sums beyond)."""
+
+    @pytest.mark.parametrize("m,k", [(64, 48), (33, 256), (16, 5000)])
+    def test_matmul_bitwise_equal(self, m, k, monkeypatch):
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal((m, k)) * 10.0 ** rng.integers(-6, 6, (m, 1))
+        b = rng.standard_normal((k, m)) * 10.0 ** rng.integers(-6, 6, (1, m))
+        from dlaf_tpu import config
+
+        ref = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+        monkeypatch.setenv("DLAF_OZAKI_DOT", "bf16")
+        config.initialize()
+        try:
+            got = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_DOT")
+            config.initialize()
+        assert got.tobytes() == ref.tobytes()
+
+    def test_syrk_bitwise_equal(self, monkeypatch):
+        rng = np.random.default_rng(6)
+        a = rng.standard_normal((96, 128))
+        from dlaf_tpu import config
+
+        ref = np.asarray(syrk_f64(jnp.asarray(a)))
+        monkeypatch.setenv("DLAF_OZAKI_DOT", "bf16")
+        config.initialize()
+        try:
+            got = np.asarray(syrk_f64(jnp.asarray(a)))
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_DOT")
+            config.initialize()
+        assert got.tobytes() == ref.tobytes()
