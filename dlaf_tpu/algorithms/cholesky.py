@@ -201,7 +201,8 @@ def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
     ib = jnp.stack(oz._peel_slices(oz._normalize(bfl, sb), s))
     hi, lo = masked_slice_product(
         ia.reshape(s, nrows, mb, mb), ib.reshape(s, ncols, mb, mb),
-        pairmask.astype(jnp.int32), interpret=interpret)
+        pairmask.astype(jnp.int32), interpret=interpret,
+        dot=oz._slice_dot_impl())
     acc = (hi.astype(jnp.float64) + lo.astype(jnp.float64)) * 4.0
     return (acc * sa.reshape(nrows, 1, mb, 1)) * sb.reshape(1, ncols, 1, mb)
 

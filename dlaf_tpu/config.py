@@ -84,7 +84,8 @@ class Configuration:
     #: ~22% of the MXU work; measured 103.9 vs 95.5 GF/s on config #1,
     #: 2026-07-31 v5e session), 8 where f64 is native (f64-grade dots).
     f64_gemm_slices: int = 0
-    #: Slice contraction route of the jnp ozaki path: "int8" (s8 x s8 ->
+    #: Slice contraction route of the ozaki paths (jnp AND the fused
+    #: pallas kernels): "int8" (s8 x s8 ->
     #: s32 dot) or "bf16" (slices cast to bf16 — exact for 7-bit integers —
     #: contracted on the MXU's native bf16 path with f32 accumulation,
     #: integer-exact while k*2^12 <= 2^24, chunked beyond; bit-identical

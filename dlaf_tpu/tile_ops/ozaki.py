@@ -205,7 +205,8 @@ def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
         from .pallas_ozaki import fused_slice_product
 
         hi, lo = fused_slice_product(jnp.stack(ia), jnp.stack(ib),
-                                     interpret=jax.default_backend() == "cpu")
+                                     interpret=jax.default_backend() == "cpu",
+                                     dot=_slice_dot_impl())
         acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)
         return _apply_scales(acc, sa, sb)
     # int32 group sums stay exact while (d+1) * k * 2^12 < 2^31
@@ -251,7 +252,8 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
         # predicated square grid: strictly-upper tiles skip their MXU
         # dots, mirrored here (halves the MXU work vs the general kernel)
         hi, lo = fused_slice_syrk(jnp.stack(ia),
-                                  interpret=jax.default_backend() == "cpu")
+                                  interpret=jax.default_backend() == "cpu",
+                                  dot=_slice_dot_impl())
         acc = hi.astype(jnp.float64) + lo.astype(jnp.float64)
         acc = jnp.tril(acc) + jnp.swapaxes(jnp.tril(acc, -1), -1, -2)
         return _apply_scales(acc, sa, jnp.swapaxes(sa, -1, -2))

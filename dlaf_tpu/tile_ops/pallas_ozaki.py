@@ -60,12 +60,17 @@ def _two_sum(a, b):
     return s, err
 
 
-def _fold_body(s: int, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract: int):
+def _fold_body(s: int, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract: int,
+               dot: str = "int8"):
     """Shared numerical body: per-shift int32 group accumulation, exact
     int32 -> double-f32 split (|p| <= s*k*2^12 < 2^27, so the residual
     after the f32 round fits f32 exactly), and the two-sum fold.
     ``rhs_contract`` picks the rhs contraction axis (0: (K, BN) blocks;
-    1: (BN, K) blocks as in the syrk form, contracting K against K)."""
+    1: (BN, K) blocks as in the syrk form, contracting K against K).
+    ``dot``: "int8" (s8 MXU dot) or "bf16" — cast the slices in VMEM and
+    contract on the native bf16 path with f32 accumulation, exact for
+    the K <= K_MAX <= 2^12 depths this kernel accepts (same bound
+    argument as ozaki._dot_bf16); bit-identical outputs."""
     bm = hi_ref.shape[0]
     bn = hi_ref.shape[1]
     hi = jnp.zeros((bm, bn), jnp.float32)
@@ -73,10 +78,18 @@ def _fold_body(s: int, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract: int):
     for d in range(s):
         p = jnp.zeros((bm, bn), jnp.int32)
         for t in range(d + 1):
-            p = p + jax.lax.dot_general(
-                ia_ref[t], ib_ref[d - t],
-                dimension_numbers=(((1,), (rhs_contract,)), ((), ())),
-                preferred_element_type=jnp.int32)
+            if dot == "bf16":
+                g = jax.lax.dot_general(
+                    ia_ref[t].astype(jnp.bfloat16),
+                    ib_ref[d - t].astype(jnp.bfloat16),
+                    dimension_numbers=(((1,), (rhs_contract,)), ((), ())),
+                    preferred_element_type=jnp.float32).astype(jnp.int32)
+            else:
+                g = jax.lax.dot_general(
+                    ia_ref[t], ib_ref[d - t],
+                    dimension_numbers=(((1,), (rhs_contract,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+            p = p + g
         phi = p.astype(jnp.float32)
         plo = (p - phi.astype(jnp.int32)).astype(jnp.float32)
         scale = float(2.0 ** (-SLICE_BITS * (d + 2)))  # exact pow2 mult
@@ -86,17 +99,18 @@ def _fold_body(s: int, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract: int):
     lo_ref[:] = lo
 
 
-def _make_kernel(s: int):
+def _make_kernel(s: int, dot: str):
     def kernel(ia_ref, ib_ref, hi_ref, lo_ref):
-        _fold_body(s, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract=0)
+        _fold_body(s, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract=0,
+                   dot=dot)
 
     return kernel
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_m", "block_n", "interpret"))
+                   static_argnames=("block_m", "block_n", "interpret", "dot"))
 def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
-                        interpret: bool = False):
+                        interpret: bool = False, dot: str = "int8"):
     """All-shift Ozaki reduction of stacked int8 slices, fused per tile.
 
     ``ia``: (s, M, K) int8 slices of the normalized A; ``ib``: (s, K, N) of
@@ -117,7 +131,7 @@ def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
     mp, np_ = m + pm, n + pn
     grid = (mp // block_m, np_ // block_n)
     hi, lo = pl.pallas_call(
-        _make_kernel(s),
+        _make_kernel(s, dot),
         out_shape=(jax.ShapeDtypeStruct((mp, np_), jnp.float32),
                    jax.ShapeDtypeStruct((mp, np_), jnp.float32)),
         grid=grid,
@@ -140,7 +154,7 @@ def fused_slice_product(ia, ib, *, block_m: int = 256, block_n: int = 256,
 MASKED_MB_MAX = 256
 
 
-def _make_masked_kernel(s: int):
+def _make_masked_kernel(s: int, dot: str):
     def kernel(mode_ref, ia_ref, ib_ref, hi_ref, lo_ref):
         # (1, 1) SMEM block selected by the grid step: the load is at a
         # static index (dynamic SMEM indexing does not legalize on the
@@ -156,13 +170,15 @@ def _make_masked_kernel(s: int):
         def _():
             # both operands are row blocks contracting k against k — the
             # syrk rhs layout, so the shared fold applies unchanged
-            _fold_body(s, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract=1)
+            _fold_body(s, ia_ref, ib_ref, hi_ref, lo_ref, rhs_contract=1,
+                       dot=dot)
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("interpret", "dot"))
+def masked_slice_product(ia, ib, mode, *, interpret: bool = False,
+                         dot: str = "int8"):
     """Per-tile-pair Ozaki slice reduction, PREDICATED on ``mode``: pairs
     with ``mode[r, c] == 0`` skip the MXU work entirely (outputs zero).
 
@@ -185,7 +201,7 @@ def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
     # same (s, b, k)/(b, b) refs as the matmul/syrk kernels and shares
     # their _fold_body
     hi, lo = pl.pallas_call(
-        _make_masked_kernel(s),
+        _make_masked_kernel(s, dot),
         grid=(R, C),
         in_specs=[
             pl.BlockSpec((1, 1), lambda r, c: (r, c),
@@ -203,7 +219,7 @@ def masked_slice_product(ia, ib, mode, *, interpret: bool = False):
     return hi, lo
 
 
-def _make_syrk_kernel(s: int):
+def _make_syrk_kernel(s: int, dot: str):
     def kernel(ia_ref, ja_ref, hi_ref, lo_ref):
         r = pl.program_id(0)
         c = pl.program_id(1)
@@ -218,13 +234,15 @@ def _make_syrk_kernel(s: int):
         def _():
             # rhs blocks are (BN, K) row blocks of the SAME operand:
             # contract the K axes directly (no transposed copy)
-            _fold_body(s, ia_ref, ja_ref, hi_ref, lo_ref, rhs_contract=1)
+            _fold_body(s, ia_ref, ja_ref, hi_ref, lo_ref, rhs_contract=1,
+                       dot=dot)
 
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block", "interpret"))
-def fused_slice_syrk(ia, *, block: int = 256, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("block", "interpret", "dot"))
+def fused_slice_syrk(ia, *, block: int = 256, interpret: bool = False,
+                     dot: str = "int8"):
     """Symmetric fused reduction: lower-triangle tiles of the gram product
     of the stacked slices ``ia`` (s, M, K) with themselves.
 
@@ -244,7 +262,7 @@ def fused_slice_syrk(ia, *, block: int = 256, interpret: bool = False):
     mp = m + pm
     nt = mp // block
     hi, lo = pl.pallas_call(
-        _make_syrk_kernel(s),
+        _make_syrk_kernel(s, dot),
         out_shape=(jax.ShapeDtypeStruct((mp, mp), jnp.float32),
                    jax.ShapeDtypeStruct((mp, mp), jnp.float32)),
         grid=(nt, nt),

@@ -49,8 +49,6 @@ def main():
 
     # raw dot-route micro: is XLA's s8 dot actually MXU-native on this
     # hardware, or does the bf16 route (exact for 7-bit slices) win?
-    import jax.numpy as jnp
-
     rngd = np.random.default_rng(3)
     i8a = jnp.asarray(rngd.integers(-64, 65, (3840, 256)), jnp.int8)
     i8b = jnp.asarray(rngd.integers(-64, 65, (256, 3840)), jnp.int8)
@@ -86,8 +84,14 @@ def main():
         for name, fn, args, fl in [
                 (f"syrk_pallas_s{s}", lambda x: fused_slice_syrk(x), (ia,),
                  flops_syrk),
+                (f"syrk_pallas_s{s}_bf16",
+                 lambda x: fused_slice_syrk(x, dot="bf16"), (ia,),
+                 flops_syrk),
                 (f"matmul_pallas_s{s}",
-                 lambda x, y: fused_slice_product(x, y), (ia, ibt), flops_mm)]:
+                 lambda x, y: fused_slice_product(x, y), (ia, ibt), flops_mm),
+                (f"matmul_pallas_s{s}_bf16",
+                 lambda x, y: fused_slice_product(x, y, dot="bf16"),
+                 (ia, ibt), flops_mm)]:
             try:
                 t = best_time(fn, *args)
                 results["kernels"][name] = {"t": t, "gflops": fl / t / 1e9}

@@ -103,3 +103,36 @@ def test_distributed_cholesky_pallas_branch(monkeypatch, devices8, uplo):
         resid = np.linalg.norm(f.T @ f - a) / np.linalg.norm(a)
         np.testing.assert_array_equal(np.tril(out, -1), np.tril(a, -1))
     assert resid < 60 * n * eps
+
+
+def test_fold_dot_routes_bitwise_equal():
+    """The bf16 in-kernel dot route must produce BIT-identical (hi, lo)
+    pairs to the int8 route (7-bit slices are exact in bf16; f32
+    accumulation exact to k <= K_MAX <= 2^12)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    from dlaf_tpu.tile_ops.pallas_ozaki import (fused_slice_product,
+                                                fused_slice_syrk,
+                                                masked_slice_product)
+
+    rng = np.random.default_rng(9)
+    s, m, k = 4, 512, 256
+    ia = jnp.asarray(rng.integers(-64, 65, (s, m, k)), jnp.int8)
+    ib = jnp.asarray(rng.integers(-64, 65, (s, k, m)), jnp.int8)
+    h1, l1 = fused_slice_product(ia, ib, interpret=True)
+    h2, l2 = fused_slice_product(ia, ib, interpret=True, dot="bf16")
+    assert np.asarray(h1).tobytes() == np.asarray(h2).tobytes()
+    assert np.asarray(l1).tobytes() == np.asarray(l2).tobytes()
+
+    h1, l1 = fused_slice_syrk(ia, interpret=True)
+    h2, l2 = fused_slice_syrk(ia, interpret=True, dot="bf16")
+    assert np.asarray(h1).tobytes() == np.asarray(h2).tobytes()
+    assert np.asarray(l1).tobytes() == np.asarray(l2).tobytes()
+
+    iat = jnp.asarray(rng.integers(-64, 65, (s, 2, k, k)), jnp.int8)
+    mode = jnp.asarray(np.tril(np.ones((2, 2), np.int32)))
+    h1, l1 = masked_slice_product(iat, iat, mode, interpret=True)
+    h2, l2 = masked_slice_product(iat, iat, mode, interpret=True, dot="bf16")
+    assert np.asarray(h1).tobytes() == np.asarray(h2).tobytes()
+    assert np.asarray(l1).tobytes() == np.asarray(l2).tobytes()
