@@ -116,6 +116,52 @@ def main():
         log(f"masked_pallas_s8 FAILED: {e!r}"[:600])
     emit()
 
+    # panel-chain probe: per-call probes through the tunnel are RTT-bound
+    # (~140 ms floor, 2026-07-31 session) — chain ITERS dependent steps
+    # inside ONE program and divide, resolving the in-program per-step
+    # panel cost that bounds config #1's serial critical path
+    try:
+        from jax import lax
+
+        from dlaf_tpu.tile_ops import mixed as mx
+
+        nbp, iters = 256, 24
+        rngp = np.random.default_rng(1)
+        xs = rngp.standard_normal((nbp, nbp))
+        spd = jnp.asarray(xs @ xs.T + nbp * np.eye(nbp))
+
+        def chain(stepfn):
+            def body(c, _):
+                out = stepfn(c)
+                # rebuild an SPD input from the factor so every iteration
+                # depends on the last (a ~20us gemm vs ms-scale steps)
+                del c
+                return out @ jnp.swapaxes(out, -1, -2), None
+
+            return jax.jit(lambda m: lax.scan(body, m, None, length=iters)[0])
+
+        # gemm-only baseline; the normalize keeps the carry bounded over
+        # the iterations (tril of an SPD matrix is not a Cholesky factor,
+        # so an unnormalized rebuild would overflow by step ~10)
+        gemm_chain = chain(lambda c: jnp.tril(c / jnp.max(jnp.abs(c))))
+        probes = {
+            "chain_gemm_baseline": gemm_chain,
+            "chain_potrf_inv_refined":
+                chain(lambda c: mx.potrf_inv_refined("L", c)[0]),
+            "chain_potrf_native_f64":
+                chain(lambda c: jnp.tril(lax.linalg.cholesky(c))),
+            "chain_potrf_f32":
+                chain(lambda c: lax.linalg.cholesky(
+                    c.astype(jnp.float32)).astype(jnp.float64)),
+        }
+        for name, fn in probes.items():
+            t = best_time(fn, spd)
+            results["kernels"][name] = {"t_ms_per_step": t / iters * 1e3}
+            log(f"{name}: {t / iters * 1e3:.3f} ms/step")
+    except Exception as e:
+        log(f"panel chain probe failed: {e!r}"[:400])
+    emit()
+
     # full config #1 under the pallas impl, with the miniapp's residual
     # check (the pallas fold carries ~48 bits; hardware must confirm the
     # factorization still meets the f64 algorithm budget before the knob
