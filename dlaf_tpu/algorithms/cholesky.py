@@ -58,7 +58,7 @@ from ..types import ceil_div
 
 #: Valid cholesky_trailing strategies (see config.Configuration); bench.py
 #: sweeps this set on the measured hardware.
-VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki")
+VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki", "scan")
 
 
 
@@ -179,6 +179,97 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                 mask = jnp.triu(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
     return a
+
+
+@register_program_cache
+@functools.partial(jax.jit, static_argnames=("uplo", "nb"))
+def _cholesky_local_scan(a, *, uplo: str, nb: int):
+    """``lax.scan`` formulation of the local factorization: ONE compiled
+    step body, looped ``nt`` times with uniform full-size shapes.
+
+    Why it exists: the unrolled trace (:func:`_cholesky_local`) compiles in
+    time linear in ``nt`` with a ~19 s/step constant on the v5e tunnel's
+    chipless AOT toolchain (docs/DESIGN.md) and its per-step intermediates
+    are all simultaneously visible to the allocator. The scanned form
+    compiles O(1) programs and reuses carry buffers, at the documented
+    price of uniform-shape work: the panel is the FULL block column (rows
+    above the pivot masked) and the trailing update is a FULL (n, n)
+    masked product every step — ~3x the exact trailing flops. The right
+    trade when compile latency or HBM liveness binds, not when flops do
+    (bench.py sweeps both).
+
+    f64/complex128 route their panels through the mixed-precision fused
+    factor+inverse and the trailing product through the ozaki MXU path
+    (same kernels as trailing="ozaki"); other dtypes run native potrf /
+    trsm / herk. Triangle pass-through semantics match the unrolled path.
+    """
+    n = a.shape[0]
+    if n == 0:
+        return a
+    use_oz = a.dtype in (jnp.float64, jnp.complex128)
+    nt = ceil_div(n, nb)
+    npad = nt * nb - n
+    if npad:
+        # pad to uniform blocks with an identity tail: chol([[A,0],[0,I]])
+        # = [[L,0],[0,I]] and the pad rows/cols never touch the result
+        a = jnp.pad(a, ((0, npad), (0, npad)))
+        a = a.at[jnp.arange(n, nt * nb), jnp.arange(n, nt * nb)].set(1)
+    m = nt * nb
+    rows = jnp.arange(m)
+    other = "U" if uplo == "L" else "L"
+
+    def step(acc, k):
+        k0 = k * nb
+        blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
+        if use_oz:
+            fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
+            diag = fac + tb.tri_mask(blk, other, k=-1)
+        else:
+            fac_inv = None
+            diag = tl.potrf(uplo, blk)
+        acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
+        below = rows >= k0 + nb          # (m,) rows/cols past the pivot
+        if uplo == "L":
+            col = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
+            if use_oz:
+                pfull = tb.mm_mxu(col, jnp.conj(fac_inv).T)
+            else:
+                pfull = tb.trsm("R", "L", "C", "N", diag, col)
+            panel = jnp.where(below[:, None], pfull, 0)
+            acc = jax.lax.dynamic_update_slice(
+                acc, jnp.where(below[:, None], pfull, col), (0, k0))
+            if use_oz:
+                upd = (oz.herk_c128(panel, slices=tb._oz_slices())
+                       if jnp.iscomplexobj(panel)
+                       else oz.syrk_f64(panel, slices=tb._oz_slices()))
+            else:
+                upd = panel @ jnp.conj(panel).T
+            # panel is zero at rows <= pivot, so upd lives only in the
+            # trailing block; restrict to the stored lower triangle
+            tri = rows[:, None] >= rows[None, :]
+            acc = acc - jnp.where(tri, upd, 0)
+        else:
+            row = jax.lax.dynamic_slice(acc, (k0, 0), (nb, m))
+            if use_oz:
+                pfull = tb.mm_mxu(jnp.conj(fac_inv).T, row)
+            else:
+                pfull = tb.trsm("L", "U", "C", "N", diag, row)
+            panel = jnp.where(below[None, :], pfull, 0)
+            acc = jax.lax.dynamic_update_slice(
+                acc, jnp.where(below[None, :], pfull, row), (k0, 0))
+            pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
+            if use_oz:
+                upd = (oz.herk_c128(pt, slices=tb._oz_slices())
+                       if jnp.iscomplexobj(panel)
+                       else oz.syrk_f64(pt, slices=tb._oz_slices()))
+            else:
+                upd = pt @ jnp.conj(pt).T
+            tri = rows[:, None] <= rows[None, :]
+            acc = acc - jnp.where(tri, upd, 0)
+        return acc, None
+
+    a, _ = jax.lax.scan(step, a, jnp.arange(nt))
+    return a[:n, :n]
 
 
 # ---------------------------------------------------------------------------
@@ -466,8 +557,11 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
                 "cholesky: block must be square")
     if mat.grid is None or mat.grid.num_devices == 1:
         a = tiles_to_global(mat.storage, mat.dist)
-        out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
-                              trailing=trailing)
+        if trailing == "scan":
+            out = _cholesky_local_scan(a, uplo=uplo, nb=mat.block_size.row)
+        else:
+            out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
+                                  trailing=trailing)
         return mat.with_storage(global_to_tiles(out, mat.dist))
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
     cfg = get_configuration()

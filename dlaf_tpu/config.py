@@ -53,7 +53,11 @@ class Configuration:
     #: large MXU op), "invgemm" (biggemm + panel formed by gemm against the
     #: explicit inverse of the diagonal factor instead of a triangular
     #: solve), or "xla" (delegate the whole local factorization to XLA's
-    #: fused native cholesky). Benchmarked per hardware; see bench.py.
+    #: fused native cholesky), or "scan" (lax.scan'd uniform step: one
+    #: compiled step body looped nt times — O(1) compile time and carry
+    #: buffer reuse at ~3x the exact trailing flops; the compile/HBM
+    #: escape hatch at large tile counts, algorithms/cholesky.py).
+    #: Benchmarked per hardware; see bench.py.
     cholesky_trailing: str = "loop"
     #: bt_band_to_tridiag reflector application: "blocked" (compact-WY
     #: staircase groups -> larft + two gemms per step level, the MXU form of

@@ -72,12 +72,31 @@ def test_cholesky_distributed_col_major_grid(uplo, devices8):
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
 @pytest.mark.parametrize("uplo", ["L", "U"])
-@pytest.mark.parametrize("trailing", ["biggemm", "invgemm", "xla"])
+@pytest.mark.parametrize("trailing", ["biggemm", "invgemm", "xla", "scan"])
 @pytest.mark.parametrize("n,nb", [(32, 8), (29, 8)])
 def test_cholesky_local_trailing_variants(uplo, trailing, n, nb, dtype, monkeypatch):
     """MXU-shaped trailing-update strategies must match the reference loop
     (config knob ``cholesky_trailing``; see bench.py for the perf A/B)."""
     monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", trailing)
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        a = hpd_matrix(n, dtype)
+        out = cholesky(uplo, Matrix_from(a, nb)).to_numpy()
+        check_factor(uplo, a, out, dtype)
+    finally:
+        monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+        config.initialize()
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.complex64])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("n,nb", [(32, 8), (29, 8), (5, 8), (0, 8)])
+def test_cholesky_scan_native_dtypes(uplo, n, nb, dtype, monkeypatch):
+    """trailing="scan" native branch (non-emulated dtypes), both uplos +
+    degenerate sizes: n < nb (single ragged block) and n = 0."""
+    monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "scan")
     import dlaf_tpu.config as config
 
     config.initialize()
