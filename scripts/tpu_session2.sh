@@ -39,8 +39,10 @@ for dt in (np.complex64, np.complex128):
 # 3. fixed pallas kernels (predicated square grid, static SMEM loads)
 run pallas_probe 2400 python scripts/tpu_pallas_probe.py
 
-# 4. N=16384 cholesky after the incremental-fold liveness fix
-run chol_16384 2400 python - <<'EOF'
+# 4. N=16384 cholesky: the scanned step first (compiles O(1); the
+# unrolled trace costs ~19 s/step on this toolchain = ~20 min at nt=64),
+# then the unrolled ozaki path to validate the incremental-fold OOM fix
+run chol_16384 3600 python - <<'EOF'
 import os, sys
 sys.path.insert(0, "scripts")  # cwd is the repo root (session script cd's)
 sys.path.insert(0, ".")
@@ -54,18 +56,25 @@ from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
 from dlaf_tpu.matrix.matrix import Matrix
 from dlaf_tpu.miniapp.generators import hpd_element_fn
 from dlaf_tpu.types import total_ops
-os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
-config.initialize()
+
 n, nb = 16384, 256
 ref = Matrix.from_element_fn(hpd_element_fn(n, np.float64),
                              GlobalElementSize(n, n),
                              TileElementSize(nb, nb), dtype=np.float64)
-t = best_time(lambda st: cholesky("L", ref.with_storage(st)).storage,
-              ref.storage + 0)
-g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
-log(f"cholesky N={n}: {t:.4f}s {g:.1f} GF/s")
-if jax.devices()[0].platform == "tpu":
-    append_history("tpu", n, nb, g, t, "post-fix N=16384 (incremental fold)")
+for variant in ("scan", "ozaki"):
+    os.environ["DLAF_CHOLESKY_TRAILING"] = variant
+    config.initialize()
+    try:
+        t = best_time(lambda st: cholesky("L", ref.with_storage(st)).storage,
+                      ref.storage + 0)
+        g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
+        log(f"cholesky N={n} trailing={variant}: {t:.4f}s {g:.1f} GF/s")
+        if jax.devices()[0].platform == "tpu":
+            append_history("tpu", n, nb, g, t, f"N=16384 trailing={variant}")
+    except Exception as e:
+        log(f"cholesky N={n} trailing={variant} FAILED: {e!r}"[:400])
+    finally:
+        os.environ.pop("DLAF_CHOLESKY_TRAILING", None)
 EOF
 
 # 5-7. the configs the wedge ate (hegst depends on the c128 diagnosis)
