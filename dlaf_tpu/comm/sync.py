@@ -29,6 +29,7 @@ import numpy as np
 import jax
 
 from ..common.sync import hard_fence
+from ..matrix import memory
 
 __all__ = ["gather", "gather_shards", "all_reduce", "reduce", "barrier"]
 
@@ -45,7 +46,7 @@ def gather(mat) -> np.ndarray:
     from ..matrix import tiling
 
     return np.asarray(
-        tiling.tiles_to_global(jax.device_get(mat.storage), mat.dist))
+        tiling.tiles_to_global(memory.fetch(mat.storage), mat.dist))
 
 
 def gather_shards(x) -> list[np.ndarray]:
@@ -53,8 +54,8 @@ def gather_shards(x) -> list[np.ndarray]:
     (the blocking analog of each rank reading its local part;
     reference ``sync::basic::receive_from`` at the test master)."""
     if hasattr(x, "addressable_shards"):
-        return [np.asarray(s.data) for s in x.addressable_shards]
-    return [np.asarray(x)]
+        return [memory.fetch(s.data) for s in x.addressable_shards]
+    return [memory.fetch(x) if hasattr(x, "devices") else np.asarray(x)]
 
 
 def all_reduce(values, op: str = "sum"):

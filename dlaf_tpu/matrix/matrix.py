@@ -30,6 +30,7 @@ from ..comm.grid import Grid
 from ..common.asserts import dlaf_assert
 from ..common.index2d import (GlobalElementSize, GlobalTileIndex, GridSize2D, RankIndex2D,
                               TileElementSize)
+from . import memory
 from .distribution import Distribution
 from . import tiling
 
@@ -129,7 +130,7 @@ class Matrix:
         """Read one global tile (its actual, possibly short, extent)."""
         r, c = tiling.global_tile_to_storage_index(self.dist, index.row, index.col)
         ts = self.dist.tile_size_of(index)
-        t = jax.device_get(self.storage[r, c])
+        t = memory.fetch(self.storage[r, c])
         return np.asarray(t[: ts.row, : ts.col])
 
     def with_storage(self, storage) -> "Matrix":

@@ -67,6 +67,12 @@ def global_to_tiles(a, dist: Distribution):
     mb, nb = dist.block_size.row, dist.block_size.col
     nt = dist.nr_tiles
     Sr, Sc, ltr, ltc = storage_tile_grid(dist)
+    if not hasattr(a, "devices"):
+        # host input: H2D through memory.place (complex-pair fallback for
+        # PJRT paths that reject complex128 transfers)
+        from . import memory as _memory
+
+        a = _memory.place(np.asarray(a))
     a = jnp.asarray(a)
     # pad to whole tiles, split into the (ntr, ntc, mb, nb) tile grid
     a = jnp.pad(a, ((0, nt.row * mb - m), (0, nt.col * nb - n)))
