@@ -520,13 +520,145 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
+                              use_mixed=False, cplx=False):
+    """``lax.scan`` form of the distributed factorization: ONE compiled
+    step body looped ``nt`` times inside the ``shard_map``.
+
+    Same motivation as :func:`_cholesky_local_scan` (the hardware
+    toolchain's ~19 s/step unrolled-compile constant — docs/DESIGN.md —
+    puts north-star tile counts at tens of minutes cold), same uniform-
+    shape price: every step solves the panel over ALL local row slots and
+    updates the ALL-pairs trailing grid under traced validity masks
+    (~2x panel work, ~3x trailing flops vs the unrolled exact schedule).
+    All per-``k`` index math — owner ranks, local slot of the pivot,
+    global tile indices, edge-tile extents — is traced arithmetic on the
+    scan counter; tile reads/writes at the pivot use dynamic slices. The
+    predicated Pallas trailing kernels are not offered in this mode (the
+    uniform masked einsum/ozaki forms are the scan-compatible shapes).
+    """
+    nt = dist.nr_tiles.row
+    mb = dist.block_size.row
+    n = dist.size.row
+    Pr, Qc = dist.grid_size.row, dist.grid_size.col
+    sr, sc = dist.source_rank.row, dist.source_rank.col
+    _, _, ltr, ltc = storage_tile_grid(dist)
+
+    def step(lt, k):
+        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
+        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+        owner_r = ud.rank_global_tile(k, Pr, sr)
+        owner_c = ud.rank_global_tile(k, Qc, sc)
+        kr = ud.local_tile_from_global_tile(k, Pr)
+        kc = ud.local_tile_from_global_tile(k, Qc)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+
+        # -- diag tile -> everyone --------------------------------------
+        cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0), (1, 1, mb, mb))[0, 0]
+        diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
+        ts = jnp.minimum(mb, n - k * mb)
+        pad = jnp.arange(mb) >= ts   # identity-pad traced short edge tiles
+        diag = jnp.where(pad[:, None] | pad[None, :], 0, diag) \
+            + jnp.diag(pad.astype(diag.dtype))
+        if use_mixed:
+            other = "U" if uplo == "L" else "L"
+            fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
+            lkk = fac + tb.tri_mask(diag, other, k=-1)
+        else:
+            lkk_inv = None
+            lkk = tl.potrf(uplo, diag)
+        # un-pad so the written diagonal tile keeps its stored edge zeros
+        lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
+        upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
+        lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
+                                          (kr, kc, 0, 0))
+
+        g_rows = jnp.arange(ltr) * Pr + rr
+        g_cols = jnp.arange(ltc) * Qc + rc
+        row_valid = (g_rows > k) & (g_rows < nt)
+        col_valid = (g_cols > k) & (g_cols < nt)
+
+        if uplo == "L":
+            # -- panel trsm over ALL local row slots of column kc --------
+            colk = jax.lax.dynamic_slice(
+                lt, (0, kc, 0, 0), (ltr, 1, mb, mb))[:, 0]
+            pan = tb.trsm_panel("R", "L", "C", "N", lkk, colk, inv_a=lkk_inv)
+            pan = jnp.where(row_valid[:, None, None], pan, 0)
+            keep = (is_owner_c & row_valid)[:, None, None]
+            lt = jax.lax.dynamic_update_slice(
+                lt, jnp.where(keep, pan, colk)[:, None], (0, kc, 0, 0))
+
+            # -- panel broadcast + transposed panel ----------------------
+            vr = cc.bcast(pan, COL_AXIS, owner_c)
+            vc = transpose_col_to_rows(DistContext(dist), vr, 0, g_cols)
+            vc = jnp.where(col_valid[:, None, None], vc, 0)
+
+            # -- trailing update over the full local pair grid -----------
+            pair = row_valid[:, None] & col_valid[None, :]
+            below = pair & (g_rows[:, None] > g_cols[None, :])
+            ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+            if use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                full = mmfn(vr.reshape(ltr * mb, mb),
+                            jnp.conj(vc).reshape(ltc * mb, mb).T,
+                            slices=tb._oz_slices())
+                upd = full.reshape(ltr, mb, ltc, mb).transpose(0, 2, 1, 3)
+            else:
+                upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
+                                 preferred_element_type=vr.dtype)
+            tri_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+        else:
+            # -- mirrored sweep: panel is block row kr --------------------
+            rowk = jax.lax.dynamic_slice(
+                lt, (kr, 0, 0, 0), (1, ltc, mb, mb))[0]
+            pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowk, inv_a=lkk_inv)
+            pan = jnp.where(col_valid[:, None, None], pan, 0)
+            keep = (is_owner_r & col_valid)[:, None, None]
+            lt = jax.lax.dynamic_update_slice(
+                lt, jnp.where(keep, pan, rowk)[None], (kr, 0, 0, 0))
+
+            vcp = cc.bcast(pan, ROW_AXIS, owner_r)
+            vrp = transpose_row_to_cols(DistContext(dist), vcp, 0, g_rows)
+            vrp = jnp.where(row_valid[:, None, None], vrp, 0)
+
+            pair = row_valid[:, None] & col_valid[None, :]
+            below = pair & (g_rows[:, None] < g_cols[None, :])   # "above"
+            ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+            if use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                ar = jnp.swapaxes(jnp.conj(vrp), -1, -2).reshape(ltr * mb, mb)
+                bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc * mb, mb)
+                full = mmfn(ar, bc2.T, slices=tb._oz_slices())
+                upd = full.reshape(ltr, mb, ltc, mb).transpose(0, 2, 1, 3)
+            else:
+                upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vrp), vcp,
+                                 preferred_element_type=vrp.dtype)
+            tri_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+
+        mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tri_m)
+        lt = lt - jnp.where(mask4, upd, 0)
+        return lt, None
+
+    def factorize(lt):
+        lt, _ = jax.lax.scan(step, lt, jnp.arange(nt))
+        return lt
+
+    return shard_map(factorize, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
 @register_program_cache
 @functools.lru_cache(maxsize=64)
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
-                          use_oz_pallas=False):
+                          use_oz_pallas=False, scan=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
+    if scan:
+        return jax.jit(_build_dist_cholesky_scan(
+            dist, mesh, uplo, use_mxu=use_mxu, use_mixed=use_mixed,
+            cplx=dtype.startswith("complex")))
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
@@ -581,9 +713,15 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     use_oz_pallas = (use_mxu and cfg.ozaki_impl == "pallas"
                      and dt == np.dtype(np.float64)
                      and mat.block_size.row <= MASKED_MB_MAX)
+    scan_mode = trailing == "scan"
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, dt.name, uplo,
-                               supports_pallas_update(mat.dtype, platform)
+                               # pallas knobs are ignored by the scan path;
+                               # normalize them so its cache key is exact
+                               (not scan_mode)
+                               and supports_pallas_update(mat.dtype, platform)
                                and not use_mxu,
-                               platform != "tpu", use_mxu, use_mixed,
-                               use_oz_pallas)
+                               (not scan_mode) and platform != "tpu",
+                               use_mxu, use_mixed,
+                               (not scan_mode) and use_oz_pallas,
+                               scan=scan_mode)
     return mat.with_storage(fn(mat.storage))

@@ -57,13 +57,23 @@ def _place_pair(array, sharding):
 
 
 def _latch_pair_mode(op: str):
+    """Latch only when a TINY direct complex transfer also fails right
+    now: a transient backend failure that clears between the failed
+    direct attempt and the successful pair retry then probes healthy and
+    does not flip the process into permanent 2x-transfer mode."""
     global _complex_pair_mode
-    if _complex_pair_mode is not True:
-        warnings.warn(
-            f"direct complex128 {op} failed but the real/imag pair "
-            "transfer succeeded; enabling pair mode for all further "
-            "complex transfers in this process (matrix/memory.py)")
-        _complex_pair_mode = True
+    if _complex_pair_mode is True:
+        return
+    try:
+        jax.device_get(jax.device_put(np.zeros((1,), np.complex128)))
+        return   # direct complex transfers work; the failure was transient
+    except Exception:
+        pass
+    warnings.warn(
+        f"direct complex128 {op} failed (confirmed by a probe) but the "
+        "real/imag pair transfer succeeded; enabling pair mode for all "
+        "further complex transfers in this process (matrix/memory.py)")
+    _complex_pair_mode = True
 
 
 def place(array, sharding=None):

@@ -145,3 +145,36 @@ def test_cholesky_vs_numpy():
     out = cholesky("L", Matrix_from(a, 8)).to_numpy()
     np.testing.assert_allclose(np.tril(out), np.linalg.cholesky(a),
                                rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("mode", ["native", "mxu+mixed"])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128, np.float32])
+@pytest.mark.parametrize("rows,cols,sr,sc", [(2, 4, 1, 2), (4, 2, 3, 1),
+                                             (2, 2, 0, 0)])
+@pytest.mark.parametrize("n,nb", [(29, 8), (16, 4)])
+def test_cholesky_distributed_scan(uplo, rows, cols, sr, sc, n, nb, dtype,
+                                   mode, devices8, monkeypatch):
+    """lax.scan distributed step (trailing="scan"): one compiled body,
+    traced per-k index math — must match the analytic factor on offset
+    grids, ragged sizes, all dtypes, native and mxu+mixed knob routes."""
+    monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "scan")
+    if mode == "mxu+mixed":
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "1")
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        grid = Grid(rows, cols)
+        a = hpd_matrix(n, dtype, seed=n + rows)
+        mat = Matrix_from(a, nb, grid=grid,
+                          src=RankIndex2D(sr % rows, sc % cols))
+        out = cholesky(uplo, mat).to_numpy()
+        check_factor(uplo, a, out, dtype)
+    finally:
+        for k in ("DLAF_CHOLESKY_TRAILING", "DLAF_F64_GEMM",
+                  "DLAF_F64_TRSM", "DLAF_F64_GEMM_MIN_DIM"):
+            monkeypatch.delenv(k, raising=False)
+        config.initialize()

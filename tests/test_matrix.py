@@ -221,3 +221,39 @@ def test_complex_pair_transfer_mode(monkeypatch):
 
     md = Matrix.from_global(a, TileElementSize(8, 8), grid=Grid(2, 4))
     assert np.asarray(md.to_numpy()).tobytes() == np.asarray(ref).tobytes()
+
+
+def test_complex_pair_fallback_detection(monkeypatch):
+    """The try/except detection path: a direct complex device_put failing
+    (while the probe also fails) falls back to the pair route, latches the
+    mode with a warning, and still round-trips bit-exactly. Non-complex
+    failures re-raise untouched."""
+    import warnings as _warnings
+
+    import jax as _jax
+
+    from dlaf_tpu.matrix import memory
+
+    real_put = _jax.device_put
+
+    def flaky_put(x, sharding=None):
+        if np.iscomplexobj(x):
+            raise RuntimeError("synthetic: backend rejects complex128")
+        return real_put(x, sharding)
+
+    monkeypatch.setattr(memory, "_complex_pair_mode", None)
+    monkeypatch.setattr(_jax, "device_put", flaky_put)
+    a = (np.arange(12.0) + 1j * np.arange(12.0)[::-1]).reshape(3, 4)
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        out = memory.place(a)
+    assert memory._complex_pair_mode is True
+    assert any("pair mode" in str(x.message) for x in w)
+    assert np.asarray(out).tobytes() == a.tobytes()
+    # real arrays that fail must re-raise, not loop into the pair path
+    monkeypatch.setattr(memory, "_complex_pair_mode", None)
+    monkeypatch.setattr(
+        _jax, "device_put",
+        lambda x, sharding=None: (_ for _ in ()).throw(RuntimeError("down")))
+    with pytest.raises(RuntimeError, match="down"):
+        memory.place(np.ones((2, 2)))
