@@ -540,19 +540,16 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
     nt = dist.nr_tiles.row
     mb = dist.block_size.row
     n = dist.size.row
-    Pr, Qc = dist.grid_size.row, dist.grid_size.col
-    sr, sc = dist.source_rank.row, dist.source_rank.col
     _, _, ltr, ltc = storage_tile_grid(dist)
 
     def step(lt, k):
-        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
-        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
-        owner_r = ud.rank_global_tile(k, Pr, sr)
-        owner_c = ud.rank_global_tile(k, Qc, sc)
-        kr = ud.local_tile_from_global_tile(k, Pr)
-        kc = ud.local_tile_from_global_tile(k, Qc)
-        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
-        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+        # block-cyclic index math through DistContext (shared with the
+        # scan solve in triangular.py — single owner of these formulas)
+        ctx = DistContext(dist)
+        owner_r, owner_c = ctx.owner_r(k), ctx.owner_c(k)
+        kr, kc = ctx.kr(k), ctx.kc(k)
+        is_owner_r = ctx.rank_r == owner_r
+        is_owner_c = ctx.rank_c == owner_c
 
         # -- diag tile -> everyone --------------------------------------
         cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0), (1, 1, mb, mb))[0, 0]
@@ -574,8 +571,8 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
         lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
                                           (kr, kc, 0, 0))
 
-        g_rows = jnp.arange(ltr) * Pr + rr
-        g_cols = jnp.arange(ltc) * Qc + rc
+        g_rows = ctx.g_rows(0, ltr)
+        g_cols = ctx.g_cols(0, ltc)
         row_valid = (g_rows > k) & (g_rows < nt)
         col_valid = (g_cols > k) & (g_cols < nt)
 

@@ -32,8 +32,10 @@ from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..matrix.matrix import Matrix
-from ..matrix.panel import (DistContext, bcast_diag, col_panel, pad_diag_identity,
-                            row_panel, transpose_col_to_rows, transpose_row_to_cols)
+from ..matrix.panel import (DistContext, bcast_diag, bcast_diag_dyn, col_panel,
+                            col_panel_dyn, pad_diag_identity,
+                            pad_diag_identity_dyn, row_panel, row_panel_dyn,
+                            transpose_col_to_rows, transpose_row_to_cols)
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 from ..tile_ops import blas as tb
 
@@ -148,6 +150,78 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
+    """``lax.scan`` form of the distributed solve (config
+    ``dist_step_mode="scan"``): one compiled step body looped ``nt`` times
+    — the same O(1)-compile / uniform-masked-shapes trade as the scan
+    Cholesky (see ``cholesky._build_dist_cholesky_scan`` and
+    docs/DESIGN.md). Per-``k`` index math is traced arithmetic; pivot
+    row/column access uses dynamic slices; the trailing update covers all
+    local slots under a traced remaining-tiles mask."""
+    nt = dist_a.nr_tiles.row
+    n = dist_a.size.row
+    mb = dist_a.block_size.row
+
+    def prog(lta, ltb):
+        ctx_a = DistContext(dist_a)
+        ctx_b = DistContext(dist_b)
+        eff_lower = (uplo == "L") == (op == "N")
+        forward = eff_lower if side == "L" else not eff_lower
+
+        def step(ltb, i):
+            k = i if forward else nt - 1 - i
+            akk = bcast_diag_dyn(ctx_a, lta, k)
+            akk = pad_diag_identity_dyn(akk, jnp.minimum(mb, n - k * mb))
+            if side == "L":
+                bk = row_panel_dyn(ctx_b, ltb, k)
+                xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
+                own = ctx_b.rank_r == ctx_b.owner_r(k)
+                row = ctx_b.kr(k)
+                cur = jax.lax.dynamic_slice(
+                    ltb, (row, 0, 0, 0), (1,) + ltb.shape[1:])[0]
+                ltb = jax.lax.dynamic_update_slice(
+                    ltb, jnp.where(own, xk, cur)[None], (row, 0, 0, 0))
+                g = ctx_b.g_rows(0, ctx_b.ltr)
+                rem = ((g > k) if forward else (g < k)) & (g < nt)
+                if op == "N":
+                    e = col_panel_dyn(ctx_a, lta, k)
+                else:
+                    rk = row_panel_dyn(ctx_a, lta, k)
+                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
+                e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                upd = tb.contract("rab,cbd->rcad", e, xk)
+                return ltb - upd, None
+            bk = col_panel_dyn(ctx_b, ltb, k)
+            xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
+            own = ctx_b.rank_c == ctx_b.owner_c(k)
+            col = ctx_b.kc(k)
+            cur = jax.lax.dynamic_slice(
+                ltb, (0, col, 0, 0),
+                (ltb.shape[0], 1) + ltb.shape[2:])[:, 0]
+            ltb = jax.lax.dynamic_update_slice(
+                ltb, jnp.where(own, xk, cur)[:, None], (0, col, 0, 0))
+            g = ctx_b.g_cols(0, ctx_b.ltc)
+            rem = ((g > k) if forward else (g < k)) & (g < nt)
+            if op == "N":
+                e = row_panel_dyn(ctx_a, lta, k)
+            else:
+                ck = col_panel_dyn(ctx_a, lta, k)
+                e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
+            e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+            upd = tb.contract("rab,cbd->rcad", xk, e)
+            return ltb - upd, None
+
+        ltb, _ = jax.lax.scan(step, ltb, jnp.arange(nt))
+        return ltb
+
+    def run(lta, ltb, alpha):
+        return prog(lta, alpha * ltb)
+
+    return shard_map(run, mesh=mesh,
+                     in_specs=(P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS), P()),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
 # ---------------------------------------------------------------------------
 # Distributed accumulation (multiply) — reference multiplication/triangular
 # ---------------------------------------------------------------------------
@@ -223,8 +297,10 @@ def _unit_diag(t, diag):
 
 @register_program_cache
 @functools.lru_cache(maxsize=128)
-def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
-    return jax.jit(_build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))
+def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
+                       scan=False):
+    build = _build_dist_solve_scan if scan else _build_dist_solve
+    return jax.jit(build(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))
 
 
 @register_program_cache
@@ -253,8 +329,11 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
         out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
                            side=side, uplo=uplo, op=op, diag=diag)
         return b.with_storage(global_to_tiles(out, b.dist))
+    from ..config import get_configuration
+
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
-                            np.dtype(a.dtype).name)
+                            np.dtype(a.dtype).name,
+                            scan=get_configuration().dist_step_mode == "scan")
     return b.with_storage(fn(a.storage, b.storage, jnp.asarray(alpha, b.dtype)))
 
 

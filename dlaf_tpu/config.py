@@ -109,6 +109,13 @@ class Configuration:
     #: f64_gemm, so with "mxu" it runs on the int8 path). Whole-matrix local
     #: solves stay native either way.
     f64_trsm: str = "native"
+    #: Distributed solver step formulation: "unrolled" (per-k steps traced
+    #: out — exact shapes, compile time linear in the tile count) or
+    #: "scan" (lax.scan'd uniform masked step — O(1) compile, ~2x panel
+    #: work; the compile-latency escape hatch at large tile counts,
+    #: algorithms/triangular.py). Cholesky selects its scan form via
+    #: cholesky_trailing="scan".
+    dist_step_mode: str = "unrolled"
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -191,6 +198,7 @@ _VALID_CHOICES = {
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16"),
     "mixed_seed": ("xla", "recursive"),
+    "dist_step_mode": ("unrolled", "scan"),
 }
 
 

@@ -18,6 +18,7 @@ time static ``k``).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..comm import collectives as cc
@@ -100,6 +101,41 @@ def pad_diag_identity(tile, real_size: int):
     pad = jnp.arange(mb) >= real_size
     cleared = jnp.where(pad[:, None] | pad[None, :], 0, tile)
     return cleared + jnp.diag(pad.astype(tile.dtype))
+
+
+def bcast_diag_dyn(ctx: DistContext, lt, k):
+    """:func:`bcast_diag` for a TRACED ``k`` (scan-mode steps): the pivot
+    slot is a dynamic slice, the owner ranks traced arithmetic."""
+    mb, nb = lt.shape[-2], lt.shape[-1]
+    cand = jax.lax.dynamic_slice(
+        lt, (ctx.kr(k), ctx.kc(k), 0, 0), (1, 1, mb, nb))[0, 0]
+    return cc.bcast(cc.bcast(cand, ROW_AXIS, ctx.owner_r(k)),
+                    COL_AXIS, ctx.owner_c(k))
+
+
+def pad_diag_identity_dyn(tile, real_size):
+    """:func:`pad_diag_identity` for a TRACED ``real_size`` (no trace-time
+    no-op shortcut; full tiles produce an all-False pad mask)."""
+    mb = tile.shape[-1]
+    pad = jnp.arange(mb) >= real_size
+    cleared = jnp.where(pad[:, None] | pad[None, :], 0, tile)
+    return cleared + jnp.diag(pad.astype(tile.dtype))
+
+
+def col_panel_dyn(ctx: DistContext, lt, k):
+    """:func:`col_panel` for a TRACED ``k``, over ALL local row slots."""
+    mb, nb = lt.shape[-2], lt.shape[-1]
+    mine = jax.lax.dynamic_slice(
+        lt, (0, ctx.kc(k), 0, 0), (lt.shape[0], 1, mb, nb))[:, 0]
+    return cc.bcast(mine, COL_AXIS, ctx.owner_c(k))
+
+
+def row_panel_dyn(ctx: DistContext, lt, k):
+    """:func:`row_panel` for a TRACED ``k``, over ALL local col slots."""
+    mb, nb = lt.shape[-2], lt.shape[-1]
+    mine = jax.lax.dynamic_slice(
+        lt, (ctx.kr(k), 0, 0, 0), (1, lt.shape[1], mb, nb))[0]
+    return cc.bcast(mine, ROW_AXIS, ctx.owner_r(k))
 
 
 def col_panel(ctx: DistContext, lt, k: int, lu: int):

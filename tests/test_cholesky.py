@@ -158,6 +158,8 @@ def test_cholesky_distributed_scan(uplo, rows, cols, sr, sc, n, nb, dtype,
     """lax.scan distributed step (trailing="scan"): one compiled body,
     traced per-k index math — must match the analytic factor on offset
     grids, ragged sizes, all dtypes, native and mxu+mixed knob routes."""
+    if mode == "mxu+mixed" and dtype == np.float32:
+        pytest.skip("mxu/mixed knobs are no-ops for float32 (dtype gate)")
     monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "scan")
     if mode == "mxu+mixed":
         monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
