@@ -42,7 +42,8 @@ from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix import util_distribution as ud
 from ..matrix.matrix import Matrix
-from ..matrix.panel import DistContext, transpose_col_to_rows, transpose_row_to_cols
+from ..matrix.panel import (DistContext, pad_diag_identity_dyn,
+                            transpose_col_to_rows, transpose_row_to_cols)
 from ..matrix.tiling import storage_tile_grid, tiles_to_global, global_to_tiles
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
@@ -555,9 +556,8 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
         cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0), (1, 1, mb, mb))[0, 0]
         diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
         ts = jnp.minimum(mb, n - k * mb)
-        pad = jnp.arange(mb) >= ts   # identity-pad traced short edge tiles
-        diag = jnp.where(pad[:, None] | pad[None, :], 0, diag) \
-            + jnp.diag(pad.astype(diag.dtype))
+        pad = jnp.arange(mb) >= ts   # short-edge mask (un-pad after potrf)
+        diag = pad_diag_identity_dyn(diag, ts)
         if use_mixed:
             other = "U" if uplo == "L" else "L"
             fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)

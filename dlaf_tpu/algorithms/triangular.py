@@ -226,6 +226,20 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
 # Distributed accumulation (multiply) — reference multiplication/triangular
 # ---------------------------------------------------------------------------
 
+def _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag):
+    """Triangle masking of a pivot panel for the multiply builders: the
+    diagonal slot gets the (unit-)triangle-masked tile, strict slots the
+    full tile, everything else zero. ``strict``: boolean per-slot mask of
+    the strictly-included side (direction already resolved by the
+    caller's eff_lower/side logic)."""
+    ondiag = (g == k)
+    dt = tb.tri_mask(e, uplo if op == "N" else ("U" if uplo == "L" else "L"))
+    dt = _unit_diag(dt, diag)
+    return jnp.where(ondiag[:, None, None], dt,
+                     jnp.where(strict[:, None, None] & (g < nt)[:, None, None],
+                               e, jnp.zeros_like(e)))
+
+
 def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
     nt = dist_a.nr_tiles.row
 
@@ -243,16 +257,8 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                 else:
                     rk = row_panel(ctx_a, lta, k, 0)
                     e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
-                # triangle mask over effective rows: strict part full tile,
-                # diagonal slot gets the (unit-)triangle-masked tile
                 strict = (g > k) if eff_lower else (g < k)
-                ondiag = (g == k)
-                dt = tb.tri_mask(e, uplo if op == "N" else
-                                 ("U" if uplo == "L" else "L"))
-                dt = _unit_diag(dt, diag)
-                e = jnp.where(ondiag[:, None, None], dt,
-                              jnp.where(strict[:, None, None] & (g < nt)[:, None, None],
-                                        e, jnp.zeros_like(e)))
+                e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
                 upd = tb.contract("rab,cbd->rcad", e, bk)
                 out = out + upd
             else:
@@ -264,15 +270,55 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                     ck = col_panel(ctx_a, lta, k, 0)
                     e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
                 strict = (g > k) if not eff_lower else (g < k)
-                ondiag = (g == k)
-                dt = tb.tri_mask(e, uplo if op == "N" else
-                                 ("U" if uplo == "L" else "L"))
-                dt = _unit_diag(dt, diag)
-                e = jnp.where(ondiag[:, None, None], dt,
-                              jnp.where(strict[:, None, None] & (g < nt)[:, None, None],
-                                        e, jnp.zeros_like(e)))
+                e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
                 upd = tb.contract("rab,cbd->rcad", bk, e)
                 out = out + upd
+        return out
+
+    def run(lta, ltb, alpha):
+        return alpha * prog(lta, ltb)
+
+    return shard_map(run, mesh=mesh,
+                     in_specs=(P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS), P()),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
+def _build_dist_mult_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
+    """``lax.scan`` form of the distributed multiply: the unrolled body is
+    already uniform-shaped (no slot shrink), so the scan version only
+    swaps the pivot panel reads for their traced-``k`` dynamic forms and
+    carries the accumulator — O(1) compile, identical flops."""
+    nt = dist_a.nr_tiles.row
+
+    def prog(lta, ltb):
+        ctx_a = DistContext(dist_a)
+        ctx_b = DistContext(dist_b)
+        eff_lower = (uplo == "L") == (op == "N")
+
+        def step(out, k):
+            if side == "L":
+                bk = row_panel_dyn(ctx_b, ltb, k)
+                g = ctx_b.g_rows(0, ctx_b.ltr)
+                if op == "N":
+                    e = col_panel_dyn(ctx_a, lta, k)
+                else:
+                    rk = row_panel_dyn(ctx_a, lta, k)
+                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
+                strict = (g > k) if eff_lower else (g < k)
+                e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
+                return out + tb.contract("rab,cbd->rcad", e, bk), None
+            bk = col_panel_dyn(ctx_b, ltb, k)
+            g = ctx_b.g_cols(0, ctx_b.ltc)
+            if op == "N":
+                e = row_panel_dyn(ctx_a, lta, k)
+            else:
+                ck = col_panel_dyn(ctx_a, lta, k)
+                e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
+            strict = (g > k) if not eff_lower else (g < k)
+            e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
+            return out + tb.contract("rab,cbd->rcad", bk, e), None
+
+        out, _ = jax.lax.scan(step, jnp.zeros_like(ltb), jnp.arange(nt))
         return out
 
     def run(lta, ltb, alpha):
@@ -305,8 +351,10 @@ def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
 
 @register_program_cache
 @functools.lru_cache(maxsize=128)
-def _dist_mult_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
-    return jax.jit(_build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))
+def _dist_mult_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
+                      scan=False):
+    build = _build_dist_mult_scan if scan else _build_dist_mult
+    return jax.jit(build(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))
 
 
 def _check_args(side, a: Matrix, b: Matrix):
@@ -349,6 +397,9 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
         out = _mult_local(am, bm, jnp.asarray(alpha, bm.dtype),
                           side=side, uplo=uplo, op=op, diag=diag)
         return b.with_storage(global_to_tiles(out, b.dist))
+    from ..config import get_configuration
+
     fn = _dist_mult_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
-                           np.dtype(a.dtype).name)
+                           np.dtype(a.dtype).name,
+                           scan=get_configuration().dist_step_mode == "scan")
     return b.with_storage(fn(a.storage, b.storage, jnp.asarray(alpha, b.dtype)))

@@ -65,24 +65,30 @@ _PROBE_PASS_LATCH_AFTER = 3
 
 def _latch_pair_mode(op: str):
     """Latch when a TINY direct complex transfer also fails right now
-    (clear-cut backend rejection), or after several direct failures whose
-    probe passed (a transfer bug specific to the real shapes/shardings
-    that the 1-element probe cannot reproduce). One-off transient
-    failures latch nothing."""
+    (clear-cut backend rejection), or after several CONSECUTIVE direct
+    failures whose probe passed (a transfer bug specific to the real
+    shapes/shardings that the 1-element probe cannot reproduce; the
+    counter resets on any direct success). One-off transient failures
+    latch nothing."""
     global _complex_pair_mode, _probe_passed_failures
     if _complex_pair_mode is True:
         return
+    reason = f"direct complex128 {op} failed; the 1-element probe failed too"
     try:
         jax.device_get(jax.device_put(np.zeros((1,), np.complex128)))
         _probe_passed_failures += 1
         if _probe_passed_failures < _PROBE_PASS_LATCH_AFTER:
             return   # probably transient; keep trying direct first
+        reason = (f"direct complex128 {op} failed "
+                  f"{_probe_passed_failures} consecutive times while the "
+                  "1-element probe kept passing (shape/sharding-specific "
+                  "transfer bug)")
     except Exception:
         pass
     warnings.warn(
-        f"direct complex128 {op} failed (confirmed by a probe) but the "
-        "real/imag pair transfer succeeded; enabling pair mode for all "
-        "further complex transfers in this process (matrix/memory.py)")
+        f"{reason}; the real/imag pair transfer succeeded — enabling pair "
+        "mode for all further complex transfers in this process "
+        "(matrix/memory.py)")
     _complex_pair_mode = True
 
 
@@ -90,10 +96,14 @@ def place(array, sharding=None):
     """Move a host array into device memory (reference: MemoryChunk alloc +
     H2D); with a NamedSharding this is the distributed placement. Also the
     device-to-device reshard path for device-array inputs."""
+    global _probe_passed_failures
     if np.iscomplexobj(array) and _complex_pair_mode:
         return _place_pair(array, sharding)
     try:
-        return jax.device_put(array, sharding)
+        out = jax.device_put(array, sharding)
+        if np.iscomplexobj(array):
+            _probe_passed_failures = 0   # direct works; reset the streak
+        return out
     except Exception:
         if not np.iscomplexobj(array):
             raise
@@ -106,10 +116,14 @@ def fetch(x) -> np.ndarray:
     """Device array -> host numpy (reference: D2H copy), with the symmetric
     complex-pair fallback: real/imag computed on device, transferred as two
     real arrays, combined on host."""
+    global _probe_passed_failures
     if np.iscomplexobj(x) and _complex_pair_mode:
         return _fetch_pair(x)
     try:
-        return np.asarray(jax.device_get(x))
+        out = np.asarray(jax.device_get(x))
+        if np.iscomplexobj(x):
+            _probe_passed_failures = 0   # direct works; reset the streak
+        return out
     except Exception:
         if not np.iscomplexobj(x):
             raise

@@ -194,3 +194,32 @@ def test_solve_distributed_scan(side, uplo, op, diag, grid_shape, dtype,
     finally:
         monkeypatch.delenv("DLAF_DIST_STEP_MODE")
         config.initialize()
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("grid_shape", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("side,uplo,op,diag",
+                         [("L", "L", "N", "N"), ("L", "U", "C", "U"),
+                          ("R", "U", "N", "N"), ("R", "L", "T", "U"),
+                          ("L", "U", "N", "N"), ("R", "L", "N", "U")])
+def test_multiply_distributed_scan(side, uplo, op, diag, grid_shape, dtype,
+                                   devices8, monkeypatch):
+    """dist_step_mode="scan" for the multiply: traced-k pivot panels,
+    carried accumulator — must match numpy on ragged sizes."""
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "scan")
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        n, m, nb = 19, 13, 4
+        a, b = make_ab(n, m, dtype, side, seed=9)
+        grid = Grid(*grid_shape)
+        am, bm = mats(a, b, nb, nb, grid=grid,
+                      src=RankIndex2D(1 % grid_shape[0], 1 % grid_shape[1]))
+        out = triangular_multiply(side, uplo, op, diag, 0.5, am, bm).to_numpy()
+        t = np_op(np_tri(a, uplo, diag), op)
+        expect = 0.5 * (t @ b) if side == "L" else 0.5 * (b @ t)
+        np.testing.assert_allclose(out, expect, **_tol(dtype))
+    finally:
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE")
+        config.initialize()
