@@ -34,6 +34,10 @@ def main():
                     help="tile size (compile cost depends on tile COUNT, "
                          "not tile size; small tiles keep tracing cheap)")
     ap.add_argument("--cache", default="")
+    ap.add_argument("--mode", default="unrolled",
+                    choices=("unrolled", "scan"),
+                    help="step formulation: unrolled per-k trace or the "
+                         "lax.scan'd uniform step (O(1) compile)")
     args = ap.parse_args()
 
     if not os.environ.get("_DLAF_COMPILE_SCALING_CHILD"):
@@ -56,7 +60,8 @@ def main():
     import numpy as np
 
     import dlaf_tpu.config as config
-    from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky
+    from dlaf_tpu.algorithms.cholesky import (_build_dist_cholesky,
+                                              _build_dist_cholesky_scan)
     from dlaf_tpu.comm.grid import Grid
     from dlaf_tpu.common.index2d import (GlobalElementSize, GridSize2D,
                                          RankIndex2D, TileElementSize)
@@ -75,8 +80,11 @@ def main():
                             rank=RankIndex2D(0, 0),
                             source_rank=RankIndex2D(0, 0))
         sr, sc, _, _ = storage_tile_grid(dist)
-        fn = _build_dist_cholesky(dist, grid.mesh, "L", use_pallas=False,
-                                  pallas_interpret=True)
+        if args.mode == "scan":
+            fn = _build_dist_cholesky_scan(dist, grid.mesh, "L")
+        else:
+            fn = _build_dist_cholesky(dist, grid.mesh, "L", use_pallas=False,
+                                      pallas_interpret=True)
         x = jax.ShapeDtypeStruct((sr, sc, nb, nb), np.float64)
         t0 = time.perf_counter()
         lowered = jax.jit(fn).lower(x)
@@ -88,7 +96,7 @@ def main():
             size = compiled.memory_analysis().generated_code_size_in_bytes
         except Exception:
             size = -1
-        row = {"nt": nt, "trace_s": round(t_trace, 2),
+        row = {"nt": nt, "mode": args.mode, "trace_s": round(t_trace, 2),
                "compile_s": round(t_compile, 2), "code_bytes": size}
         results.append(row)
         log(f"nt={nt}: trace {t_trace:.1f}s, compile {t_compile:.1f}s, "
