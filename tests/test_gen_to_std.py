@@ -136,3 +136,37 @@ def test_general_sub_multiply(grid_shape, devices8):
     sl = slice(4, 12)
     expect[sl, sl] = 2.0 * a[sl, sl] @ b[sl, sl] + 0.5 * c[sl, sl]
     np.testing.assert_allclose(out, expect, rtol=1e-13, atol=1e-13)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_gen_to_std_distributed_scan_mode(uplo, devices8, monkeypatch):
+    """dist_step_mode="scan" flows through gen_to_std's composition of
+    distributed solves (config #3's compile-time escape hatch at large
+    tile counts comes for free from the solver's scan step)."""
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "scan")
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        n, nb = 21, 4
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+        a = x @ x.conj().T + 2 * n * np.eye(n)
+        y = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+        bmat = y @ y.conj().T + 2 * n * np.eye(n)
+        l = np.linalg.cholesky(bmat) if uplo == "L" else \
+            np.linalg.cholesky(bmat).conj().T
+        grid = Grid(2, 4)
+        am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+        lm = Matrix.from_global(l, TileElementSize(nb, nb), grid=grid)
+        out = gen_to_std(uplo, am, lm).to_numpy()
+        if uplo == "L":
+            expect = np.linalg.inv(l) @ a @ np.linalg.inv(l).conj().T
+        else:
+            expect = np.linalg.inv(l).conj().T @ a @ np.linalg.inv(l)
+        got = out if uplo != "L" else out  # full result matrix
+        tri = np.tril if uplo == "L" else np.triu
+        np.testing.assert_allclose(tri(got), tri(expect), atol=1e-10)
+    finally:
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE")
+        config.initialize()
