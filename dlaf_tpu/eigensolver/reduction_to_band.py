@@ -215,10 +215,115 @@ def _build_dist_red2band(dist, mesh, dtype, band):
                      out_specs=(P(ROW_AXIS, COL_AXIS), P()), check_vma=False)
 
 
+def _build_dist_red2band_scan(dist, mesh, dtype, band):
+    """``lax.scan`` form of the distributed reduction (config
+    ``dist_step_mode="scan"``): one compiled panel step looped
+    ``ceil(n/b) - 1`` times — by far the framework's worst unrolled
+    compile case (config #4 is 127 panels at ~19 s/step on the hardware
+    AOT toolchain, docs/DESIGN.md).
+
+    Uniform-shape scheme: the panel's tile column and in-tile offset are
+    traced; the full-height masked column is gathered in static global
+    order (``k1=0``), top-aligned with a traced ``jnp.roll`` (zero rows
+    below a Householder panel do not perturb its reflectors, so
+    ``geqrf`` of the rolled (n_t*nb, b) column equals the shrunken
+    panel's factorization zero-padded), and the two-sided update runs
+    over ALL local slots under traced element masks. Extra work vs the
+    unrolled form: full-height panels and full-grid updates every step
+    (~2-3x flops)."""
+    nt = dist.nr_tiles.row
+    nb = dist.block_size.row
+    n = dist.size.row
+    b = band
+    npan = ceil_div(n, b) - 1 if n else 0
+
+    def step(carry, p):
+        lt, taus_out = carry
+        ctx = DistContext(dist)
+        bdy = (p + 1) * b
+        tc = (p * b) // nb
+        co = (p * b) % nb
+        kc = ctx.kc(tc)
+        arange_nb = jnp.arange(nb)
+
+        # -- full-height masked panel column, replicated + top-aligned ---
+        g_rows = ctx.g_rows(0, ctx.ltr)
+        g_erows = g_rows[:, None] * nb + arange_nb[None, :]
+        row_val_e = (g_erows >= bdy) & (g_erows < n)
+        raw = jax.lax.dynamic_slice(
+            lt, (0, kc, 0, co), (ctx.ltr, 1, nb, b))[:, 0]
+        mine = jnp.where(row_val_e[:, :, None], raw, jnp.zeros_like(raw))
+        mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
+        ptiles = gather_col_panel_ordered(ctx, mine, 0, 0)   # static order
+        full_col = ptiles.reshape(nt * nb, b)
+        pan = jnp.roll(full_col, -bdy, axis=0)   # panel rows at the top
+        vfull, taus = geqrf(pan)
+        ntau = taus.shape[0]
+        if ntau < b:
+            taus = jnp.pad(taus, (0, b - ntau))
+        col_live = jnp.arange(b) < (n - bdy)
+        taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
+        taus_out = taus_out.at[p].set(taus)
+        v = jnp.tril(vfull, -1) + jnp.eye(nt * nb, b, dtype=pan.dtype)
+
+        def tiles_of(mat):
+            # roll back to matrix row space and cut into tiles
+            return jnp.roll(mat, bdy, axis=0).reshape(nt, nb, b)
+
+        # -- write the factored panel back (owner column, my rows) -------
+        vtiles = tiles_of(vfull)
+        my_new = vtiles[g_rows]
+        keep = (ctx.rank_c == ctx.owner_c(tc)) & row_val_e
+        new = jnp.where(keep[:, :, None], my_new, raw)
+        lt = jax.lax.dynamic_update_slice(lt, new[:, None], (0, kc, 0, co))
+
+        # -- trailing two-sided update over all local slots --------------
+        g_cols = ctx.g_cols(0, ctx.ltc)
+        g_ecols = g_cols[:, None] * nb + arange_nb[None, :]
+        col_val_e = (g_ecols >= bdy) & (g_ecols < n)
+        t = larft(v, taus)
+        v_tiles = tiles_of(v)
+        vt_tiles = tiles_of(v @ t)
+        vtl = jnp.where(col_val_e[:, :, None], vt_tiles[g_cols],
+                        jnp.zeros((ctx.ltc, nb, b), dtype=pan.dtype))
+        atr = jnp.where((row_val_e[:, None, :, None]
+                         & col_val_e[None, :, None, :]), lt,
+                        jnp.zeros_like(lt))
+        w_loc = tb.contract("rcab,cbd->rad", atr, vtl)
+        w_loc = cc.all_reduce(w_loc, COL_AXIS)
+        vr = jnp.where(row_val_e[:, :, None], v_tiles[g_rows],
+                       jnp.zeros((ctx.ltr, nb, b), dtype=pan.dtype))
+        m_mat = tb.contract("rab,rad->bd", jnp.conj(vr), w_loc)
+        m_mat = cc.all_reduce(m_mat, ROW_AXIS)
+        x_loc = w_loc - 0.5 * jnp.einsum("rab,bd->rad", vr,
+                                         t.conj().T @ m_mat,
+                                         preferred_element_type=lt.dtype)
+        xfull = gather_col_panel_ordered(ctx, x_loc, 0, 0)
+        xc = jnp.where(col_val_e[:, :, None], xfull[g_cols],
+                       jnp.zeros((ctx.ltc, nb, b), dtype=pan.dtype))
+        vc = jnp.where(col_val_e[:, :, None], v_tiles[g_cols],
+                       jnp.zeros((ctx.ltc, nb, b), dtype=pan.dtype))
+        xr = jnp.where(row_val_e[:, :, None], x_loc, jnp.zeros_like(x_loc))
+        upd = (tb.contract("rad,cbd->rcab", xr, jnp.conj(vc))
+               + tb.contract("rad,cbd->rcab", vr, jnp.conj(xc)))
+        return (lt - upd, taus_out), None
+
+    def run(lt):
+        taus0 = jnp.zeros((max(npan, 0), b), dtype=lt.dtype)
+        if npan <= 0:
+            return lt, taus0
+        (lt, taus), _ = jax.lax.scan(step, (lt, taus0), jnp.arange(npan))
+        return lt, taus
+
+    return shard_map(run, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),
+                     out_specs=(P(ROW_AXIS, COL_AXIS), P()), check_vma=False)
+
+
 @register_program_cache
 @functools.lru_cache(maxsize=32)
-def _dist_red2band_cached(dist, mesh, dtype, band):
-    return jax.jit(_build_dist_red2band(dist, mesh, dtype, band))
+def _dist_red2band_cached(dist, mesh, dtype, band, scan=False):
+    build = _build_dist_red2band_scan if scan else _build_dist_red2band
+    return jax.jit(build(dist, mesh, dtype, band))
 
 
 # ---------------------------------------------------------------------------
@@ -249,8 +354,12 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
         out, taus = _red2band_local(g, nb=band)
         return BandReduction(a.with_storage(global_to_tiles(out, a.dist)),
                              taus, band)
+    from ..config import get_configuration
+
     fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name,
-                               band)
+                               band,
+                               scan=get_configuration().dist_step_mode
+                               == "scan")
     storage, taus = fn(a.storage)
     return BandReduction(a.with_storage(storage), taus, band)
 

@@ -228,3 +228,36 @@ def test_red2band_distributed_complex(dtype, devices8):
     bd = band_dense(red, n)
     np.testing.assert_allclose(np.linalg.eigvalsh(bd), np.linalg.eigvalsh(a),
                                atol=1e-9)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb,band,grid_shape,src",
+                         [(24, 4, 4, (2, 4), (0, 0)),
+                          (21, 4, 4, (4, 2), (1, 1)),
+                          (24, 8, 4, (2, 2), (0, 1)),
+                          (19, 8, 2, (2, 4), (1, 0))])
+def test_red2band_distributed_scan(n, nb, band, grid_shape, src, dtype,
+                                   devices8, monkeypatch):
+    """dist_step_mode="scan" reduction: traced panel offsets, rolled
+    full-height geqrf panels — eigenvalues must match the dense matrix on
+    offset grids, ragged sizes, sub-block bands, both dtypes."""
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "scan")
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        a = herm(n, dtype, n + band)
+        grid = Grid(*grid_shape)
+        mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
+                                 source_rank=RankIndex2D(
+                                     src[0] % grid_shape[0],
+                                     src[1] % grid_shape[1]))
+        red = reduction_to_band(mat, band_size=band)
+        bd = band_dense(red, n)
+        mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > band
+        assert np.allclose(bd[mask], 0, atol=1e-12)
+        np.testing.assert_allclose(np.linalg.eigvalsh(bd),
+                                   np.linalg.eigvalsh(a), atol=1e-9)
+    finally:
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE")
+        config.initialize()
