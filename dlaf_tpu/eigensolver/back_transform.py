@@ -53,7 +53,8 @@ from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, gather_sub_panel,
-                            pad_sub_panel_to_tiles)
+                            gather_sub_panel_dyn, pad_sub_panel_to_tiles,
+                            tiles_of_rolled)
 from ..matrix.tiling import (_axis_perm_inv, global_to_tiles, storage_tile_grid,
                              tiles_to_global)
 from ..tile_ops.lapack import larft
@@ -363,10 +364,59 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
+def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
+    """``lax.scan`` form of the distributed back-transform
+    (``dist_step_mode="scan"``): one compiled reflector-block step looped
+    ``ceil(n/b) - 1`` times in reverse — config #5's back-transform has
+    the same per-panel unrolled-compile exposure as the forward reduction
+    (docs/DESIGN.md). Uses the shared traced-``p`` rolled sub-panel
+    gather; the W2 psum and the C update run over ALL local row slots
+    under traced element masks."""
+    nt = dist_a.nr_tiles.row
+    nb = dist_a.block_size.row
+    n = dist_a.size.row
+    b = band
+    npan = ceil_div(n, b) - 1 if n else 0
+
+    def run(lt_a, taus, lt_c):
+        ctx_a = DistContext(dist_a)
+        ctx_c = DistContext(dist_c)
+        arange_nb = jnp.arange(nb)
+
+        def step(lt_c, i):
+            p = npan - 1 - i
+            pan, bdy, _, _, _, _, _ = gather_sub_panel_dyn(
+                ctx_a, lt_a, p=p, b=b, n=n)
+            v = jnp.tril(pan, -1) + jnp.eye(nt * nb, b, dtype=pan.dtype)
+            t = larft(v, taus[p])
+            vt = tiles_of_rolled(ctx_a, v, bdy)
+
+            g_rows_c = ctx_c.g_rows(0, ctx_c.ltr)
+            g_erows_c = g_rows_c[:, None] * nb + arange_nb[None, :]
+            rv_c_e = (g_erows_c >= bdy) & (g_erows_c < n)
+            v_my = jnp.where(rv_c_e[:, :, None], vt[g_rows_c],
+                             jnp.zeros((ctx_c.ltr, nb, b), dtype=pan.dtype))
+            w2 = tb.contract("rab,rcad->cbd", jnp.conj(v_my), lt_c)
+            w2 = cc.all_reduce(w2, ROW_AXIS)
+            w2 = tb.contract("xb,cbd->cxd", t, w2)
+            upd = tb.contract("rab,cbd->rcad", v_my, w2)
+            return lt_c - upd, None
+
+        if npan <= 0:
+            return lt_c
+        lt_c, _ = jax.lax.scan(step, lt_c, jnp.arange(npan))
+        return lt_c
+
+    return shard_map(run, mesh=mesh,
+                     in_specs=(P(ROW_AXIS, COL_AXIS), P(), P(ROW_AXIS, COL_AXIS)),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
 @register_program_cache
 @functools.lru_cache(maxsize=32)
-def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band):
-    return jax.jit(_build_dist_bt_r2b(dist_a, dist_c, mesh, band))
+def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band, scan=False):
+    build = _build_dist_bt_r2b_scan if scan else _build_dist_bt_r2b
+    return jax.jit(build(dist_a, dist_c, mesh, band))
 
 
 def bt_reduction_to_band(red: BandReduction, evecs):
@@ -391,7 +441,11 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         storage = evecs.storage
         if storage.dtype != a.dtype:
             storage = storage.astype(a.dtype)
-        fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band)
+        from ..config import get_configuration
+
+        fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band,
+                                 scan=get_configuration().dist_step_mode
+                                 == "scan")
         out = fn(a.storage, jnp.asarray(red.taus), storage)
         return Matrix(evecs.dist, out, evecs.grid)
     a_v = tiles_to_global(a.storage, a.dist)

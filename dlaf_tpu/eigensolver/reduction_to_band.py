@@ -48,7 +48,8 @@ from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, gather_col_panel_ordered,
-                            gather_sub_panel, pad_sub_panel_to_tiles)
+                            gather_sub_panel, gather_sub_panel_dyn,
+                            pad_sub_panel_to_tiles, tiles_of_rolled)
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft
@@ -240,23 +241,12 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
     def step(carry, p):
         lt, taus_out = carry
         ctx = DistContext(dist)
-        bdy = (p + 1) * b
-        tc = (p * b) // nb
-        co = (p * b) % nb
-        kc = ctx.kc(tc)
         arange_nb = jnp.arange(nb)
 
         # -- full-height masked panel column, replicated + top-aligned ---
-        g_rows = ctx.g_rows(0, ctx.ltr)
-        g_erows = g_rows[:, None] * nb + arange_nb[None, :]
-        row_val_e = (g_erows >= bdy) & (g_erows < n)
-        raw = jax.lax.dynamic_slice(
-            lt, (0, kc, 0, co), (ctx.ltr, 1, nb, b))[:, 0]
-        mine = jnp.where(row_val_e[:, :, None], raw, jnp.zeros_like(raw))
-        mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
-        ptiles = gather_col_panel_ordered(ctx, mine, 0, 0)   # static order
-        full_col = ptiles.reshape(nt * nb, b)
-        pan = jnp.roll(full_col, -bdy, axis=0)   # panel rows at the top
+        pan, bdy, tc, co, row_val_e, g_rows, raw = gather_sub_panel_dyn(
+            ctx, lt, p=p, b=b, n=n)
+        kc = ctx.kc(tc)
         vfull, taus = geqrf(pan)
         ntau = taus.shape[0]
         if ntau < b:
@@ -267,8 +257,7 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
         v = jnp.tril(vfull, -1) + jnp.eye(nt * nb, b, dtype=pan.dtype)
 
         def tiles_of(mat):
-            # roll back to matrix row space and cut into tiles
-            return jnp.roll(mat, bdy, axis=0).reshape(nt, nb, b)
+            return tiles_of_rolled(ctx, mat, bdy)
 
         # -- write the factored panel back (owner column, my rows) -------
         vtiles = tiles_of(vfull)

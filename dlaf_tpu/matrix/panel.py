@@ -113,6 +113,41 @@ def bcast_diag_dyn(ctx: DistContext, lt, k):
                     COL_AXIS, ctx.owner_c(k))
 
 
+def gather_sub_panel_dyn(ctx: DistContext, lt, *, p, b: int, n: int):
+    """:func:`gather_sub_panel` for a TRACED panel index ``p`` (scan-mode
+    steps), uniform shapes: the full-height masked panel column is
+    gathered in static global order and top-aligned with a traced roll —
+    zero rows below a Householder panel do not perturb its reflectors, so
+    ``geqrf``/reflector application on the rolled (nt*mb, b) column
+    equals the shrunken panel's, zero-padded. Returns
+    ``(pan, bdy, tc, co, row_val_e, g_rows, raw)`` with ``row_val_e``/
+    ``g_rows`` over ALL local row slots and ``raw`` the unmasked local
+    slice of the panel column (for write-back)."""
+    nb = ctx.mb
+    nt = ctx.nt.row
+    bdy = (p + 1) * b
+    tc = (p * b) // nb
+    co = (p * b) % nb
+    g_rows = ctx.g_rows(0, ctx.ltr)
+    g_erows = g_rows[:, None] * nb + jnp.arange(nb)[None, :]
+    row_val_e = (g_erows >= bdy) & (g_erows < n)
+    raw = jax.lax.dynamic_slice(
+        lt, (0, ctx.kc(tc), 0, co), (ctx.ltr, 1, nb, b))[:, 0]
+    mine = jnp.where(row_val_e[:, :, None], raw, jnp.zeros_like(raw))
+    mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
+    ptiles = gather_col_panel_ordered(ctx, mine, 0, 0)   # static order
+    pan = jnp.roll(ptiles.reshape(nt * nb, b), -bdy, axis=0)
+    return pan, bdy, tc, co, row_val_e, g_rows, raw
+
+
+def tiles_of_rolled(ctx: DistContext, mat, bdy):
+    """Roll a top-aligned sub-panel quantity back to matrix row space and
+    cut into (nt, mb, b) tiles (scan-mode counterpart of
+    :func:`pad_sub_panel_to_tiles`)."""
+    return jnp.roll(mat, bdy, axis=0).reshape(ctx.nt.row, ctx.mb,
+                                              mat.shape[1])
+
+
 def pad_diag_identity_dyn(tile, real_size):
     """:func:`pad_diag_identity` for a TRACED ``real_size`` (no trace-time
     no-op shortcut; full tiles produce an all-False pad mask)."""

@@ -315,3 +315,35 @@ def test_permutations():
     expect = a.copy()
     expect[:, 4:12] = a[:, 4:12][:, perm]
     np.testing.assert_array_equal(out, expect)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("n,nb,band,grid_shape",
+                         [(24, 4, 4, (2, 4)), (21, 4, 4, (4, 2)),
+                          (22, 8, 4, (2, 2))])
+def test_bt_reduction_to_band_distributed_scan(n, nb, band, grid_shape,
+                                               dtype, devices8, monkeypatch):
+    """dist_step_mode="scan" back-transform (traced reflector-block index,
+    rolled sub-panels) must match the unrolled local result, sub-block
+    bands included."""
+    a = herm(n, dtype, n + band)
+    rng = np.random.default_rng(n)
+    c = rng.standard_normal((n, n)).astype(dtype)
+    red_local = reduction_to_band(M(a, nb), band_size=band)
+    q_local = np.asarray(bt_reduction_to_band(red_local, c))
+
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "scan")
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        grid = Grid(*grid_shape)
+        red_dist = reduction_to_band(
+            Matrix.from_global(a, TileElementSize(nb, nb), grid=grid),
+            band_size=band)
+        cm = Matrix.from_global(c, TileElementSize(nb, nb), grid=grid)
+        q_dist = bt_reduction_to_band(red_dist, cm)
+        np.testing.assert_allclose(q_dist.to_numpy(), q_local, atol=1e-12 * n)
+    finally:
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE")
+        config.initialize()
