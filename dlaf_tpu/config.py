@@ -109,11 +109,13 @@ class Configuration:
     #: f64_gemm, so with "mxu" it runs on the int8 path). Whole-matrix local
     #: solves stay native either way.
     f64_trsm: str = "native"
-    #: Distributed solver step formulation: "unrolled" (per-k steps traced
-    #: out — exact shapes, compile time linear in the tile count) or
-    #: "scan" (lax.scan'd uniform masked step — O(1) compile, ~2x panel
-    #: work; the compile-latency escape hatch at large tile counts,
-    #: algorithms/triangular.py). Cholesky selects its scan form via
+    #: Per-k step formulation for the distributed algorithms (triangular
+    #: solve/multiply, reduction_to_band + its back-transform, gen_to_std
+    #: via its solves) AND the local reduction_to_band: "unrolled" (per-k
+    #: steps traced out — exact shapes, compile time linear in the step
+    #: count) or "scan" (lax.scan'd uniform masked step — O(1) compile,
+    #: ~2-3x masked-shape work; the compile-latency escape hatch at large
+    #: tile counts, docs/DESIGN.md). Cholesky selects its scan form via
     #: cholesky_trailing="scan".
     dist_step_mode: str = "unrolled"
     #: Conditioning guard for the "mixed" fast path, as a limit on the

@@ -100,6 +100,60 @@ def _red2band_local(a, *, nb: int):
     return a, taus_out
 
 
+@register_program_cache
+@functools.partial(jax.jit, static_argnames=("nb",))
+def _red2band_local_scan(a, *, nb: int):
+    """``lax.scan`` form of the local reduction (``dist_step_mode="scan"``):
+    one compiled panel step — the local unrolled trace costs ~19 s/panel
+    on the hardware AOT toolchain and config #4's single-chip form is 127
+    panels (docs/DESIGN.md). Uniform scheme: the full-height masked panel
+    column is top-aligned with a traced roll (zero rows below a
+    Householder panel leave its reflectors unchanged), and the two-sided
+    update is full-size under traced masks (~2-3x flops)."""
+    n = a.shape[0]
+    if n == 0:
+        return a, jnp.zeros((0, nb), dtype=a.dtype)
+    nt = ceil_div(n, nb)
+    npan = nt - 1
+    npad = nt * nb - n
+    if npad:
+        a = jnp.pad(a, ((0, npad), (0, npad)))
+    m = nt * nb
+    rows = jnp.arange(m)
+
+    def step(carry, k):
+        acc, taus_out = carry
+        k0 = k * nb
+        bdy = k0 + nb
+        below = rows >= bdy                        # (m,)
+        raw = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
+        pan = jnp.roll(jnp.where(below[:, None], raw, 0), -bdy, axis=0)
+        # pan has m >= 2*nb rows whenever a step runs, so geqrf returns
+        # exactly nb taus; dead columns of the last panel are masked below
+        vfull, taus = geqrf(pan)
+        col_live = jnp.arange(nb) < (n - bdy)
+        taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
+        taus_out = taus_out.at[k].set(taus)
+        vtop = jnp.tril(vfull, -1) + jnp.eye(m, nb, dtype=acc.dtype)
+        t = larft(vtop, taus)
+        v = jnp.where(below[:, None], jnp.roll(vtop, bdy, axis=0), 0)
+        vr = jnp.roll(vfull, bdy, axis=0)
+        newcol = jnp.where(below[:, None], vr, raw)
+        acc = jax.lax.dynamic_update_slice(acc, newcol, (0, k0))
+        trail = jnp.where(below[:, None] & below[None, :], acc, 0)
+        w = tb.mm(trail, v @ t)
+        mm = tb.mm(v.conj().T, w)
+        x = w - 0.5 * v @ (t.conj().T @ mm)
+        acc = acc - tb.mm(x, v.conj().T) - tb.mm(v, x.conj().T)
+        return (acc, taus_out), None
+
+    taus0 = jnp.zeros((npan, nb), dtype=a.dtype)   # npan >= 0 given n > 0
+    if npan == 0:
+        return a[:n, :n], taus0
+    (a, taus), _ = jax.lax.scan(step, (a, taus0), jnp.arange(npan))
+    return a[:n, :n], taus
+
+
 # ---------------------------------------------------------------------------
 # Distributed
 # ---------------------------------------------------------------------------
@@ -338,13 +392,16 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
     dlaf_assert(nb % band == 0,
                 f"reduction_to_band: block size {nb} not divisible by band_size {band}"
                 " (reference reduction_to_band.h:84)")
-    if a.grid is None or a.grid.num_devices == 1:
-        g = tiles_to_global(a.storage, a.dist)
-        out, taus = _red2band_local(g, nb=band)
-        return BandReduction(a.with_storage(global_to_tiles(out, a.dist)),
-                             taus, band)
     from ..config import get_configuration
 
+    if a.grid is None or a.grid.num_devices == 1:
+        g = tiles_to_global(a.storage, a.dist)
+        if get_configuration().dist_step_mode == "scan":
+            out, taus = _red2band_local_scan(g, nb=band)
+        else:
+            out, taus = _red2band_local(g, nb=band)
+        return BandReduction(a.with_storage(global_to_tiles(out, a.dist)),
+                             taus, band)
     fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name,
                                band,
                                scan=get_configuration().dist_step_mode
