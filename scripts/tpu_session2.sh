@@ -80,7 +80,10 @@ EOF
 # 5-7. the configs the wedge ate (hegst depends on the c128 diagnosis)
 run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
     -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
-run red2band_d_16384 2400 python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+# 127 panels: the unrolled trace alone would be ~40 min on this
+# toolchain — the scan step mode compiles one panel (docs/DESIGN.md)
+run red2band_d_16384 2400 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
     -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
 run eig_d_4096 2400 python -m dlaf_tpu.miniapp.miniapp_eigensolver \
     -m 4096 -b 256 --nruns 2 --nwarmups 1 --check-result last
