@@ -522,7 +522,8 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
 
 
 def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
-                              use_mixed=False, cplx=False):
+                              use_mixed=False, cplx=False,
+                              use_oz_pallas=False, pallas_interpret=False):
     """``lax.scan`` form of the distributed factorization: ONE compiled
     step body looped ``nt`` times inside the ``shard_map``.
 
@@ -534,9 +535,10 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
     (~2x panel work, ~3x trailing flops vs the unrolled exact schedule).
     All per-``k`` index math — owner ranks, local slot of the pivot,
     global tile indices, edge-tile extents — is traced arithmetic on the
-    scan counter; tile reads/writes at the pivot use dynamic slices. The
-    predicated Pallas trailing kernels are not offered in this mode (the
-    uniform masked einsum/ozaki forms are the scan-compatible shapes).
+    scan counter; tile reads/writes at the pivot use dynamic slices.
+    ``use_oz_pallas`` recovers EXACT trailing flops inside the scan: the
+    predicated per-tile-pair kernel takes its mode mask as data, so the
+    traced per-step masks predicate the MXU work directly.
     """
     nt = dist.nr_tiles.row
     mb = dist.block_size.row
@@ -595,7 +597,12 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             pair = row_valid[:, None] & col_valid[None, :]
             below = pair & (g_rows[:, None] > g_cols[None, :])
             ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-            if use_mxu:
+            if use_mxu and use_oz_pallas:
+                upd = _masked_oz_update(
+                    vr.reshape(ltr * mb, mb),
+                    jnp.conj(vc).reshape(ltc * mb, mb),
+                    below | ondiag, ltr, ltc, mb, pallas_interpret)
+            elif use_mxu:
                 mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
                 full = mmfn(vr.reshape(ltr * mb, mb),
                             jnp.conj(vc).reshape(ltc * mb, mb).T,
@@ -622,7 +629,12 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             pair = row_valid[:, None] & col_valid[None, :]
             below = pair & (g_rows[:, None] < g_cols[None, :])   # "above"
             ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-            if use_mxu:
+            if use_mxu and use_oz_pallas:
+                ar = jnp.swapaxes(jnp.conj(vrp), -1, -2).reshape(ltr * mb, mb)
+                bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc * mb, mb)
+                upd = _masked_oz_update(ar, bc2, below | ondiag,
+                                        ltr, ltc, mb, pallas_interpret)
+            elif use_mxu:
                 mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
                 ar = jnp.swapaxes(jnp.conj(vrp), -1, -2).reshape(ltr * mb, mb)
                 bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc * mb, mb)
@@ -655,7 +667,9 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
     if scan:
         return jax.jit(_build_dist_cholesky_scan(
             dist, mesh, uplo, use_mxu=use_mxu, use_mixed=use_mixed,
-            cplx=dtype.startswith("complex")))
+            cplx=dtype.startswith("complex"),
+            use_oz_pallas=use_oz_pallas,
+            pallas_interpret=pallas_interpret))
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
@@ -712,13 +726,15 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
                      and mat.block_size.row <= MASKED_MB_MAX)
     scan_mode = trailing == "scan"
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, dt.name, uplo,
-                               # pallas knobs are ignored by the scan path;
-                               # normalize them so its cache key is exact
+                               # the f32/bf16 pallas trailing kernel is
+                               # unrolled-only; normalize it out of scan
+                               # cache keys. use_oz_pallas works in BOTH
+                               # modes (its mode mask is data).
                                (not scan_mode)
                                and supports_pallas_update(mat.dtype, platform)
                                and not use_mxu,
-                               (not scan_mode) and platform != "tpu",
+                               platform != "tpu",
                                use_mxu, use_mixed,
-                               (not scan_mode) and use_oz_pallas,
+                               use_oz_pallas,
                                scan=scan_mode)
     return mat.with_storage(fn(mat.storage))
