@@ -31,7 +31,11 @@ def main():
     p.add_argument("--nruns", type=int, default=5)
     p.add_argument("--nwarmups", type=int, default=1)
     p.add_argument("--type", default="d")
+    p.add_argument("--dlaf", nargs="*", default=[],
+                   help="extra --dlaf:<knob>=<value> options appended to "
+                        "every command (e.g. dist-step-mode=scan)")
     args = p.parse_args()
+    extra = "".join(f" --dlaf:{o}" for o in args.dlaf)
     mod = MINIAPPS[args.miniapp]
     print("#!/bin/sh")
     print(f"# strong scaling: {args.miniapp} N={args.m} nb={args.b}")
@@ -39,7 +43,7 @@ def main():
         r, c = g.split("x")
         print(f"python -m {mod} -m {args.m} -b {args.b} --grid-rows {r} "
               f"--grid-cols {c} --nruns {args.nruns} --nwarmups {args.nwarmups} "
-              f"--type {args.type}")
+              f"--type {args.type}{extra}")
 
 
 if __name__ == "__main__":

@@ -23,7 +23,11 @@ def main():
     p.add_argument("--grids", nargs="+", default=["1x1", "2x2", "4x4"])
     p.add_argument("--nruns", type=int, default=5)
     p.add_argument("--type", default="d")
+    p.add_argument("--dlaf", nargs="*", default=[],
+                   help="extra --dlaf:<knob>=<value> options appended to "
+                        "every command (e.g. dist-step-mode=scan)")
     args = p.parse_args()
+    extra = "".join(f" --dlaf:{o}" for o in args.dlaf)
     mod = MINIAPPS[args.miniapp]
     print("#!/bin/sh")
     print(f"# weak scaling: {args.miniapp} m/device={args.m_per_device}")
@@ -32,7 +36,8 @@ def main():
         n = int(args.m_per_device * math.sqrt(r * c))
         n = (n // args.b) * args.b or args.b
         print(f"python -m {mod} -m {n} -b {args.b} --grid-rows {r} "
-              f"--grid-cols {c} --nruns {args.nruns} --type {args.type}")
+              f"--grid-cols {c} --nruns {args.nruns} --type {args.type}"
+              f"{extra}")
 
 
 if __name__ == "__main__":
