@@ -7,6 +7,9 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=${OUT:-/tmp/tpu_session2_$(date +%H%M)}
 mkdir -p "$OUT"
+# persist every step's XLA programs (hegst/red2band compiles cost minutes;
+# a killed step's retry must not pay them twice)
+export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
 echo "results -> $OUT" >&2
 
 run() { # name timeout_s cmd...
