@@ -200,3 +200,15 @@ def test_round_robin():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         RoundRobin([])
+
+
+def test_profile_dir_hook(tmp_path):
+    """--dlaf:profile-dir emits a jax.profiler trace (SURVEY §5 tracing;
+    the green-field observability hook the reference lacks)."""
+    from dlaf_tpu.miniapp.miniapp_cholesky import run as crun
+
+    out = crun(["-m", "64", "-b", "16", "--nruns", "1",
+                f"--dlaf:profile-dir={tmp_path}"])
+    assert len(out) == 1
+    assert any((tmp_path / p).exists() for p in ("plugins",)) or \
+        any(tmp_path.iterdir())
