@@ -52,6 +52,7 @@ from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
+from ..matrix import memory
 from ..matrix.panel import (DistContext, gather_sub_panel,
                             gather_sub_panel_dyn, pad_sub_panel_to_tiles,
                             tiles_of_rolled)
@@ -243,13 +244,14 @@ def _dist_bt_b2t_cached(dist, mesh, b, cplx, n_sweeps, impl, group):
 def _bt_b2t_local_array(tri: TridiagResult, e) -> jax.Array:
     n = tri.d.shape[0]
     cplx = np.issubdtype(tri.v.dtype, np.complexfloating)
-    e = jnp.asarray(e)
+    e = memory.as_device(e)
     if cplx:
-        e = e.astype(tri.v.dtype) * jnp.asarray(tri.phase)[:, None]
+        e = e.astype(tri.v.dtype) * memory.as_device(tri.phase)[:, None]
     if tri.v.shape[0] == 0:
         return e
     impl, group = _bt_b2t_params()
-    return _apply_chase_reflectors(jnp.asarray(tri.v), jnp.asarray(tri.tau),
+    return _apply_chase_reflectors(memory.as_device(tri.v),
+                                   memory.as_device(tri.tau),
                                    e, b=tri.band, n=n, impl=impl, group=group)
 
 
@@ -282,8 +284,8 @@ def bt_band_to_tridiag(tri: TridiagResult, evecs):
     group = _effective_group(tri.band, n_sweeps, group) if impl == "blocked" else 0
     fn = _dist_bt_b2t_cached(evecs.dist, evecs.grid.mesh, tri.band, cplx,
                              n_sweeps, impl, group)
-    out = fn(jnp.asarray(tri.v), jnp.asarray(tri.tau),
-             jnp.asarray(tri.phase), storage)
+    out = fn(memory.as_device(tri.v), memory.as_device(tri.tau),
+             memory.as_device(tri.phase), storage)
     return Matrix(evecs.dist, out, evecs.grid)
 
 
@@ -446,15 +448,15 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band,
                                  scan=get_configuration().dist_step_mode
                                  == "scan")
-        out = fn(a.storage, jnp.asarray(red.taus), storage)
+        out = fn(a.storage, memory.as_device(red.taus), storage)
         return Matrix(evecs.dist, out, evecs.grid)
     a_v = tiles_to_global(a.storage, a.dist)
     arr = evecs
     ret_matrix = isinstance(evecs, Matrix)
     if ret_matrix:
         arr = tiles_to_global(evecs.storage, evecs.dist)
-    e = jnp.asarray(arr, dtype=a_v.dtype)
-    out = _bt_r2b_local(a_v, jnp.asarray(red.taus), e, nb=red.band)
+    e = memory.as_device(arr).astype(a_v.dtype)
+    out = _bt_r2b_local(a_v, memory.as_device(red.taus), e, nb=red.band)
     if ret_matrix:
         return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
     return out

@@ -112,6 +112,15 @@ def place(array, sharding=None):
         return out
 
 
+def as_device(x):
+    """``jnp.asarray`` for possibly-host inputs, routed through
+    :func:`place` so complex host arrays get the pair-transfer fallback;
+    device arrays pass through untouched."""
+    if _is_device_array(x):
+        return x
+    return place(np.asarray(x))
+
+
 def fetch(x) -> np.ndarray:
     """Device array -> host numpy (reference: D2H copy), with the symmetric
     complex-pair fallback: real/imag computed on device, transferred as two

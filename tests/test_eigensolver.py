@@ -347,3 +347,21 @@ def test_bt_reduction_to_band_distributed_scan(n, nb, band, grid_shape,
     finally:
         monkeypatch.delenv("DLAF_DIST_STEP_MODE")
         config.initialize()
+
+
+def test_eigensolver_complex_pair_transfer_mode(monkeypatch):
+    """Forced complex pair-transfer mode (matrix/memory.py): the full
+    complex local eigensolver — band gather, host chase, phase arrays,
+    back-transforms — must work without any direct complex transfer."""
+    from dlaf_tpu.matrix import memory
+
+    n, nb = 24, 4
+    a = herm(n, np.complex128, 9)
+    lam_ref = np.linalg.eigvalsh(a)
+
+    monkeypatch.setattr(memory, "_complex_pair_mode", True)
+    res = eigensolver("L", M(a, nb))
+    np.testing.assert_allclose(res.eigenvalues, lam_ref, atol=1e-9)
+    q = res.eigenvectors.to_numpy()
+    resid = np.linalg.norm(a @ q - q * res.eigenvalues[None, :])
+    assert resid < 1e-9 * np.linalg.norm(a)
