@@ -59,6 +59,10 @@ from ..types import ceil_div
 
 #: Valid cholesky_trailing strategies (see config.Configuration); bench.py
 #: sweeps this set on the measured hardware.
+#: Trailing-update formulations. "scan" is the lax.scan step mode; unlike
+#: "ozaki" (which forces the MXU route for f64/c128) it selects its panel
+#: and trailing routes from the f64_trsm/f64_gemm knobs, identically on
+#: 1 device and on a grid.
 VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki", "scan")
 
 
@@ -183,8 +187,10 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("uplo", "nb"))
-def _cholesky_local_scan(a, *, uplo: str, nb: int):
+@functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
+                                             "use_mixed"))
+def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
+                         use_mixed: bool = False):
     """``lax.scan`` formulation of the local factorization: ONE compiled
     step body, looped ``nt`` times with uniform full-size shapes.
 
@@ -199,15 +205,19 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int):
     trade when compile latency or HBM liveness binds, not when flops do
     (bench.py sweeps both).
 
-    f64/complex128 route their panels through the mixed-precision fused
-    factor+inverse and the trailing product through the ozaki MXU path
-    (same kernels as trailing="ozaki"); other dtypes run native potrf /
-    trsm / herk. Triangle pass-through semantics match the unrolled path.
+    The panel and trailing routes follow the same knobs as the distributed
+    scan builder (:func:`_build_dist_cholesky_scan`): ``use_mixed``
+    (``f64_trsm="mixed"``) factors panels via the mixed-precision fused
+    factor+inverse, ``use_mxu`` (``f64_gemm="mxu"``) contracts the trailing
+    product on the ozaki MXU path. Both default off, so the same dtype and
+    ``trailing="scan"`` config resolves identically on 1 device and on a
+    grid (round-2 advisory: the previous hardwired f64 route made the scan
+    variant pathological off-TPU). Triangle pass-through semantics match
+    the unrolled path.
     """
     n = a.shape[0]
     if n == 0:
         return a
-    use_oz = a.dtype in (jnp.float64, jnp.complex128)
     nt = ceil_div(n, nb)
     npad = nt * nb - n
     if npad:
@@ -222,7 +232,7 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int):
     def step(acc, k):
         k0 = k * nb
         blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
-        if use_oz:
+        if use_mixed:
             fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
             diag = fac + tb.tri_mask(blk, other, k=-1)
         else:
@@ -232,14 +242,15 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int):
         below = rows >= k0 + nb          # (m,) rows/cols past the pivot
         if uplo == "L":
             col = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
-            if use_oz:
-                pfull = tb.mm_mxu(col, jnp.conj(fac_inv).T)
+            if use_mixed:
+                inv_t = jnp.conj(fac_inv).T
+                pfull = tb.mm_mxu(col, inv_t) if use_mxu else col @ inv_t
             else:
                 pfull = tb.trsm("R", "L", "C", "N", diag, col)
             panel = jnp.where(below[:, None], pfull, 0)
             acc = jax.lax.dynamic_update_slice(
                 acc, jnp.where(below[:, None], pfull, col), (0, k0))
-            if use_oz:
+            if use_mxu:
                 upd = (oz.herk_c128(panel, slices=tb._oz_slices())
                        if jnp.iscomplexobj(panel)
                        else oz.syrk_f64(panel, slices=tb._oz_slices()))
@@ -251,15 +262,16 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int):
             acc = acc - jnp.where(tri, upd, 0)
         else:
             row = jax.lax.dynamic_slice(acc, (k0, 0), (nb, m))
-            if use_oz:
-                pfull = tb.mm_mxu(jnp.conj(fac_inv).T, row)
+            if use_mixed:
+                inv_t = jnp.conj(fac_inv).T
+                pfull = tb.mm_mxu(inv_t, row) if use_mxu else inv_t @ row
             else:
                 pfull = tb.trsm("L", "U", "C", "N", diag, row)
             panel = jnp.where(below[None, :], pfull, 0)
             acc = jax.lax.dynamic_update_slice(
                 acc, jnp.where(below[None, :], pfull, row), (k0, 0))
             pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
-            if use_oz:
+            if use_mxu:
                 upd = (oz.herk_c128(pt, slices=tb._oz_slices())
                        if jnp.iscomplexobj(panel)
                        else oz.syrk_f64(pt, slices=tb._oz_slices()))
@@ -698,24 +710,23 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     dlaf_assert(mat.size.row == mat.size.col, "cholesky: matrix must be square")
     dlaf_assert(mat.block_size.row == mat.block_size.col,
                 "cholesky: block must be square")
+    cfg = get_configuration()
+    dt = np.dtype(mat.dtype)
+    # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
+    # resolution local and distributed, single owner in tile_ops.blas);
+    # the unrolled local path selects its route via cholesky_trailing
+    use_mxu = tb.f64_gemm_uses_mxu(dt, mat.block_size.row)
+    use_mixed = tb.trsm_panel_uses_mixed(dt)
     if mat.grid is None or mat.grid.num_devices == 1:
         a = tiles_to_global(mat.storage, mat.dist)
         if trailing == "scan":
-            out = _cholesky_local_scan(a, uplo=uplo, nb=mat.block_size.row)
+            out = _cholesky_local_scan(a, uplo=uplo, nb=mat.block_size.row,
+                                       use_mxu=use_mxu, use_mixed=use_mixed)
         else:
             out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
                                   trailing=trailing)
         return mat.with_storage(global_to_tiles(out, mat.dist))
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
-    cfg = get_configuration()
-    dt = np.dtype(mat.dtype)
-    use_mxu = (cfg.f64_gemm == "mxu"
-               and dt in (np.dtype(np.float64), np.dtype(np.complex128))
-               and mat.block_size.row >= cfg.f64_gemm_min_dim)
-    # panel potrf/trsm follow the f64_trsm knob, independent of f64_gemm
-    # (config.py: f64_gemm affects contractions only)
-    use_mixed = cfg.f64_trsm == "mixed" and dt in (np.dtype(np.float64),
-                                                   np.dtype(np.complex128))
     # exact-flop predicated contraction (ozaki_impl="pallas"): real f64
     # only (complex keeps the 4-real-product composition), within the
     # masked kernel's per-cell VMEM bound
