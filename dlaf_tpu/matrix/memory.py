@@ -20,6 +20,16 @@ two f64 transfers combined by ``lax.complex`` on the destination side; the
 mode latches process-wide (with a warning) only when the pair retry
 actually succeeds, so transient backend failures — which fail both ways —
 never flip it.
+
+Scope limits of the fallback (round-2 advisory): only the transfer-error
+types in :data:`_TRANSFER_ERRORS` trigger the retry — and RESOURCE_EXHAUSTED
+(device OOM) is re-raised without one, since the pair path needs MORE
+transient memory, not less. PJRT transfers can also fail ASYNCHRONOUSLY:
+``device_put`` may return a future-backed array whose failure only
+surfaces at consumption (``block_until_ready``/compute). Such deferred
+failures bypass this guard entirely — a wedge observed at
+``block_until_ready`` will NOT auto-latch pair mode; set it explicitly by
+calling :func:`_latch_pair_mode` or retry at the operator level.
 """
 
 from __future__ import annotations
@@ -34,6 +44,25 @@ import jax.numpy as jnp
 #: Tri-state per-process cache: None = direct complex transfers untested,
 #: False/None treated as direct-first, True = pair fallback required.
 _complex_pair_mode = None
+
+try:  # the PJRT runtime-error type (transfer rejections, backend faults)
+    from jax.errors import JaxRuntimeError as _JaxRuntimeError
+except ImportError:  # older jaxlib spelling
+    from jaxlib.xla_extension import XlaRuntimeError as _JaxRuntimeError
+
+#: Exception types that plausibly mean "this transfer path rejected the
+#: buffer" and are worth a pair retry. Bare ``Exception`` used to be
+#: caught here; that routed unrelated failures (OOM, interpreter
+#: teardown) into a doomed second transfer attempt.
+_TRANSFER_ERRORS = (_JaxRuntimeError, ValueError, TypeError)
+
+
+def _retryable_transfer_error(e: Exception) -> bool:
+    """A pair retry is sensible: a recognized transfer-error type that is
+    NOT device OOM (RESOURCE_EXHAUSTED needs less memory, and the pair
+    path transiently needs more)."""
+    return (isinstance(e, _TRANSFER_ERRORS)
+            and "RESOURCE_EXHAUSTED" not in str(e))
 
 _combine = jax.jit(jax.lax.complex)
 
@@ -104,8 +133,8 @@ def place(array, sharding=None):
         if np.iscomplexobj(array):
             _probe_passed_failures = 0   # direct works; reset the streak
         return out
-    except Exception:
-        if not np.iscomplexobj(array):
+    except Exception as e:
+        if not np.iscomplexobj(array) or not _retryable_transfer_error(e):
             raise
         out = _place_pair(array, sharding)   # raises too if truly broken
         _latch_pair_mode("device_put")
@@ -133,8 +162,8 @@ def fetch(x) -> np.ndarray:
         if np.iscomplexobj(x):
             _probe_passed_failures = 0   # direct works; reset the streak
         return out
-    except Exception:
-        if not np.iscomplexobj(x):
+    except Exception as e:
+        if not np.iscomplexobj(x) or not _retryable_transfer_error(e):
             raise
         out = _fetch_pair(x)
         _latch_pair_mode("device_get")

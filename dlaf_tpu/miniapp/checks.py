@@ -32,19 +32,37 @@ def _real_dtype(dtype) -> np.dtype:
     return np.dtype(np.dtype(dtype).type(0).real.dtype)
 
 
-def f64_is_emulated() -> bool:
-    """True when the active jax backend has no native f64 unit (TPU)."""
+def f64_is_emulated(of=None) -> bool:
+    """True when f64 runs as double-f32 emulation — judged from the
+    platform of the array that actually holds the checked result (``of``:
+    the DEVICE array, e.g. ``out.storage`` — not a fetched numpy copy),
+    so a result computed under ``jax.default_device`` on a non-default
+    backend is judged by ITS platform, not the process default. A host
+    numpy ``of`` is judged as native f64 arithmetic (False) — it carries
+    no provenance, so pass the device array for device-computed results.
+    With ``of=None`` the active jax default backend decides."""
+    if of is not None:
+        devs = getattr(of, "devices", None)
+        if callable(devs):
+            try:
+                return any(d.platform == "tpu" for d in devs())
+            except Exception:
+                pass  # fall through to the process default
+        else:
+            return False  # host numpy array: native f64 arithmetic
     import jax
 
     return jax.default_backend() == "tpu"
 
 
-def effective_eps(dtype):
+def effective_eps(dtype, of=None):
     """``(eps, label)`` for residual tolerances: the dtype's eps, widened
     to :data:`EMULATED_F64_EPS` for 64-bit dtypes on f64-emulating
-    backends. ``label`` is "" when nothing was widened."""
+    backends. ``of`` (optional jax array) pins the judgment to the devices
+    that produced the checked result. ``label`` is "" when nothing was
+    widened."""
     rt = _real_dtype(dtype)
     eps = float(np.finfo(rt).eps)
-    if rt == np.float64 and f64_is_emulated():
+    if rt == np.float64 and f64_is_emulated(of):
         return EMULATED_F64_EPS, " [tpu f64=2xf32 emulation, eps=2^-47]"
     return eps, ""

@@ -80,7 +80,7 @@ def check(band, b, res, n) -> None:
     w_ref = np.linalg.eigvalsh(a)
     w_tri = sla.eigvalsh_tridiagonal(res.d, res.e)
     resid = np.abs(w_ref - w_tri).max() / max(np.abs(w_ref).max(), 1e-30)
-    eps, eps_label = checks.effective_eps(np.float64)
+    eps, eps_label = checks.effective_eps(np.float64, of=res.d)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
     print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)

@@ -92,7 +92,7 @@ def check(tri, e0, out) -> None:
     qe = qmat @ np.asarray(e0, dtype=out.dtype)
     got = out.to_numpy()
     resid = np.linalg.norm(got - qe) / max(np.linalg.norm(qe), 1e-30)
-    eps, eps_label = checks.effective_eps(out.dtype)
+    eps, eps_label = checks.effective_eps(out.dtype, of=out.storage)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
     print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)

@@ -101,7 +101,7 @@ def check_cholesky(uplo: str, ref: Matrix, out: Matrix) -> None:
     a = ref.to_numpy()
     f = out.to_numpy()
     n = a.shape[0]
-    eps, eps_label = checks.effective_eps(a.dtype)
+    eps, eps_label = checks.effective_eps(a.dtype, of=out.storage)
     if uplo == "L":
         l = np.tril(f)
         resid = np.linalg.norm(l @ l.conj().T - a) / np.linalg.norm(a)

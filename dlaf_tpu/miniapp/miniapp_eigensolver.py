@@ -129,7 +129,7 @@ def check(args, am, bm, res) -> None:
     else:
         resid = np.linalg.norm(afull @ q - q * lam[None, :])
         resid /= max(np.linalg.norm(afull), 1e-30)
-    eps, eps_label = checks.effective_eps(a.dtype)
+    eps, eps_label = checks.effective_eps(a.dtype, of=res.eigenvectors.storage)
     tol = 200 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
     print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)

@@ -96,7 +96,7 @@ def check(uplo, am, bf, out) -> None:
         cf = np.triu(c) + np.triu(c, 1).conj().T
         resid = np.linalg.norm(u.conj().T @ cf @ u - _hermfull(a, "U"))
     resid /= max(np.linalg.norm(a), 1e-30)
-    eps, eps_label = checks.effective_eps(a.dtype)
+    eps, eps_label = checks.effective_eps(a.dtype, of=out.storage)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
     print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)

@@ -98,7 +98,7 @@ def check(ref, red, n, band) -> None:
     w1 = np.linalg.eigvalsh(bd)
     w2 = np.linalg.eigvalsh(a)
     resid = np.abs(w1 - w2).max() / max(np.abs(w2).max(), 1e-30)
-    eps, eps_label = checks.effective_eps(a.dtype)
+    eps, eps_label = checks.effective_eps(a.dtype, of=red.matrix.storage)
     tol = 100 * n * eps
     status = "PASSED" if resid < tol else "FAILED"
     print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)

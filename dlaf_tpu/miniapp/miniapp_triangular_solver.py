@@ -106,7 +106,7 @@ def check(args, am: Matrix, bm: Matrix, out: Matrix) -> None:
     b = bm.to_numpy()
     resid = np.linalg.norm((t @ x if args.side == "L" else x @ t) - b) \
         / max(np.linalg.norm(b), 1e-30)
-    eps, eps_label = checks.effective_eps(a.dtype)
+    eps, eps_label = checks.effective_eps(a.dtype, of=out.storage)
     tol = 60 * max(args.m, args.n) * eps
     status = "PASSED" if resid < tol else "FAILED"
     print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)

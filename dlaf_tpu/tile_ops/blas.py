@@ -51,6 +51,12 @@ def _mxu_f64(*arrs, dims) -> bool:
     return min(dims) >= cfg.f64_gemm_min_dim
 
 
+#: (backend, slices) pairs already announced — the auto-tier resolution
+#: logs once per distinct outcome so the accuracy tier in effect (56 vs
+#: 49 mantissa bits) is visible, not silent (round-2 advisory).
+_announced_tiers: set = set()
+
+
 def _oz_slices() -> int:
     """Resolved slice count: the configured value, or — for the 0 "auto"
     default — 7 on f64-emulating backends (TPU: the platform's ~47-48-bit
@@ -59,7 +65,9 @@ def _oz_slices() -> int:
     dots) where f64 is native. Keyed on the PROCESS default backend: a
     trace explicitly placed on a non-default backend (jax.default_device)
     inherits the process tier — set the knob explicitly for that case.
-    See Configuration.f64_gemm_slices."""
+    The auto resolution is announced once per (backend, count) on stderr
+    so the tier in effect is never silent. See
+    Configuration.f64_gemm_slices."""
     from ..config import get_configuration
 
     s = int(get_configuration().f64_gemm_slices)
@@ -67,7 +75,17 @@ def _oz_slices() -> int:
         return s
     import jax
 
-    return 7 if jax.default_backend() == "tpu" else 8
+    backend = jax.default_backend()
+    s = 7 if backend == "tpu" else 8
+    if (backend, s) not in _announced_tiers:
+        _announced_tiers.add((backend, s))
+        import sys
+
+        print(f"dlaf_tpu: f64_gemm_slices=0 (auto) resolved to {s} for "
+              f"default backend {backend!r} (~{7 * s} mantissa bits); "
+              "traces placed on other backends inherit this — set the knob "
+              "explicitly to override", file=sys.stderr, flush=True)
+    return s
 
 
 def mm_mxu(a, b):
@@ -338,6 +356,35 @@ def trsm(side: str, uplo: str, op_a: str, diag: str, a, b, *, alpha=1.0):
     if a.ndim == 2 and b.ndim == 2 and a.shape[-1] > TRSM_RECURSE_MIN:
         return _trsm_rec(side, uplo, op_a, diag, a, b).astype(out_dtype)
     return _trsm_native(side, uplo, op_a, diag, a, b).astype(out_dtype)
+
+
+def f64_gemm_uses_mxu(dtype, dim: int) -> bool:
+    """Does the ``f64_gemm="mxu"`` knob route this dtype at this block size
+    onto the int8/bf16 MXU path? Single owner of the algorithm-level route
+    decision (the tile-level ``_mm`` gate checks per-operand shapes
+    itself)."""
+    from ..config import get_configuration
+
+    import numpy as _np
+
+    cfg = get_configuration()
+    return (cfg.f64_gemm == "mxu"
+            and _np.dtype(dtype) in (_np.dtype(_np.float64),
+                                     _np.dtype(_np.complex128))
+            and dim >= cfg.f64_gemm_min_dim)
+
+
+def trsm_panel_uses_mixed(dtype) -> bool:
+    """Will :func:`trsm_panel` route this dtype through the refined-inverse
+    mixed path under the current config? For callers that precompute
+    ``inv_a`` once and reuse it across several panel solves."""
+    from ..config import get_configuration
+
+    import numpy as _np
+
+    return (get_configuration().f64_trsm == "mixed"
+            and _np.dtype(dtype) in (_np.dtype(_np.float64),
+                                     _np.dtype(_np.complex128)))
 
 
 def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *,

@@ -238,7 +238,11 @@ def test_complex_pair_fallback_detection(monkeypatch):
 
     def flaky_put(x, sharding=None):
         if np.iscomplexobj(x):
-            raise RuntimeError("synthetic: backend rejects complex128")
+            # the PJRT error type place() recognizes as a transfer
+            # rejection (a bare RuntimeError must NOT trigger the retry)
+            from jax.errors import JaxRuntimeError
+
+            raise JaxRuntimeError("synthetic: backend rejects complex128")
         return real_put(x, sharding)
 
     monkeypatch.setattr(memory, "_complex_pair_mode", None)
@@ -257,3 +261,46 @@ def test_complex_pair_fallback_detection(monkeypatch):
         lambda x, sharding=None: (_ for _ in ()).throw(RuntimeError("down")))
     with pytest.raises(RuntimeError, match="down"):
         memory.place(np.ones((2, 2)))
+
+
+def test_complex_pair_fallback_ignores_non_transfer_errors(monkeypatch):
+    """Round-2 advisory: only recognized transfer-error types trigger the
+    pair retry. A bare RuntimeError (interpreter teardown, unrelated bug)
+    and a RESOURCE_EXHAUSTED device OOM both re-raise directly — the pair
+    path transiently needs MORE memory, and an unrelated failure would
+    just fail a second time."""
+    import jax as _jax
+    from jax.errors import JaxRuntimeError
+
+    from dlaf_tpu.matrix import memory
+
+    a = (np.arange(4.0) + 1j * np.arange(4.0)).reshape(2, 2)
+
+    def put_raising(exc):
+        return lambda x, sharding=None: (_ for _ in ()).throw(exc)
+
+    monkeypatch.setattr(memory, "_complex_pair_mode", None)
+    monkeypatch.setattr(_jax, "device_put",
+                        put_raising(RuntimeError("not a transfer error")))
+    with pytest.raises(RuntimeError, match="not a transfer"):
+        memory.place(a)
+    assert memory._complex_pair_mode is None
+
+    monkeypatch.setattr(
+        _jax, "device_put",
+        put_raising(JaxRuntimeError("RESOURCE_EXHAUSTED: out of memory")))
+    with pytest.raises(JaxRuntimeError, match="RESOURCE_EXHAUSTED"):
+        memory.place(a)
+    assert memory._complex_pair_mode is None
+    # fetch symmetric: device OOM on readback re-raises too
+    monkeypatch.setattr(
+        _jax, "device_get",
+        put_raising(JaxRuntimeError("RESOURCE_EXHAUSTED: host")))
+    with pytest.raises(JaxRuntimeError, match="RESOURCE_EXHAUSTED"):
+        memory.fetch(jnp_complex_probe())
+
+
+def jnp_complex_probe():
+    import jax.numpy as jnp
+
+    return jnp.asarray(np.ones((2, 2), np.complex128))
