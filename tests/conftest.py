@@ -36,6 +36,61 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
+#: The `quick` smoke tier (``pytest -m quick``): ONE representative config
+#: per algorithm family / core layer, for hardware-session sanity checks
+#: where the full suite's ~11 min wall is unaffordable (tunnel windows are
+#: ~1 h). The FIRST collected parametrization of each named test gets the
+#: marker, so the tier tracks parametrize changes without hand-pinned ids.
+_QUICK_TESTS = {
+    ("test_cholesky.py", "test_cholesky_local"),
+    ("test_cholesky.py", "test_cholesky_distributed"),
+    ("test_cholesky.py", "test_cholesky_local_trailing_variants"),
+    ("test_cholesky.py", "test_cholesky_scan_native_dtypes"),
+    ("test_triangular.py", "test_solve_local_all_combos"),
+    ("test_triangular.py", "test_solve_distributed"),
+    ("test_qr.py", "test_t_factor_local_matrix"),
+    ("test_qr.py", "test_t_factor_distributed"),
+    ("test_gen_to_std.py", "test_gen_to_std_local"),
+    ("test_gen_to_std.py", "test_gen_to_std_distributed"),
+    ("test_gen_to_std.py", "test_general_sub_multiply"),
+    ("test_reduction_to_band.py", "test_red2band_local"),
+    ("test_reduction_to_band.py", "test_red2band_distributed_band_size"),
+    ("test_band_to_tridiag.py", "test_band_to_tridiag"),
+    ("test_band_to_tridiag.py", "test_native_matches_numpy"),
+    ("test_tridiag_solver.py", "test_random"),
+    ("test_eigensolver.py", "test_eigensolver"),
+    ("test_eigensolver.py", "test_eigensolver_distributed"),
+    ("test_eigensolver.py", "test_gen_eigensolver"),
+    ("test_eigensolver.py", "test_bt_reduction_to_band"),
+    ("test_eigensolver.py", "test_bt_band_to_tridiag"),
+    ("test_eigensolver.py", "test_permutations"),
+    ("test_ozaki.py", "test_accuracy_f64_grade"),
+    ("test_ozaki.py", "test_syrk_matches_matmul"),
+    ("test_pallas_kernels.py", "test_masked_trailing_update"),
+    ("test_tile_ops.py", "test_gemm"),
+    ("test_tile_ops.py", "test_lange"),
+    ("test_matrix.py", "test_matrix_roundtrip_local"),
+    ("test_matrix.py", "test_matrix_sharded_over_mesh"),
+    ("test_comm.py", "test_bcast"),
+    ("test_comm.py", "test_grid_shapes"),
+    ("test_config.py", "test_defaults"),
+    ("test_config.py", "test_cli_overrides_env"),
+    ("test_distribution.py", "test_distribution_2d"),
+    ("test_index2d.py", "test_basic_coords"),
+    ("test_types.py", "test_flop_weights"),
+    ("test_aux_components.py", "test_max_norm_local_and_distributed"),
+    ("test_aux_components.py", "test_bench_headline_fallback_replays_history"),
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    seen = set()
+    for item in items:
+        key = (item.path.name, getattr(item, "originalname", item.name))
+        if key in _QUICK_TESTS and key not in seen:
+            seen.add(key)
+            item.add_marker(pytest.mark.quick)
+
 
 @pytest.fixture(scope="session")
 def devices8():
