@@ -7,33 +7,64 @@ B, transform ``A x = lambda B x`` to standard form:
     uplo='L':  A <- inv(L) A inv(L)^H        (B = L L^H)
     uplo='U':  A <- inv(U^H) A inv(U)        (B = U^H U)
 
-The reference hand-blocks the two-sided update (per-k ``hegst`` diag, panel
-``trsm``+``hemm``, trailing ``her2k``/``gemm``) to exploit Hermitian symmetry.
-The TPU-native formulation: Hermitianize A from its stored triangle, then
-apply TWO whole-matrix triangular solves — each is a fully parallel blocked
-substitution (local: one XLA TriangularSolve; distributed: the shard_map
-substitution of :mod:`.triangular`). This trades the ~2x symmetry saving for
-two perfectly MXU-shaped dense sweeps with no panel round-trips — the right
-trade on a systolic array, and it reuses the verified solver path end to end.
+Two formulations (config knob ``hegst_impl``):
+
+* ``"blocked"`` (default) — the reference's flop discipline (~n^3 real ops):
+  per-``k`` two-sided update — hegst on the diagonal block, panel trsm +
+  two half-weight hemm's, her2k trailing update exploiting Hermitian
+  symmetry, and the trailing triangular solve of the panel. Local form:
+  the k-loop unrolled at trace time over exact slices (the trailing solve
+  rides the recursive blocked trsm, so its bulk flops are gemms that
+  follow the ``f64_gemm`` MXU reroute). Distributed form: the per-step
+  trailing solve is DEFERRED and applied incrementally at later steps
+  using that step's already-broadcast panel — the reference's reshuffle
+  ("the tasks of the final huge TRSM have been reshuffled to avoid extra
+  communication of the matrix L", ``impl.h:330-335``) — so each panel
+  broadcast serves both the trailing update and the pending solves of all
+  previous panels.
+
+* ``"twosolve"`` — Hermitianize A, then TWO whole-matrix triangular solves
+  (each a fully parallel blocked substitution). ~2x the flops, but two
+  perfectly MXU-shaped dense sweeps with no panel round-trips and O(1)
+  step count; kept as the fallback/cross-check and as the scan-compatible
+  compile-latency hatch: the distributed blocked form is unrolled-only, so
+  ``dist_step_mode="scan"`` routes distributed HEGST through this path.
 
 Local + distributed, both uplos (reference parity: local L/U + distributed
-L/U).
+L/U, ``call_L``/``call_U``).
 """
 
 from __future__ import annotations
 
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..config import get_configuration, register_program_cache
+from ..comm import collectives as cc
+from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix import ops as mops
+from ..matrix import util_distribution as ud
 from ..matrix.matrix import Matrix
+from ..matrix.panel import (DistContext, transpose_col_to_rows,
+                            transpose_row_to_cols)
+from ..matrix.tiling import (global_to_tiles, storage_tile_grid,
+                             tiles_to_global)
+from ..tile_ops import blas as tb
+from ..tile_ops import mixed as mx
+from ..tile_ops import ozaki as oz
+from ..types import ceil_div
 from .triangular import triangular_solve
 
 
-def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
-    """Transform ``a`` (Hermitian, stored in ``uplo``) using ``b_factor`` =
-    the Cholesky factor of B (same ``uplo``). Returns the transformed A with
-    its opposite triangle passing through unchanged."""
-    dlaf_assert(a.size == b_factor.size, "gen_to_std: A/B size mismatch")
-    dlaf_assert(a.block_size == b_factor.block_size, "gen_to_std: block mismatch")
+def _gen_to_std_twosolve(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
+    """Two-whole-solve formulation (see module docstring)."""
     ah = mops.hermitianize(a, uplo)
     if uplo == "L":
         x = triangular_solve("L", "L", "N", "N", 1.0, b_factor, ah)
@@ -42,3 +73,363 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
         x = triangular_solve("L", "U", "C", "N", 1.0, b_factor, ah)
         y = triangular_solve("R", "U", "N", "N", 1.0, b_factor, x)
     return mops.merge_triangle(y, a, uplo)
+
+
+# ---------------------------------------------------------------------------
+# Local blocked form (reference impl.h:169-266 call_L / call_U local)
+# ---------------------------------------------------------------------------
+
+def _hegst_diag(uplo: str, akk, lkk):
+    """Transformed diagonal block, full Hermitian form: W = inv(L) herm(Akk)
+    inv(L)^H (uplo='L') / inv(U^H) herm(Akk) inv(U) (uplo='U'). The two
+    block-size solves follow the f64_trsm knob via trsm_panel."""
+    ah = tb.hermitian_from(akk, uplo)
+    if uplo == "L":
+        w = tb.trsm_panel("L", "L", "N", "N", lkk, ah)
+        w = tb.trsm_panel("R", "L", "C", "N", lkk, w)
+    else:
+        w = tb.trsm_panel("L", "U", "C", "N", lkk, ah)
+        w = tb.trsm_panel("R", "U", "N", "N", lkk, w)
+    # the algorithm reads W as Hermitian-stored from its uplo triangle (the
+    # reference's hemmPanelTile does the same with the written tile)
+    return tb.hermitian_from(w, uplo)
+
+
+@register_program_cache
+@functools.partial(jax.jit, static_argnames=("uplo", "nb"))
+def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
+    """Unrolled blocked two-sided transform on the global 2D array.
+
+    Per step (uplo='L', LAPACK xHEGST itype=1 structure, which the
+    reference's tile loop realizes — ``impl.h:207-264``):
+    diag hegst; P <- P inv(Lkk)^H; P -= 1/2 L21 W; A22 -= P L21^H +
+    L21 P^H (her2k, one gemm + transpose here); P -= 1/2 L21 W;
+    P <- inv(L22) P (recursive blocked trsm -> MXU gemms). uplo='U' is the
+    mirrored row-panel sweep. Exact slice shapes per step; the opposite
+    triangle of ``a`` passes through untouched (merged by the caller).
+    """
+    n = a.shape[0]
+    nt = ceil_div(n, nb)
+    for k in range(nt):
+        k0, k1 = k * nb, min((k + 1) * nb, n)
+        lkk = l[k0:k1, k0:k1]
+        w = _hegst_diag(uplo, a[k0:k1, k0:k1], lkk)
+        a = a.at[k0:k1, k0:k1].set(w)
+        if k1 == n:
+            continue
+        if uplo == "L":
+            p = a[k1:, k0:k1]
+            l21 = l[k1:, k0:k1]
+            p = tb.trsm_panel("R", "L", "C", "N", lkk, p)
+            p = p - 0.5 * tb.gemm(l21, w)
+            a = a.at[k1:, k1:].set(
+                tb.her2k("L", "N", p, l21, a[k1:, k1:], alpha=-1.0))
+            p = p - 0.5 * tb.gemm(l21, w)
+            p = tb.trsm("L", "L", "N", "N", l[k1:, k1:], p)
+            a = a.at[k1:, k0:k1].set(p)
+        else:
+            p = a[k0:k1, k1:]
+            u12 = l[k0:k1, k1:]
+            p = tb.trsm_panel("L", "U", "C", "N", lkk, p)
+            p = p - 0.5 * tb.gemm(w, u12)
+            a = a.at[k1:, k1:].set(
+                tb.her2k("U", "C", p, u12, a[k1:, k1:], alpha=-1.0))
+            p = p - 0.5 * tb.gemm(w, u12)
+            p = tb.trsm("R", "U", "N", "N", l[k1:, k1:], p)
+            a = a.at[k0:k1, k1:].set(p)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Distributed blocked form (reference impl.h:268-740 call_L / call_U)
+# ---------------------------------------------------------------------------
+
+def _pair_product(x_tiles, y_tiles, cplx: bool, use_mxu: bool):
+    """All-pairs tile product ``out[r, c] = x[r] @ conj(y[c])^T`` over two
+    tile batches (the distributed gemm fan-out of one her2k term /
+    deferred-solve sweep), optionally flattened through the int8/bf16 MXU
+    path (``f64_gemm="mxu"``)."""
+    if use_mxu:
+        nr, mb = x_tiles.shape[0], x_tiles.shape[-2]
+        nc = y_tiles.shape[0]
+        mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+        full = mmfn(x_tiles.reshape(nr * mb, -1),
+                    jnp.conj(y_tiles).reshape(nc * mb, -1).T,
+                    slices=tb._oz_slices())
+        return full.reshape(nr, mb, nc, mb).transpose(0, 2, 1, 3)
+    return jnp.einsum("rab,cdb->rcad", x_tiles, jnp.conj(y_tiles),
+                      preferred_element_type=x_tiles.dtype)
+
+
+def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
+    """shard_map'd blocked HEGST over the 2D mesh, k-loop unrolled.
+
+    Per step k (uplo='L'): broadcast the L diag + col-panel (row-wise and
+    transposed — the same panel machinery as the distributed Cholesky);
+    FIRST apply the deferred trailing-solve contributions to all previous
+    panel columns (row k: A_kj <- inv(L_kk) A_kj, then A_ij -= L_ik A_kj —
+    the reference's reshuffled huge-TRSM, ``impl.h:327-372``); then hegst
+    the diagonal block (redundantly on every rank, like the dist
+    Cholesky's potrf), panel trsm + first half-hemm, broadcast the A
+    panel, her2k trailing as two all-pairs tile products, second
+    half-hemm. uplo='U' mirrors with row panels / the upper triangle.
+    All index bounds are static per k; validity masks are the only traced
+    rank-dependent values.
+    """
+    nt = dist.nr_tiles.row
+    mb = dist.block_size.row
+    n = dist.size.row
+    Pr, Qc = dist.grid_size.row, dist.grid_size.col
+    sr, sc = dist.source_rank.row, dist.source_rank.col
+    _, _, ltr, ltc = storage_tile_grid(dist)
+
+    def pad_lkk(lkk, k):
+        ts = min(mb, n - k * mb)
+        if ts < mb:  # identity pad keeps the edge-tile solves defined
+            pad = jnp.arange(mb) >= ts
+            lkk = jnp.where(pad[:, None] | pad[None, :], 0, lkk) \
+                + jnp.diag(pad.astype(lkk.dtype))
+        return lkk
+
+    def step_L(lt, ll, k, rr, rc):
+        owner_r = ud.rank_global_tile(k, Pr, sr)
+        owner_c = ud.rank_global_tile(k, Qc, sc)
+        kr = ud.local_tile_from_global_tile(k, Pr)
+        kc = ud.local_tile_from_global_tile(k, Qc)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+
+        # -- L diag -> everyone --------------------------------------------
+        lkk = pad_lkk(cc.bcast(cc.bcast(ll[kr, kc], ROW_AXIS, owner_r),
+                               COL_AXIS, owner_c), k)
+        lkk_inv = None
+        if tb.trsm_panel_uses_mixed(lkk.dtype):
+            # lkk is already triangular: refined inverse computed ONCE per
+            # step, shared by the prev-panel solve and the panel trsm
+            lkk_inv = mx.tri_inv_refined(tb.tri_mask(lkk, "L"), lower=True)
+
+        # -- L col-panel (rows > k) row-broadcast --------------------------
+        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
+        nrows = ltr - lu_r
+        g_rows = (lu_r + jnp.arange(max(nrows, 1))) * Pr + rr
+        row_valid = (g_rows > k) & (g_rows < nt)
+        vr_l = None
+        if nrows > 0:
+            vr_l = cc.bcast(jnp.where((is_owner_c & row_valid)[:, None, None],
+                                      ll[lu_r:, kc], 0), COL_AXIS, owner_c)
+            vr_l = jnp.where(row_valid[:, None, None], vr_l, 0)
+
+        # -- deferred trailing-solve updates of previous panels ------------
+        # (reference impl.h:327-372: only tasks involving the k-th panel of
+        # L run at iteration k, so every previous panel updates here)
+        lc_ub = ceil_div(k, Qc)   # max local cols with global col < k
+        if lc_ub > 0:
+            g_pcols = jnp.arange(lc_ub) * Qc + rc
+            pcol_valid = g_pcols < k
+            rowk = lt[kr, :lc_ub]
+            rowk_new = tb.trsm_panel("L", "L", "N", "N", lkk, rowk,
+                                     inv_a=lkk_inv)
+            keep = (is_owner_r & pcol_valid)[:, None, None]
+            lt = lt.at[kr, :lc_ub].set(jnp.where(keep, rowk_new, rowk))
+            akj = cc.bcast(jnp.where(keep, rowk_new, 0), ROW_AXIS, owner_r)
+            if nrows > 0:
+                upd = _pair_product(vr_l, jnp.conj(jnp.swapaxes(
+                    akj, -1, -2)), cplx, use_mxu)
+                mask4 = (row_valid[:, None] & pcol_valid[None, :]
+                         )[:, :, None, None]
+                lt = lt.at[lu_r:, :lc_ub].add(-jnp.where(mask4, upd, 0))
+
+        # -- diag hegst (redundant on every rank) --------------------------
+        cand = lt[kr, kc]
+        akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
+        w = _hegst_diag("L", akk, lkk)
+        lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
+                                         tb.tri_mask(w, "L")
+                                         + tb.tri_mask(akk, "U", k=-1), cand))
+        if k == nt - 1 or nrows == 0:
+            return lt
+
+        # -- panel: trsm right with Lkk + first half-hemm ------------------
+        pan = tb.trsm_panel("R", "L", "C", "N", lkk, lt[lu_r:, kc],
+                            inv_a=lkk_inv)
+        pan = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
+        pan = jnp.where(row_valid[:, None, None], pan, 0)
+        keep = (is_owner_c & row_valid)[:, None, None]
+        lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan, lt[lu_r:, kc]))
+
+        # -- A panel broadcast + transposed panels -------------------------
+        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
+        ncols = ltc - lu_c
+        if ncols == 0:
+            # no trailing columns on any rank; finish the second half-hemm
+            pan2 = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
+            lt = lt.at[lu_r:, kc].set(
+                jnp.where(keep, pan2, lt[lu_r:, kc]))
+            return lt
+        g_cols = (lu_c + jnp.arange(ncols)) * Qc + rc
+        col_valid = (g_cols > k) & (g_cols < nt)
+        ctx = DistContext(dist)
+        vr_a = cc.bcast(jnp.where(keep, pan, 0), COL_AXIS, owner_c)
+        vc_a = transpose_col_to_rows(ctx, vr_a, lu_r, g_cols)
+        vc_l = transpose_col_to_rows(ctx, vr_l, lu_r, g_cols)
+        vc_a = jnp.where(col_valid[:, None, None], vc_a, 0)
+        vc_l = jnp.where(col_valid[:, None, None], vc_l, 0)
+
+        # -- her2k trailing: A_ij -= P_i L_jk^H + L_ik P_j^H ---------------
+        pair = row_valid[:, None] & col_valid[None, :]
+        below = pair & (g_rows[:, None] > g_cols[None, :])
+        ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        upd = _pair_product(vr_a, vc_l, cplx, use_mxu) \
+            + _pair_product(vr_l, vc_a, cplx, use_mxu)
+        tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+        mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
+        lt = lt.at[lu_r:, lu_c:].add(-jnp.where(mask4, upd, 0))
+
+        # -- second half-hemm on the panel ---------------------------------
+        pan2 = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
+        lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan2, lt[lu_r:, kc]))
+        return lt
+
+    def step_U(lt, ll, k, rr, rc):
+        owner_r = ud.rank_global_tile(k, Pr, sr)
+        owner_c = ud.rank_global_tile(k, Qc, sc)
+        kr = ud.local_tile_from_global_tile(k, Pr)
+        kc = ud.local_tile_from_global_tile(k, Qc)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+
+        ukk = pad_lkk(cc.bcast(cc.bcast(ll[kr, kc], ROW_AXIS, owner_r),
+                               COL_AXIS, owner_c), k)
+        ukk_inv = None
+        if tb.trsm_panel_uses_mixed(ukk.dtype):
+            ukk_inv = mx.tri_inv_refined(tb.tri_mask(ukk, "U"), lower=False)
+
+        # -- U row-panel (cols > k) col-broadcast --------------------------
+        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
+        ncols = ltc - lu_c
+        g_cols = (lu_c + jnp.arange(max(ncols, 1))) * Qc + rc
+        col_valid = (g_cols > k) & (g_cols < nt)
+        vc_u = None
+        if ncols > 0:
+            vc_u = cc.bcast(jnp.where((is_owner_r & col_valid)[:, None, None],
+                                      ll[kr, lu_c:], 0), ROW_AXIS, owner_r)
+            vc_u = jnp.where(col_valid[:, None, None], vc_u, 0)
+
+        # -- deferred right-solve updates of previous panel rows -----------
+        lr_ub = ceil_div(k, Pr)   # max local rows with global row < k
+        if lr_ub > 0:
+            g_prows = jnp.arange(lr_ub) * Pr + rr
+            prow_valid = g_prows < k
+            colk = lt[:lr_ub, kc]
+            colk_new = tb.trsm_panel("R", "U", "N", "N", ukk, colk,
+                                     inv_a=ukk_inv)
+            keep = (is_owner_c & prow_valid)[:, None, None]
+            lt = lt.at[:lr_ub, kc].set(jnp.where(keep, colk_new, colk))
+            ajk = cc.bcast(jnp.where(keep, colk_new, 0), COL_AXIS, owner_c)
+            if ncols > 0:
+                # A_ji -= A_jk U_ki: pair product with x = A_jk tiles,
+                # y[c] = conj(U_ki)^T so conj(y)^T = U_ki
+                upd = _pair_product(ajk, jnp.conj(jnp.swapaxes(
+                    vc_u, -1, -2)), cplx, use_mxu)
+                mask4 = (prow_valid[:, None] & col_valid[None, :]
+                         )[:, :, None, None]
+                lt = lt.at[:lr_ub, lu_c:].add(-jnp.where(mask4, upd, 0))
+
+        cand = lt[kr, kc]
+        akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
+        w = _hegst_diag("U", akk, ukk)
+        lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
+                                         tb.tri_mask(w, "U")
+                                         + tb.tri_mask(akk, "L", k=-1), cand))
+        if k == nt - 1 or ncols == 0:
+            return lt
+
+        # -- panel: trsm left with Ukk^H + first half-hemm -----------------
+        pan = tb.trsm_panel("L", "U", "C", "N", ukk, lt[kr, lu_c:],
+                            inv_a=ukk_inv)
+        pan = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
+        pan = jnp.where(col_valid[:, None, None], pan, 0)
+        keep = (is_owner_r & col_valid)[:, None, None]
+        lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
+
+        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
+        nrows = ltr - lu_r
+        if nrows == 0:
+            pan2 = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
+            lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan2, lt[kr, lu_c:]))
+            return lt
+        g_rows = (lu_r + jnp.arange(nrows)) * Pr + rr
+        row_valid = (g_rows > k) & (g_rows < nt)
+        ctx = DistContext(dist)
+        vc_a = cc.bcast(jnp.where(keep, pan, 0), ROW_AXIS, owner_r)
+        vr_a = transpose_row_to_cols(ctx, vc_a, lu_c, g_rows)
+        vr_u = transpose_row_to_cols(ctx, vc_u, lu_c, g_rows)
+        vr_a = jnp.where(row_valid[:, None, None], vr_a, 0)
+        vr_u = jnp.where(row_valid[:, None, None], vr_u, 0)
+
+        # -- her2k trailing (upper): A_ij -= P_i^H U_kj + U_ki^H P_j -------
+        # tile (i, j), i < j: A_ij -= conj(P_ki)^T U_kj + conj(U_ki)^T P_kj
+        pair = row_valid[:, None] & col_valid[None, :]
+        above = pair & (g_rows[:, None] < g_cols[None, :])
+        ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        upd = _pair_product(jnp.conj(jnp.swapaxes(vr_a, -1, -2)),
+                            jnp.conj(jnp.swapaxes(vc_u, -1, -2)),
+                            cplx, use_mxu) \
+            + _pair_product(jnp.conj(jnp.swapaxes(vr_u, -1, -2)),
+                            jnp.conj(jnp.swapaxes(vc_a, -1, -2)),
+                            cplx, use_mxu)
+        triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+        mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
+        lt = lt.at[lu_r:, lu_c:].add(-jnp.where(mask4, upd, 0))
+
+        pan2 = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
+        lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan2, lt[kr, lu_c:]))
+        return lt
+
+    step = step_L if uplo == "L" else step_U
+
+    def transform(lt, ll):
+        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
+        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+        for k in range(nt):
+            lt = step(lt, ll, k, rr, rc)
+        return lt
+
+    return shard_map(transform, mesh=mesh,
+                     in_specs=(P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS)),
+                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
+@register_program_cache
+@functools.lru_cache(maxsize=64)
+def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu):
+    return jax.jit(_build_dist_hegst(dist, mesh, uplo, use_mxu=use_mxu,
+                                     cplx=dtype.startswith("complex")))
+
+
+def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
+    """Transform ``a`` (Hermitian, stored in ``uplo``) using ``b_factor`` =
+    the Cholesky factor of B (same ``uplo``). Returns the transformed A with
+    its opposite triangle passing through unchanged."""
+    dlaf_assert(uplo in ("L", "U"), f"gen_to_std: bad uplo {uplo!r}")
+    dlaf_assert(a.size == b_factor.size, "gen_to_std: A/B size mismatch")
+    dlaf_assert(a.block_size == b_factor.block_size, "gen_to_std: block mismatch")
+    cfg = get_configuration()
+    distributed = a.grid is not None and a.grid.num_devices > 1
+    if cfg.hegst_impl == "twosolve" or (distributed
+                                        and cfg.dist_step_mode == "scan"):
+        # the scan step mode's O(1)-compile guarantee flows through the
+        # triangular solver's scan form; the blocked builder is
+        # unrolled-only (see module docstring)
+        return _gen_to_std_twosolve(uplo, a, b_factor)
+    if not distributed:
+        g = tiles_to_global(a.storage, a.dist)
+        lg = tiles_to_global(b_factor.storage, b_factor.dist)
+        out = _hegst_local_blocked(g, lg, uplo=uplo,
+                                   nb=a.block_size.row)
+        out_m = a.with_storage(global_to_tiles(out, a.dist))
+        return mops.merge_triangle(out_m, a, uplo)
+    dt = np.dtype(a.dtype)
+    use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
+    fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu)
+    return a.with_storage(fn(a.storage, b_factor.storage))

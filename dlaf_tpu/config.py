@@ -118,6 +118,17 @@ class Configuration:
     #: tile counts, docs/DESIGN.md). Cholesky selects its scan form via
     #: cholesky_trailing="scan".
     dist_step_mode: str = "unrolled"
+    #: HEGST (gen_to_std) formulation: "blocked" (per-k two-sided update —
+    #: hegst diag, panel trsm/hemm, her2k trailing, deferred trailing
+    #: solve — ~n^3 flops, the reference's flop discipline,
+    #: ``eigensolver/gen_to_std/impl.h:200-740``) or "twosolve" (two
+    #: whole-matrix triangular solves: ~2x the flops as two dense
+    #: MXU-shaped sweeps with no panel round-trips; kept as the
+    #: fallback/check and as the scan-compatible compile-latency hatch —
+    #: the distributed blocked form is unrolled-only, so
+    #: dist_step_mode="scan" routes distributed HEGST through "twosolve"
+    #: regardless of this knob).
+    hegst_impl: str = "blocked"
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -201,6 +212,7 @@ _VALID_CHOICES = {
     "ozaki_dot": ("int8", "bf16"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan"),
+    "hegst_impl": ("blocked", "twosolve"),
 }
 
 

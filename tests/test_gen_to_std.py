@@ -170,3 +170,69 @@ def test_gen_to_std_distributed_scan_mode(uplo, devices8, monkeypatch):
     finally:
         monkeypatch.delenv("DLAF_DIST_STEP_MODE")
         config.initialize()
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("grid_shape", [None, (2, 4)])
+def test_hegst_blocked_matches_twosolve(uplo, grid_shape, devices8,
+                                        monkeypatch):
+    """The two formulations (config knob hegst_impl) agree to rounding on
+    the same inputs — the twosolve path is the blocked path's
+    cross-check (reference impl.h:200-740 vs the dense two-solve form)."""
+    import dlaf_tpu.config as config
+
+    dtype = np.complex128
+    n, nb = 21, 4
+    a = herm(n, dtype, 11)
+    b = herm(n, dtype, 12, pd=True)
+    grid = Grid(*grid_shape) if grid_shape else None
+    src = RankIndex2D(1, 2) if grid_shape else RankIndex2D(0, 0)
+    l = np.linalg.cholesky(b)
+    bf = np.tril(l) if uplo == "L" else np.triu(l.conj().T)
+    outs = {}
+    try:
+        for impl in ("blocked", "twosolve"):
+            monkeypatch.setenv("DLAF_HEGST_IMPL", impl)
+            config.initialize()
+            outs[impl] = gen_to_std(uplo, M(a, nb, grid, src),
+                                    M(bf, nb, grid, src)).to_numpy()
+    finally:
+        monkeypatch.delenv("DLAF_HEGST_IMPL", raising=False)
+        config.initialize()
+    tri = np.tril if uplo == "L" else np.triu
+    np.testing.assert_allclose(tri(outs["blocked"]), tri(outs["twosolve"]),
+                               rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_hegst_blocked_dist_mxu_mixed_knobs(uplo, devices8, monkeypatch):
+    """Distributed blocked HEGST under f64_gemm=mxu + f64_trsm=mixed (the
+    TPU product-config route: MXU pair products + refined-inverse panel
+    solves) matches the numpy reference at f64-grade residual."""
+    import dlaf_tpu.config as config
+
+    monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+    monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "4")
+    monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+    config.initialize()
+    try:
+        dtype = np.float64
+        n, nb = 24, 4
+        a = herm(n, dtype, 21)
+        b = herm(n, dtype, 22, pd=True)
+        l = np.linalg.cholesky(b)
+        bf = np.tril(l) if uplo == "L" else np.triu(l.conj().T)
+        grid = Grid(2, 4)
+        out = gen_to_std(uplo, M(a, nb, grid), M(bf, nb, grid)).to_numpy()
+        if uplo == "L":
+            expect = np.linalg.solve(bf, a) @ np.linalg.inv(bf).conj().T
+            np.testing.assert_allclose(np.tril(out), np.tril(expect),
+                                       rtol=1e-9, atol=1e-9)
+        else:
+            expect = np.linalg.solve(bf.conj().T, a) @ np.linalg.inv(bf)
+            np.testing.assert_allclose(np.triu(out), np.triu(expect),
+                                       rtol=1e-9, atol=1e-9)
+    finally:
+        for k in ("DLAF_F64_GEMM", "DLAF_F64_GEMM_MIN_DIM", "DLAF_F64_TRSM"):
+            monkeypatch.delenv(k, raising=False)
+        config.initialize()
