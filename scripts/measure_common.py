@@ -26,9 +26,18 @@ def repo_root() -> str:
 
 
 def setup_env():
-    """x64 + persistent compile cache; returns the jax module."""
+    """x64 + persistent compile cache; returns the jax module.
+
+    Honors a ``JAX_PLATFORMS`` env request at the config level too: the
+    accelerator plugin's register() force-sets ``jax_platforms`` at
+    interpreter start, overriding the env var — without this, a script run
+    with ``JAX_PLATFORMS=cpu`` still probes the (possibly wedged) tunnel
+    and hangs (same workaround as tests/conftest.py)."""
     import jax
 
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        jax.config.update("jax_platforms", requested)
     jax.config.update("jax_enable_x64", True)
     os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR",
                           os.path.join(repo_root(), ".jax_cache"))

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Cholesky N-sweep + scan-vs-unrolled premium on one chip.
+
+Covers two round-3 items in one pass over N in {4096, 8192, 16384}
+(nt = 16/32/64 at nb=256):
+
+* the unrolled ozaki path's panel-latency amortization curve, including
+  the first post-``_fold_group`` attempt at N=16384 (the collect-then-
+  combine form OOM'd HBM at compile: 22.68 GB vs 15.75) and the
+  bf16-vs-int8 slice-dot A/B at N=8192 where trailing flops dominate;
+* the scan formulation's run premium on real hardware (the 2.1x figure in
+  docs/DESIGN.md is a CPU-mesh number at nt=16) — the input the
+  ``dist_step_mode`` auto default needs (VERDICT r2 item 8).
+
+Each combo is guarded; results append to ``.bench_history.jsonl`` as they
+land and the results document re-prints after every combo.
+
+Usage: python scripts/tpu_nsweep.py [out.json]
+"""
+
+import gc
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from measure_common import append_history, best_time, log, setup_env  # noqa: E402
+
+#: (N, variant, knobs) in value-per-minute order: the known-good unrolled
+#: N=8192 first (re-confirm 286 GF/s), then its bf16 A/B (the round's
+#: designated lever), then the scan premium ladder, then the post-OOM-fix
+#: N=16384 runs (scan before unrolled: O(1) compile vs ~19 s/step).
+COMBOS = [
+    (8192, "ozaki", {"DLAF_OZAKI_DOT": "int8"}),
+    (8192, "ozaki", {"DLAF_OZAKI_DOT": "bf16"}),
+    (4096, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed"}),
+    (8192, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed"}),
+    (8192, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed",
+                    "DLAF_OZAKI_DOT": "bf16"}),
+    (16384, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed"}),
+    (16384, "ozaki", {}),
+    (4096, "ozaki", {}),  # same-session tie point for the premium table
+]
+
+KNOB_KEYS = ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_DOT", "DLAF_F64_GEMM",
+             "DLAF_F64_TRSM", "DLAF_OZAKI_IMPL", "DLAF_F64_GEMM_SLICES")
+
+#: DLAF_NSWEEP_SMOKE=1 shrinks every N by 16x (and nb to 64) so the
+#: script's control flow is testable off-hardware in seconds; history
+#: appends stay disabled off-TPU either way.
+SMOKE = bool(os.environ.get("DLAF_NSWEEP_SMOKE"))
+
+
+def main():
+    jax = setup_env()
+    import dlaf_tpu.config as config
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.types import total_ops
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}, devices: {jax.devices()}")
+    results = {"platform": platform, "nb": 256, "runs": {}}
+
+    def emit():
+        print(json.dumps(results, default=float), flush=True)
+
+    nb = 64 if SMOKE else 256
+    combos = [(n // 16 if SMOKE else n, v, kn) for n, v, kn in COMBOS]
+    results["nb"] = nb
+    mats = {}  # one generator pass per N, shared across combos
+    for n, variant, knobs in combos:
+        key = f"N={n} {variant} " + ",".join(
+            f"{k.lower().replace('dlaf_', '')}={v}" for k, v in knobs.items())
+        for k in KNOB_KEYS:
+            os.environ.pop(k, None)
+        os.environ["DLAF_CHOLESKY_TRAILING"] = variant
+        os.environ.update(knobs)
+        config.initialize()
+        try:
+            if n not in mats:
+                mats[n] = Matrix.from_element_fn(
+                    hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                    TileElementSize(nb, nb), dtype=np.float64)
+            ref = mats[n]
+            t = best_time(
+                lambda st: cholesky("L", ref.with_storage(st)).storage,
+                ref.storage + 0, reps=3)
+            g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
+            results["runs"][key] = {"t": t, "gflops": g}
+            log(f"{key}: {t:.4f}s {g:.1f} GF/s")
+            if platform == "tpu":
+                append_history("tpu", n, nb, g, t,
+                               f"tpu_nsweep {key}", variant=variant)
+        except Exception as e:
+            results["runs"][key] = {"error": repr(e)[:300]}
+            log(f"{key} FAILED: {e!r}"[:500])
+        finally:
+            for k in KNOB_KEYS:
+                os.environ.pop(k, None)
+            config.initialize()
+            gc.collect()
+        emit()
+
+    # premium table: scan_t / unrolled_t per nt where both landed
+    prem = {}
+    for n in sorted({n for n, _, _ in combos}):
+        uk = [k for k in results["runs"]
+              if k.startswith(f"N={n} ozaki") and "t" in results["runs"][k]]
+        sk = [k for k in results["runs"]
+              if k.startswith(f"N={n} scan") and "t" in results["runs"][k]]
+        if uk and sk:
+            tu = min(results["runs"][k]["t"] for k in uk)
+            ts = min(results["runs"][k]["t"] for k in sk)
+            prem[f"nt={n // nb}"] = {"unrolled_t": tu, "scan_t": ts,
+                                     "premium": ts / tu}
+    results["scan_premium"] = prem
+    log(f"scan premium: {prem}")
+    emit()
+
+    path = sys.argv[1] if len(sys.argv) > 1 else None
+    if path:
+        with open(path, "w") as f:
+            json.dump(results, f, default=float)
+        log(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

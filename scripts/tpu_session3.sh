@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Round-3 hardware session: retire the built-but-untimed levers (VERDICT r2
+# item 2), close configs #3/#4 (item 3), measure the scan premium where it
+# matters (item 8 input), and rehearse config #5 on one chip (item 7).
+# Ordered by value-per-minute; every step is timeout-guarded and appends
+# durable results to .bench_history.jsonl as it lands.
+# Results land in $OUT (default /tmp/tpu_session3_<ts>/).
+
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-/tmp/tpu_session3_$(date +%H%M)}
+mkdir -p "$OUT"
+export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
+echo "results -> $OUT" >&2
+
+run() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%T)) ===" >&2
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  echo "=== $name rc=$? ($(date +%T)) ===" >&2
+}
+
+# 1. official headline (warm cache; live TPU line replaces the replay)
+run bench 2700 python bench.py
+
+# 2. bf16-vs-int8 dot A/B + fixed pallas kernels + panel chain + config #1
+# knob grid (the round's designated throughput levers)
+run pallas_probe 2400 python scripts/tpu_pallas_probe.py "$OUT/pallas_probe.json"
+
+# 3. N-sweep + scan-vs-unrolled premium in one pass: nt=16/32/64 both
+# step formulations, both dot routes at N=8192 (post-_fold_group 16384)
+run nsweep_premium 5400 python scripts/tpu_nsweep.py "$OUT/nsweep.json"
+
+# 4. config #3: c128 capability diag, then hegst z/8192 local
+run c128_diag 300 python -c "
+import jax, numpy as np
+jax.config.update('jax_enable_x64', True)
+import jax.numpy as jnp
+print('devices:', jax.devices())
+for dt in (np.complex64, np.complex128):
+    try:
+        x = jnp.asarray(np.full((8, 8), 1 + 1j, dt))
+        y = (x @ x).block_until_ready()
+        print(dt.__name__, 'ok ->', y.dtype, np.asarray(y)[0, 0])
+    except Exception as e:
+        print(dt.__name__, 'FAIL:', repr(e)[:200])
+"
+run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+# 5. config #4: red2band d/16384/band128 (scan step mode: 127 panels
+# would cost ~40 min of unrolled trace on this toolchain)
+run red2band_d_16384 2400 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
+
+# 6. config #2 TRSM: bf16 vs int8 dot route on the mxu path (round-2 best
+# 722 GF/s was int8; the s8 HLO dot measured ~1% of MXU peak at micro
+# scale, so bf16 may move the full solve too)
+run trsm_bf16 1800 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=bf16 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1
+run trsm_int8 1200 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=int8 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1
+
+# 7. config #5 rehearsal: full eigensolver pipeline on the single chip
+# with the phase table on (device reduction vs host chase/D&C vs device
+# back-transforms) — first end-to-end hardware wall time
+run eig_rehearsal 10800 env DLAF_PROFILE_DIR="$OUT/eig_prof" \
+    DLAF_DIST_STEP_MODE=scan DLAF_CHOLESKY_TRAILING=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 8192 -b 512 --nruns 1 --nwarmups 1 --check-result last
+
+echo "session3 done ($(date +%T)); summary:" >&2
+grep -h "GFlop/s\|metric\|ok ->\|FAIL\|phases" "$OUT"/*.out "$OUT"/*.log 2>/dev/null | tail -40 >&2
