@@ -414,10 +414,13 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
     dlaf_assert(uplo in ("L", "U"), f"gen_to_std: bad uplo {uplo!r}")
     dlaf_assert(a.size == b_factor.size, "gen_to_std: A/B size mismatch")
     dlaf_assert(a.block_size == b_factor.block_size, "gen_to_std: block mismatch")
+    from ..config import resolve_step_mode
+
     cfg = get_configuration()
     distributed = a.grid is not None and a.grid.num_devices > 1
-    if cfg.hegst_impl == "twosolve" or (distributed
-                                        and cfg.dist_step_mode == "scan"):
+    if cfg.hegst_impl == "twosolve" or (
+            distributed
+            and resolve_step_mode(a.dist.nr_tiles.row) == "scan"):
         # the scan step mode's O(1)-compile guarantee flows through the
         # triangular solver's scan form; the blocked builder is
         # unrolled-only (see module docstring)

@@ -377,11 +377,12 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
         out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
                            side=side, uplo=uplo, op=op, diag=diag)
         return b.with_storage(global_to_tiles(out, b.dist))
-    from ..config import get_configuration
+    from ..config import resolve_step_mode
 
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
                             np.dtype(a.dtype).name,
-                            scan=get_configuration().dist_step_mode == "scan")
+                            scan=resolve_step_mode(a.dist.nr_tiles.row)
+                            == "scan")
     return b.with_storage(fn(a.storage, b.storage, jnp.asarray(alpha, b.dtype)))
 
 
@@ -397,9 +398,10 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
         out = _mult_local(am, bm, jnp.asarray(alpha, bm.dtype),
                           side=side, uplo=uplo, op=op, diag=diag)
         return b.with_storage(global_to_tiles(out, b.dist))
-    from ..config import get_configuration
+    from ..config import resolve_step_mode
 
     fn = _dist_mult_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
                            np.dtype(a.dtype).name,
-                           scan=get_configuration().dist_step_mode == "scan")
+                           scan=resolve_step_mode(a.dist.nr_tiles.row)
+                           == "scan")
     return b.with_storage(fn(a.storage, b.storage, jnp.asarray(alpha, b.dtype)))

@@ -113,11 +113,13 @@ class Configuration:
     #: solve/multiply, reduction_to_band + its back-transform, gen_to_std
     #: via its solves) AND the local reduction_to_band: "unrolled" (per-k
     #: steps traced out — exact shapes, compile time linear in the step
-    #: count) or "scan" (lax.scan'd uniform masked step — O(1) compile,
+    #: count), "scan" (lax.scan'd uniform masked step — O(1) compile,
     #: ~2-3x masked-shape work; the compile-latency escape hatch at large
-    #: tile counts, docs/DESIGN.md). Cholesky selects its scan form via
+    #: tile counts, docs/DESIGN.md), or "auto" (default): pick per (step
+    #: count, platform) from the measured compile constants via
+    #: :func:`resolve_step_mode`. Cholesky selects its scan form via
     #: cholesky_trailing="scan".
-    dist_step_mode: str = "unrolled"
+    dist_step_mode: str = "auto"
     #: HEGST (gen_to_std) formulation: "blocked" (per-k two-sided update —
     #: hegst diag, panel trsm/hemm, her2k trailing, deferred trailing
     #: solve — ~n^3 flops, the reference's flop discipline,
@@ -129,6 +131,12 @@ class Configuration:
     #: dist_step_mode="scan" routes distributed HEGST through "twosolve"
     #: regardless of this knob).
     hegst_impl: str = "blocked"
+    #: Broadcast realization in comm.collectives.bcast: "psum"
+    #: (mask-then-all-reduce — ~2V(p-1)/p per link, the bandwidth shape
+    #: for panel payloads) or "tree" (binomial ppermute doubling —
+    #: ceil(log2 p) hop latencies, the candidate for small diagonal-tile
+    #: payloads). First multi-chip ICI access must A/B these.
+    bcast_impl: str = "psum"
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -211,8 +219,9 @@ _VALID_CHOICES = {
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16"),
     "mixed_seed": ("xla", "recursive"),
-    "dist_step_mode": ("unrolled", "scan"),
+    "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve"),
+    "bcast_impl": ("psum", "tree"),
 }
 
 
@@ -301,6 +310,35 @@ def get_configuration() -> Configuration:
     if _active is None:
         _active = initialize()
     return _active
+
+
+#: Step counts at which ``dist_step_mode="auto"`` switches to the scan
+#: formulation, per platform. Derived from the measured compile constants
+#: (docs/DESIGN.md): the hardware AOT toolchain compiles unrolled per-step
+#: programs at ~19 s/step (vs ~2.3 s total for the scan form), so at 32+
+#: steps a cold unrolled compile costs 10+ minutes against a scan run
+#: premium measured at ~2.1x (CPU mesh, nt=16; single-run wall is
+#: milliseconds-to-seconds). The CPU toolchain's ~0.35 s/step constant
+#: moves the breakpoint to ~128. Thresholds are refreshed as hardware
+#: premium data lands (scripts/tpu_nsweep.py measures the scan ladder).
+STEP_MODE_AUTO_SCAN_AT = {"tpu": 32, "cpu": 128}
+
+
+def resolve_step_mode(steps: int, platform: Optional[str] = None) -> str:
+    """Effective step formulation for an algorithm with ``steps`` traced
+    per-k steps: the configured ``dist_step_mode``, with ``"auto"``
+    resolved per (step count, platform) from the measured compile
+    constants (:data:`STEP_MODE_AUTO_SCAN_AT`). ``platform`` defaults to
+    the jax default backend."""
+    mode = get_configuration().dist_step_mode
+    if mode != "auto":
+        return mode
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return "scan" if steps >= STEP_MODE_AUTO_SCAN_AT.get(platform, 128) \
+        else "unrolled"
 
 
 def finalize() -> None:

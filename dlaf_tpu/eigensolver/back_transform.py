@@ -443,10 +443,12 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         storage = evecs.storage
         if storage.dtype != a.dtype:
             storage = storage.astype(a.dtype)
-        from ..config import get_configuration
+        from ..config import resolve_step_mode
 
+        # the builders trace ceil(n/band) - 1 reflector-block steps
         fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band,
-                                 scan=get_configuration().dist_step_mode
+                                 scan=resolve_step_mode(max(
+                                     -(-a.size.row // red.band) - 1, 1))
                                  == "scan")
         out = fn(a.storage, memory.as_device(red.taus), storage)
         return Matrix(evecs.dist, out, evecs.grid)

@@ -392,11 +392,14 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
     dlaf_assert(nb % band == 0,
                 f"reduction_to_band: block size {nb} not divisible by band_size {band}"
                 " (reference reduction_to_band.h:84)")
-    from ..config import get_configuration
+    from ..config import resolve_step_mode
 
+    # the traced step count is the PANEL count: the builders run
+    # ceil(n/band) - 1 panel steps (the last panel has no trailing block)
+    steps = max(-(-a.size.row // band) - 1, 1)
     if a.grid is None or a.grid.num_devices == 1:
         g = tiles_to_global(a.storage, a.dist)
-        if get_configuration().dist_step_mode == "scan":
+        if resolve_step_mode(steps) == "scan":
             out, taus = _red2band_local_scan(g, nb=band)
         else:
             out, taus = _red2band_local(g, nb=band)
@@ -404,8 +407,7 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
                              taus, band)
     fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name,
                                band,
-                               scan=get_configuration().dist_step_mode
-                               == "scan")
+                               scan=resolve_step_mode(steps) == "scan")
     storage, taus = fn(a.storage)
     return BandReduction(a.with_storage(storage), taus, band)
 

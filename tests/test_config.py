@@ -65,3 +65,26 @@ def test_slices_auto_default(monkeypatch):
         C.initialize()
     monkeypatch.delenv("DLAF_F64_GEMM_SLICES")
     C.initialize()
+
+
+def test_resolve_step_mode(monkeypatch):
+    # auto (the default) picks per (step count, platform) from the
+    # measured compile constants; explicit modes pass through untouched
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        assert config.get_configuration().dist_step_mode == "auto"
+        assert config.resolve_step_mode(8, "cpu") == "unrolled"
+        assert config.resolve_step_mode(200, "cpu") == "scan"
+        assert config.resolve_step_mode(31, "tpu") == "unrolled"
+        assert config.resolve_step_mode(32, "tpu") == "scan"
+        monkeypatch.setenv("DLAF_DIST_STEP_MODE", "scan")
+        config.initialize()
+        assert config.resolve_step_mode(2, "tpu") == "scan"
+        monkeypatch.setenv("DLAF_DIST_STEP_MODE", "unrolled")
+        config.initialize()
+        assert config.resolve_step_mode(10_000, "tpu") == "unrolled"
+    finally:
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE", raising=False)
+        config.initialize()
