@@ -39,24 +39,50 @@ def bcast(x, axis: str, src: int):
     (reference ``scheduleSendBcast``/``scheduleRecvBcast``,
     ``kernels/broadcast.h:62-115``).
 
-    Implemented as mask-then-psum: contributions from non-source ranks are
-    zeroed, so the all-reduce returns exactly the source value. On a TPU ring
-    this lowers to one all-reduce over ICI; XLA fuses the masking.
+    Two implementations (config knob ``bcast_impl``):
 
-    Cost rationale (round-1 review asked why not a bcast tree): for axis
-    size p and payload V, psum on a bidirectional ring moves ~2V(p-1)/p
-    per link (reduce-scatter + all-gather) — within 2x of the V(p-1)/p
-    one-to-all lower bound. The XLA-expressible alternatives are worse or
-    latency-bound: ``all_gather``+select moves (p-1)V per link; a
-    pipelined ``ppermute`` chain reaches ~V but pays p-1 serialized hops
-    (wins only for payloads far below the panel sizes these algorithms
-    broadcast). MPI-style log-tree broadcasts are not expressible in SPMD
-    XLA collectives. Measuring the ppermute variant against this needs a
-    multi-chip ICI axis, which the one-chip environment cannot provide;
-    the 2x-of-optimal bound is the design budget until then.
+    * ``"psum"`` (default) — mask-then-psum: contributions from non-source
+      ranks are zeroed, so the all-reduce returns exactly the source
+      value. On a TPU ring this lowers to one all-reduce over ICI; XLA
+      fuses the masking. For axis size p and payload V it moves
+      ~2V(p-1)/p per link (reduce-scatter + all-gather) — within 2x of
+      the V(p-1)/p one-to-all lower bound, the right shape for the
+      bandwidth-bound panel broadcasts.
+    * ``"tree"`` — binomial doubling over ``ppermute`` rounds: ceil(log2 p)
+      serialized collective-permutes, each moving the full payload on
+      disjoint links. ~log2(p) link latencies vs the ring's ~2(p-1), at
+      log2(p)x the per-link traffic — the candidate winner for SMALL
+      payloads (diagonal tiles) where hop latency dominates. (A one-hop
+      multicast is not expressible: XLA collective-permute requires
+      unique sources AND destinations.)
+
+    First multi-chip access must A/B the two on real ICI (round-2 review
+    carried this); the knob makes both measurable with the same programs.
     """
+    from ..config import get_configuration
+
+    if get_configuration().bcast_impl == "tree":
+        return _bcast_tree(x, axis, src)
     mask = (this_rank(axis) == src).astype(x.dtype)
     return lax.psum(x * mask, axis)
+
+
+def _bcast_tree(x, axis: str, src: int):
+    """Binomial-tree broadcast: at round r (r = 1, 2, 4, ...), ranks
+    ``src .. src+r-1`` (cyclically) send to ``src+r .. src+2r-1`` in one
+    ``ppermute`` with disjoint pairs. Handles non-power-of-2 axis sizes."""
+    p = axis_size(axis)
+    dist = (this_rank(axis) - src) % p
+    val = x
+    r = 1
+    while r < p:
+        npairs = min(r, p - r)
+        perm = [((src + i) % p, (src + i + r) % p) for i in range(npairs)]
+        sent = lax.ppermute(val, axis, perm=perm)
+        take = (dist >= r) & (dist < min(2 * r, p))
+        val = jnp.where(take, sent, val)
+        r *= 2
+    return val
 
 
 def all_reduce(x, axis: str, op: str = "sum"):
@@ -75,12 +101,16 @@ def reduce(x, axis: str, root: int, op: str = "sum"):
     """Reduce to ``root`` (reference ``scheduleReduceRecvInPlace`` +
     ``scheduleReduceSend``, ``kernels/reduce.h:36-124``).
 
-    SPMD note: every rank receives the reduced value; non-root ranks simply
-    ignore it (XLA DCEs unused results). This matches the reference's
-    semantics where only the root's output tile is defined.
+    SPMD realization: the reduction runs as an all-reduce (one XLA
+    collective; there is no partial-reduce primitive), and non-root ranks
+    get ZEROS — the reference's contract defines only the root's output
+    tile, and zeroing makes accidental reads of non-root results surface
+    in tests instead of silently working and then breaking under a real
+    rooted implementation.
     """
-    del root
-    return all_reduce(x, axis, op)
+    full = all_reduce(x, axis, op)
+    return jnp.where(this_rank(axis) == root, full,
+                     jnp.zeros_like(full))
 
 
 def send_recv(x, axis: str, src: int, dst: int):

@@ -217,3 +217,76 @@ def test_sync_barrier_is_hard_fence():
     from dlaf_tpu.common.sync import hard_fence
 
     assert cs.barrier is hard_fence
+
+
+@pytest.mark.parametrize("rows,cols,axis,src", [
+    (2, 4, "col", 0), (2, 4, "col", 2), (1, 8, "col", 3), (8, 1, "row", 5),
+    (2, 3, "col", 1),  # non-power-of-2 axis (last doubling round truncated)
+])
+def test_bcast_tree_matches_psum(rows, cols, axis, src, devices8, monkeypatch):
+    """bcast_impl="tree" (binomial ppermute doubling) is value-identical to
+    the psum form on every axis size/source — the knob exists so the first
+    multi-chip ICI access can A/B hop latency vs ring bandwidth."""
+    import dlaf_tpu.config as config
+
+    if rows * cols > 8:
+        pytest.skip("needs more virtual devices")
+    g = Grid(rows, cols)
+    n = rows * cols
+    x = jnp.arange(n, dtype=jnp.float64).reshape(rows, cols) + 1.0
+
+    def f(x):
+        return cc.bcast(x.reshape(()), axis, src).reshape(1, 1)
+
+    ref = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    monkeypatch.setenv("DLAF_BCAST_IMPL", "tree")
+    config.initialize()
+    try:
+        out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    finally:
+        monkeypatch.delenv("DLAF_BCAST_IMPL")
+        config.initialize()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_bcast_tree_full_algorithm(devices8, monkeypatch):
+    """A full distributed factorization under bcast_impl="tree" matches the
+    psum-broadcast result bit-for-bit (same reductions, different bcast)."""
+    import dlaf_tpu.config as config
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    n, nb = 16, 4
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, n))
+    a = x @ x.T + n * np.eye(n)
+    g = Grid(2, 4)
+    ref = cholesky("L", Matrix.from_global(a, TileElementSize(nb, nb),
+                                           grid=g)).to_numpy()
+    monkeypatch.setenv("DLAF_BCAST_IMPL", "tree")
+    config.initialize()
+    try:
+        out = cholesky("L", Matrix.from_global(a, TileElementSize(nb, nb),
+                                               grid=g)).to_numpy()
+    finally:
+        monkeypatch.delenv("DLAF_BCAST_IMPL")
+        config.initialize()
+    np.testing.assert_allclose(np.tril(out), np.tril(ref), rtol=0, atol=0)
+
+
+def test_reduce_root_semantics(devices8):
+    """reduce() defines the result ONLY on root (zeros elsewhere) — the
+    reference's contract (kernels/reduce.h: only the root's output tile is
+    defined); accidental non-root reads must surface, not silently work."""
+    g = Grid(2, 4)
+    x = jnp.arange(8, dtype=jnp.float64).reshape(2, 4) + 1.0
+
+    def f(x):
+        return cc.reduce(x.reshape(()), "col", root=2).reshape(1, 1)
+
+    out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    rowsums = np.asarray(x).sum(axis=1)
+    expect = np.zeros((2, 4))
+    expect[:, 2] = rowsums
+    np.testing.assert_array_equal(out, expect)
