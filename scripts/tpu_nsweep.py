@@ -38,12 +38,15 @@ COMBOS = [
     (8192, "ozaki", {"DLAF_OZAKI_DOT": "int8"}),
     (8192, "ozaki", {"DLAF_OZAKI_DOT": "bf16"}),
     (4096, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed"}),
+    (4096, "ozaki", {}),  # same-session tie point for the premium table
     (8192, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed"}),
     (8192, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed",
                     "DLAF_OZAKI_DOT": "bf16"}),
+    # 16384 last: every smaller input is evicted by then, so the whole
+    # HBM budget minus the 2 GB input is available for the post-
+    # _fold_group compile
     (16384, "scan", {"DLAF_F64_GEMM": "mxu", "DLAF_F64_TRSM": "mixed"}),
     (16384, "ozaki", {}),
-    (4096, "ozaki", {}),  # same-session tie point for the premium table
 ]
 
 KNOB_KEYS = ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_DOT", "DLAF_F64_GEMM",
@@ -74,8 +77,12 @@ def main():
     nb = 64 if SMOKE else 256
     combos = [(n // 16 if SMOKE else n, v, kn) for n, v, kn in COMBOS]
     results["nb"] = nb
-    mats = {}  # one generator pass per N, shared across combos
-    for n, variant, knobs in combos:
+    # one generator pass per N, shared across combos — and EVICTED after a
+    # size's last combo: a dead N=8192 input pins 512 MB of the 15.75 GB
+    # HBM budget exactly when the N=16384 runs need the headroom
+    last_combo_idx = {n: i for i, (n, _, _) in enumerate(combos)}
+    mats = {}
+    for ci, (n, variant, knobs) in enumerate(combos):
         key = f"N={n} {variant} " + ",".join(
             f"{k.lower().replace('dlaf_', '')}={v}" for k, v in knobs.items())
         for k in KNOB_KEYS:
@@ -105,6 +112,8 @@ def main():
             for k in KNOB_KEYS:
                 os.environ.pop(k, None)
             config.initialize()
+            if last_combo_idx[n] == ci:
+                mats.pop(n, None)
             gc.collect()
         emit()
 

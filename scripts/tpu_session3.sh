@@ -8,7 +8,9 @@
 
 set -u
 cd "$(dirname "$0")/.."
-OUT=${OUT:-/tmp/tpu_session3_$(date +%H%M)}
+# default under the repo: a container reset must not eat session logs
+# (round-2 lesson — the git-tracked history survived, a /tmp log did not)
+OUT=${OUT:-$(pwd)/.session3_$(date +%m%d_%H%M)}
 mkdir -p "$OUT"
 export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
 echo "results -> $OUT" >&2
@@ -45,7 +47,15 @@ for dt in (np.complex64, np.complex128):
     except Exception as e:
         print(dt.__name__, 'FAIL:', repr(e)[:200])
 "
-run hegst_z_8192 2400 python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+# twosolve first: its recursive-trsm program family measured fine on this
+# toolchain in round 2 (TRSM d/8192 722 GF/s), so it lands a number even
+# if the unrolled 32-step blocked compile proves expensive; blocked second
+# for the flop-parity figure
+run hegst_z_8192_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+run hegst_z_8192_blocked 3600 env DLAF_HEGST_IMPL=blocked \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
     -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
 # 5. config #4: red2band d/16384/band128 (scan step mode: 127 panels
 # would cost ~40 min of unrolled trace on this toolchain)
