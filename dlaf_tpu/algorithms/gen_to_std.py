@@ -12,23 +12,26 @@ Two formulations (config knob ``hegst_impl``):
 * ``"blocked"`` (default) — the reference's flop discipline (~n^3 real ops):
   per-``k`` two-sided update — hegst on the diagonal block, panel trsm +
   two half-weight hemm's, her2k trailing update exploiting Hermitian
-  symmetry, and the trailing triangular solve of the panel. Local form:
-  the k-loop unrolled at trace time over exact slices (the trailing solve
-  rides the recursive blocked trsm, so its bulk flops are gemms that
-  follow the ``f64_gemm`` MXU reroute). Distributed form: the per-step
-  trailing solve is DEFERRED and applied incrementally at later steps
-  using that step's already-broadcast panel — the reference's reshuffle
-  ("the tasks of the final huge TRSM have been reshuffled to avoid extra
-  communication of the matrix L", ``impl.h:330-335``) — so each panel
-  broadcast serves both the trailing update and the pending solves of all
-  previous panels.
+  symmetry, and the trailing triangular solve of the panel realized as
+  DEFERRED incremental updates in BOTH forms: at each later step, the
+  step's solved row/column fans one gemm into the remaining region — the
+  reference's reshuffle ("the tasks of the final huge TRSM have been
+  reshuffled to avoid extra communication of the matrix L",
+  ``impl.h:330-335``). Distributed, each panel broadcast thereby serves
+  the trailing update AND the pending solves of all previous panels;
+  locally it keeps every unrolled step a small fixed op set instead of a
+  per-step recursive whole-trailing trsm the AOT compile budget could
+  not afford.
 
 * ``"twosolve"`` — Hermitianize A, then TWO whole-matrix triangular solves
   (each a fully parallel blocked substitution). ~2x the flops, but two
   perfectly MXU-shaped dense sweeps with no panel round-trips and O(1)
-  step count; kept as the fallback/cross-check and as the scan-compatible
-  compile-latency hatch: the distributed blocked form is unrolled-only, so
-  ``dist_step_mode="scan"`` routes distributed HEGST through this path.
+  step count; kept as the fallback/cross-check and as the scan-mode
+  route: a masked uniform-shape scan of the blocked form would pay the
+  usual ~3x masked-work premium on its n^3 (~3n^3) — MORE than
+  twosolve's 2n^3 dense flops — so at step counts where the compile
+  hatch matters, twosolve IS the optimal scan-mode HEGST, not a
+  placeholder (``dist_step_mode`` auto/scan routes here).
 
 Local + distributed, both uplos (reference parity: local L/U + distributed
 L/U, ``call_L``/``call_U``).
@@ -79,20 +82,31 @@ def _gen_to_std_twosolve(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
 # Local blocked form (reference impl.h:169-266 call_L / call_U local)
 # ---------------------------------------------------------------------------
 
-def _hegst_diag(uplo: str, akk, lkk):
+def _hegst_diag(uplo: str, akk, lkk, inv=None):
     """Transformed diagonal block, full Hermitian form: W = inv(L) herm(Akk)
     inv(L)^H (uplo='L') / inv(U^H) herm(Akk) inv(U) (uplo='U'). The two
-    block-size solves follow the f64_trsm knob via trsm_panel."""
+    block-size solves follow the f64_trsm knob via trsm_panel; ``inv`` is
+    the optional precomputed refined inverse of ``lkk``'s triangle, shared
+    with the step's panel solve so the mixed route derives it ONCE."""
     ah = tb.hermitian_from(akk, uplo)
     if uplo == "L":
-        w = tb.trsm_panel("L", "L", "N", "N", lkk, ah)
-        w = tb.trsm_panel("R", "L", "C", "N", lkk, w)
+        w = tb.trsm_panel("L", "L", "N", "N", lkk, ah, inv_a=inv)
+        w = tb.trsm_panel("R", "L", "C", "N", lkk, w, inv_a=inv)
     else:
-        w = tb.trsm_panel("L", "U", "C", "N", lkk, ah)
-        w = tb.trsm_panel("R", "U", "N", "N", lkk, w)
+        w = tb.trsm_panel("L", "U", "C", "N", lkk, ah, inv_a=inv)
+        w = tb.trsm_panel("R", "U", "N", "N", lkk, w, inv_a=inv)
     # the algorithm reads W as Hermitian-stored from its uplo triangle (the
     # reference's hemmPanelTile does the same with the written tile)
     return tb.hermitian_from(w, uplo)
+
+
+def _step_inv(uplo: str, lkk):
+    """Refined triangle inverse for one step's solves, or None when the
+    config routes trsm_panel natively."""
+    if tb.trsm_panel_uses_mixed(lkk.dtype):
+        return mx.tri_inv_refined(tb.tri_mask(lkk, uplo),
+                                  lower=(uplo == "L"))
+    return None
 
 
 @register_program_cache
@@ -102,40 +116,62 @@ def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
 
     Per step (uplo='L', LAPACK xHEGST itype=1 structure, which the
     reference's tile loop realizes — ``impl.h:207-264``):
-    diag hegst; P <- P inv(Lkk)^H; P -= 1/2 L21 W; A22 -= P L21^H +
-    L21 P^H (her2k, one gemm + transpose here); P -= 1/2 L21 W;
-    P <- inv(L22) P (recursive blocked trsm -> MXU gemms). uplo='U' is the
-    mirrored row-panel sweep. Exact slice shapes per step; the opposite
-    triangle of ``a`` passes through untouched (merged by the caller).
+    deferred-solve update of all PREVIOUS panel columns (row k solved
+    with Lkk, one gemm fans it into the rows below — the same
+    incremental realization of the trailing inv(L22) solve as the
+    distributed builder, so each step is a small fixed op set instead
+    of a per-step recursive whole-trailing trsm whose unrolled program
+    would dwarf the AOT compile budget); diag hegst; P <- P inv(Lkk)^H;
+    P -= 1/2 L21 W; A22 -= P L21^H + L21 P^H (her2k, one gemm +
+    transpose here); P -= 1/2 L21 W. uplo='U' is the mirrored row-panel
+    sweep. Exact slice shapes per step; the opposite triangle of ``a``
+    passes through untouched (merged by the caller).
     """
     n = a.shape[0]
     nt = ceil_div(n, nb)
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, n)
         lkk = l[k0:k1, k0:k1]
-        w = _hegst_diag(uplo, a[k0:k1, k0:k1], lkk)
-        a = a.at[k0:k1, k0:k1].set(w)
-        if k1 == n:
-            continue
+        lkk_inv = _step_inv(uplo, lkk)
         if uplo == "L":
+            if k0 > 0:
+                # deferred trailing-solve: row k of every previous panel
+                # column, then one gemm into the rows below
+                rowk = tb.trsm_panel("L", "L", "N", "N", lkk,
+                                     a[k0:k1, :k0], inv_a=lkk_inv)
+                a = a.at[k0:k1, :k0].set(rowk)
+                if k1 < n:
+                    a = a.at[k1:, :k0].add(-tb.gemm(l[k1:, k0:k1], rowk))
+            w = _hegst_diag(uplo, a[k0:k1, k0:k1], lkk, inv=lkk_inv)
+            a = a.at[k0:k1, k0:k1].set(w)
+            if k1 == n:
+                continue
             p = a[k1:, k0:k1]
             l21 = l[k1:, k0:k1]
-            p = tb.trsm_panel("R", "L", "C", "N", lkk, p)
+            p = tb.trsm_panel("R", "L", "C", "N", lkk, p, inv_a=lkk_inv)
             p = p - 0.5 * tb.gemm(l21, w)
             a = a.at[k1:, k1:].set(
                 tb.her2k("L", "N", p, l21, a[k1:, k1:], alpha=-1.0))
             p = p - 0.5 * tb.gemm(l21, w)
-            p = tb.trsm("L", "L", "N", "N", l[k1:, k1:], p)
             a = a.at[k1:, k0:k1].set(p)
         else:
+            if k0 > 0:
+                colk = tb.trsm_panel("R", "U", "N", "N", lkk,
+                                     a[:k0, k0:k1], inv_a=lkk_inv)
+                a = a.at[:k0, k0:k1].set(colk)
+                if k1 < n:
+                    a = a.at[:k0, k1:].add(-tb.gemm(colk, l[k0:k1, k1:]))
+            w = _hegst_diag(uplo, a[k0:k1, k0:k1], lkk, inv=lkk_inv)
+            a = a.at[k0:k1, k0:k1].set(w)
+            if k1 == n:
+                continue
             p = a[k0:k1, k1:]
             u12 = l[k0:k1, k1:]
-            p = tb.trsm_panel("L", "U", "C", "N", lkk, p)
+            p = tb.trsm_panel("L", "U", "C", "N", lkk, p, inv_a=lkk_inv)
             p = p - 0.5 * tb.gemm(w, u12)
             a = a.at[k1:, k1:].set(
                 tb.her2k("U", "C", p, u12, a[k1:, k1:], alpha=-1.0))
             p = p - 0.5 * tb.gemm(w, u12)
-            p = tb.trsm("R", "U", "N", "N", l[k1:, k1:], p)
             a = a.at[k0:k1, k1:].set(p)
     return a
 
@@ -202,11 +238,9 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
         # -- L diag -> everyone --------------------------------------------
         lkk = pad_lkk(cc.bcast(cc.bcast(ll[kr, kc], ROW_AXIS, owner_r),
                                COL_AXIS, owner_c), k)
-        lkk_inv = None
-        if tb.trsm_panel_uses_mixed(lkk.dtype):
-            # lkk is already triangular: refined inverse computed ONCE per
-            # step, shared by the prev-panel solve and the panel trsm
-            lkk_inv = mx.tri_inv_refined(tb.tri_mask(lkk, "L"), lower=True)
+        # lkk is already triangular: refined inverse computed ONCE per
+        # step, shared by the prev-panel solve, diag hegst and panel trsm
+        lkk_inv = _step_inv("L", lkk)
 
         # -- L col-panel (rows > k) row-broadcast --------------------------
         lu_r = max(0, -(-(k + 2 - Pr) // Pr))
@@ -242,7 +276,7 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
         # -- diag hegst (redundant on every rank) --------------------------
         cand = lt[kr, kc]
         akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
-        w = _hegst_diag("L", akk, lkk)
+        w = _hegst_diag("L", akk, lkk, inv=lkk_inv)
         lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
                                          tb.tri_mask(w, "L")
                                          + tb.tri_mask(akk, "U", k=-1), cand))
@@ -300,9 +334,7 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
 
         ukk = pad_lkk(cc.bcast(cc.bcast(ll[kr, kc], ROW_AXIS, owner_r),
                                COL_AXIS, owner_c), k)
-        ukk_inv = None
-        if tb.trsm_panel_uses_mixed(ukk.dtype):
-            ukk_inv = mx.tri_inv_refined(tb.tri_mask(ukk, "U"), lower=False)
+        ukk_inv = _step_inv("U", ukk)
 
         # -- U row-panel (cols > k) col-broadcast --------------------------
         lu_c = max(0, -(-(k + 2 - Qc) // Qc))
@@ -337,7 +369,7 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
 
         cand = lt[kr, kc]
         akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
-        w = _hegst_diag("U", akk, ukk)
+        w = _hegst_diag("U", akk, ukk, inv=ukk_inv)
         lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
                                          tb.tri_mask(w, "U")
                                          + tb.tri_mask(akk, "L", k=-1), cand))

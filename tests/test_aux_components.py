@@ -76,7 +76,7 @@ def test_effective_eps_platform_calibration(monkeypatch):
         assert eps == np.finfo(np.dtype(dt).type(0).real.dtype).eps
         assert label == ""
 
-    monkeypatch.setattr(checks, "f64_is_emulated", lambda: True)
+    monkeypatch.setattr(checks, "f64_is_emulated", lambda of=None: True)
     eps, label = checks.effective_eps(np.float64)
     assert eps == checks.EMULATED_F64_EPS and "2^-47" in label
     eps_c, label_c = checks.effective_eps(np.complex128)
