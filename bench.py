@@ -304,6 +304,7 @@ def sweep(platform: str) -> None:
         sys.exit(1)
     n = int(os.environ.get("DLAF_BENCH_N", "4096"))
     nb = int(os.environ.get("DLAF_BENCH_NB", "256"))
+    best = max(results, key=lambda r: r["gflops"])  # best LIVE result
     result = assemble_headline(results, n, nb)
     print(json.dumps(result), flush=True)
 

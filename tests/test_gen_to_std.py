@@ -204,11 +204,15 @@ def test_hegst_blocked_matches_twosolve(uplo, grid_shape, devices8,
                                rtol=1e-10, atol=1e-10)
 
 
+@pytest.mark.parametrize("grid_shape", [None, (2, 4)])
 @pytest.mark.parametrize("uplo", ["L", "U"])
-def test_hegst_blocked_dist_mxu_mixed_knobs(uplo, devices8, monkeypatch):
-    """Distributed blocked HEGST under f64_gemm=mxu + f64_trsm=mixed (the
-    TPU product-config route: MXU pair products + refined-inverse panel
-    solves) matches the numpy reference at f64-grade residual."""
+def test_hegst_blocked_mxu_mixed_knobs(uplo, grid_shape, devices8,
+                                       monkeypatch):
+    """Blocked HEGST under f64_gemm=mxu + f64_trsm=mixed (the TPU
+    product-config route: MXU pair products + shared refined-inverse
+    panel/deferred solves) matches the numpy reference at f64-grade
+    residual — LOCAL (the _step_inv sharing across _hegst_diag and the
+    deferred row/column solves) and distributed."""
     import dlaf_tpu.config as config
 
     monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
@@ -222,7 +226,7 @@ def test_hegst_blocked_dist_mxu_mixed_knobs(uplo, devices8, monkeypatch):
         b = herm(n, dtype, 22, pd=True)
         l = np.linalg.cholesky(b)
         bf = np.tril(l) if uplo == "L" else np.triu(l.conj().T)
-        grid = Grid(2, 4)
+        grid = Grid(*grid_shape) if grid_shape else None
         out = gen_to_std(uplo, M(a, nb, grid), M(bf, nb, grid)).to_numpy()
         if uplo == "L":
             expect = np.linalg.solve(bf, a) @ np.linalg.inv(bf).conj().T
