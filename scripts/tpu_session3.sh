@@ -84,3 +84,6 @@ run eig_rehearsal 10800 env DLAF_PROFILE_DIR="$OUT/eig_prof" \
 
 echo "session3 done ($(date +%T)); summary:" >&2
 grep -h "GFlop/s\|metric\|ok ->\|FAIL\|phases" "$OUT"/*.out "$OUT"/*.log 2>/dev/null | tail -40 >&2
+# durable: every TPU miniapp line lands in the git-tracked history
+# (bench.py/nsweep/probe already append their own)
+python scripts/summarize_session.py "$OUT" >"$OUT/summary.json" 2>&2 || true
