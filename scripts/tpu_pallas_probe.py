@@ -158,6 +158,22 @@ def main():
             t = best_time(fn, spd)
             results["kernels"][name] = {"t_ms_per_step": t / iters * 1e3}
             log(f"{name}: {t / iters * 1e3:.3f} ms/step")
+        # recursive gemm-only seed, IN-PROGRAM: the round-2 point probes
+        # were tunnel-RTT-bound (~290 ms vs a ~150 ms floor) and could not
+        # resolve the real per-step cost — the chain divides the RTT out.
+        # Trace AFTER setting the knob (the seed choice is trace-time).
+        os.environ["DLAF_MIXED_SEED"] = "recursive"
+        config.initialize()
+        try:
+            fn = chain(lambda c: mx.potrf_inv_refined("L", c)[0])
+            t = best_time(fn, spd)
+            results["kernels"]["chain_potrf_inv_recursive_seed"] = {
+                "t_ms_per_step": t / iters * 1e3}
+            log(f"chain_potrf_inv_recursive_seed: {t / iters * 1e3:.3f} "
+                "ms/step")
+        finally:
+            os.environ.pop("DLAF_MIXED_SEED", None)
+            config.initialize()
     except Exception as e:
         log(f"panel chain probe failed: {e!r}"[:400])
     emit()
