@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..config import register_program_cache
+from ..tile_ops import blas as tb
 from ..tile_ops.lapack import stedc
 
 _EPS = np.finfo(np.float64).eps
@@ -319,6 +321,7 @@ def _eye_perm_jit(n: int, dtype_name: str, mesh):
     return jax.jit(fn, out_shardings=_qc_col_sharding(mesh))
 
 
+@register_program_cache
 @functools.lru_cache(maxsize=None)
 def _apply_qc_jit(mesh):
     """Compiled merge gemms ``blkdiag(q1, q2) @ qc`` (jit specializes per
@@ -329,11 +332,16 @@ def _apply_qc_jit(mesh):
     one-device HBM ceiling on the (n, n) merge arrays; the remaining
     single-device term is the deflated secular workspace (kb x kb, bounded
     by the deflation count) — the sharded-Q extension the reference,
-    local-only here, does not have."""
+    local-only here, does not have.
+
+    The gemms ride ``tb.mm`` so ``f64_gemm="mxu"`` reroutes the D&C
+    stage's dominant flops onto the int8/bf16 MXU path like every other
+    algorithm's trailing products (raw jnp.matmul kept them on the
+    ~342 GF/s emulated-f64 tier regardless of the knob)."""
     def fn(q1, q2, qc):
         n1 = q1.shape[0]
-        top = jnp.matmul(q1, qc[:n1, :])
-        bot = jnp.matmul(q2, qc[n1:, :])
+        top = tb.mm(q1, qc[:n1, :])
+        bot = tb.mm(q2, qc[n1:, :])
         return jnp.concatenate([top, bot], axis=0)
 
     if mesh is None:
