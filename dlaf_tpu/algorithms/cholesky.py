@@ -43,7 +43,8 @@ from ..common.asserts import dlaf_assert
 from ..matrix import util_distribution as ud
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, pad_diag_identity_dyn,
-                            transpose_col_to_rows, transpose_row_to_cols)
+                            transpose_col_to_rows, transpose_row_to_cols,
+                            uniform_slot_start)
 from ..matrix.tiling import storage_tile_grid, tiles_to_global, global_to_tiles
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
@@ -586,114 +587,160 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
     nt = dist.nr_tiles.row
     mb = dist.block_size.row
     n = dist.size.row
+    Pr, Qc = dist.grid_size.row, dist.grid_size.col
     _, _, ltr, ltc = storage_tile_grid(dist)
 
-    def step(lt, k):
-        # block-cyclic index math through DistContext (shared with the
-        # scan solve in triangular.py — single owner of these formulas)
-        ctx = DistContext(dist)
-        owner_r, owner_c = ctx.owner_r(k), ctx.owner_c(k)
-        kr, kc = ctx.kr(k), ctx.kc(k)
-        is_owner_r = ctx.rank_r == owner_r
-        is_owner_c = ctx.rank_c == owner_c
+    def make_step(lu_r0, lu_c0, ltr_s, ltc_s):
+        """Step body over the sliced local grid ``lt[lu_r0:, lu_c0:]`` — the
+        telescoped segment's trailing view. For every k in the segment the
+        pivot's local slot satisfies ``kr >= lu_r0`` (kr = k // P and the
+        segment starts at ``k_start`` with ``lu_r0 = k_start // P``), so
+        slot indices shift by the static offsets and validity masks do the
+        rest."""
 
-        # -- diag tile -> everyone --------------------------------------
-        cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0), (1, 1, mb, mb))[0, 0]
-        diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
-        ts = jnp.minimum(mb, n - k * mb)
-        pad = jnp.arange(mb) >= ts   # short-edge mask (un-pad after potrf)
-        diag = pad_diag_identity_dyn(diag, ts)
-        if use_mixed:
-            other = "U" if uplo == "L" else "L"
-            fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
-            lkk = fac + tb.tri_mask(diag, other, k=-1)
-        else:
-            lkk_inv = None
-            lkk = tl.potrf(uplo, diag)
-        # un-pad so the written diagonal tile keeps its stored edge zeros
-        lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
-        upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
-        lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
-                                          (kr, kc, 0, 0))
+        def step(lt, k):
+            # block-cyclic index math through DistContext (shared with
+            # the scan solve in triangular.py — single owner)
+            ctx = DistContext(dist)
+            owner_r, owner_c = ctx.owner_r(k), ctx.owner_c(k)
+            kr = ctx.kr(k) - lu_r0
+            kc = ctx.kc(k) - lu_c0
+            is_owner_r = ctx.rank_r == owner_r
+            is_owner_c = ctx.rank_c == owner_c
 
-        g_rows = ctx.g_rows(0, ltr)
-        g_cols = ctx.g_cols(0, ltc)
-        row_valid = (g_rows > k) & (g_rows < nt)
-        col_valid = (g_cols > k) & (g_cols < nt)
-
-        if uplo == "L":
-            # -- panel trsm over ALL local row slots of column kc --------
-            colk = jax.lax.dynamic_slice(
-                lt, (0, kc, 0, 0), (ltr, 1, mb, mb))[:, 0]
-            pan = tb.trsm_panel("R", "L", "C", "N", lkk, colk, inv_a=lkk_inv)
-            pan = jnp.where(row_valid[:, None, None], pan, 0)
-            keep = (is_owner_c & row_valid)[:, None, None]
-            lt = jax.lax.dynamic_update_slice(
-                lt, jnp.where(keep, pan, colk)[:, None], (0, kc, 0, 0))
-
-            # -- panel broadcast + transposed panel ----------------------
-            vr = cc.bcast(pan, COL_AXIS, owner_c)
-            vc = transpose_col_to_rows(DistContext(dist), vr, 0, g_cols)
-            vc = jnp.where(col_valid[:, None, None], vc, 0)
-
-            # -- trailing update over the full local pair grid -----------
-            pair = row_valid[:, None] & col_valid[None, :]
-            below = pair & (g_rows[:, None] > g_cols[None, :])
-            ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-            if use_mxu and use_oz_pallas:
-                upd = _masked_oz_update(
-                    vr.reshape(ltr * mb, mb),
-                    jnp.conj(vc).reshape(ltc * mb, mb),
-                    below | ondiag, ltr, ltc, mb, pallas_interpret)
-            elif use_mxu:
-                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
-                full = mmfn(vr.reshape(ltr * mb, mb),
-                            jnp.conj(vc).reshape(ltc * mb, mb).T,
-                            slices=tb._oz_slices())
-                upd = full.reshape(ltr, mb, ltc, mb).transpose(0, 2, 1, 3)
+            # -- diag tile -> everyone ----------------------------------
+            cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0),
+                                         (1, 1, mb, mb))[0, 0]
+            diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r),
+                            COL_AXIS, owner_c)
+            ts = jnp.minimum(mb, n - k * mb)
+            pad = jnp.arange(mb) >= ts   # short-edge mask
+            diag = pad_diag_identity_dyn(diag, ts)
+            if use_mixed:
+                other = "U" if uplo == "L" else "L"
+                fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
+                lkk = fac + tb.tri_mask(diag, other, k=-1)
             else:
-                upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
-                                 preferred_element_type=vr.dtype)
-            tri_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
-        else:
-            # -- mirrored sweep: panel is block row kr --------------------
-            rowk = jax.lax.dynamic_slice(
-                lt, (kr, 0, 0, 0), (1, ltc, mb, mb))[0]
-            pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowk, inv_a=lkk_inv)
-            pan = jnp.where(col_valid[:, None, None], pan, 0)
-            keep = (is_owner_r & col_valid)[:, None, None]
-            lt = jax.lax.dynamic_update_slice(
-                lt, jnp.where(keep, pan, rowk)[None], (kr, 0, 0, 0))
+                lkk_inv = None
+                lkk = tl.potrf(uplo, diag)
+            # un-pad: the written diagonal tile keeps stored edge zeros
+            lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
+            upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
+            lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
+                                              (kr, kc, 0, 0))
 
-            vcp = cc.bcast(pan, ROW_AXIS, owner_r)
-            vrp = transpose_row_to_cols(DistContext(dist), vcp, 0, g_rows)
-            vrp = jnp.where(row_valid[:, None, None], vrp, 0)
+            g_rows = ctx.g_rows(lu_r0, ltr_s)
+            g_cols = ctx.g_cols(lu_c0, ltc_s)
+            row_valid = (g_rows > k) & (g_rows < nt)
+            col_valid = (g_cols > k) & (g_cols < nt)
 
-            pair = row_valid[:, None] & col_valid[None, :]
-            below = pair & (g_rows[:, None] < g_cols[None, :])   # "above"
-            ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-            if use_mxu and use_oz_pallas:
-                ar = jnp.swapaxes(jnp.conj(vrp), -1, -2).reshape(ltr * mb, mb)
-                bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc * mb, mb)
-                upd = _masked_oz_update(ar, bc2, below | ondiag,
-                                        ltr, ltc, mb, pallas_interpret)
-            elif use_mxu:
-                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
-                ar = jnp.swapaxes(jnp.conj(vrp), -1, -2).reshape(ltr * mb, mb)
-                bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc * mb, mb)
-                full = mmfn(ar, bc2.T, slices=tb._oz_slices())
-                upd = full.reshape(ltr, mb, ltc, mb).transpose(0, 2, 1, 3)
+            if uplo == "L":
+                # -- panel trsm over the segment's local row slots -------
+                colk = jax.lax.dynamic_slice(
+                    lt, (0, kc, 0, 0), (ltr_s, 1, mb, mb))[:, 0]
+                pan = tb.trsm_panel("R", "L", "C", "N", lkk, colk,
+                                    inv_a=lkk_inv)
+                pan = jnp.where(row_valid[:, None, None], pan, 0)
+                keep = (is_owner_c & row_valid)[:, None, None]
+                lt = jax.lax.dynamic_update_slice(
+                    lt, jnp.where(keep, pan, colk)[:, None], (0, kc, 0, 0))
+
+                # -- panel broadcast + transposed panel ------------------
+                vr = cc.bcast(pan, COL_AXIS, owner_c)
+                vc = transpose_col_to_rows(DistContext(dist), vr, lu_r0,
+                                           g_cols)
+                vc = jnp.where(col_valid[:, None, None], vc, 0)
+
+                # -- trailing update over the segment's pair grid --------
+                pair = row_valid[:, None] & col_valid[None, :]
+                below = pair & (g_rows[:, None] > g_cols[None, :])
+                ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+                if use_mxu and use_oz_pallas:
+                    upd = _masked_oz_update(
+                        vr.reshape(ltr_s * mb, mb),
+                        jnp.conj(vc).reshape(ltc_s * mb, mb),
+                        below | ondiag, ltr_s, ltc_s, mb, pallas_interpret)
+                elif use_mxu:
+                    mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                    full = mmfn(vr.reshape(ltr_s * mb, mb),
+                                jnp.conj(vc).reshape(ltc_s * mb, mb).T,
+                                slices=tb._oz_slices())
+                    upd = full.reshape(ltr_s, mb, ltc_s,
+                                       mb).transpose(0, 2, 1, 3)
+                else:
+                    upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
+                                     preferred_element_type=vr.dtype)
+                tri_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
             else:
-                upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vrp), vcp,
-                                 preferred_element_type=vrp.dtype)
-            tri_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+                # -- mirrored sweep: panel is block row kr ---------------
+                rowk = jax.lax.dynamic_slice(
+                    lt, (kr, 0, 0, 0), (1, ltc_s, mb, mb))[0]
+                pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowk,
+                                    inv_a=lkk_inv)
+                pan = jnp.where(col_valid[:, None, None], pan, 0)
+                keep = (is_owner_r & col_valid)[:, None, None]
+                lt = jax.lax.dynamic_update_slice(
+                    lt, jnp.where(keep, pan, rowk)[None], (kr, 0, 0, 0))
 
-        mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tri_m)
-        lt = lt - jnp.where(mask4, upd, 0)
-        return lt, None
+                vcp = cc.bcast(pan, ROW_AXIS, owner_r)
+                vrp = transpose_row_to_cols(DistContext(dist), vcp, lu_c0,
+                                            g_rows)
+                vrp = jnp.where(row_valid[:, None, None], vrp, 0)
+
+                pair = row_valid[:, None] & col_valid[None, :]
+                below = pair & (g_rows[:, None] < g_cols[None, :])
+                ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+                if use_mxu and use_oz_pallas:
+                    ar = jnp.swapaxes(jnp.conj(vrp),
+                                      -1, -2).reshape(ltr_s * mb, mb)
+                    bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc_s * mb, mb)
+                    upd = _masked_oz_update(ar, bc2, below | ondiag,
+                                            ltr_s, ltc_s, mb,
+                                            pallas_interpret)
+                elif use_mxu:
+                    mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                    ar = jnp.swapaxes(jnp.conj(vrp),
+                                      -1, -2).reshape(ltr_s * mb, mb)
+                    bc2 = jnp.swapaxes(vcp, -1, -2).reshape(ltc_s * mb, mb)
+                    full = mmfn(ar, bc2.T, slices=tb._oz_slices())
+                    upd = full.reshape(ltr_s, mb, ltc_s,
+                                       mb).transpose(0, 2, 1, 3)
+                else:
+                    upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vrp), vcp,
+                                     preferred_element_type=vrp.dtype)
+                tri_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+
+            mask4 = below[:, :, None, None] \
+                | (ondiag[:, :, None, None] & tri_m)
+            lt = lt - jnp.where(mask4, upd, 0)
+            return lt, None
+
+        return step
 
     def factorize(lt):
-        lt, _ = jax.lax.scan(step, lt, jnp.arange(nt))
+        # telescoped segments (see _cholesky_local_scan): each segment
+        # scans only the remaining trailing slice of the local grid, so
+        # the uniform masked work tracks the live trailing block.
+        # Adjacent segments whose slice offsets coincide (large grids:
+        # the local grid can't shrink every halving) coalesce into one
+        # scan — no duplicate identically-shaped step programs.
+        segs = []
+        k_start = 0
+        for seg_len in _telescope_segments(nt):
+            lu = (uniform_slot_start(k_start, Pr),
+                  uniform_slot_start(k_start, Qc))
+            if segs and segs[-1][0] == lu:
+                segs[-1] = (lu, segs[-1][1], segs[-1][2] + seg_len)
+            else:
+                segs.append((lu, k_start, seg_len))
+            k_start += seg_len
+        for (lu_r0, lu_c0), k0_seg, seg_len in segs:
+            ltr_s, ltc_s = ltr - lu_r0, ltc - lu_c0
+            sub = lt[lu_r0:, lu_c0:]
+            sub, _ = jax.lax.scan(
+                make_step(lu_r0, lu_c0, ltr_s, ltc_s), sub,
+                jnp.arange(k0_seg, k0_seg + seg_len))
+            lt = lt.at[lu_r0:, lu_c0:].set(sub)
         return lt
 
     return shard_map(factorize, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),

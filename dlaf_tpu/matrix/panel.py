@@ -26,6 +26,14 @@ from ..comm.grid import COL_AXIS, ROW_AXIS
 from . import util_distribution as ud
 
 
+def uniform_slot_start(k: int, p: int) -> int:
+    """Uniform local slot covering every rank's tiles >= global tile ``k``
+    on a ``p``-rank axis (equals ``floor(k / p)``; off by at most one slot
+    from the per-rank optimum). Single owner of this bound — used by the
+    per-``k`` panel ranges AND the telescoped-scan segment slicing."""
+    return max(0, -(-(k + 1 - p) // p))
+
+
 class DistContext:
     """Trace-time constants + traced rank coordinates for one distribution.
 
@@ -68,10 +76,10 @@ class DistContext:
     def row_start(self, k: int) -> int:
         """Uniform local row slot covering every rank's tiles >= k (off by at
         most one slot from the per-rank optimum; see cholesky design note)."""
-        return max(0, -(-(k + 1 - self.P) // self.P))
+        return uniform_slot_start(k, self.P)
 
     def col_start(self, k: int) -> int:
-        return max(0, -(-(k + 1 - self.Q) // self.Q))
+        return uniform_slot_start(k, self.Q)
 
     def g_rows(self, lu: int, count: int):
         """Traced global tile rows of local slots lu..lu+count-1."""

@@ -180,3 +180,37 @@ def test_cholesky_distributed_scan(uplo, rows, cols, sr, sc, n, nb, dtype,
                   "DLAF_F64_TRSM", "DLAF_F64_GEMM_MIN_DIM"):
             monkeypatch.delenv(k, raising=False)
         config.initialize()
+
+
+@pytest.mark.parametrize("mode", ["native", "mxu+mixed"])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_cholesky_distributed_scan_multisegment(dtype, mode, devices8,
+                                                monkeypatch):
+    """nt=11 crosses the telescoping threshold (_telescope_segments -> two
+    segments, the second with NONZERO slice offsets lu_r0/lu_c0) — the
+    small-nt parametrizations above all run single-segment, so this is the
+    coverage for the offset slot math on an offset grid."""
+    from dlaf_tpu.algorithms.cholesky import _telescope_segments
+
+    n, nb = 41, 4   # nt = 11
+    assert len(_telescope_segments(11)) > 1
+    monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "scan")
+    if mode == "mxu+mixed":
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "1")
+    import dlaf_tpu.config as config
+
+    config.initialize()
+    try:
+        for uplo in ("L", "U"):
+            grid = Grid(2, 4)
+            a = hpd_matrix(n, dtype, seed=97)
+            mat = Matrix_from(a, nb, grid=grid, src=RankIndex2D(1, 2))
+            out = cholesky(uplo, mat).to_numpy()
+            check_factor(uplo, a, out, dtype)
+    finally:
+        for k in ("DLAF_CHOLESKY_TRAILING", "DLAF_F64_GEMM",
+                  "DLAF_F64_TRSM", "DLAF_F64_GEMM_MIN_DIM"):
+            monkeypatch.delenv(k, raising=False)
+        config.initialize()
