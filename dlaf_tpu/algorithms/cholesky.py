@@ -51,7 +51,10 @@ from ..tile_ops import lapack as tl
 from ..tile_ops import mixed as mx
 from ..tile_ops import ozaki as oz
 from ..tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
-from ..types import ceil_div
+from ..types import ceil_div, telescope_segments
+
+# back-compat alias (tests import the old private name)
+_telescope_segments = telescope_segments
 
 
 # ---------------------------------------------------------------------------
@@ -292,7 +295,7 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
     # ~1.7x at O(log nt) step programs instead of O(1) (still far below
     # the unrolled form's O(nt) on the ~19 s/step AOT toolchain).
     off = 0
-    for seg_len in _telescope_segments(nt):
+    for seg_len in telescope_segments(nt):
         m_seg = (nt - off) * nb
         sub = a[off * nb:, off * nb:]
         sub, _ = jax.lax.scan(make_step(m_seg), sub, jnp.arange(seg_len))
@@ -301,20 +304,7 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
     return a[:n, :n]
 
 
-def _telescope_segments(nt: int, min_tail: int = 8):
-    """Segment lengths for the telescoped scan: halve the remaining tile
-    count per segment until the tail is small, then finish in one. Work
-    ratio vs the exact schedule: sum(seg * rem^2) / (nt^3 / 3) ~= 1.7 at
-    nt=64 (vs 3.0 untelescoped)."""
-    segs = []
-    rem = nt
-    while rem > min_tail:
-        take = rem // 2
-        segs.append(take)
-        rem -= take
-    if rem:
-        segs.append(rem)
-    return tuple(segs)
+
 
 
 # ---------------------------------------------------------------------------
@@ -726,7 +716,7 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
         # scan — no duplicate identically-shaped step programs.
         segs = []
         k_start = 0
-        for seg_len in _telescope_segments(nt):
+        for seg_len in telescope_segments(nt):
             lu = (uniform_slot_start(k_start, Pr),
                   uniform_slot_start(k_start, Qc))
             if segs and segs[-1][0] == lu:

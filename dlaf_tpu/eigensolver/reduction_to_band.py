@@ -53,7 +53,7 @@ from ..matrix.panel import (DistContext, gather_col_panel_ordered,
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft
-from ..types import ceil_div
+from ..types import ceil_div, telescope_segments
 
 
 @dataclasses.dataclass
@@ -118,39 +118,59 @@ def _red2band_local_scan(a, *, nb: int):
     npad = nt * nb - n
     if npad:
         a = jnp.pad(a, ((0, npad), (0, npad)))
-    m = nt * nb
-    rows = jnp.arange(m)
 
-    def step(carry, k):
-        acc, taus_out = carry
-        k0 = k * nb
-        bdy = k0 + nb
-        below = rows >= bdy                        # (m,)
-        raw = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
-        pan = jnp.roll(jnp.where(below[:, None], raw, 0), -bdy, axis=0)
-        # pan has m >= 2*nb rows whenever a step runs, so geqrf returns
-        # exactly nb taus; dead columns of the last panel are masked below
-        vfull, taus = geqrf(pan)
-        col_live = jnp.arange(nb) < (n - bdy)
-        taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
-        taus_out = taus_out.at[k].set(taus)
-        vtop = jnp.tril(vfull, -1) + jnp.eye(m, nb, dtype=acc.dtype)
-        t = larft(vtop, taus)
-        v = jnp.where(below[:, None], jnp.roll(vtop, bdy, axis=0), 0)
-        vr = jnp.roll(vfull, bdy, axis=0)
-        newcol = jnp.where(below[:, None], vr, raw)
-        acc = jax.lax.dynamic_update_slice(acc, newcol, (0, k0))
-        trail = jnp.where(below[:, None] & below[None, :], acc, 0)
-        w = tb.mm(trail, v @ t)
-        mm = tb.mm(v.conj().T, w)
-        x = w - 0.5 * v @ (t.conj().T @ mm)
-        acc = acc - tb.mm(x, v.conj().T) - tb.mm(v, x.conj().T)
-        return (acc, taus_out), None
+    def make_step(m, off):
+        """Step body on the trailing submatrix a[off*nb:, off*nb:] (size
+        m) — completed reflector columns live outside it and the
+        two-sided update only touches rows/cols past the (absolute)
+        elimination boundary, so the telescoped segments are exact."""
+        rows = jnp.arange(m)
+
+        def step(carry, k):
+            acc, taus_out = carry
+            k0 = (k - off) * nb            # panel column inside the slice
+            bdy = k0 + nb
+            below = rows >= bdy            # (m,)
+            raw = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
+            pan = jnp.roll(jnp.where(below[:, None], raw, 0), -bdy, axis=0)
+            # pan has m >= 2*nb rows whenever a step runs, so geqrf
+            # returns exactly nb taus; dead columns masked below
+            vfull, taus = geqrf(pan)
+            col_live = jnp.arange(nb) < (n - (k + 1) * nb)
+            taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
+            taus_out = taus_out.at[k].set(taus)
+            vtop = jnp.tril(vfull, -1) + jnp.eye(m, nb, dtype=acc.dtype)
+            t = larft(vtop, taus)
+            v = jnp.where(below[:, None], jnp.roll(vtop, bdy, axis=0), 0)
+            vr = jnp.roll(vfull, bdy, axis=0)
+            newcol = jnp.where(below[:, None], vr, raw)
+            acc = jax.lax.dynamic_update_slice(acc, newcol, (0, k0))
+            trail = jnp.where(below[:, None] & below[None, :], acc, 0)
+            w = tb.mm(trail, v @ t)
+            mm = tb.mm(v.conj().T, w)
+            x = w - 0.5 * v @ (t.conj().T @ mm)
+            acc = acc - tb.mm(x, v.conj().T) - tb.mm(v, x.conj().T)
+            return (acc, taus_out), None
+
+        return step
 
     taus0 = jnp.zeros((npan, nb), dtype=a.dtype)   # npan >= 0 given n > 0
     if npan == 0:
         return a[:n, :n], taus0
-    (a, taus), _ = jax.lax.scan(step, (a, taus0), jnp.arange(npan))
+    # telescoped segments over the panel count (see cholesky's
+    # _telescope_segments): each segment scans the shrinking trailing
+    # submatrix, cutting the full-size masked-work premium toward ~1.7x
+    taus = taus0
+    p_start = 0
+    for seg_len in telescope_segments(npan):
+        off = p_start
+        m_seg = (nt - off) * nb
+        sub = a[off * nb:, off * nb:]
+        (sub, taus), _ = jax.lax.scan(
+            make_step(m_seg, off), (sub, taus),
+            jnp.arange(p_start, p_start + seg_len))
+        a = a.at[off * nb:, off * nb:].set(sub)
+        p_start += seg_len
     return a[:n, :n], taus
 
 

@@ -134,3 +134,21 @@ def ceil_div(num: SizeType, den: SizeType) -> SizeType:
 
 
 ScalarLike = Union[int, float, complex]
+
+
+def telescope_segments(steps: int, min_tail: int = 8):
+    """Segment lengths for the telescoped ``lax.scan`` formulations: halve
+    the remaining step count per segment until the tail is small, then
+    finish in one. Each segment re-traces the step body on the shrinking
+    trailing region, so the uniform masked work tracks the live block —
+    work ratio vs the exact schedule ~1.7 at 64 steps (vs 3.0 for a
+    single full-size scan) at O(log steps) compiled step bodies."""
+    segs = []
+    rem = steps
+    while rem > min_tail:
+        take = rem // 2
+        segs.append(take)
+        rem -= take
+    if rem:
+        segs.append(rem)
+    return tuple(segs)
