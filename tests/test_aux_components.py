@@ -304,3 +304,65 @@ def test_max_norm_local_and_distributed(uplo, devices8):
 
     empty = Matrix.from_global(np.zeros((0, 0)), TileElementSize(4, 4))
     assert max_norm(empty, uplo) == 0.0
+
+
+def test_telescope_segments_properties():
+    from dlaf_tpu.types import telescope_segments
+
+    for steps in [0, 1, 2, 7, 8, 9, 11, 16, 31, 32, 64, 127, 128, 1000]:
+        segs = telescope_segments(steps)
+        assert sum(segs) == steps
+        assert all(s > 0 for s in segs)
+        # halving keeps the count O(log); the final tail may be slightly
+        # larger than the preceding halved segment (e.g. 9 -> (4, 5))
+        if steps:
+            assert len(segs) <= max(1, steps.bit_length())
+    assert telescope_segments(8) == (8,)       # tail runs in one segment
+    assert telescope_segments(16) == (8, 8)
+    assert telescope_segments(127) == (63, 32, 16, 8, 8)
+
+
+def test_summarize_session_parses_all_schemas(tmp_path, monkeypatch):
+    """The session summarizer extracts the best line per step file for
+    every miniapp schema variant and appends only TPU lines to the
+    history log (redirected into tmp_path here)."""
+    import importlib.util
+    import json as _json
+
+    out = tmp_path / "sess"
+    out.mkdir()
+    (out / "hegst.out").write_text(
+        "[0] 12.0s 88.10GFlop/s zL (8192, 8192) (256, 256) (1, 1) 8 tpu\n"
+        "[1] 10.0s 108.80GFlop/s zL (8192, 8192) (256, 256) (1, 1) 8 tpu\n"
+        "check: PASSED residual=1e-10 tol=2e-9\n")
+    (out / "eig.out").write_text(
+        "[0] 300.0s 3.20GFlop/s dL evp (8192, 8192) (512, 512) (1, 1) 8 tpu\n"
+        "[0] phases: reduction_to_band=100.0s\n")
+    (out / "b2t.out").write_text(
+        "[0] 175.0s 12.00GFlop/s d (32768, 32768) band=128 (1, 1) 8 host\n")
+    (out / "cpu.out").write_text(
+        "[0] 1.0s 5.00GFlop/s dL (1024, 1024) (256, 256) (1, 1) 1 cpu\n")
+
+    spec = importlib.util.spec_from_file_location(
+        "summarize_session", "/root/repo/scripts/summarize_session.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import measure_common
+
+    monkeypatch.setattr(measure_common, "repo_root", lambda: str(tmp_path))
+    monkeypatch.setattr(sys, "argv", ["x", str(out)])
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        mod.main()
+    summary = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert summary["hegst"] == {"gflops": 108.8, "platform": "tpu"}
+    assert summary["eig"]["platform"] == "tpu"
+    assert summary["b2t"]["platform"] == "host"
+    hist = (tmp_path / ".bench_history.jsonl").read_text().splitlines()
+    rows = [_json.loads(r) for r in hist]
+    assert {r["variant"] for r in rows} == {"hegst", "eig"}  # tpu only
+    h = next(r for r in rows if r["variant"] == "hegst")
+    assert h["dtype"] == "complex128" and h["n"] == 8192 and h["t"] == 10.0
