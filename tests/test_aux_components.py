@@ -313,13 +313,15 @@ def test_telescope_segments_properties():
         segs = telescope_segments(steps)
         assert sum(segs) == steps
         assert all(s > 0 for s in segs)
-        # halving keeps the count O(log); the final tail may be slightly
-        # larger than the preceding halved segment (e.g. 9 -> (4, 5))
-        if steps:
-            assert len(segs) <= max(1, steps.bit_length())
-    assert telescope_segments(8) == (8,)       # tail runs in one segment
+        # equal chunks: bounded program count, every chunk >= min size
+        assert len(segs) <= 9   # max_segments + ragged tail
+        if len(segs) > 1:
+            assert all(s_ == segs[0] for s_ in segs[:-1])
+            assert segs[-1] <= segs[0]
+    assert telescope_segments(8) == (8,)
     assert telescope_segments(16) == (8, 8)
-    assert telescope_segments(127) == (63, 32, 16, 8, 8)
+    assert telescope_segments(127) == (16,) * 7 + (15,)
+    assert telescope_segments(64) == (8,) * 8
 
 
 def test_summarize_session_parses_all_schemas(tmp_path, monkeypatch):
