@@ -92,9 +92,22 @@ def multihost_grid(rows: Optional[int] = None, cols: Optional[int] = None,
         while n % rows:
             rows -= 1
         cols = n // rows
+    dev2d = layout_2d(devs, rows, cols)
+    g = Grid.__new__(Grid)
+    from jax.sharding import Mesh
+
+    g._mesh = Mesh(dev2d, (ROW_AXIS, COL_AXIS))
+    g._ordering = "row-major"
+    return g
+
+
+def layout_2d(devs: Sequence, rows: int, cols: int) -> np.ndarray:
+    """The topology-aware (rows, cols) device layout — pure function of the
+    device sequence and its slice grouping, so the ICI/DCN axis decisions
+    are testable without a pod (fake devices with ``slice_index`` work)."""
+    n = len(devs)
     dlaf_assert(rows * cols == n,
                 f"multihost grid {rows}x{cols} must use all {n} devices")
-
     groups = slice_groups(devs)
     dev2d = None
     if len(groups) > 1:
@@ -123,12 +136,7 @@ def multihost_grid(rows: Optional[int] = None, cols: Optional[int] = None,
             dev2d = np.array(ordered, dtype=object).reshape(rows, cols)
     else:
         dev2d = np.array(devs, dtype=object).reshape(rows, cols)
-    g = Grid.__new__(Grid)
-    from jax.sharding import Mesh
-
-    g._mesh = Mesh(dev2d, (ROW_AXIS, COL_AXIS))
-    g._ordering = "row-major"
-    return g
+    return dev2d
 
 
 def process_info() -> tuple:
