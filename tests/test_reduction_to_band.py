@@ -303,3 +303,38 @@ def test_red2band_local_scan_via_knob(monkeypatch, devices8):
     finally:
         monkeypatch.delenv("DLAF_DIST_STEP_MODE")
         config.initialize()
+
+
+def test_auto_step_mode_routes_to_scan(monkeypatch):
+    """dist_step_mode="auto" (the default) actually selects the scan
+    formulation once the traced step count crosses the platform
+    threshold — integration of config.resolve_step_mode with the
+    dispatcher, not just the resolver's unit test."""
+    import importlib
+
+    import dlaf_tpu.config as config
+    r2b = importlib.import_module("dlaf_tpu.eigensolver.reduction_to_band")
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    config.initialize()
+    assert config.get_configuration().dist_step_mode == "auto"
+    calls = []
+    real = r2b._red2band_local_scan
+    monkeypatch.setattr(r2b, "_red2band_local_scan",
+                        lambda *a, **k: calls.append("scan") or real(*a, **k))
+    monkeypatch.setitem(config.STEP_MODE_AUTO_SCAN_AT, "cpu", 3)
+    try:
+        n, band = 24, 4   # 5 panel steps >= threshold 3 -> scan
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((n, n))
+        am = Matrix.from_global((x + x.T) / 2, TileElementSize(8, 8))
+        r2b.reduction_to_band(am, band_size=band)
+        assert calls == ["scan"]
+        calls.clear()
+        am2 = Matrix.from_global((x[:8, :8] + x[:8, :8].T) / 2,
+                                 TileElementSize(4, 4))
+        r2b.reduction_to_band(am2, band_size=4)   # 1 step < 3 -> unrolled
+        assert calls == []
+    finally:
+        config.initialize()
