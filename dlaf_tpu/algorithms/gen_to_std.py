@@ -54,6 +54,7 @@ from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
 from ..matrix import ops as mops
 from ..matrix import util_distribution as ud
+from ..matrix.distribution import assert_slot_aligned
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, transpose_col_to_rows,
                             transpose_row_to_cols)
@@ -464,6 +465,10 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
                                    nb=a.block_size.row)
         out_m = a.with_storage(global_to_tiles(out, a.dist))
         return mops.merge_triangle(out_m, a, uplo)
+    # the blocked builder shares one set of slot indices between A and L
+    # (diag/panel reads of ll at A's kr/kc) — both axes must align
+    assert_slot_aligned(a.dist, b_factor.dist, rows=True, cols=True,
+                        what="gen_to_std(A, B_factor)")
     dt = np.dtype(a.dtype)
     use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
     fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu)

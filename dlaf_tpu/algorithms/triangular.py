@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
 from ..comm.grid import COL_AXIS, ROW_AXIS
+from ..matrix.distribution import assert_slot_aligned
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, bcast_diag, bcast_diag_dyn, col_panel,
                             col_panel_dyn, pad_diag_identity,
@@ -377,6 +378,10 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
         out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
                            side=side, uplo=uplo, op=op, diag=diag)
         return b.with_storage(global_to_tiles(out, b.dist))
+    # the distributed builders combine A's per-slot panels with B's slots
+    # on the swept axis — misalignment corrupts silently, so contract it
+    assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
+                        what="triangular_solve(A, B)")
     from ..config import resolve_step_mode
 
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
@@ -398,6 +403,8 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
         out = _mult_local(am, bm, jnp.asarray(alpha, bm.dtype),
                           side=side, uplo=uplo, op=op, diag=diag)
         return b.with_storage(global_to_tiles(out, b.dist))
+    assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
+                        what="triangular_multiply(A, B)")
     from ..config import resolve_step_mode
 
     fn = _dist_mult_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,

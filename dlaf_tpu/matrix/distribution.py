@@ -148,3 +148,37 @@ class Distribution:
     def __str__(self) -> str:
         return (f"Distribution(size={self.size}, block={self.block_size}, "
                 f"grid={self.grid_size}, rank={self.rank}, src={self.source_rank})")
+
+
+def assert_slot_aligned(da: "Distribution", db: "Distribution",
+                        rows: bool = False, cols: bool = False,
+                        what: str = "operands") -> None:
+    """Contract check: two distributions' LOCAL TILE SLOTS address the same
+    global tiles along the requested axes (same grid extent AND same
+    source rank there). The distributed algorithms combine per-slot panels
+    of one operand with per-slot tiles of the other (e.g. the solver's
+    ``e[slot] @ x`` applied to ``B[slot]``), which is only correct under
+    this alignment — a silent mismatch produces numerically wrong results,
+    not an error, so callers assert it loudly (round-3 finding: a
+    mismatched source rank corrupted a distributed solve with max err
+    ~0.26 and no diagnostic)."""
+    if rows:
+        dlaf_assert(
+            da.grid_size.row == db.grid_size.row
+            and da.source_rank.row == db.source_rank.row,
+            f"{what}: row slots misaligned — grid rows "
+            f"{da.grid_size.row}/{db.grid_size.row}, source rows "
+            f"{da.source_rank.row}/{db.source_rank.row}; distributed "
+            "algorithms require operands aligned on this axis (re-shard "
+            "one operand, e.g. Matrix.from_global with the other's "
+            "source_rank)")
+    if cols:
+        dlaf_assert(
+            da.grid_size.col == db.grid_size.col
+            and da.source_rank.col == db.source_rank.col,
+            f"{what}: col slots misaligned — grid cols "
+            f"{da.grid_size.col}/{db.grid_size.col}, source cols "
+            f"{da.source_rank.col}/{db.source_rank.col}; distributed "
+            "algorithms require operands aligned on this axis (re-shard "
+            "one operand, e.g. Matrix.from_global with the other's "
+            "source_rank)")

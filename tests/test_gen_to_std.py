@@ -240,3 +240,20 @@ def test_hegst_blocked_mxu_mixed_knobs(uplo, grid_shape, devices8,
         for k in ("DLAF_F64_GEMM", "DLAF_F64_GEMM_MIN_DIM", "DLAF_F64_TRSM"):
             monkeypatch.delenv(k, raising=False)
         config.initialize()
+
+
+def test_hegst_distributed_misaligned_sources_raise(devices8):
+    """The blocked HEGST shares one set of slot indices between A and the
+    Cholesky factor — both axes must align, loudly (see the solver's
+    misalignment test for the silent-corruption failure mode)."""
+    from dlaf_tpu.common.asserts import DlafAssertError
+
+    n, nb = 16, 4
+    a = herm(n, np.float64, 30)
+    b = herm(n, np.float64, 31, pd=True)
+    l = np.linalg.cholesky(b)
+    grid = Grid(2, 4)
+    am = M(a, nb, grid, src=RankIndex2D(0, 0))
+    lm = M(np.tril(l), nb, grid, src=RankIndex2D(1, 2))
+    with pytest.raises(DlafAssertError, match="misaligned"):
+        gen_to_std("L", am, lm)
