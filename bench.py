@@ -113,16 +113,18 @@ def run_variant() -> None:
     config.initialize()
     platform = jax.devices()[0].platform
     log(f"[{variant}] devices: {jax.devices()} ({time.time() - t_start:.1f}s)")
-    if variant == "scan" and platform == "tpu" \
-            and "DLAF_F64_GEMM" not in os.environ:
+    if variant == "scan" and platform == "tpu":
         # the scan formulation follows the f64_gemm/f64_trsm knobs (it no
         # longer hardwires the MXU route); on TPU the measured scan config
         # is the MXU one, so resolve the knobs the way the product config
-        # does there — explicit env still overrides
-        os.environ["DLAF_F64_GEMM"] = "mxu"
-        os.environ["DLAF_F64_TRSM"] = "mixed"
+        # does there — explicit env still overrides, each knob on its own
+        # variable's absence (an explicit DLAF_F64_TRSM alone must not be
+        # clobbered)
+        os.environ.setdefault("DLAF_F64_GEMM", "mxu")
+        os.environ.setdefault("DLAF_F64_TRSM", "mixed")
         config.initialize()
-        log(f"[{variant}] tpu: resolved f64_gemm=mxu f64_trsm=mixed")
+        log(f"[{variant}] tpu: f64_gemm={os.environ['DLAF_F64_GEMM']} "
+            f"f64_trsm={os.environ['DLAF_F64_TRSM']}")
 
     from dlaf_tpu.algorithms.cholesky import cholesky
     from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize

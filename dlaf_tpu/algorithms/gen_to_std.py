@@ -451,12 +451,14 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
 
     cfg = get_configuration()
     distributed = a.grid is not None and a.grid.num_devices > 1
-    if cfg.hegst_impl == "twosolve" or (
-            distributed
-            and resolve_step_mode(a.dist.nr_tiles.row) == "scan"):
+    if cfg.hegst_impl == "twosolve" or \
+            resolve_step_mode(a.dist.nr_tiles.row) == "scan":
         # the scan step mode's O(1)-compile guarantee flows through the
-        # triangular solver's scan form; the blocked builder is
-        # unrolled-only (see module docstring)
+        # triangular solver's scan form; BOTH blocked builders (local and
+        # distributed) unroll all nt per-k steps inside one jit, so both
+        # reroute — at ~19 s/step on the TPU AOT toolchain an unrolled
+        # local blocked run would pay the exact O(nt) cold compile the
+        # auto step mode exists to avoid (round-3 advisory)
         return _gen_to_std_twosolve(uplo, a, b_factor)
     if not distributed:
         g = tiles_to_global(a.storage, a.dist)

@@ -90,12 +90,16 @@ class Configuration:
     f64_gemm_slices: int = 0
     #: Slice contraction route of the ozaki paths (jnp AND the fused
     #: pallas kernels): "int8" (s8 x s8 ->
-    #: s32 dot) or "bf16" (slices cast to bf16 — exact for 7-bit integers —
+    #: s32 dot), "bf16" (slices cast to bf16 — exact for 7-bit integers —
     #: contracted on the MXU's native bf16 path with f32 accumulation,
     #: integer-exact while k*2^12 <= 2^24, chunked beyond; bit-identical
-    #: results). Exists because XLA's int8 dot measured ~1% of MXU peak on
-    #: v5e while bf16 matmul is the hardware's first-class path.
-    ozaki_dot: str = "int8"
+    #: results), or "auto" (default): bf16 on TPU, int8 elsewhere. The
+    #: bf16-on-TPU default exists because XLA's HLO s8 dot measured ~1% of
+    #: the v5e's int8 peak while bf16 matmul is the hardware's first-class
+    #: MXU path; the routes are bit-identical (tests/test_ozaki.py), so
+    #: the default follows the measured-fast route and a hardware A/B can
+    #: revert per platform.
+    ozaki_dot: str = "auto"
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
@@ -127,9 +131,9 @@ class Configuration:
     #: whole-matrix triangular solves: ~2x the flops as two dense
     #: MXU-shaped sweeps with no panel round-trips; kept as the
     #: fallback/check and as the scan-compatible compile-latency hatch —
-    #: the distributed blocked form is unrolled-only, so
-    #: dist_step_mode="scan" routes distributed HEGST through "twosolve"
-    #: regardless of this knob).
+    #: both blocked forms (local and distributed) are unrolled-only, so
+    #: when dist_step_mode resolves to "scan" HEGST routes through
+    #: "twosolve" regardless of this knob).
     hegst_impl: str = "blocked"
     #: Broadcast realization in comm.collectives.bcast: "psum"
     #: (mask-then-all-reduce — ~2V(p-1)/p per link, the bandwidth shape
@@ -217,7 +221,7 @@ _VALID_CHOICES = {
     "f64_gemm": ("native", "mxu"),
     "f64_trsm": ("native", "mixed"),
     "ozaki_impl": ("jnp", "pallas"),
-    "ozaki_dot": ("int8", "bf16"),
+    "ozaki_dot": ("int8", "bf16", "auto"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve"),

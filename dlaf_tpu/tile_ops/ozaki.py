@@ -101,6 +101,12 @@ _K_CHUNK = 1 << 18
 _K_F32_EXACT = 1 << 12
 
 
+#: one announcement per (backend, route) resolution of ozaki_dot="auto",
+#: mirroring blas._announced_tiers (round-2 advisory: auto decisions must
+#: not be silent)
+_announced_dot: set = set()
+
+
 def _slice_dot_impl() -> str:
     """"int8" (s8 x s8 -> s32 dot) or "bf16": cast the slices to bf16 —
     every value is a small integer in [-2^6, 2^6], exactly representable —
@@ -109,10 +115,27 @@ def _slice_dot_impl() -> str:
     contractions are chunked). Same bits out either way; the knob exists
     because XLA's HLO-level int8 dot has measured far below MXU peak on
     v5e (~1-4.5 TF/s-int8) while bf16 matmul is the hardware's first-class
-    path (config ``ozaki_dot``)."""
+    path. The "auto" default resolves bf16 on TPU, int8 elsewhere, keyed
+    on the PROCESS default backend like blas._oz_slices (config
+    ``ozaki_dot``)."""
     from ..config import get_configuration
 
-    return get_configuration().ozaki_dot
+    dot = get_configuration().ozaki_dot
+    if dot != "auto":
+        return dot
+    import jax
+
+    backend = jax.default_backend()
+    dot = "bf16" if backend == "tpu" else "int8"
+    if (backend, dot) not in _announced_dot:
+        _announced_dot.add((backend, dot))
+        import sys
+
+        print(f"dlaf_tpu: ozaki_dot=auto resolved to {dot!r} for default "
+              f"backend {backend!r} (bit-identical routes; bf16 targets the "
+              "MXU's native path) — set the knob explicitly to override",
+              file=sys.stderr, flush=True)
+    return dot
 
 
 def _dot_bf16(ia, ib):

@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# Round-4 hardware session: convert three rounds of CPU-validated levers into
+# silicon numbers (VERDICT r3 items 1-3, 5, 8-input). Ordered by value-per-
+# minute under the ~1h-healthy-window assumption: the live headline and the
+# first-ever config #3/#4 numbers come before the long sweeps. Every step is
+# timeout-guarded and appends durable results to .bench_history.jsonl.
+# Results land in $OUT (default <repo>/.session4_<ts>/).
+
+set -u
+cd "$(dirname "$0")/.."
+# default under the repo: a container reset must not eat session logs
+OUT=${OUT:-$(pwd)/.session4_$(date +%m%d_%H%M)}
+mkdir -p "$OUT"
+export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
+echo "results -> $OUT" >&2
+
+run() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%T)) ===" >&2
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  echo "=== $name rc=$? ($(date +%T)) ===" >&2
+}
+
+# 1. official headline (live TPU line replaces the round-2 replay; since the
+# round-4 default flip, bench's ozaki variants ride the bf16 dot route)
+run bench 2700 python bench.py
+
+# 2. bf16-vs-int8 dot A/B + fixed pallas kernels + panel chain + config #1
+# knob grid (the designated throughput levers; VERDICT r3 weak #1/#2)
+run pallas_probe 2400 python scripts/tpu_pallas_probe.py "$OUT/pallas_probe.json"
+
+# 3. config #3: c128 capability diag, then hegst z/8192 (first-ever numbers)
+run c128_diag 300 python -c "
+import jax, numpy as np
+jax.config.update('jax_enable_x64', True)
+import jax.numpy as jnp
+print('devices:', jax.devices())
+for dt in (np.complex64, np.complex128):
+    try:
+        x = jnp.asarray(np.full((8, 8), 1 + 1j, dt))
+        y = (x @ x).block_until_ready()
+        print(dt.__name__, 'ok ->', y.dtype, np.asarray(y)[0, 0])
+    except Exception as e:
+        print(dt.__name__, 'FAIL:', repr(e)[:200])
+"
+run hegst_z_8192_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+# DIST_STEP_MODE=unrolled: nt=32 hits the TPU auto-scan threshold and the
+# local reroute (gen_to_std.py) would silently send "blocked" to twosolve —
+# this arm exists to pay the unrolled compile for the flop-parity figure
+run hegst_z_8192_blocked 3600 env DLAF_HEGST_IMPL=blocked \
+    DLAF_DIST_STEP_MODE=unrolled \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+
+# 4. config #4: red2band d/16384/band128 (scan step mode; first-ever numbers)
+run red2band_d_16384 2400 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
+
+# 5. N-sweep + scan-vs-unrolled premium ladder (refresh STEP_MODE_AUTO_SCAN_AT
+# from hardware data; VERDICT r3 item 5)
+run nsweep_premium 5400 python scripts/tpu_nsweep.py "$OUT/nsweep.json"
+
+# 6. config #2 TRSM: bf16 vs int8 dot route on the mxu path
+run trsm_bf16 1800 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=bf16 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1
+run trsm_int8 1200 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=int8 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1
+
+# 7. config #5 rehearsal: full eigensolver pipeline on one chip with the
+# phase table on (device reduction vs host chase/D&C vs back-transforms)
+run eig_rehearsal 10800 env DLAF_PROFILE_DIR="$OUT/eig_prof" \
+    DLAF_DIST_STEP_MODE=scan DLAF_CHOLESKY_TRAILING=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 8192 -b 512 --nruns 1 --nwarmups 1 --check-result last
+
+echo "session4 done ($(date +%T)); summary:" >&2
+grep -h "GFlop/s\|metric\|ok ->\|FAIL\|phases" "$OUT"/*.out "$OUT"/*.log 2>/dev/null | tail -40 >&2
+python scripts/summarize_session.py "$OUT" >"$OUT/summary.json" \
+    2>"$OUT/summary.log" || true
