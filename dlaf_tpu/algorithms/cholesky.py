@@ -51,7 +51,7 @@ from ..tile_ops import lapack as tl
 from ..tile_ops import mixed as mx
 from ..tile_ops import ozaki as oz
 from ..tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
-from ..types import ceil_div, telescope_segments
+from ..types import ceil_div, telescope_segments, telescope_windows
 
 # back-compat alias (tests import the old private name)
 _telescope_segments = telescope_segments
@@ -713,18 +713,11 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
         # the uniform masked work tracks the live trailing block.
         # Adjacent segments whose slice offsets coincide (large grids:
         # the local grid can't shrink every halving) coalesce into one
-        # scan — no duplicate identically-shaped step programs.
-        segs = []
-        k_start = 0
-        for seg_len in telescope_segments(nt):
-            lu = (uniform_slot_start(k_start, Pr),
-                  uniform_slot_start(k_start, Qc))
-            if segs and segs[-1][0] == lu:
-                segs[-1] = (lu, segs[-1][1], segs[-1][2] + seg_len)
-            else:
-                segs.append((lu, k_start, seg_len))
-            k_start += seg_len
-        for (lu_r0, lu_c0), k0_seg, seg_len in segs:
+        # scan — no duplicate identically-shaped step programs
+        # (types.telescope_windows, shared by all telescoped builders).
+        for (lu_r0, lu_c0), k0_seg, seg_len in telescope_windows(
+                nt, lambda k_start, _len: (uniform_slot_start(k_start, Pr),
+                                           uniform_slot_start(k_start, Qc))):
             ltr_s, ltc_s = ltr - lu_r0, ltc - lu_c0
             sub = lt[lu_r0:, lu_c0:]
             sub, _ = jax.lax.scan(

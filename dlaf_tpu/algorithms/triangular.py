@@ -36,9 +36,11 @@ from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, bcast_diag, bcast_diag_dyn, col_panel,
                             col_panel_dyn, pad_diag_identity,
                             pad_diag_identity_dyn, row_panel, row_panel_dyn,
-                            transpose_col_to_rows, transpose_row_to_cols)
+                            transpose_col_to_rows, transpose_row_to_cols,
+                            uniform_slot_start)
 from ..matrix.tiling import global_to_tiles, tiles_to_global
 from ..tile_ops import blas as tb
+from ..types import telescope_windows
 
 
 def _tile_op(t, op: str):
@@ -153,12 +155,18 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
 
 def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
     """``lax.scan`` form of the distributed solve (config
-    ``dist_step_mode="scan"``): one compiled step body looped ``nt`` times
-    — the same O(1)-compile / uniform-masked-shapes trade as the scan
-    Cholesky (see ``cholesky._build_dist_cholesky_scan`` and
-    docs/DESIGN.md). Per-``k`` index math is traced arithmetic; pivot
-    row/column access uses dynamic slices; the trailing update covers all
-    local slots under a traced remaining-tiles mask."""
+    ``dist_step_mode="scan"``): one compiled step body per telescoped
+    segment, looped over the segment's steps — the same O(1)-compile /
+    uniform-masked-shapes trade as the scan Cholesky (see
+    ``cholesky._build_dist_cholesky_scan`` and docs/DESIGN.md). Per-``k``
+    index math is traced arithmetic; pivot row/column access uses dynamic
+    slices. The swept axis of B (rows for side='L', cols for 'R') is
+    TELESCOPED: forward substitutions slice the live bottom ``[lu0:]``
+    of the slot axis per segment, backward substitutions the live top
+    ``[:ub]``, so the uniform masked trailing update tracks the shrinking
+    live region instead of paying all slots every step; A's panel reads
+    and the transpose-exchange windows shrink with it. B's orthogonal
+    axis never shrinks (every step solves the full pivot panel)."""
     nt = dist_a.nr_tiles.row
     n = dist_a.size.row
     mb = dist_a.block_size.row
@@ -168,51 +176,96 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
         ctx_b = DistContext(dist_b)
         eff_lower = (uplo == "L") == (op == "N")
         forward = eff_lower if side == "L" else not eff_lower
+        # swept-axis grid/slot extents (B rows for 'L', B cols for 'R')
+        # and A's transpose-exchange axis (the opposite one of A)
+        p_swept = ctx_b.P if side == "L" else ctx_b.Q
+        lt_swept = ctx_b.ltr if side == "L" else ctx_b.ltc
+        q_orth = ctx_a.Q if side == "L" else ctx_a.P
+        lt_orth = ctx_a.ltc if side == "L" else ctx_a.ltr
 
-        def step(ltb, i):
-            k = i if forward else nt - 1 - i
-            akk = bcast_diag_dyn(ctx_a, lta, k)
-            akk = pad_diag_identity_dyn(akk, jnp.minimum(mb, n - k * mb))
-            if side == "L":
-                bk = row_panel_dyn(ctx_b, ltb, k)
-                xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
-                own = ctx_b.rank_r == ctx_b.owner_r(k)
-                row = ctx_b.kr(k)
+        def make_step(lu0, cnt, lq0, cnt_q):
+            """Step body over the swept-axis window ``[lu0, lu0+cnt)`` of
+            B's slots (``lq0``/``cnt_q``: matching window of A's
+            transpose-exchange axis). Every pivot of the segment lies
+            inside the window; validity masks do the rest."""
+
+            def step(sub, i):
+                k = i if forward else nt - 1 - i
+                akk = bcast_diag_dyn(ctx_a, lta, k)
+                akk = pad_diag_identity_dyn(akk, jnp.minimum(mb, n - k * mb))
+                if side == "L":
+                    bk = row_panel_dyn(ctx_b, sub, k, row_off=lu0)
+                    xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
+                    own = ctx_b.rank_r == ctx_b.owner_r(k)
+                    row = ctx_b.kr(k) - lu0
+                    cur = jax.lax.dynamic_slice(
+                        sub, (row, 0, 0, 0), (1,) + sub.shape[1:])[0]
+                    sub = jax.lax.dynamic_update_slice(
+                        sub, jnp.where(own, xk, cur)[None], (row, 0, 0, 0))
+                    g = ctx_b.g_rows(lu0, cnt)
+                    rem = ((g > k) if forward else (g < k)) & (g < nt)
+                    if op == "N":
+                        e = col_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+                    else:
+                        rk = row_panel_dyn(ctx_a, lta, k, lu=lq0,
+                                           count=cnt_q)
+                        e = _tile_op(
+                            transpose_row_to_cols(ctx_a, rk, lq0, g), op)
+                    e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                    upd = tb.contract("rab,cbd->rcad", e, xk)
+                    return sub - upd, None
+                bk = col_panel_dyn(ctx_b, sub, k, col_off=lu0)
+                xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
+                own = ctx_b.rank_c == ctx_b.owner_c(k)
+                col = ctx_b.kc(k) - lu0
                 cur = jax.lax.dynamic_slice(
-                    ltb, (row, 0, 0, 0), (1,) + ltb.shape[1:])[0]
-                ltb = jax.lax.dynamic_update_slice(
-                    ltb, jnp.where(own, xk, cur)[None], (row, 0, 0, 0))
-                g = ctx_b.g_rows(0, ctx_b.ltr)
+                    sub, (0, col, 0, 0),
+                    (sub.shape[0], 1) + sub.shape[2:])[:, 0]
+                sub = jax.lax.dynamic_update_slice(
+                    sub, jnp.where(own, xk, cur)[:, None], (0, col, 0, 0))
+                g = ctx_b.g_cols(lu0, cnt)
                 rem = ((g > k) if forward else (g < k)) & (g < nt)
                 if op == "N":
-                    e = col_panel_dyn(ctx_a, lta, k)
+                    e = row_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
                 else:
-                    rk = row_panel_dyn(ctx_a, lta, k)
-                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
+                    ck = col_panel_dyn(ctx_a, lta, k, lu=lq0, count=cnt_q)
+                    e = _tile_op(
+                        transpose_col_to_rows(ctx_a, ck, lq0, g), op)
                 e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
-                upd = tb.contract("rab,cbd->rcad", e, xk)
-                return ltb - upd, None
-            bk = col_panel_dyn(ctx_b, ltb, k)
-            xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
-            own = ctx_b.rank_c == ctx_b.owner_c(k)
-            col = ctx_b.kc(k)
-            cur = jax.lax.dynamic_slice(
-                ltb, (0, col, 0, 0),
-                (ltb.shape[0], 1) + ltb.shape[2:])[:, 0]
-            ltb = jax.lax.dynamic_update_slice(
-                ltb, jnp.where(own, xk, cur)[:, None], (0, col, 0, 0))
-            g = ctx_b.g_cols(0, ctx_b.ltc)
-            rem = ((g > k) if forward else (g < k)) & (g < nt)
-            if op == "N":
-                e = row_panel_dyn(ctx_a, lta, k)
-            else:
-                ck = col_panel_dyn(ctx_a, lta, k)
-                e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
-            e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
-            upd = tb.contract("rab,cbd->rcad", xk, e)
-            return ltb - upd, None
+                upd = tb.contract("rab,cbd->rcad", xk, e)
+                return sub - upd, None
 
-        ltb, _ = jax.lax.scan(step, ltb, jnp.arange(nt))
+            return step
+
+        # telescoped segments over the swept axis (see
+        # cholesky._build_dist_cholesky_scan); the transpose-exchange
+        # window only splits segments when op != "N" actually uses it
+        def window(pos, seg_len):
+            # slot bounds via uniform_slot_start — the declared single
+            # owner (matrix/panel.py); k//p would be identical today
+            if forward:
+                lo, loq = (uniform_slot_start(pos, p_swept),
+                           uniform_slot_start(pos, q_orth))
+                win = (lo, lt_swept - lo)
+                winq = (loq, lt_orth - loq)
+            else:
+                k_hi = nt - 1 - pos
+                win = (0, min(lt_swept,
+                              uniform_slot_start(k_hi, p_swept) + 1))
+                winq = (0, min(lt_orth,
+                               uniform_slot_start(k_hi, q_orth) + 1))
+            return (win, winq if op != "N" else (0, lt_orth))
+
+        for ((lu0, cnt), (lq0, cnt_q)), i0, seg_len in \
+                telescope_windows(nt, window):
+            sub = jax.lax.slice_in_dim(ltb, lu0, lu0 + cnt,
+                                       axis=0 if side == "L" else 1)
+            sub, _ = jax.lax.scan(make_step(lu0, cnt, lq0, cnt_q), sub,
+                                  jnp.arange(i0, i0 + seg_len))
+            if side == "L":
+                ltb = ltb.at[lu0:lu0 + cnt].set(sub)
+            else:
+                ltb = ltb.at[:, lu0:lu0 + cnt].set(sub)
         return ltb
 
     def run(lta, ltb, alpha):
@@ -248,32 +301,68 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
         ctx_a = DistContext(dist_a)
         ctx_b = DistContext(dist_b)
         eff_lower = (uplo == "L") == (op == "N")
+        # does step k touch output slots g >= k (True) or g <= k (False)?
+        ascending = eff_lower if side == "L" else not eff_lower
         out = jnp.zeros_like(ltb)
         for k in range(nt):
             if side == "L":
-                bk = row_panel(ctx_b, ltb, k, 0)          # B[k,:] my cols
-                g = ctx_b.g_rows(0, ctx_b.ltr)
-                if op == "N":
-                    e = col_panel(ctx_a, lta, k, 0)       # A[i,k]
+                # static accumulation window: step k only reaches output
+                # rows on the strict-plus-diagonal side of k
+                if ascending:
+                    lu = ctx_b.row_start(k)
+                    sl = slice(lu, ctx_b.ltr)
                 else:
-                    rk = row_panel(ctx_a, lta, k, 0)
-                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
+                    lu, sl = 0, slice(0, min(ctx_b.ltr, k // ctx_b.P + 1))
+                cnt = sl.stop - sl.start
+                if cnt <= 0:
+                    continue
+                bk = row_panel(ctx_b, ltb, k, 0)          # B[k,:] my cols
+                g = ctx_b.g_rows(lu, cnt)
+                if op == "N":
+                    e = col_panel(ctx_a, lta, k, lu)[:cnt]  # A[i,k]
+                else:
+                    # transpose-exchange windowed to the reachable tiles
+                    # (g >= k ascending / g <= k descending)
+                    if ascending:
+                        lq = uniform_slot_start(k, ctx_a.Q)
+                        rk = row_panel(ctx_a, lta, k, lq)
+                    else:
+                        lq = 0
+                        rk = row_panel(ctx_a, lta, k, 0)[
+                            :min(ctx_a.ltc,
+                                 uniform_slot_start(k, ctx_a.Q) + 1)]
+                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, lq, g), op)
                 strict = (g > k) if eff_lower else (g < k)
                 e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
                 upd = tb.contract("rab,cbd->rcad", e, bk)
-                out = out + upd
+                out = out.at[sl].add(upd)
             else:
-                bk = col_panel(ctx_b, ltb, k, 0)          # B[:,k] my rows
-                g = ctx_b.g_cols(0, ctx_b.ltc)
-                if op == "N":
-                    e = row_panel(ctx_a, lta, k, 0)       # A[k,j]
+                if ascending:
+                    lu = ctx_b.col_start(k)
+                    sl = slice(lu, ctx_b.ltc)
                 else:
-                    ck = col_panel(ctx_a, lta, k, 0)
-                    e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
+                    lu, sl = 0, slice(0, min(ctx_b.ltc, k // ctx_b.Q + 1))
+                cnt = sl.stop - sl.start
+                if cnt <= 0:
+                    continue
+                bk = col_panel(ctx_b, ltb, k, 0)          # B[:,k] my rows
+                g = ctx_b.g_cols(lu, cnt)
+                if op == "N":
+                    e = row_panel(ctx_a, lta, k, lu)[:cnt]  # A[k,j]
+                else:
+                    if ascending:
+                        lq = uniform_slot_start(k, ctx_a.P)
+                        ck = col_panel(ctx_a, lta, k, lq)
+                    else:
+                        lq = 0
+                        ck = col_panel(ctx_a, lta, k, 0)[
+                            :min(ctx_a.ltr,
+                                 uniform_slot_start(k, ctx_a.P) + 1)]
+                    e = _tile_op(transpose_col_to_rows(ctx_a, ck, lq, g), op)
                 strict = (g > k) if not eff_lower else (g < k)
                 e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
                 upd = tb.contract("rab,cbd->rcad", bk, e)
-                out = out + upd
+                out = out.at[:, sl].add(upd)
         return out
 
     def run(lta, ltb, alpha):
@@ -285,41 +374,80 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
 
 
 def _build_dist_mult_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
-    """``lax.scan`` form of the distributed multiply: the unrolled body is
-    already uniform-shaped (no slot shrink), so the scan version only
-    swaps the pivot panel reads for their traced-``k`` dynamic forms and
-    carries the accumulator — O(1) compile, identical flops."""
+    """``lax.scan`` form of the distributed multiply, TELESCOPED over the
+    triangular axis: step ``k`` only touches output slots on one side of
+    the diagonal (``g >= k`` or ``g <= k`` depending on side/uplo/op), so
+    each telescoped segment accumulates into just the still-reachable
+    window of the output — the windows shrink (or start small and grow)
+    exactly like the solve's. ``k`` always ascends (accumulation order is
+    the unrolled one); the pivot panel of B spans its full orthogonal
+    extent every step."""
     nt = dist_a.nr_tiles.row
 
     def prog(lta, ltb):
         ctx_a = DistContext(dist_a)
         ctx_b = DistContext(dist_b)
         eff_lower = (uplo == "L") == (op == "N")
+        # does step k touch output slots g >= k (True) or g <= k (False)?
+        ascending = eff_lower if side == "L" else not eff_lower
+        p_out = ctx_b.P if side == "L" else ctx_b.Q
+        lt_out = ctx_b.ltr if side == "L" else ctx_b.ltc
+        q_orth = ctx_a.Q if side == "L" else ctx_a.P
+        lt_orth = ctx_a.ltc if side == "L" else ctx_a.ltr
 
-        def step(out, k):
-            if side == "L":
-                bk = row_panel_dyn(ctx_b, ltb, k)
-                g = ctx_b.g_rows(0, ctx_b.ltr)
+        def make_step(lu0, cnt, lq0, cnt_q):
+            def step(sub, k):
+                if side == "L":
+                    bk = row_panel_dyn(ctx_b, ltb, k)
+                    g = ctx_b.g_rows(lu0, cnt)
+                    if op == "N":
+                        e = col_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+                    else:
+                        rk = row_panel_dyn(ctx_a, lta, k, lu=lq0,
+                                           count=cnt_q)
+                        e = _tile_op(
+                            transpose_row_to_cols(ctx_a, rk, lq0, g), op)
+                    strict = (g > k) if eff_lower else (g < k)
+                    e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
+                    return sub + tb.contract("rab,cbd->rcad", e, bk), None
+                bk = col_panel_dyn(ctx_b, ltb, k)
+                g = ctx_b.g_cols(lu0, cnt)
                 if op == "N":
-                    e = col_panel_dyn(ctx_a, lta, k)
+                    e = row_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
                 else:
-                    rk = row_panel_dyn(ctx_a, lta, k)
-                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
-                strict = (g > k) if eff_lower else (g < k)
+                    ck = col_panel_dyn(ctx_a, lta, k, lu=lq0, count=cnt_q)
+                    e = _tile_op(
+                        transpose_col_to_rows(ctx_a, ck, lq0, g), op)
+                strict = (g > k) if not eff_lower else (g < k)
                 e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
-                return out + tb.contract("rab,cbd->rcad", e, bk), None
-            bk = col_panel_dyn(ctx_b, ltb, k)
-            g = ctx_b.g_cols(0, ctx_b.ltc)
-            if op == "N":
-                e = row_panel_dyn(ctx_a, lta, k)
-            else:
-                ck = col_panel_dyn(ctx_a, lta, k)
-                e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
-            strict = (g > k) if not eff_lower else (g < k)
-            e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
-            return out + tb.contract("rab,cbd->rcad", bk, e), None
+                return sub + tb.contract("rab,cbd->rcad", bk, e), None
 
-        out, _ = jax.lax.scan(step, jnp.zeros_like(ltb), jnp.arange(nt))
+            return step
+
+        def window(pos, seg_len):
+            if ascending:
+                lo, loq = (uniform_slot_start(pos, p_out),
+                           uniform_slot_start(pos, q_orth))
+                win = (lo, lt_out - lo)
+                winq = (loq, lt_orth - loq)
+            else:
+                k_hi = pos + seg_len - 1
+                win = (0, min(lt_out, uniform_slot_start(k_hi, p_out) + 1))
+                winq = (0, min(lt_orth,
+                               uniform_slot_start(k_hi, q_orth) + 1))
+            return (win, winq if op != "N" else (0, lt_orth))
+
+        out = jnp.zeros_like(ltb)
+        for ((lu0, cnt), (lq0, cnt_q)), k0s, seg_len in \
+                telescope_windows(nt, window):
+            sub = jax.lax.slice_in_dim(out, lu0, lu0 + cnt,
+                                       axis=0 if side == "L" else 1)
+            sub, _ = jax.lax.scan(make_step(lu0, cnt, lq0, cnt_q), sub,
+                                  jnp.arange(k0s, k0s + seg_len))
+            if side == "L":
+                out = out.at[lu0:lu0 + cnt].set(sub)
+            else:
+                out = out.at[:, lu0:lu0 + cnt].set(sub)
         return out
 
     def run(lta, ltb, alpha):

@@ -55,11 +55,11 @@ from ..matrix.matrix import Matrix
 from ..matrix import memory
 from ..matrix.panel import (DistContext, gather_sub_panel,
                             gather_sub_panel_dyn, pad_sub_panel_to_tiles,
-                            tiles_of_rolled)
+                            tiles_of_rolled, uniform_slot_start)
 from ..matrix.tiling import (_axis_perm_inv, global_to_tiles, storage_tile_grid,
                              tiles_to_global)
 from ..tile_ops.lapack import larft
-from ..types import ceil_div
+from ..types import ceil_div, telescope_windows
 from .band_to_tridiag import TridiagResult
 from .reduction_to_band import BandReduction
 
@@ -372,11 +372,15 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
     ``ceil(n/b) - 1`` times in reverse — config #5's back-transform has
     the same per-panel unrolled-compile exposure as the forward reduction
     (docs/DESIGN.md). Uses the shared traced-``p`` rolled sub-panel
-    gather; the W2 psum and the C update run over ALL local row slots
-    under traced element masks."""
+    gather. TELESCOPED like the forward reduction, mirrored for the
+    reverse sweep: panel ``p`` only touches C rows at element >= (p+1)*b,
+    so early segments (large ``p``) work on a small bottom window of the
+    row-slot axis that grows as the sweep ascends; the W2 psum and the C
+    update run over the window's slots under traced element masks."""
     nt = dist_a.nr_tiles.row
     nb = dist_a.block_size.row
     n = dist_a.size.row
+    Pr, Qc = dist_a.grid_size.row, dist_a.grid_size.col
     b = band
     npan = ceil_div(n, b) - 1 if n else 0
 
@@ -385,28 +389,51 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
         ctx_c = DistContext(dist_c)
         arange_nb = jnp.arange(nb)
 
-        def step(lt_c, i):
-            p = npan - 1 - i
-            pan, bdy, _, _, _, _, _ = gather_sub_panel_dyn(
-                ctx_a, lt_a, p=p, b=b, n=n)
-            v = jnp.tril(pan, -1) + jnp.eye(nt * nb, b, dtype=pan.dtype)
-            t = larft(v, taus[p])
-            vt = tiles_of_rolled(ctx_a, v, bdy)
+        def make_step(lu_off, lc_off, ltr_w):
+            base = lu_off * Pr
+            sub_a = lt_a[lu_off:, lc_off:]
 
-            g_rows_c = ctx_c.g_rows(0, ctx_c.ltr)
-            g_erows_c = g_rows_c[:, None] * nb + arange_nb[None, :]
-            rv_c_e = (g_erows_c >= bdy) & (g_erows_c < n)
-            v_my = jnp.where(rv_c_e[:, :, None], vt[g_rows_c],
-                             jnp.zeros((ctx_c.ltr, nb, b), dtype=pan.dtype))
-            w2 = tb.contract("rab,rcad->cbd", jnp.conj(v_my), lt_c)
-            w2 = cc.all_reduce(w2, ROW_AXIS)
-            w2 = tb.contract("xb,cbd->cxd", t, w2)
-            upd = tb.contract("rab,cbd->rcad", v_my, w2)
-            return lt_c - upd, None
+            def step(sub_c, i):
+                p = npan - 1 - i
+                pan, bdy, _, _, _, _, _ = gather_sub_panel_dyn(
+                    ctx_a, sub_a, p=p, b=b, n=n,
+                    row_off=lu_off, col_off=lc_off)
+                m_w = (nt - base) * nb
+                v = jnp.tril(pan, -1) + jnp.eye(m_w, b, dtype=pan.dtype)
+                t = larft(v, taus[p])
+                vt = tiles_of_rolled(ctx_a, v, bdy, base * nb)
+
+                g_rows_c = ctx_c.g_rows(lu_off, ltr_w)
+                g_erows_c = g_rows_c[:, None] * nb + arange_nb[None, :]
+                rv_c_e = (g_erows_c >= bdy) & (g_erows_c < n)
+                sel = jnp.clip(g_rows_c - base, 0, nt - base - 1)
+                v_my = jnp.where(rv_c_e[:, :, None], vt[sel],
+                                 jnp.zeros((ltr_w, nb, b), dtype=pan.dtype))
+                w2 = tb.contract("rab,rcad->cbd", jnp.conj(v_my), sub_c)
+                w2 = cc.all_reduce(w2, ROW_AXIS)
+                w2 = tb.contract("xb,cbd->cxd", t, w2)
+                upd = tb.contract("rab,cbd->rcad", v_my, w2)
+                return sub_c - upd, None
+
+            return step
 
         if npan <= 0:
             return lt_c
-        lt_c, _ = jax.lax.scan(step, lt_c, jnp.arange(npan))
+        # telescoped segments (reverse sweep: segment [i0, i0+len) covers
+        # p = npan-1-i0 down to p_lo = npan-i0-len; its window covers
+        # every row tile >= (p_lo*b)//nb)
+        def window(pos, seg_len):
+            p_lo = npan - pos - seg_len
+            t_min = (p_lo * b) // nb
+            return (uniform_slot_start(t_min, Pr),
+                    uniform_slot_start(t_min, Qc))
+
+        for (lu_off, lc_off), i0, seg_len in telescope_windows(npan, window):
+            sub_c = lt_c[lu_off:]
+            sub_c, _ = jax.lax.scan(
+                make_step(lu_off, lc_off, ctx_c.ltr - lu_off), sub_c,
+                jnp.arange(i0, i0 + seg_len))
+            lt_c = lt_c.at[lu_off:].set(sub_c)
         return lt_c
 
     return shard_map(run, mesh=mesh,

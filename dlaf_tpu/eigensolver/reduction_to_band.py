@@ -49,11 +49,12 @@ from ..common.asserts import dlaf_assert
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, gather_col_panel_ordered,
                             gather_sub_panel, gather_sub_panel_dyn,
-                            pad_sub_panel_to_tiles, tiles_of_rolled)
-from ..matrix.tiling import global_to_tiles, tiles_to_global
+                            pad_sub_panel_to_tiles, tiles_of_rolled,
+                            uniform_slot_start)
+from ..matrix.tiling import global_to_tiles, storage_tile_grid, tiles_to_global
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft
-from ..types import ceil_div, telescope_segments
+from ..types import ceil_div, telescope_segments, telescope_windows
 
 
 @dataclasses.dataclass
@@ -298,84 +299,116 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
     AOT toolchain, docs/DESIGN.md).
 
     Uniform-shape scheme: the panel's tile column and in-tile offset are
-    traced; the full-height masked column is gathered in static global
-    order (``k1=0``), top-aligned with a traced ``jnp.roll`` (zero rows
-    below a Householder panel do not perturb its reflectors, so
-    ``geqrf`` of the rolled (n_t*nb, b) column equals the shrunken
-    panel's factorization zero-padded), and the two-sided update runs
-    over ALL local slots under traced element masks. Extra work vs the
-    unrolled form: full-height panels and full-grid updates every step
-    (~2-3x flops)."""
+    traced; the window-height masked column is gathered in static global
+    order, top-aligned with a traced ``jnp.roll`` (zero rows below a
+    Householder panel do not perturb its reflectors, so ``geqrf`` of the
+    rolled (nt_w*nb, b) column equals the shrunken panel's factorization
+    zero-padded), and the two-sided update runs over the window's slots
+    under traced element masks. TELESCOPED like the scan Cholesky: panel
+    ``p`` only touches rows/cols at element index > p*b, so each segment
+    works on the trailing window ``lt[lu_off:, lc_off:]`` (slot offsets
+    of tile ``(p0*b)//nb``) — the masked uniform work tracks the live
+    trailing block instead of paying the full grid every step."""
     nt = dist.nr_tiles.row
     nb = dist.block_size.row
     n = dist.size.row
+    Pr, Qc = dist.grid_size.row, dist.grid_size.col
     b = band
     npan = ceil_div(n, b) - 1 if n else 0
 
-    def step(carry, p):
-        lt, taus_out = carry
-        ctx = DistContext(dist)
-        arange_nb = jnp.arange(nb)
+    def make_step(lu_off, lc_off, ltr_w, ltc_w):
+        """Step body over the window ``full[lu_off:, lc_off:]``; ``base``
+        = ``lu_off*P`` is the window's first global tile row, and all
+        panel-tile indexing is window-relative (``g - base``)."""
+        base = lu_off * Pr
 
-        # -- full-height masked panel column, replicated + top-aligned ---
-        pan, bdy, tc, co, row_val_e, g_rows, raw = gather_sub_panel_dyn(
-            ctx, lt, p=p, b=b, n=n)
-        kc = ctx.kc(tc)
-        vfull, taus = geqrf(pan)
-        ntau = taus.shape[0]
-        if ntau < b:
-            taus = jnp.pad(taus, (0, b - ntau))
-        col_live = jnp.arange(b) < (n - bdy)
-        taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
-        taus_out = taus_out.at[p].set(taus)
-        v = jnp.tril(vfull, -1) + jnp.eye(nt * nb, b, dtype=pan.dtype)
+        def step(carry, p):
+            lt, taus_out = carry
+            ctx = DistContext(dist)
+            arange_nb = jnp.arange(nb)
 
-        def tiles_of(mat):
-            return tiles_of_rolled(ctx, mat, bdy)
+            # -- window-height masked panel column, top-aligned ----------
+            pan, bdy, tc, co, row_val_e, g_rows, raw = gather_sub_panel_dyn(
+                ctx, lt, p=p, b=b, n=n, row_off=lu_off, col_off=lc_off)
+            kc = ctx.kc(tc) - lc_off
+            vfull, taus = geqrf(pan)
+            ntau = taus.shape[0]
+            if ntau < b:
+                taus = jnp.pad(taus, (0, b - ntau))
+            col_live = jnp.arange(b) < (n - bdy)
+            taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
+            taus_out = taus_out.at[p].set(taus)
+            m_w = (nt - base) * nb
+            v = jnp.tril(vfull, -1) + jnp.eye(m_w, b, dtype=pan.dtype)
 
-        # -- write the factored panel back (owner column, my rows) -------
-        vtiles = tiles_of(vfull)
-        my_new = vtiles[g_rows]
-        keep = (ctx.rank_c == ctx.owner_c(tc)) & row_val_e
-        new = jnp.where(keep[:, :, None], my_new, raw)
-        lt = jax.lax.dynamic_update_slice(lt, new[:, None], (0, kc, 0, co))
+            def tiles_of(mat):
+                return tiles_of_rolled(ctx, mat, bdy, base * nb)
 
-        # -- trailing two-sided update over all local slots --------------
-        g_cols = ctx.g_cols(0, ctx.ltc)
-        g_ecols = g_cols[:, None] * nb + arange_nb[None, :]
-        col_val_e = (g_ecols >= bdy) & (g_ecols < n)
-        t = larft(v, taus)
-        v_tiles = tiles_of(v)
-        vt_tiles = tiles_of(v @ t)
-        vtl = jnp.where(col_val_e[:, :, None], vt_tiles[g_cols],
-                        jnp.zeros((ctx.ltc, nb, b), dtype=pan.dtype))
-        atr = jnp.where((row_val_e[:, None, :, None]
-                         & col_val_e[None, :, None, :]), lt,
-                        jnp.zeros_like(lt))
-        w_loc = tb.contract("rcab,cbd->rad", atr, vtl)
-        w_loc = cc.all_reduce(w_loc, COL_AXIS)
-        vr = jnp.where(row_val_e[:, :, None], v_tiles[g_rows],
-                       jnp.zeros((ctx.ltr, nb, b), dtype=pan.dtype))
-        m_mat = tb.contract("rab,rad->bd", jnp.conj(vr), w_loc)
-        m_mat = cc.all_reduce(m_mat, ROW_AXIS)
-        x_loc = w_loc - 0.5 * jnp.einsum("rab,bd->rad", vr,
-                                         t.conj().T @ m_mat,
-                                         preferred_element_type=lt.dtype)
-        xfull = gather_col_panel_ordered(ctx, x_loc, 0, 0)
-        xc = jnp.where(col_val_e[:, :, None], xfull[g_cols],
-                       jnp.zeros((ctx.ltc, nb, b), dtype=pan.dtype))
-        vc = jnp.where(col_val_e[:, :, None], v_tiles[g_cols],
-                       jnp.zeros((ctx.ltc, nb, b), dtype=pan.dtype))
-        xr = jnp.where(row_val_e[:, :, None], x_loc, jnp.zeros_like(x_loc))
-        upd = (tb.contract("rad,cbd->rcab", xr, jnp.conj(vc))
-               + tb.contract("rad,cbd->rcab", vr, jnp.conj(xc)))
-        return (lt - upd, taus_out), None
+            # -- write the factored panel back (owner column, my rows) ---
+            vtiles = tiles_of(vfull)
+            my_new = vtiles[g_rows - base]
+            keep = (ctx.rank_c == ctx.owner_c(tc)) & row_val_e
+            new = jnp.where(keep[:, :, None], my_new, raw)
+            lt = jax.lax.dynamic_update_slice(lt, new[:, None],
+                                              (0, kc, 0, co))
+
+            # -- trailing two-sided update over the window's slots -------
+            g_cols = ctx.g_cols(lc_off, ltc_w)
+            g_ecols = g_cols[:, None] * nb + arange_nb[None, :]
+            col_val_e = (g_ecols >= bdy) & (g_ecols < n)
+            # col tiles below the window's first row tile are fully above
+            # the boundary (masked); clip keeps their indices in range
+            selc = jnp.clip(g_cols - base, 0, nt - base - 1)
+            t = larft(v, taus)
+            v_tiles = tiles_of(v)
+            vt_tiles = tiles_of(v @ t)
+            vtl = jnp.where(col_val_e[:, :, None], vt_tiles[selc],
+                            jnp.zeros((ltc_w, nb, b), dtype=pan.dtype))
+            atr = jnp.where((row_val_e[:, None, :, None]
+                             & col_val_e[None, :, None, :]), lt,
+                            jnp.zeros_like(lt))
+            w_loc = tb.contract("rcab,cbd->rad", atr, vtl)
+            w_loc = cc.all_reduce(w_loc, COL_AXIS)
+            vr = jnp.where(row_val_e[:, :, None], v_tiles[g_rows - base],
+                           jnp.zeros((ltr_w, nb, b), dtype=pan.dtype))
+            m_mat = tb.contract("rab,rad->bd", jnp.conj(vr), w_loc)
+            m_mat = cc.all_reduce(m_mat, ROW_AXIS)
+            x_loc = w_loc - 0.5 * jnp.einsum("rab,bd->rad", vr,
+                                             t.conj().T @ m_mat,
+                                             preferred_element_type=lt.dtype)
+            xfull = gather_col_panel_ordered(ctx, x_loc, base, lu_off)
+            xc = jnp.where(col_val_e[:, :, None], xfull[selc],
+                           jnp.zeros((ltc_w, nb, b), dtype=pan.dtype))
+            vc = jnp.where(col_val_e[:, :, None], v_tiles[selc],
+                           jnp.zeros((ltc_w, nb, b), dtype=pan.dtype))
+            xr = jnp.where(row_val_e[:, :, None], x_loc,
+                           jnp.zeros_like(x_loc))
+            upd = (tb.contract("rad,cbd->rcab", xr, jnp.conj(vc))
+                   + tb.contract("rad,cbd->rcab", vr, jnp.conj(xc)))
+            return (lt - upd, taus_out), None
+
+        return step
 
     def run(lt):
         taus0 = jnp.zeros((max(npan, 0), b), dtype=lt.dtype)
         if npan <= 0:
             return lt, taus0
-        (lt, taus), _ = jax.lax.scan(step, (lt, taus0), jnp.arange(npan))
+        _, _, ltr, ltc = storage_tile_grid(dist)
+
+        # telescoped segments over the panel count (slot bounds via
+        # uniform_slot_start, the declared single owner)
+        def window(pos, _seg_len):
+            t_min = (pos * b) // nb
+            return (uniform_slot_start(t_min, Pr),
+                    uniform_slot_start(t_min, Qc))
+
+        taus = taus0
+        for (lu_off, lc_off), p0, seg_len in telescope_windows(npan, window):
+            sub = lt[lu_off:, lc_off:]
+            (sub, taus), _ = jax.lax.scan(
+                make_step(lu_off, lc_off, ltr - lu_off, ltc - lc_off),
+                (sub, taus), jnp.arange(p0, p0 + seg_len))
+            lt = lt.at[lu_off:, lc_off:].set(sub)
         return lt, taus
 
     return shard_map(run, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),

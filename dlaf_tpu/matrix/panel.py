@@ -121,39 +121,49 @@ def bcast_diag_dyn(ctx: DistContext, lt, k):
                     COL_AXIS, ctx.owner_c(k))
 
 
-def gather_sub_panel_dyn(ctx: DistContext, lt, *, p, b: int, n: int):
+def gather_sub_panel_dyn(ctx: DistContext, lt, *, p, b: int, n: int,
+                         row_off: int = 0, col_off: int = 0):
     """:func:`gather_sub_panel` for a TRACED panel index ``p`` (scan-mode
     steps), uniform shapes: the full-height masked panel column is
     gathered in static global order and top-aligned with a traced roll —
     zero rows below a Householder panel do not perturb its reflectors, so
-    ``geqrf``/reflector application on the rolled (nt*mb, b) column
+    ``geqrf``/reflector application on the rolled (nt_w*mb, b) column
     equals the shrunken panel's, zero-padded. Returns
     ``(pan, bdy, tc, co, row_val_e, g_rows, raw)`` with ``row_val_e``/
-    ``g_rows`` over ALL local row slots and ``raw`` the unmasked local
-    slice of the panel column (for write-back)."""
+    ``g_rows`` over the window's local row slots and ``raw`` the unmasked
+    local slice of the panel column (for write-back).
+
+    ``row_off``/``col_off``: static slot offsets when ``lt`` is a
+    telescoped window ``full[row_off:, col_off:]`` — the gather covers
+    global tile rows ``[row_off*P, nt)`` and the roll is relative to the
+    window's first element row (``bdy - row_off*P*mb``)."""
     nb = ctx.mb
     nt = ctx.nt.row
+    base = row_off * ctx.P          # first global tile row of the window
     bdy = (p + 1) * b
     tc = (p * b) // nb
     co = (p * b) % nb
-    g_rows = ctx.g_rows(0, ctx.ltr)
+    g_rows = ctx.g_rows(row_off, ctx.ltr - row_off)
     g_erows = g_rows[:, None] * nb + jnp.arange(nb)[None, :]
     row_val_e = (g_erows >= bdy) & (g_erows < n)
     raw = jax.lax.dynamic_slice(
-        lt, (0, ctx.kc(tc), 0, co), (ctx.ltr, 1, nb, b))[:, 0]
+        lt, (0, ctx.kc(tc) - col_off, 0, co),
+        (ctx.ltr - row_off, 1, nb, b))[:, 0]
     mine = jnp.where(row_val_e[:, :, None], raw, jnp.zeros_like(raw))
     mine = cc.bcast(mine, COL_AXIS, ctx.owner_c(tc))
-    ptiles = gather_col_panel_ordered(ctx, mine, 0, 0)   # static order
-    pan = jnp.roll(ptiles.reshape(nt * nb, b), -bdy, axis=0)
+    ptiles = gather_col_panel_ordered(ctx, mine, base, row_off)
+    pan = jnp.roll(ptiles.reshape((nt - base) * nb, b),
+                   -(bdy - base * nb), axis=0)
     return pan, bdy, tc, co, row_val_e, g_rows, raw
 
 
-def tiles_of_rolled(ctx: DistContext, mat, bdy):
+def tiles_of_rolled(ctx: DistContext, mat, bdy, base_el: int = 0):
     """Roll a top-aligned sub-panel quantity back to matrix row space and
-    cut into (nt, mb, b) tiles (scan-mode counterpart of
-    :func:`pad_sub_panel_to_tiles`)."""
-    return jnp.roll(mat, bdy, axis=0).reshape(ctx.nt.row, ctx.mb,
-                                              mat.shape[1])
+    cut into (rows/mb, mb, b) tiles (scan-mode counterpart of
+    :func:`pad_sub_panel_to_tiles`). ``base_el``: first element row of the
+    telescoped window the quantity lives in (0 = whole matrix)."""
+    return jnp.roll(mat, bdy - base_el, axis=0).reshape(
+        mat.shape[0] // ctx.mb, ctx.mb, mat.shape[1])
 
 
 def pad_diag_identity_dyn(tile, real_size):
@@ -165,19 +175,29 @@ def pad_diag_identity_dyn(tile, real_size):
     return cleared + jnp.diag(pad.astype(tile.dtype))
 
 
-def col_panel_dyn(ctx: DistContext, lt, k):
-    """:func:`col_panel` for a TRACED ``k``, over ALL local row slots."""
+def col_panel_dyn(ctx: DistContext, lt, k, *, col_off: int = 0,
+                  lu: int = 0, count: int | None = None):
+    """:func:`col_panel` for a TRACED ``k``. Row slots restricted to the
+    static window ``[lu, lu+count)`` (telescoped-scan segments slice the
+    live trailing region; default = all slots). ``col_off``: slot offset
+    of ``lt``'s column axis when the caller passes a column-sliced window
+    (the pivot column index becomes ``kc(k) - col_off``)."""
     mb, nb = lt.shape[-2], lt.shape[-1]
+    cnt = lt.shape[0] - lu if count is None else count
     mine = jax.lax.dynamic_slice(
-        lt, (0, ctx.kc(k), 0, 0), (lt.shape[0], 1, mb, nb))[:, 0]
+        lt, (lu, ctx.kc(k) - col_off, 0, 0), (cnt, 1, mb, nb))[:, 0]
     return cc.bcast(mine, COL_AXIS, ctx.owner_c(k))
 
 
-def row_panel_dyn(ctx: DistContext, lt, k):
-    """:func:`row_panel` for a TRACED ``k``, over ALL local col slots."""
+def row_panel_dyn(ctx: DistContext, lt, k, *, row_off: int = 0,
+                  lu: int = 0, count: int | None = None):
+    """:func:`row_panel` for a TRACED ``k``. Col slots restricted to the
+    static window ``[lu, lu+count)``; ``row_off``: slot offset of ``lt``'s
+    row axis when the caller passes a row-sliced window."""
     mb, nb = lt.shape[-2], lt.shape[-1]
+    cnt = lt.shape[1] - lu if count is None else count
     mine = jax.lax.dynamic_slice(
-        lt, (ctx.kr(k), 0, 0, 0), (1, lt.shape[1], mb, nb))[0]
+        lt, (ctx.kr(k) - row_off, lu, 0, 0), (1, cnt, mb, nb))[0]
     return cc.bcast(mine, ROW_AXIS, ctx.owner_r(k))
 
 

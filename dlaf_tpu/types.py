@@ -154,3 +154,23 @@ def telescope_segments(steps: int, min_chunk: int = 8,
     if steps % c:
         segs.append(steps % c)
     return tuple(segs)
+
+
+def telescope_windows(steps: int, window_fn):
+    """Coalesced ``(window, start, length)`` segments for the telescoped
+    scan builders — the single owner of the segment-building loop shared
+    by Cholesky, triangular solve/multiply, reduction_to_band and its
+    back-transform. ``window_fn(pos, seg_len)`` maps a segment (first
+    step index, length) to a hashable window descriptor (slot offsets /
+    extents); adjacent segments with equal descriptors merge into one
+    scan so no two identically-shaped step programs are compiled."""
+    segs = []
+    pos = 0
+    for seg_len in telescope_segments(steps):
+        win = window_fn(pos, seg_len)
+        if segs and segs[-1][0] == win:
+            segs[-1] = (win, segs[-1][1], segs[-1][2] + seg_len)
+        else:
+            segs.append((win, pos, seg_len))
+        pos += seg_len
+    return segs
