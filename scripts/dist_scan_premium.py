@@ -54,6 +54,7 @@ def main():
                                                 triangular_solve)
     from dlaf_tpu.comm.grid import Grid
     from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.eigensolver.back_transform import bt_reduction_to_band
     from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
     from dlaf_tpu.matrix.matrix import Matrix
 
@@ -85,8 +86,14 @@ def main():
         def run_red2band():
             reduction_to_band(hm).matrix.storage.block_until_ready()
 
+        red = reduction_to_band(hm)
+
+        def run_bt_r2b():
+            bt_reduction_to_band(red, bm).storage.block_until_ready()
+
         for name, fn in (("trsm_LLN", run_solve), ("trmm_LLN", run_mult),
-                         ("red2band", run_red2band)):
+                         ("red2band", run_red2band),
+                         ("bt_r2b", run_bt_r2b)):
             t0 = time.perf_counter()
             t = bench(fn, args.runs)
             log(f"{mode} {name}: best {t*1e3:.1f} ms "

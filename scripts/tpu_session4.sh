@@ -63,6 +63,15 @@ run red2band_d_16384 2400 env DLAF_DIST_STEP_MODE=scan \
 # from hardware data; VERDICT r3 item 5)
 run nsweep_premium 5400 python scripts/tpu_nsweep.py "$OUT/nsweep.json"
 
+# 5b. telescoped red2band scan premium on silicon (local, 31 panels —
+# the CPU-mesh premium is 1.03x; config #4's single-chip formulation)
+run red2band_scan_4096 1800 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1
+run red2band_unrolled_4096 2400 env DLAF_DIST_STEP_MODE=unrolled \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1
+
 # 6. config #2 TRSM: bf16 vs int8 dot route on the mxu path
 run trsm_bf16 1800 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=bf16 \
     python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
