@@ -43,10 +43,15 @@ class Configuration:
     #: Deflated-merge size above which the D&C secular solve + z-refinement
     #: run on the device (see eigensolver/tridiag_solver.py; the threshold
     #: drops automatically when the native host solver failed to build).
-    #: The reference's look-ahead/round-robin workspace knobs
+    #: 0 = auto (default): 4096 on TPU (device = MXU-backed batched math),
+    #: device-disabled on CPU — the round-4 sweep (BASELINE.md: n=16384 at
+    #: thr 2048/4096/8192/host-only -> 218/135/81/66 s, identical
+    #: residuals) shows the CPU backend's "device" route loses to the
+    #: native host solver at every size. The reference's
+    #: look-ahead/round-robin workspace knobs
     #: (``factorization/cholesky/impl.h:187-189``) have no analog here:
     #: XLA sees the whole step DAG at compile time and owns the overlap.
-    secular_device_min_k: int = 4096
+    secular_device_min_k: int = 0
     #: Local Cholesky trailing-update strategy: "loop" (exact-flop per-column
     #: herk/gemm, the reference's task shape), "biggemm" (ONE masked full
     #: trailing gemm per step — 2x flops on the strict triangle but a single

@@ -46,19 +46,53 @@ _SHARD_MERGE_MIN_N = 512
 _DEVICE_SECULAR_MIN_K_NO_NATIVE = 1024
 
 
+#: one announcement per (backend, threshold) resolution of the 0 "auto"
+#: default (auto decisions must not be silent — round-2 advisory pattern)
+_announced_secular: set = set()
+
+
 def _device_secular_min_k() -> int:
     from ..config import get_configuration
 
     cfg = get_configuration()
+    s = cfg.secular_device_min_k
+    auto = s == 0
+    if auto:
+        import jax
+
+        # measured round 4 (BASELINE.md): the CPU backend's device route
+        # loses to the native host solver at every size, so auto disables
+        # it there; on TPU the device side is MXU-backed batched math
+        s = 4096 if jax.default_backend() == "tpu" else (1 << 62)
+    have_native = False
     if cfg.secular_impl == "native":
         try:
             from ..native import bindings
 
             bindings.get_lib()
-            return cfg.secular_device_min_k
+            have_native = True
         except Exception:
             pass
-    return min(cfg.secular_device_min_k, _DEVICE_SECULAR_MIN_K_NO_NATIVE)
+    if not have_native:
+        # the numpy bisection fallback is ~100x the native Newton solver,
+        # so the device takes over much earlier — this overrides the auto
+        # host-always resolution on CPU too
+        s = min(s, _DEVICE_SECULAR_MIN_K_NO_NATIVE)
+    if auto:
+        import jax
+
+        backend = jax.default_backend()
+        if (backend, s) not in _announced_secular:
+            _announced_secular.add((backend, s))
+            import sys
+
+            label = "host-always" if s >= (1 << 62) else str(s)
+            print(f"dlaf_tpu: secular_device_min_k=0 (auto) resolved to "
+                  f"{label} for default backend {backend!r}"
+                  f"{'' if have_native else ' (no native secular solver)'}"
+                  " — set the knob explicitly to override",
+                  file=sys.stderr, flush=True)
+    return s
 
 
 def _secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):

@@ -6,7 +6,8 @@ import dlaf_tpu.config as C
 def test_defaults():
     cfg = C.update_configuration()
     assert cfg.grid_ordering == "row-major"
-    assert cfg.secular_device_min_k == 4096
+    # 0 = auto: 4096 on TPU, device-disabled on CPU (round-4 sweep)
+    assert cfg.secular_device_min_k == 0
 
 
 def test_user_struct_layer():
