@@ -148,6 +148,22 @@ def test_red2band_deep(mode, grid, monkeypatch):
                                np.asarray(local.taus), atol=1e-11 * N)
 
 
+def test_cholesky_deep_complex(grid, monkeypatch):
+    """Complex128 distributed Cholesky at 32 tiles/rank, scan mode — the
+    deep tier's one complex configuration (the toy suites sweep complex
+    broadly; this pins the telescoped windows x complex tile-op
+    interaction at realistic tile counts)."""
+    monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "scan")
+    config.initialize()
+    rng = np.random.default_rng(10)
+    x = rng.standard_normal((N, N)) + 1j * rng.standard_normal((N, N))
+    a = x @ x.conj().T + N * np.eye(N)
+    out = cholesky("L", Matrix.from_global(a, TileElementSize(NB, NB),
+                                           grid=grid)).to_numpy()
+    np.testing.assert_allclose(np.tril(out), sla.cholesky(a, lower=True),
+                               atol=1e-8 * N)
+
+
 def test_bt_r2b_deep(grid, monkeypatch):
     """Distributed bt_reduction_to_band in scan mode at npan=31 (n=512,
     nb=64, band=16): the telescoped reverse-sweep windows take NONZERO
