@@ -324,6 +324,33 @@ def test_telescope_segments_properties():
     assert telescope_segments(64) == (8,) * 8
 
 
+def test_telescope_windows_coalescing():
+    """types.telescope_windows — the shared segment builder of every
+    telescoped scan formulation: segments cover all steps exactly once in
+    order, and adjacent segments with equal window descriptors merge into
+    one (no duplicate identically-shaped step programs)."""
+    from dlaf_tpu.types import telescope_windows
+
+    # distinct windows: no merging, starts/lengths tile the step range
+    segs = telescope_windows(32, lambda pos, _len: pos)
+    assert [(s, l) for _, s, l in segs] == [(0, 8), (8, 8), (16, 8),
+                                           (24, 8)]
+    # slot-window style fn on a 4-rank axis: chunks whose k0 // 4 agree
+    # coalesce (e.g. nt=32, chunks of 8 -> windows 0,2,4,6: distinct)
+    segs = telescope_windows(32, lambda pos, _len: pos // 16)
+    assert [(w, s, l) for w, s, l in segs] == [(0, 0, 16), (1, 16, 16)]
+    # constant window: everything merges into ONE scan
+    segs = telescope_windows(1000, lambda pos, _len: 0)
+    assert segs == [(0, 0, 1000)]
+    # length-dependent window (the reverse-sweep/bt form): merging keeps
+    # coverage exact and ordered
+    segs = telescope_windows(31, lambda pos, ln: (31 - pos - ln) // 8)
+    assert sum(l for _, _, l in segs) == 31
+    starts = [s for _, s, l in segs]
+    assert starts == sorted(starts) and starts[0] == 0
+    assert telescope_windows(0, lambda pos, _len: 0) == []
+
+
 def test_summarize_session_parses_all_schemas(tmp_path, monkeypatch):
     """The session summarizer extracts the best line per step file for
     every miniapp schema variant and appends only TPU lines to the
