@@ -41,6 +41,7 @@ def main():
     ap.add_argument("--nt", type=int, default=32)
     ap.add_argument("--nb", type=int, default=16)
     ap.add_argument("--runs", type=int, default=5)
+    ap.add_argument("--grid", default="2,4")
     args = ap.parse_args()
 
     import numpy as np
@@ -64,10 +65,11 @@ def main():
     b_h = rng.standard_normal((n, n))
     herm_h = rng.standard_normal((n, n))
     herm_h = (herm_h + herm_h.T) / 2
-    grid = Grid(2, 4)
+    gr, gc = (int(x) for x in args.grid.split(","))
+    grid = Grid(gr, gc)
     ts = TileElementSize(args.nb, args.nb)
 
-    out = {"nt": args.nt, "nb": args.nb, "grid": "2x4", "cases": {}}
+    out = {"nt": args.nt, "nb": args.nb, "grid": f"{gr}x{gc}", "cases": {}}
     for mode in ("unrolled", "scan"):
         os.environ["DLAF_DIST_STEP_MODE"] = mode
         config.initialize()
