@@ -14,8 +14,19 @@ mkdir -p "$OUT"
 export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
 echo "results -> $OUT" >&2
 
+healthy() { # cheap probe: the tunnel re-wedges mid-session sometimes; a
+  # wedged jax.devices() HANGS, so probe in a killable subprocess
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    2>/dev/null
+}
+
 run() { # name timeout_s cmd...
   local name=$1 tmo=$2; shift 2
+  if ! healthy; then
+    echo "=== $name SKIPPED: tunnel re-wedged ($(date +%T)) ===" >&2
+    echo "skipped: tunnel re-wedged" >"$OUT/$name.log"
+    return 1
+  fi
   echo "=== $name ($(date +%T)) ===" >&2
   timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
   echo "=== $name rc=$? ($(date +%T)) ===" >&2
