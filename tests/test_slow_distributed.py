@@ -269,3 +269,33 @@ def test_slot_alignment_net_has_teeth(grid, monkeypatch):
     finally:
         monkeypatch.undo()
         chol_mod._dist_cholesky_cached.cache_clear()
+
+
+def test_slot_alignment_net_has_teeth_triangular(grid, monkeypatch):
+    """Same sabotage for the telescoped triangular solve's own
+    uniform_slot_start binding (each builder imports the bound into its
+    namespace, so the Cholesky check does not cover it)."""
+    import importlib
+
+    tri_mod = importlib.import_module("dlaf_tpu.algorithms.triangular")
+    set_step_mode(monkeypatch, "scan")
+    rng = np.random.default_rng(12)
+    a = np.tril(rng.standard_normal((N, N))) + N * np.eye(N)
+    b = rng.standard_normal((N, N))
+    ts = TileElementSize(NB, NB)
+    am = Matrix.from_global(a, ts, grid=grid)
+    bm = Matrix.from_global(b, ts, grid=grid)
+    good = triangular_solve("L", "L", "N", "N", 1.0, am, bm).to_numpy()
+    ref = sla.solve_triangular(a, b, lower=True)
+    np.testing.assert_allclose(good, ref, atol=1e-9 * N)
+
+    monkeypatch.setattr(tri_mod, "uniform_slot_start",
+                        lambda k, p: k // p + 1)
+    tri_mod._dist_solve_cached.cache_clear()
+    try:
+        bad = triangular_solve("L", "L", "N", "N", 1.0, am, bm).to_numpy()
+        assert not np.allclose(bad, ref, atol=1e-9 * N), \
+            "sabotaged solve windows produced a correct result"
+    finally:
+        monkeypatch.undo()
+        tri_mod._dist_solve_cached.cache_clear()
