@@ -341,6 +341,19 @@ def test_permutations_distributed(grid_shape, src, devices8):
     expect = a.copy()
     expect[:, 12:21] = a[:, 12:21][:, permc]
     np.testing.assert_array_equal(out, expect)
+    # non-square blocks: the two axes use distinct block sizes in the
+    # gather tables and the storage reshape layouts
+    rect = Matrix.from_global(a, TileElementSize(4, 8), grid=grid,
+                              source_rank=src)
+    out = permute("Row", perm, rect, 1, 3).to_numpy()
+    expect = a.copy()
+    expect[4:12] = a[4:12][perm]
+    np.testing.assert_array_equal(out, expect)
+    permc8 = rng.permutation(13)  # cols 8..21 with 8-wide blocks
+    out = permute("Col", permc8, rect, 1, None).to_numpy()
+    expect = a.copy()
+    expect[:, 8:21] = a[:, 8:21][:, permc8]
+    np.testing.assert_array_equal(out, expect)
 
 
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
