@@ -109,6 +109,11 @@ class Configuration:
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
     #: no intermediate HBM traffic; see tile_ops/pallas_ozaki.py).
+    #: EXPERIMENTAL: interpret-mode validated only — the 2026-08-01 hardware
+    #: session found the axon tunnel's remote compile helper rejects every
+    #: pallas_call with an infrastructure error (HTTP 500, tpu_compile_helper
+    #: exit 1; not a Mosaic legalization failure), so the fused kernels have
+    #: never executed on silicon (docs/ROUND4.md).
     ozaki_impl: str = "jnp"
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
     #: under TPU f64 emulation) or "mixed" (f32 seed + Newton refinement,

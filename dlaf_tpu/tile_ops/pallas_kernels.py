@@ -30,10 +30,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _update_kernel(mode_ref, vr_ref, vc_ref, a_ref, out_ref):
-    # (1, 1) SMEM block selected by the grid step: the load is at a static
-    # index (dynamic SMEM indexing does not legalize on the chipless AOT
-    # Mosaic path — same fix as pallas_ozaki._make_masked_kernel)
-    mode = mode_ref[0, 0]
+    # whole (R, C) mode table in SMEM, indexed by the grid step in the
+    # kernel body: TPU lowering rejects sub-(8, 128) SMEM blocks (the
+    # earlier (1, 1)-block form), and loads inside the INDEX MAP failed
+    # Mosaic AOT legalization (r2 session) — same form as
+    # pallas_ozaki._make_masked_kernel; body-load legality on the AOT
+    # path is still unverified on silicon (no pallas_call compiles via
+    # the current tunnel, docs/ROUND4.md)
+    mode = mode_ref[pl.program_id(0), pl.program_id(1)]
 
     @pl.when(mode == 0)
     def _():
@@ -66,7 +70,7 @@ def masked_trailing_update(a, vr, vc, mode, *, interpret: bool = False):
         _update_kernel,
         grid=(R, C),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda r, c: (r, c),
+            pl.BlockSpec((R, C), lambda r, c: (0, 0),
                          memory_space=pltpu.SMEM),                 # mode
             pl.BlockSpec((1, nb, nb), lambda r, c: (r, 0, 0)),     # vr
             pl.BlockSpec((1, nb, nb), lambda r, c: (c, 0, 0)),     # vc

@@ -156,10 +156,15 @@ MASKED_MB_MAX = 256
 
 def _make_masked_kernel(s: int, dot: str):
     def kernel(mode_ref, ia_ref, ib_ref, hi_ref, lo_ref):
-        # (1, 1) SMEM block selected by the grid step: the load is at a
-        # static index (dynamic SMEM indexing does not legalize on the
-        # chipless AOT Mosaic path)
-        mode = mode_ref[0, 0]
+        # whole (R, C) mode table in SMEM, indexed by the grid step in the
+        # kernel BODY: TPU lowering rejects sub-(8, 128) SMEM blocks (the
+        # earlier (1, 1)-block form — r4 session finding), and loads
+        # inside the INDEX MAP failed Mosaic AOT legalization (r2 session
+        # finding). A program_id-indexed body load is the form the Pallas
+        # docs sanction for per-cell predication, but whether it legalizes
+        # on the chipless AOT path is UNVERIFIED — no pallas_call compiles
+        # through the current tunnel at all (docs/ROUND4.md)
+        mode = mode_ref[pl.program_id(0), pl.program_id(1)]
 
         @pl.when(mode == 0)
         def _():
@@ -204,7 +209,7 @@ def masked_slice_product(ia, ib, mode, *, interpret: bool = False,
         _make_masked_kernel(s, dot),
         grid=(R, C),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda r, c: (r, c),
+            pl.BlockSpec((R, C), lambda r, c: (0, 0),
                          memory_space=pltpu.SMEM),                   # mode
             pl.BlockSpec((s, None, bm, k), lambda r, c: (0, r, 0, 0)),
             pl.BlockSpec((s, None, bn, k), lambda r, c: (0, c, 0, 0)),

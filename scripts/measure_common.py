@@ -92,3 +92,63 @@ def peel(x, s: int):
 
     sa = oz._scale(x, axis=-1)
     return jnp.stack(oz._peel_slices(oz._normalize(x, sa), s)), sa
+
+
+def cholesky_arm(impl: str, slices: int, dot: str, *, n: int = 4096,
+                 nb: int = 256, source: str):
+    """One config-#1 Cholesky measurement under the given ozaki knobs,
+    with the miniapp-grade residual check — THE shared protocol for every
+    script's full-cholesky arm (probe-identical by construction, per this
+    module's no-copy contract). Returns ``{t, gflops, residual, tol,
+    check}``; on a passing TPU run the result is appended to the durable
+    history as ``"<source> impl=...,slices=...,dot=..."``. Knobs are
+    restored and config re-initialized on exit."""
+    import jax
+    import numpy as np
+
+    from dlaf_tpu import config
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.checks import effective_eps
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.types import total_ops
+
+    key = f"impl={impl},slices={slices},dot={dot}"
+    os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
+    os.environ["DLAF_OZAKI_IMPL"] = impl
+    os.environ["DLAF_F64_GEMM_SLICES"] = str(slices)
+    os.environ["DLAF_OZAKI_DOT"] = dot
+    config.initialize()
+    try:
+        ref = Matrix.from_element_fn(
+            hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+            TileElementSize(nb, nb), dtype=np.float64)
+
+        def run(st):
+            return cholesky("L", ref.with_storage(st)).storage
+
+        t, last = best_time(run, ref.storage + 0, return_last=True)
+        g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
+        lfac = np.tril(np.asarray(ref.with_storage(last).to_numpy()))
+        aref = np.asarray(ref.to_numpy())
+        ah = np.tril(aref) + np.tril(aref, -1).T
+        resid = float(np.linalg.norm(lfac @ lfac.T - ah)
+                      / np.linalg.norm(ah))
+        # judge tolerance from the devices that produced the result
+        # (`of=last`), not the process default backend
+        eps, _ = effective_eps(np.float64, of=last)
+        tol = 60 * n * eps
+        out = {"t": float(t), "gflops": float(g), "residual": resid,
+               "tol": float(tol), "check": bool(resid < tol)}
+        log(f"cholesky N={n} {key}: {t:.4f}s {g:.1f} GF/s "
+            f"residual={resid:.3e} tol={tol:.3e} "
+            f"({'PASS' if out['check'] else 'FAIL'})")
+        if jax.devices()[0].platform == "tpu" and out["check"]:
+            append_history("tpu", n, nb, g, t, f"{source} {key}")
+        return out
+    finally:
+        for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",
+                   "DLAF_F64_GEMM_SLICES", "DLAF_OZAKI_DOT"):
+            os.environ.pop(k_, None)
+        config.initialize()

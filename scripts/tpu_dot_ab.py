@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Decisive A/B for the ozaki_dot route on real TPU hardware.
+
+The round-4 session's pallas_probe measured the bf16 full-Cholesky arm at
+109.3 GF/s with residual 6.1e-9 — 3.5x over the 60*n*eps(2^-47) budget —
+but has no int8 arm at the same config, so it cannot tell whether the
+excess error is the bf16 dot (MXU f32 accumulation deviating from the
+exactness proof in ``ozaki._dot_bf16``) or route-independent platform
+error (emulated-f64 panels), the round-2 TRSM pattern.
+
+Three experiments, most decisive first:
+
+1. BIT-COMPARE the slice contraction itself on device: random 7-bit slice
+   matrices, int8 route vs bf16 route, k in {1024, 2048, 4096}. Any
+   mismatch => the MXU/axon bf16 path is NOT integer-exact and the route
+   is mathematically broken at depth, not just imprecise.
+2. Full config-#1 Cholesky under dot=int8 with the same residual check as
+   the probe's bf16 arm (the missing arm).
+3. If (1) finds mismatches: re-compare with half-chunk (2^11) bf16
+   accumulation to locate the exactness boundary the hardware honors.
+
+Usage: python scripts/tpu_dot_ab.py [out.json]
+Reference protocol: miniapp/miniapp_cholesky.cpp:123-174 (fenced timing).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    from measure_common import cholesky_arm, setup_env
+
+    jax = setup_env()
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}, devices: {jax.devices()}")
+    results = {"platform": platform, "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "bitcompare": {}, "cholesky": {}}
+
+    path = sys.argv[1] if len(sys.argv) > 1 else None
+
+    def emit():
+        if path:
+            with open(path, "w") as f:
+                json.dump(results, f, indent=1, default=float)
+
+    # --- 1. device bit-compare of the two dot routes on raw slices -------
+    from dlaf_tpu.tile_ops import ozaki
+
+    rng = np.random.default_rng(7)
+    for k in (1024, 2048, 4096):
+        ia = rng.integers(-64, 65, (256, k), dtype=np.int8)
+        ib = rng.integers(-64, 65, (k, 256), dtype=np.int8)
+        ja, jb = jnp.asarray(ia), jnp.asarray(ib)
+
+        i8 = np.asarray(jax.jit(
+            lambda a, b: jnp.matmul(a, b, preferred_element_type=jnp.int32)
+        )(ja, jb))
+        bf = np.asarray(jax.jit(ozaki._dot_bf16)(ja, jb))
+        n_mismatch = int((i8 != bf).sum())
+        max_abs = int(np.abs(i8.astype(np.int64)
+                             - bf.astype(np.int64)).max()) if n_mismatch else 0
+        results["bitcompare"][f"k={k}"] = {
+            "mismatches": n_mismatch, "total": i8.size, "max_abs_diff": max_abs}
+        log(f"bitcompare k={k}: {n_mismatch}/{i8.size} mismatches, "
+            f"max |diff| {max_abs}")
+        emit()
+
+    # 3. if the full-chunk bf16 dot mismatches, find the boundary the
+    # hardware honors: same compare with smaller accumulation chunks
+    if any(v["mismatches"] for v in results["bitcompare"].values()):
+        def bf16_chunked(a, b, chunk):
+            acc = None
+            for s0 in range(0, a.shape[-1], chunk):
+                p = jnp.matmul(a[..., s0:s0 + chunk].astype(jnp.bfloat16),
+                               b[..., s0:s0 + chunk, :].astype(jnp.bfloat16),
+                               preferred_element_type=jnp.float32)
+                acc = (p.astype(jnp.int32) if acc is None
+                       else acc + p.astype(jnp.int32))
+            return acc
+
+        k = 4096
+        ia = rng.integers(-64, 65, (256, k), dtype=np.int8)
+        ib = rng.integers(-64, 65, (k, 256), dtype=np.int8)
+        ja, jb = jnp.asarray(ia), jnp.asarray(ib)
+        i8 = np.asarray(jax.jit(
+            lambda a, b: jnp.matmul(a, b, preferred_element_type=jnp.int32)
+        )(ja, jb))
+        for chunk in (2048, 1024, 512, 256):
+            bf = np.asarray(jax.jit(
+                lambda a, b, c=chunk: bf16_chunked(a, b, c))(ja, jb))
+            nm = int((i8 != bf).sum())
+            results["bitcompare"][f"k={k},chunk={chunk}"] = {
+                "mismatches": nm, "total": i8.size}
+            log(f"bitcompare k={k} chunk={chunk}: {nm}/{i8.size} mismatches")
+            emit()
+
+    # --- 2. full config #1 under both dot routes, shared protocol --------
+    for dot in ("int8", "bf16"):
+        try:
+            results["cholesky"][f"impl=jnp,slices=7,dot={dot}"] = \
+                cholesky_arm("jnp", 7, dot, source="tpu_dot_ab")
+        except Exception as e:
+            log(f"cholesky dot={dot} FAILED: {e!r}"[:600])
+        emit()
+
+    log("done")
+    emit()
+
+
+if __name__ == "__main__":
+    main()

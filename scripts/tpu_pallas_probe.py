@@ -181,59 +181,17 @@ def main():
     # full config #1 under the pallas impl, with the miniapp's residual
     # check (the pallas fold carries ~48 bits; hardware must confirm the
     # factorization still meets the f64 algorithm budget before the knob
-    # can be promoted)
-    from dlaf_tpu.algorithms.cholesky import cholesky
-    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
-    from dlaf_tpu.matrix.matrix import Matrix
-    from dlaf_tpu.miniapp.generators import hpd_element_fn
-    from dlaf_tpu.types import total_ops
+    # can be promoted) — shared protocol: measure_common.cholesky_arm
+    from measure_common import cholesky_arm
 
-    n, nb = 4096, 256
     for impl, s, dot in (("pallas", 8, "int8"), ("pallas", 7, "int8"),
                          ("jnp", 7, "bf16"), ("jnp", 8, "bf16")):
         key = f"impl={impl},slices={s},dot={dot}"
-        os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
-        os.environ["DLAF_OZAKI_IMPL"] = impl
-        os.environ["DLAF_F64_GEMM_SLICES"] = str(s)
-        os.environ["DLAF_OZAKI_DOT"] = dot
-        config.initialize()
         try:
-            ref = Matrix.from_element_fn(
-                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
-                TileElementSize(nb, nb), dtype=np.float64)
-
-            def run(st):
-                return cholesky("L", ref.with_storage(st)).storage
-
-            t, last = best_time(run, ref.storage + 0, return_last=True)
-            g = total_ops(np.float64, n**3 / 6, n**3 / 6) / t / 1e9
-            # residual check |A - L L^H| / |A| on the last timed result
-            # (same criterion as miniapp_cholesky --check-result)
-            lfac = np.tril(np.asarray(
-                ref.with_storage(last).to_numpy()))
-            aref = np.asarray(ref.to_numpy())
-            ah = np.tril(aref) + np.tril(aref, -1).T
-            resid = (np.linalg.norm(lfac @ lfac.T - ah)
-                     / np.linalg.norm(ah))
-            from dlaf_tpu.miniapp.checks import effective_eps
-            eps, _ = effective_eps(np.float64)
-            tol = 60 * n * eps
-            ok = bool(resid < tol)
-            results["cholesky"][key] = {"t": t, "gflops": g,
-                                        "residual": resid, "check": ok}
-            log(f"cholesky N={n} {key}: {t:.4f}s {g:.1f} GF/s "
-                f"residual={resid:.3e} ({'PASS' if ok else 'FAIL'})")
-            if results["platform"] == "tpu" and ok:
-                from measure_common import append_history
-                append_history("tpu", n, nb, g, t,
-                               f"tpu_pallas_probe {key}")
+            results["cholesky"][key] = cholesky_arm(
+                impl, s, dot, source="tpu_pallas_probe")
         except Exception as e:
             log(f"cholesky {key} FAILED: {e!r}"[:600])
-        finally:
-            for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",
-                       "DLAF_F64_GEMM_SLICES", "DLAF_OZAKI_DOT"):
-                os.environ.pop(k_, None)
-            config.initialize()
         emit()
 
     path = sys.argv[1] if len(sys.argv) > 1 else None

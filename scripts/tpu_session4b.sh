@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# Round-4 follow-up session: the steps the 08:29-09:24 UTC healthy window
+# did not reach (the window closed mid-pallas_probe), plus the dot-route
+# A/B that window's data made decisive (bf16 full-cholesky measured
+# 109.3 GF/s but residual 6.1e-9 vs the 1.7e-9 budget; the int8 arm and
+# an on-device bit-compare discriminate MXU-accumulation error from
+# route-independent platform error). Armed on scripts/tpu_watch.sh.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4b_$(date +%m%d_%H%M)}
+mkdir -p "$OUT"
+export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
+echo "results -> $OUT" >&2
+
+healthy() {
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    2>/dev/null
+}
+
+run() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  if ! healthy; then
+    echo "=== $name SKIPPED: tunnel re-wedged ($(date +%T)) ===" >&2
+    echo "skipped: tunnel re-wedged" >"$OUT/$name.log"
+    return 1
+  fi
+  echo "=== $name ($(date +%T)) ===" >&2
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  echo "=== $name rc=$? ($(date +%T)) ===" >&2
+}
+
+# 1. the decisive dot-route A/B (bit-compare + int8 full-cholesky arm)
+run dot_ab 2400 python scripts/tpu_dot_ab.py "$OUT/dot_ab.json"
+
+# 2. config #3: c128 capability diag, then hegst z/8192 (first-ever numbers)
+run c128_diag 300 python -c "
+import jax, numpy as np
+jax.config.update('jax_enable_x64', True)
+import jax.numpy as jnp
+print('devices:', jax.devices())
+for dt in (np.complex64, np.complex128):
+    try:
+        x = jnp.asarray(np.full((8, 8), 1 + 1j, dt))
+        y = (x @ x).block_until_ready()
+        print(dt.__name__, 'ok ->', y.dtype, np.asarray(y)[0, 0])
+    except Exception as e:
+        print(dt.__name__, 'FAIL:', repr(e)[:200])
+"
+run hegst_z_8192_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+run hegst_z_8192_blocked 3600 env DLAF_HEGST_IMPL=blocked \
+    DLAF_DIST_STEP_MODE=unrolled \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --type z --nruns 3 --nwarmups 1
+
+# 3. config #4: red2band d/16384/band128 (scan step mode; first-ever numbers)
+run red2band_d_16384 2400 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 3 --nwarmups 1
+
+# 4. N-sweep + scan-vs-unrolled premium ladder (STEP_MODE_AUTO_SCAN_AT)
+run nsweep_premium 5400 python scripts/tpu_nsweep.py "$OUT/nsweep.json"
+
+# 5. telescoped red2band scan premium on silicon (local, 31 panels)
+run red2band_scan_4096 1800 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1
+run red2band_unrolled_4096 2400 env DLAF_DIST_STEP_MODE=unrolled \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1
+
+# 6. config #2 TRSM: bf16 vs int8 dot route on the mxu path
+run trsm_bf16 1800 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=bf16 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1
+run trsm_int8 1200 env DLAF_F64_GEMM=mxu DLAF_OZAKI_DOT=int8 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1
+
+# 7. config #5 rehearsal: full eigensolver on one chip with the phase table
+run eig_rehearsal 10800 env DLAF_PROFILE_DIR="$OUT/eig_prof" \
+    DLAF_DIST_STEP_MODE=scan DLAF_CHOLESKY_TRAILING=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 8192 -b 512 --nruns 1 --nwarmups 1 --check-result last
+
+echo "session4b done ($(date +%T)); summary:" >&2
+grep -h "GFlop/s\|metric\|ok ->\|FAIL\|phases\|mismatch" \
+    "$OUT"/*.out "$OUT"/*.log 2>/dev/null | tail -40 >&2
+python scripts/summarize_session.py "$OUT" >"$OUT/summary.json" \
+    2>"$OUT/summary.log" || true
