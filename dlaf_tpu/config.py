@@ -105,6 +105,19 @@ class Configuration:
     #: the default follows the measured-fast route and a hardware A/B can
     #: revert per platform.
     ozaki_dot: str = "auto"
+    #: Shape of the jnp path's per-shift group sums: "dots" (one MXU dot
+    #: per slice pair, group summed elementwise in HBM — the original,
+    #: hardware-proven form) or "concat" (ONE dot per shift group over
+    #: k-concatenated slice operands: the d+1 pair sums ride the MXU
+    #: accumulator instead of materializing d+1 (m, n) int32 buffers).
+    #: Bit-identical integer math either way (tests/test_ozaki.py); the
+    #: r4 session data pins the jnp path ~100x under the raw MXU dot
+    #: ceiling, i.e. HBM-bound on exactly this traffic, so "concat"
+    #: trades more int8 operand reads (cheap, 1 B/elt) for fewer int32
+    #: intermediates (4 B/elt). Hardware A/B decides promotion; syrk's
+    #: even-shift groups keep their diagonal pair as a second dot to
+    #: preserve the transpose-mirroring MAC saving.
+    ozaki_group: str = "dots"
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
@@ -232,6 +245,7 @@ _VALID_CHOICES = {
     "f64_trsm": ("native", "mixed"),
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
+    "ozaki_group": ("dots", "concat"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve"),

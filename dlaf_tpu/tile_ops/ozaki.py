@@ -138,6 +138,17 @@ def _slice_dot_impl() -> str:
     return dot
 
 
+def _group_impl() -> str:
+    """Per-shift group summation shape (config ``ozaki_group``): "dots"
+    (one dot per slice pair + elementwise group sums) or "concat" (one
+    dot per group over k-concatenated operands). Trace-time knob like
+    :func:`_slice_dot_impl`; bit-identical results (tests/test_ozaki.py
+    TestConcatGroupRoute)."""
+    from ..config import get_configuration
+
+    return get_configuration().ozaki_group
+
+
 def _dot_bf16(ia, ib):
     """Exact slice contraction over the native bf16 MXU path: bf16
     operands (exact for 7-bit slices), f32 accumulation (exact while
@@ -235,6 +246,19 @@ def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
     # int32 group sums stay exact while (d+1) * k * 2^12 < 2^31
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     acc = None
+    if _group_impl() == "concat":
+        # one dot per shift group over k-concatenated operands: the d+1
+        # pair sums ride the MXU accumulator (same integer math as the
+        # "dots" form — the concatenated contraction is exactly the sum
+        # of the per-pair contractions — so chunking/exactness bounds in
+        # _dot_i8/_dot_bf16 apply to (d+1)*k unchanged, and they chunk
+        # at depths far above s*k for every supported shape)
+        for d in range(s):
+            ga = jnp.concatenate([ia[t] for t in range(d + 1)], axis=-1)
+            gb = jnp.concatenate([ib[d - t] for t in range(d + 1)], axis=-2)
+            p = _dot_i8(ga, gb)
+            acc = _fold_group(acc, d, p)
+        return _apply_scales(acc, sa, sb)
     for d in range(s):
         terms = [_dot_i8(ia[t], ib[d - t]) for t in range(d + 1)]
         if exact_i32:
@@ -283,6 +307,24 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
     exact_i32 = (s * k) << (2 * SLICE_BITS - 2) < (1 << 31)
     cast = (lambda x: x) if exact_i32 else (lambda x: x.astype(jnp.float64))
     acc = None
+    if _group_impl() == "concat":
+        # one dot for the strict-upper pair half of each shift group
+        # (mirrored once), plus the even-shift diagonal pair separately —
+        # keeps the syrk MAC halving while the pair sums ride the MXU
+        # accumulator; exactness as in _matmul_f64_2d's concat branch
+        for d in range(s):
+            half = [t for t in range(d // 2 + 1) if t != d - t]
+            p = None
+            if half:
+                ga = jnp.concatenate([ia[t] for t in half], axis=-1)
+                gb = jnp.concatenate([ia[d - t] for t in half], axis=-1)
+                g = _dot_i8(ga, jnp.swapaxes(gb, -1, -2))
+                p = g + jnp.swapaxes(g, -1, -2)
+            if d % 2 == 0:
+                g = _dot_i8(ia[d // 2], jnp.swapaxes(ia[d // 2], -1, -2))
+                p = g if p is None else p + g
+            acc = _fold_group(acc, d, p)
+        return _apply_scales(acc, sa, jnp.swapaxes(sa, -1, -2))
     for d in range(s):
         # G_{t,u} with t+u=d: pair (t,u) and (u,t) are mutual transposes —
         # compute the strict-upper half once and mirror (the syrk symmetry

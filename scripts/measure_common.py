@@ -95,7 +95,7 @@ def peel(x, s: int):
 
 
 def cholesky_arm(impl: str, slices: int, dot: str, *, n: int = 4096,
-                 nb: int = 256, source: str):
+                 nb: int = 256, source: str, extra_env: dict = None):
     """One config-#1 Cholesky measurement under the given ozaki knobs,
     with the miniapp-grade residual check — THE shared protocol for every
     script's full-cholesky arm (probe-identical by construction, per this
@@ -114,7 +114,12 @@ def cholesky_arm(impl: str, slices: int, dot: str, *, n: int = 4096,
     from dlaf_tpu.miniapp.generators import hpd_element_fn
     from dlaf_tpu.types import total_ops
 
-    key = f"impl={impl},slices={slices},dot={dot}"
+    extra_env = dict(extra_env or {})
+    key = f"impl={impl},slices={slices},dot={dot}" + "".join(
+        f",{k.removeprefix('DLAF_').lower()}={v}"
+        for k, v in sorted(extra_env.items()))
+    for k, v in extra_env.items():
+        os.environ[k] = v
     os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
     os.environ["DLAF_OZAKI_IMPL"] = impl
     os.environ["DLAF_F64_GEMM_SLICES"] = str(slices)
@@ -149,6 +154,7 @@ def cholesky_arm(impl: str, slices: int, dot: str, *, n: int = 4096,
         return out
     finally:
         for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",
-                   "DLAF_F64_GEMM_SLICES", "DLAF_OZAKI_DOT"):
+                   "DLAF_F64_GEMM_SLICES", "DLAF_OZAKI_DOT",
+                   *extra_env):
             os.environ.pop(k_, None)
         config.initialize()

@@ -106,13 +106,21 @@ def main():
             log(f"bitcompare k={k} chunk={chunk}: {nm}/{i8.size} mismatches")
             emit()
 
-    # --- 2. full config #1 under both dot routes, shared protocol --------
-    for dot in ("int8", "bf16"):
+    # --- 2. full config #1: dot routes x group forms, shared protocol ----
+    # int8-vs-bf16 decides the residual question (missing arm); the
+    # group=concat arms A/B the k-concatenated group sums (one MXU dot
+    # per shift group instead of d+1 dots + HBM int32 adds — targets the
+    # ~100x gap between the jnp path and the raw dot ceiling)
+    for dot, extra in (("int8", None), ("bf16", None),
+                       ("bf16", {"DLAF_OZAKI_GROUP": "concat"}),
+                       ("int8", {"DLAF_OZAKI_GROUP": "concat"})):
+        label = f"impl=jnp,slices=7,dot={dot}" + (
+            ",group=concat" if extra else "")
         try:
-            results["cholesky"][f"impl=jnp,slices=7,dot={dot}"] = \
-                cholesky_arm("jnp", 7, dot, source="tpu_dot_ab")
+            results["cholesky"][label] = cholesky_arm(
+                "jnp", 7, dot, source="tpu_dot_ab", extra_env=extra)
         except Exception as e:
-            log(f"cholesky dot={dot} FAILED: {e!r}"[:600])
+            log(f"cholesky {label} FAILED: {e!r}"[:600])
         emit()
 
     log("done")

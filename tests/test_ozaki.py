@@ -725,3 +725,67 @@ class TestBf16DotRoute:
             monkeypatch.delenv("DLAF_OZAKI_DOT")
             config.initialize()
         assert got.tobytes() == ref.tobytes()
+
+
+class TestConcatGroupRoute:
+    """ozaki_group="concat": one k-concatenated dot per shift group must be
+    BIT-IDENTICAL to the per-pair "dots" form — the concatenated
+    contraction is exactly the sum of the per-pair contractions, in exact
+    integer arithmetic on every route (int8 i32-accumulated, bf16
+    f32-chunk-accumulated)."""
+
+    def _ab(self, monkeypatch, fn, *args, dot=None):
+        from dlaf_tpu import config
+
+        if dot is not None:
+            monkeypatch.setenv("DLAF_OZAKI_DOT", dot)
+        config.initialize()
+        try:
+            ref = np.asarray(fn(*args))
+            monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+            config.initialize()
+            got = np.asarray(fn(*args))
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_GROUP", raising=False)
+            if dot is not None:
+                monkeypatch.delenv("DLAF_OZAKI_DOT")
+            config.initialize()
+        assert got.tobytes() == ref.tobytes()
+
+    @pytest.mark.parametrize("dot", ["int8", "bf16"])
+    @pytest.mark.parametrize("m,k,s", [(64, 48, 7), (33, 256, 8),
+                                       (16, 5000, 6)])
+    def test_matmul_bitwise_equal(self, m, k, s, dot, monkeypatch):
+        rng = np.random.default_rng(12)
+        a = rng.standard_normal((m, k)) * 10.0 ** rng.integers(-6, 6, (m, 1))
+        b = rng.standard_normal((k, m)) * 10.0 ** rng.integers(-6, 6, (1, m))
+        self._ab(monkeypatch, lambda x, y: matmul_f64(x, y, slices=s),
+                 jnp.asarray(a), jnp.asarray(b), dot=dot)
+
+    @pytest.mark.parametrize("dot", ["int8", "bf16"])
+    @pytest.mark.parametrize("s", [7, 8])
+    def test_syrk_bitwise_equal(self, s, dot, monkeypatch):
+        rng = np.random.default_rng(13)
+        a = rng.standard_normal((96, 128)) * 10.0 ** rng.integers(-4, 4,
+                                                                  (96, 1))
+        self._ab(monkeypatch, lambda x: syrk_f64(x, slices=s),
+                 jnp.asarray(a), dot=dot)
+
+    def test_accuracy_f64_grade_under_concat(self, monkeypatch):
+        # same budget as TestOzaki.test_accuracy_f64_grade, via the knob
+        from dlaf_tpu import config
+
+        rng = np.random.default_rng(14)
+        a = rng.standard_normal((40, 64))
+        b = rng.standard_normal((64, 40))
+        monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+        config.initialize()
+        try:
+            got = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_GROUP")
+            config.initialize()
+        ref = a @ b
+        scale = (np.abs(a).max(axis=-1)[:, None]
+                 * np.abs(b).max(axis=-2)[None, :] * a.shape[-1])
+        assert (np.abs(got - ref) / scale).max() < 4 * EPS
