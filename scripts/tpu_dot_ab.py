@@ -106,6 +106,54 @@ def main():
             log(f"bitcompare k={k} chunk={chunk}: {nm}/{i8.size} mismatches")
             emit()
 
+    # --- 1b. chained trailing-syrk probes: per-op probes are RTT-bound
+    # (~140 ms floor), so chain ITERS dependent syrks inside one program
+    # and divide — resolves the flop-dominant trailing op's real cost
+    # under each (group, dot) combo at the config-#1 step shape
+    try:
+        from jax import lax
+
+        from dlaf_tpu import config
+        from dlaf_tpu.tile_ops import ozaki
+
+        m_, k_, iters = 3840, 256, 12
+        rng2 = np.random.default_rng(3)
+        a0 = jnp.asarray(rng2.standard_normal((m_, k_)))
+        results["chains"] = {}
+
+        def syrk_chain():
+            def body(c, _):
+                g = ozaki.syrk_f64(c, slices=7)
+                # refresh the carry from the output so steps depend on
+                # each other without growing magnitude
+                nxt = g[:, :k_] / jnp.max(jnp.abs(g))
+                return nxt, None
+
+            return jax.jit(lambda a: lax.scan(body, a, None,
+                                              length=iters)[0])
+
+        for group in ("dots", "concat"):
+            for dot in ("int8", "bf16"):
+                os.environ["DLAF_OZAKI_GROUP"] = group
+                os.environ["DLAF_OZAKI_DOT"] = dot
+                config.initialize()
+                try:
+                    from measure_common import best_time
+
+                    t = best_time(syrk_chain(), a0)
+                    key = f"chain_syrk_{group}_{dot}"
+                    results["chains"][key] = {
+                        "t_ms_per_step": t / iters * 1e3}
+                    log(f"{key}: {t / iters * 1e3:.3f} ms/step "
+                        f"(m={m_}, k={k_})")
+                finally:
+                    os.environ.pop("DLAF_OZAKI_GROUP", None)
+                    os.environ.pop("DLAF_OZAKI_DOT", None)
+                    config.initialize()
+        emit()
+    except Exception as e:
+        log(f"syrk chain probes FAILED: {e!r}"[:400])
+
     # --- 2. full config #1: dot routes x group forms, shared protocol ----
     # int8-vs-bf16 decides the residual question (missing arm); the
     # group=concat arms A/B the k-concatenated group sums (one MXU dot
