@@ -106,7 +106,14 @@ def run_variant() -> None:
 
     jax.config.update("jax_enable_x64", True)
     os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR", _cache_dir())
-    os.environ["DLAF_CHOLESKY_TRAILING"] = variant
+    # "ozaki_concat" = the ozaki trailing with the k-concatenated group
+    # sums (config ozaki_group) — labeled separately so the sweep A/Bs the
+    # two group forms and the headline picks whichever silicon prefers
+    if variant == "ozaki_concat":
+        os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
+        os.environ.setdefault("DLAF_OZAKI_GROUP", "concat")
+    else:
+        os.environ["DLAF_CHOLESKY_TRAILING"] = variant
 
     import dlaf_tpu.config as config
 
@@ -142,8 +149,8 @@ def run_variant() -> None:
     except Exception as e:  # platform without f64 support
         log(f"[{variant}] {dtype_name} unavailable ({e}); using float32")
         dtype = np.float32
-    if dtype != np.float64 and variant == "ozaki":
-        # "ozaki" is the emulated-f64 path; for other dtypes it statically
+    if dtype != np.float64 and variant.startswith("ozaki"):
+        # "ozaki*" is the emulated-f64 path; for other dtypes it statically
         # falls back to biggemm — keep the label truthful
         os.environ["DLAF_CHOLESKY_TRAILING"] = variant = "biggemm"
         config.initialize()
@@ -263,14 +270,14 @@ def sweep(platform: str) -> None:
     # measured winner first (ozaki 91-99 GF/s vs xla 37-47 on the v5e
     # tunnel, honest hard_fence timing): if the time budget runs out or a
     # later variant wedges, the best measurement has already landed
-    order = ["ozaki", "xla", "loop", "biggemm", "invgemm"]
+    order = ["ozaki", "ozaki_concat", "xla", "loop", "biggemm", "invgemm"]
     variants = [pinned] if pinned else \
-        [v for v in order if v in VALID_TRAILING] + \
+        [v for v in order if v in VALID_TRAILING or v == "ozaki_concat"] + \
         [v for v in VALID_TRAILING if v not in order]
     if on_cpu and not pinned:
         # the CPU fallback has fast native f64 — the int8-emulation variant
         # has no hardware to win on there; accelerators keep it leading
-        variants = [v for v in variants if v != "ozaki"]
+        variants = [v for v in variants if not v.startswith("ozaki")]
         variants = sorted(variants, key=lambda v: v != "xla")
 
     budget_s = float(os.environ.get("DLAF_BENCH_BUDGET", "1800"))
