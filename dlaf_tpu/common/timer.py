@@ -48,7 +48,11 @@ class PhaseTimer:
             import jax
 
             if not self._tracing:
-                jax.profiler.start_trace(self.profile_dir)
+                # perfetto trace alongside the xplane: a gzipped JSON this
+                # container can post-process WITHOUT tensorboard
+                # (scripts/profile_summary.py aggregates op durations)
+                jax.profiler.start_trace(self.profile_dir,
+                                         create_perfetto_trace=True)
                 self._tracing = True
             ctx = jax.profiler.TraceAnnotation(name)
         t0 = time.perf_counter()

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Offline summary of a jax.profiler trace — no tensorboard needed.
+
+Reads the newest ``plugins/profile/<ts>/*.trace.json.gz`` (Chrome trace
+event format; written alongside the xplane by ``--dlaf:profile-dir`` runs
+since PhaseTimer enables ``create_perfetto_trace``) under the given
+directory and prints, per process track (device vs host threads), the
+top-N ops by total duration. This is the instrument for deciding WHERE
+config #1's 0.2 s actually goes — per-op tunnel probes sit on the ~140 ms
+RTT floor and cannot (BASELINE.md round 4).
+
+Usage: python scripts/profile_summary.py <profile_dir> [top_n]
+"""
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def newest_trace(root: str) -> str:
+    cands = sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json.gz"),
+                  recursive=True) +
+        glob.glob(os.path.join(root, "**", "perfetto_trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime)
+    if not cands:
+        raise SystemExit(f"no *.trace.json.gz under {root}")
+    # prefer the chrome trace over the perfetto one at equal recency (both
+    # carry the events; the chrome one names processes in metadata events)
+    chrome = [c for c in cands if not c.endswith("perfetto_trace.json.gz")]
+    return (chrome or cands)[-1]
+
+
+def main():
+    root = sys.argv[1]
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    path = newest_trace(root)
+    print(f"trace: {path}")
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    events = data["traceEvents"] if isinstance(data, dict) else data
+
+    proc_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            proc_names[e.get("pid")] = e.get("args", {}).get("name", "")
+
+    # complete events only (ph == "X": have a duration)
+    by_track = collections.defaultdict(collections.Counter)
+    track_total = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        track = proc_names.get(pid, f"pid{pid}")
+        dur = e.get("dur", 0) / 1e3  # us -> ms
+        by_track[track][e.get("name", "?")] += dur
+        track_total[track] += dur
+
+    for track, total in track_total.most_common():
+        print(f"\n== {track}: {total:.1f} ms total (sum of events) ==")
+        for name, dur in by_track[track].most_common(top_n):
+            print(f"  {dur:10.2f} ms  {100 * dur / max(total, 1e-9):5.1f}%"
+                  f"  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

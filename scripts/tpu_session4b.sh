@@ -32,6 +32,16 @@ run() { # name timeout_s cmd...
 # 1. the decisive dot-route A/B (bit-compare + int8 full-cholesky arm)
 run dot_ab 2400 python scripts/tpu_dot_ab.py "$OUT/dot_ab.json"
 
+# 1c. op-level profile of config #1 (perfetto trace parsed offline by
+# profile_summary.py — the only instrument that resolves where the 0.2 s
+# goes; per-op tunnel probes sit on the ~140 ms RTT floor)
+run chol_profile 1200 env DLAF_PROFILE_DIR="$OUT/chol_prof" \
+    DLAF_CHOLESKY_TRAILING=ozaki \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 4096 -b 256 --nruns 2 --nwarmups 1
+run chol_profile_summary 300 \
+    python scripts/profile_summary.py "$OUT/chol_prof" 40
+
 # 2. config #3: c128 capability diag, then hegst z/8192 (first-ever numbers)
 run c128_diag 300 python -c "
 import jax, numpy as np
