@@ -345,10 +345,14 @@ def get_configuration() -> Configuration:
 #: (docs/DESIGN.md): the hardware AOT toolchain compiles unrolled per-step
 #: programs at ~19 s/step (vs ~2.3 s total for the scan form), so at 32+
 #: steps a cold unrolled compile costs 10+ minutes against a scan run
-#: premium measured at ~2.1x (CPU mesh, nt=16; single-run wall is
-#: milliseconds-to-seconds). The CPU toolchain's ~0.35 s/step constant
-#: moves the breakpoint to ~128. Thresholds are refreshed as hardware
-#: premium data lands (scripts/tpu_nsweep.py measures the scan ladder).
+#: premium of 1.11x MEASURED ON SILICON (2026-08-01 live session: scan
+#: 89.2 vs ozaki 98.9 GF/s at N=4096/nb=256, nt=16 — the telescoped
+#: formulation; the pre-telescoping prior was ~2.1x). The CPU
+#: toolchain's ~0.35 s/step constant moves the breakpoint to ~128. The
+#: nt-sweep ladder (scripts/tpu_nsweep.py, armed) refines the TPU
+#: threshold; with an 11% premium the crossover is compile-dominated, so
+#: 32 is conservative — a COLD cache argues for scan well below it,
+#: while this warm-cache container amortizes unrolled compiles away.
 STEP_MODE_AUTO_SCAN_AT = {"tpu": 32, "cpu": 128}
 
 
