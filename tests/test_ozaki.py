@@ -789,3 +789,39 @@ class TestConcatGroupRoute:
         scale = (np.abs(a).max(axis=-1)[:, None]
                  * np.abs(b).max(axis=-2)[None, :] * a.shape[-1])
         assert (np.abs(got - ref) / scale).max() < 4 * EPS
+
+    def test_distributed_cholesky_mxu_under_concat(self, monkeypatch,
+                                                   devices8):
+        """The distributed mxu trailing einsums route through the same
+        matmul/syrk entry points, so group=concat must hold there too —
+        different contraction shapes (batched tile axes) than the local
+        arms above."""
+        from dlaf_tpu import config
+
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "8")
+        monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+        monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+        config.initialize()
+        try:
+            from dlaf_tpu.algorithms.cholesky import cholesky
+            from dlaf_tpu.comm.grid import Grid
+            from dlaf_tpu.common.index2d import (GlobalElementSize,
+                                                 TileElementSize)
+            from dlaf_tpu.matrix.matrix import Matrix
+            from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+            n, nb = 64, 16
+            mat = Matrix.from_element_fn(
+                hpd_element_fn(n, np.float64), GlobalElementSize(n, n),
+                TileElementSize(nb, nb), dtype=np.float64, grid=Grid(2, 4))
+            f = cholesky("L", mat).to_numpy()
+            a = mat.to_numpy()
+            tri = np.tril(f)
+            resid = np.linalg.norm(tri @ tri.T - a) / np.linalg.norm(a)
+            assert resid < 60 * n * EPS
+        finally:
+            for k in ("DLAF_F64_GEMM", "DLAF_F64_GEMM_MIN_DIM",
+                      "DLAF_F64_TRSM", "DLAF_OZAKI_GROUP"):
+                monkeypatch.delenv(k)
+            config.initialize()
