@@ -132,8 +132,9 @@ def _slice_dot_impl() -> str:
         import sys
 
         print(f"dlaf_tpu: ozaki_dot=auto resolved to {dot!r} for default "
-              f"backend {backend!r} (bit-identical routes; bf16 targets the "
-              "MXU's native path) — set the knob explicitly to override",
+              f"backend {backend!r} (routes bit-identical by test; the "
+              "on-silicon confirmation is the armed dot_ab A/B — "
+              "BASELINE.md round 4) — set the knob explicitly to override",
               file=sys.stderr, flush=True)
     return dot
 
