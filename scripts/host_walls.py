@@ -32,7 +32,11 @@ def emit(rec):
 
 
 def session_started():
-    return os.path.isdir(os.path.join(REPO, ".session4_auto"))
+    # a TPU measurement session owns the box: the round-4 watcher mkdirs
+    # its OUT the moment a probe succeeds (.session4_auto was the r3
+    # name; .session4b is the r4 follow-up session)
+    return any(os.path.isdir(os.path.join(REPO, d))
+               for d in (".session4_auto", ".session4b"))
 
 
 def rss_gb():
