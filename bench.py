@@ -106,12 +106,15 @@ def run_variant() -> None:
 
     jax.config.update("jax_enable_x64", True)
     os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR", _cache_dir())
-    # "ozaki_concat" = the ozaki trailing with the k-concatenated group
-    # sums (config ozaki_group) — labeled separately so the sweep A/Bs the
-    # two group forms and the headline picks whichever silicon prefers
-    if variant == "ozaki_concat":
+    # "ozaki_concat"/"ozaki_dots" = the ozaki trailing with the group form
+    # pinned (config ozaki_group) — labeled separately so the sweep A/Bs
+    # the two group forms against the auto default (concat on TPU since
+    # the 2026-08-01 dot_ab session) and the headline picks whichever
+    # silicon prefers
+    if variant in ("ozaki_concat", "ozaki_dots"):
         os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
-        os.environ.setdefault("DLAF_OZAKI_GROUP", "concat")
+        os.environ.setdefault("DLAF_OZAKI_GROUP",
+                              variant.removeprefix("ozaki_"))
     else:
         os.environ["DLAF_CHOLESKY_TRAILING"] = variant
 
@@ -270,9 +273,13 @@ def sweep(platform: str) -> None:
     # measured winner first (ozaki 91-99 GF/s vs xla 37-47 on the v5e
     # tunnel, honest hard_fence timing): if the time budget runs out or a
     # later variant wedges, the best measurement has already landed
-    order = ["ozaki", "ozaki_concat", "xla", "loop", "biggemm", "invgemm"]
+    # the group-form A/B arm pins whichever form ozaki_group=auto does
+    # NOT resolve to on this platform (concat on TPU, dots elsewhere),
+    # so "ozaki" (the auto default) vs the pinned arm is a real A/B
+    ab_arm = "ozaki_dots" if platform == "tpu" else "ozaki_concat"
+    order = ["ozaki", ab_arm, "xla", "loop", "biggemm", "invgemm"]
     variants = [pinned] if pinned else \
-        [v for v in order if v in VALID_TRAILING or v == "ozaki_concat"] + \
+        [v for v in order if v in VALID_TRAILING or v == ab_arm] + \
         [v for v in VALID_TRAILING if v not in order]
     if on_cpu and not pinned:
         # the CPU fallback has fast native f64 — the int8-emulation variant

@@ -99,11 +99,12 @@ class Configuration:
     #: contracted on the MXU's native bf16 path with f32 accumulation,
     #: integer-exact while k*2^12 <= 2^24, chunked beyond; bit-identical
     #: results), or "auto" (default): bf16 on TPU, int8 elsewhere. The
-    #: bf16-on-TPU default exists because XLA's HLO s8 dot measured ~1% of
-    #: the v5e's int8 peak while bf16 matmul is the hardware's first-class
-    #: MXU path; the routes are bit-identical (tests/test_ozaki.py), so
-    #: the default follows the measured-fast route and a hardware A/B can
-    #: revert per platform.
+    #: 2026-08-01 dot_ab session settled the routes on silicon:
+    #: bit-identical on device (0/65536 mismatches at k up to 4096) and
+    #: at performance parity at the pipeline level (within 1% on full
+    #: config #1 under either group form — the jnp path is HBM-bound, so
+    #: the raw s8-dot lowering deficit never binds); bf16 stays the TPU
+    #: default as the hardware's first-class MXU path.
     ozaki_dot: str = "auto"
     #: Shape of the jnp path's per-shift group sums: "dots" (one MXU dot
     #: per slice pair, group summed elementwise in HBM — the original,
@@ -114,10 +115,16 @@ class Configuration:
     #: r4 session data pins the jnp path ~100x under the raw MXU dot
     #: ceiling, i.e. HBM-bound on exactly this traffic, so "concat"
     #: trades more int8 operand reads (cheap, 1 B/elt) for fewer int32
-    #: intermediates (4 B/elt). Hardware A/B decides promotion; syrk's
+    #: intermediates (4 B/elt). The 2026-08-01 dot_ab session confirmed
+    #: the traffic model on silicon: trailing-syrk chains 16.6 vs
+    #: 19.1 ms/step and full config #1 at 112.1/111.7 GF/s (int8/bf16)
+    #: vs 105.1/104.5 for "dots", identical residuals — so "auto"
+    #: (default) resolves concat on TPU and keeps dots elsewhere (the
+    #: traffic argument is TPU-HBM-specific; off-TPU stays on the
+    #: long-proven form until measured). Syrk's
     #: even-shift groups keep their diagonal pair as a second dot to
     #: preserve the transpose-mirroring MAC saving.
-    ozaki_group: str = "dots"
+    ozaki_group: str = "auto"
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
@@ -245,7 +252,7 @@ _VALID_CHOICES = {
     "f64_trsm": ("native", "mixed"),
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
-    "ozaki_group": ("dots", "concat"),
+    "ozaki_group": ("dots", "concat", "auto"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve"),

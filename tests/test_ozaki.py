@@ -739,6 +739,10 @@ class TestConcatGroupRoute:
 
         if dot is not None:
             monkeypatch.setenv("DLAF_OZAKI_DOT", dot)
+        # pin the reference arm to "dots" explicitly: the default is
+        # "auto" (concat on TPU), which would make this A/B vacuous on
+        # exactly the platform where concat is the production form
+        monkeypatch.setenv("DLAF_OZAKI_GROUP", "dots")
         config.initialize()
         try:
             ref = np.asarray(fn(*args))
