@@ -32,11 +32,33 @@ def emit(rec):
 
 
 def session_started():
-    # a TPU measurement session owns the box: the round-4 watcher mkdirs
-    # its OUT the moment a probe succeeds (.session4_auto was the r3
-    # name; .session4b is the r4 follow-up session)
-    return any(os.path.isdir(os.path.join(REPO, d))
-               for d in (".session4_auto", ".session4b"))
+    # a TPU measurement session owns the box. Two signals, either one
+    # suffices: a live tpu_session*.sh process, or a session OUT dir
+    # (.session4_auto, .session4b_live, .session4c_<ts>, ...) touched in
+    # the last 4 h — prefix+mtime rather than an exact-name list so new
+    # session scripts are covered without editing this guard, while
+    # stale dirs from finished windows don't block host walls forever.
+    import subprocess
+    try:
+        if subprocess.run(["pgrep", "-f", r"tpu_session.*\.sh"],
+                          stdout=subprocess.DEVNULL).returncode == 0:
+            return True
+    except OSError:
+        pass
+    now = time.time()
+    try:
+        entries = os.listdir(REPO)
+    except OSError:
+        return False
+    for e in entries:
+        p = os.path.join(REPO, e)
+        if e.startswith(".session") and os.path.isdir(p):
+            try:
+                if now - os.path.getmtime(p) < 4 * 3600:
+                    return True
+            except OSError:
+                continue
+    return False
 
 
 def rss_gb():

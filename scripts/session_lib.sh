@@ -1,0 +1,38 @@
+# Shared helpers for the one-shot TPU measurement sessions
+# (tpu_session4c.sh onward; 4/4b predate this and keep inline copies —
+# they were live or already-run when this was extracted, and a running
+# bash script must not be edited in place). Source from a session
+# script AFTER setting OUT:
+#
+#   source "$(dirname "$0")/session_lib.sh"
+#
+# Provides: healthy(), run NAME TIMEOUT CMD..., session_summary.
+# Expects: set -u, cwd = repo root, $OUT set.
+
+mkdir -p "$OUT"
+export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"
+echo "results -> $OUT" >&2
+
+healthy() {
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    2>/dev/null
+}
+
+run() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  if ! healthy; then
+    echo "=== $name SKIPPED: tunnel re-wedged ($(date +%T)) ===" >&2
+    echo "skipped: tunnel re-wedged" >"$OUT/$name.log"
+    return 1
+  fi
+  echo "=== $name ($(date +%T)) ===" >&2
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.log"
+  echo "=== $name rc=$? ($(date +%T)) ===" >&2
+}
+
+session_summary() {
+  echo "session done ($(date +%T)); summary:" >&2
+  grep -h "GFlop/s\|check:" "$OUT"/*.out 2>/dev/null | tail -20 >&2
+  python scripts/summarize_session.py "$OUT" >"$OUT/summary.json" \
+      2>"$OUT/summary.log" || true
+}

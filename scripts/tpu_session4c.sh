@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Round-4 correction pass: session4b's red2band arms ran WITHOUT the
+# product's TPU gemm knobs, so their trailing updates took the native
+# f64-emulation dot — whose (8, n, n) f32 slice workspaces cost 2x8 GB
+# at n=16384 and OOMed the 15.75 GB v5e (config #4, rc=1; allocation
+# dump in .session4b_live/red2band_d_16384.log). These arms re-run the
+# red2band ladder under DLAF_F64_GEMM=mxu / DLAF_F64_TRSM=mixed — the
+# measured-winning TPU route, whose int8 slice planes are 4x smaller —
+# sized so at least one config-#4-family number must land. Every arm
+# carries --check-result last: a first-ever hardware number without a
+# residual is not a number.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4c_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+# 1. smallest first so a number lands before any wedge/OOM surprise:
+#    config-#4 family at n=8192 (mxu route; fits with wide margin)
+run red2band_8192_scan_mxu 2400 env DLAF_DIST_STEP_MODE=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 8192 -b 512 --band-size 128 --nruns 3 --nwarmups 1 \
+    --check-result last
+
+# 2. the full config #4 retry under the mxu route (the OOM decider:
+#    int8 slices are 1 B/elt vs the native route's 4 B/elt f32 planes)
+run red2band_16384_scan_mxu 3600 env DLAF_DIST_STEP_MODE=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+
+# 3. product-route scan-vs-unrolled premium for red2band at 4096
+#    (session4b's 4096 arms measured the NATIVE route premium; these
+#    measure it on the route the product actually uses on TPU)
+run red2band_4096_scan_mxu 1800 env DLAF_DIST_STEP_MODE=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+run red2band_4096_unrolled_mxu 2400 env DLAF_DIST_STEP_MODE=unrolled \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+
+# 4. gen_to_std config-#3 FAMILY on a dtype this tunnel can run: the z
+#    (complex128) BASELINE config is environment-gated (complex64 raises
+#    UNIMPLEMENTED, c128 transfers hang — .session4b_live/c128_diag),
+#    so land the d/8192 arms that exercise the same blocked-HEGST code
+#    path (first-ever hardware HEGST numbers either way)
+run hegst_d_8192_blocked 2400 env DLAF_HEGST_IMPL=blocked \
+    DLAF_DIST_STEP_MODE=unrolled DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1 --check-result last
+run hegst_d_8192_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1 --check-result last
+
+session_summary
