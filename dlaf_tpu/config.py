@@ -348,18 +348,18 @@ def get_configuration() -> Configuration:
 
 
 #: Step counts at which ``dist_step_mode="auto"`` switches to the scan
-#: formulation, per platform. Derived from the measured compile constants
-#: (docs/DESIGN.md): the hardware AOT toolchain compiles unrolled per-step
-#: programs at ~19 s/step (vs ~2.3 s total for the scan form), so at 32+
-#: steps a cold unrolled compile costs 10+ minutes against a scan run
-#: premium of 1.11x MEASURED ON SILICON (2026-08-01 live session: scan
-#: 89.2 vs ozaki 98.9 GF/s at N=4096/nb=256, nt=16 — the telescoped
-#: formulation; the pre-telescoping prior was ~2.1x). The CPU
-#: toolchain's ~0.35 s/step constant moves the breakpoint to ~128. The
-#: nt-sweep ladder (scripts/tpu_nsweep.py, armed) refines the TPU
-#: threshold; with an 11% premium the crossover is compile-dominated, so
-#: 32 is conservative — a COLD cache argues for scan well below it,
-#: while this warm-cache container amortizes unrolled compiles away.
+#: formulation, per platform. The TPU point now rests on the MEASURED
+#: silicon ladder (scripts/tpu_nsweep.py, 2026-08-01 session, telescoped
+#: scan, nb=256): run premium 1.149x at nt=16 (N=4096) and 1.248x at
+#: nt=32 (N=8192) — the premium GROWS with nt (more telescope windows =
+#: more slot padding), so lowering the threshold buys nothing, while the
+#: compile side still cliffs: the hardware AOT toolchain compiles
+#: unrolled per-step programs at ~19 s/step (vs ~2.3 s total for scan),
+#: i.e. 10+ cold minutes at nt=32 against a 0.13 s/run premium — a
+#: ~4600-run break-even no real session reaches. 32 therefore stays: a
+#: COLD cache argues for scan well below it, a warm cache amortizes
+#: unrolled compiles away above it. The CPU toolchain's ~0.35 s/step
+#: constant moves the breakpoint to ~128.
 STEP_MODE_AUTO_SCAN_AT = {"tpu": 32, "cpu": 128}
 
 
