@@ -763,9 +763,13 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     holds the factor; the other triangle passes through.
     """
     dlaf_assert(uplo in ("L", "U"), f"cholesky: uplo must be 'L' or 'U', got {uplo!r}")
-    from ..config import get_configuration
+    from ..config import get_configuration, resolve_platform_auto
 
-    trailing = get_configuration().cholesky_trailing
+    trailing = resolve_platform_auto(
+        get_configuration().cholesky_trailing, knob="cholesky_trailing",
+        tpu_choice="ozaki", other_choice="loop",
+        detail="ozaki trailing measured 112.8/351.0 GF/s at N=4096/8192 "
+               "vs 42-47 for loop/xla — 2026-08-01 v5e session")
     dlaf_assert(trailing in VALID_TRAILING,
                 f"cholesky_trailing must be one of {VALID_TRAILING}, got {trailing!r}")
     dlaf_assert(mat.size.row == mat.size.col, "cholesky: matrix must be square")

@@ -61,9 +61,13 @@ class Configuration:
     #: fused native cholesky), or "scan" (lax.scan'd uniform step: one
     #: compiled step body looped nt times — O(1) compile time and carry
     #: buffer reuse at ~3x the exact trailing flops; the compile/HBM
-    #: escape hatch at large tile counts, algorithms/cholesky.py).
+    #: escape hatch at large tile counts, algorithms/cholesky.py). Also
+    #: "ozaki" (error-free int8-slice trailing on the MXU) and "auto"
+    #: (default): ozaki on TPU — the measured winner every silicon
+    #: session (112.8/351.0 GF/s at N=4096/8192 vs 42-47 for the other
+    #: forms, 2026-08-01) — and loop elsewhere.
     #: Benchmarked per hardware; see bench.py.
-    cholesky_trailing: str = "loop"
+    cholesky_trailing: str = "auto"
     #: bt_band_to_tridiag reflector application: "blocked" (compact-WY
     #: staircase groups -> larft + two gemms per step level, the MXU form of
     #: the reference's b x b HH re-tiling) or "sweeps" (one batched rank-1
@@ -77,10 +81,18 @@ class Configuration:
     #: Real-f64 level-3 contraction backend for the tile ops (gemm / herk /
     #: her2k / hemm / trmm): "native" (XLA's dot — on TPU, compiler-emulated
     #: double-double arithmetic) or "mxu" (error-free int8 slicing with exact
-    #: int32 accumulation, tile_ops/ozaki.py — ~2x native emulation on a v5e
-    #: and f64-grade accurate). Triangular *solves* are unaffected (they are
-    #: latency-, not throughput-bound; see tile_ops/mixed.py for that side).
-    f64_gemm: str = "native"
+    #: int32 accumulation, tile_ops/ozaki.py), or "auto" (default): mxu on
+    #: TPU, native elsewhere. The TPU resolution is measurement-backed
+    #: (2026-08-01 v5e session): the mxu route ran 281-351 GF/s where the
+    #: native emulation ran 47-49 (cholesky N=4096/8192), its int8 slice
+    #: planes are 4x smaller than the emulation's f32 workspaces (the
+    #: native route OOMed red2band n=16384 at 32 GB asked of 15.75), and
+    #: scan-form algorithms pair pathologically with the native dot (XLA
+    #: sinks the emulation's constant planes into the loop: red2band scan
+    #: measured 1.86 GF/s native vs 48.9 unrolled). Triangular *solves*
+    #: are unaffected (they are latency-, not throughput-bound; see
+    #: ``f64_trsm`` for that side).
+    f64_gemm: str = "auto"
     #: Smallest dimension for which f64_gemm="mxu" actually reroutes a
     #: contraction; below it the slicing overhead outweighs the MXU win and
     #: the native path is kept.
@@ -136,13 +148,16 @@ class Configuration:
     #: never executed on silicon (docs/ROUND4.md).
     ozaki_impl: str = "jnp"
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
-    #: under TPU f64 emulation) or "mixed" (f32 seed + Newton refinement,
+    #: under TPU f64 emulation), "mixed" (f32 seed + Newton refinement,
     #: tile_ops/mixed.py: refined explicit inverse + matmul for per-tile
     #: panel solves via tile_ops.blas.trsm_panel, and the distributed
     #: cholesky's per-step panel potrf/trsm; the matmul application follows
-    #: f64_gemm, so with "mxu" it runs on the int8 path). Whole-matrix local
-    #: solves stay native either way.
-    f64_trsm: str = "native"
+    #: f64_gemm, so with "mxu" it runs on the int8 path), or "auto"
+    #: (default): mixed on TPU (panel-chain probes, 2026-08-01 v5e
+    #: session: +0.6 ms/step over pure gemm vs +15.7 ms for native-f64
+    #: panels), native elsewhere. Whole-matrix local solves stay native
+    #: either way.
+    f64_trsm: str = "auto"
     #: Per-k step formulation for the distributed algorithms (triangular
     #: solve/multiply, reduction_to_band + its back-transform, gen_to_std
     #: via its solves) AND the local reduction_to_band: "unrolled" (per-k
@@ -248,8 +263,8 @@ _VALID_CHOICES = {
     "band_to_tridiag_impl": ("native", "numpy"),
     "secular_impl": ("native", "numpy"),
     "bt_b2t_impl": ("blocked", "sweeps"),
-    "f64_gemm": ("native", "mxu"),
-    "f64_trsm": ("native", "mixed"),
+    "f64_gemm": ("native", "mxu", "auto"),
+    "f64_trsm": ("native", "mixed", "auto"),
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
     "ozaki_group": ("dots", "concat", "auto"),
@@ -345,6 +360,59 @@ def get_configuration() -> Configuration:
     if _active is None:
         _active = initialize()
     return _active
+
+
+#: (knob, backend, choice) resolutions already announced on stderr — the
+#: platform-auto knobs log once per distinct outcome so the route in
+#: effect is visible, not silent (round-2 advisory).
+_announced_auto: set = set()
+
+
+def resolve_platform_auto(value: str, *, knob: str, tpu_choice: str,
+                          other_choice: str, detail: str) -> str:
+    """Shared resolve-and-announce for the platform-keyed "auto" knobs
+    (ozaki_dot, ozaki_group, f64_gemm, f64_trsm, cholesky_trailing):
+    pick per the PROCESS
+    default jax backend — a trace explicitly placed on a non-default
+    backend inherits the process choice; set the knob explicitly for
+    that case — and print one stderr announcement per (knob, backend,
+    choice) so the decision is never silent."""
+    if value != "auto":
+        return value
+    import jax
+
+    backend = jax.default_backend()
+    choice = tpu_choice if backend == "tpu" else other_choice
+    key = (knob, backend, choice)
+    if key not in _announced_auto:
+        _announced_auto.add(key)
+        import sys
+
+        print(f"dlaf_tpu: {knob}=auto resolved to {choice!r} for default "
+              f"backend {backend!r} ({detail}) — set the knob explicitly "
+              "to override", file=sys.stderr, flush=True)
+    return choice
+
+
+def resolved_f64_gemm() -> str:
+    """``f64_gemm`` with "auto" resolved: mxu on TPU, native elsewhere
+    (see the knob docstring for the measurement basis)."""
+    return resolve_platform_auto(
+        get_configuration().f64_gemm, knob="f64_gemm", tpu_choice="mxu",
+        other_choice="native",
+        detail="int8-slice MXU gemms measured 281-351 GF/s vs 47-49 for "
+               "the native f64 emulation, with 4x smaller workspaces — "
+               "2026-08-01 v5e session")
+
+
+def resolved_f64_trsm() -> str:
+    """``f64_trsm`` with "auto" resolved: mixed on TPU, native elsewhere
+    (see the knob docstring for the measurement basis)."""
+    return resolve_platform_auto(
+        get_configuration().f64_trsm, knob="f64_trsm",
+        tpu_choice="mixed", other_choice="native",
+        detail="f32-seed Newton-refined panel solves measured +0.6 ms/step "
+               "vs +15.7 for native-f64 panels — 2026-08-01 v5e session")
 
 
 #: Step counts at which ``dist_step_mode="auto"`` switches to the scan

@@ -41,14 +41,13 @@ def _mxu_f64(*arrs, dims) -> bool:
     the error-free int8 MXU path (config knob ``f64_gemm``; see
     tile_ops/ozaki.py)? Programs caching this decision register with
     ``config.register_program_cache`` so knob changes re-trace."""
-    from ..config import get_configuration
+    from ..config import get_configuration, resolved_f64_gemm
 
-    cfg = get_configuration()
-    if cfg.f64_gemm != "mxu":
+    if resolved_f64_gemm() != "mxu":
         return False
     if any(x.dtype not in (jnp.float64, jnp.complex128) for x in arrs):
         return False
-    return min(dims) >= cfg.f64_gemm_min_dim
+    return min(dims) >= get_configuration().f64_gemm_min_dim
 
 
 #: (backend, slices) pairs already announced — the auto-tier resolution
@@ -363,26 +362,25 @@ def f64_gemm_uses_mxu(dtype, dim: int) -> bool:
     onto the int8/bf16 MXU path? Single owner of the algorithm-level route
     decision (the tile-level ``_mm`` gate checks per-operand shapes
     itself)."""
-    from ..config import get_configuration
+    from ..config import get_configuration, resolved_f64_gemm
 
     import numpy as _np
 
-    cfg = get_configuration()
-    return (cfg.f64_gemm == "mxu"
+    return (resolved_f64_gemm() == "mxu"
             and _np.dtype(dtype) in (_np.dtype(_np.float64),
                                      _np.dtype(_np.complex128))
-            and dim >= cfg.f64_gemm_min_dim)
+            and dim >= get_configuration().f64_gemm_min_dim)
 
 
 def trsm_panel_uses_mixed(dtype) -> bool:
     """Will :func:`trsm_panel` route this dtype through the refined-inverse
     mixed path under the current config? For callers that precompute
     ``inv_a`` once and reuse it across several panel solves."""
-    from ..config import get_configuration
+    from ..config import resolved_f64_trsm
 
     import numpy as _np
 
-    return (get_configuration().f64_trsm == "mixed"
+    return (resolved_f64_trsm() == "mixed"
             and _np.dtype(dtype) in (_np.dtype(_np.float64),
                                      _np.dtype(_np.complex128)))
 
@@ -401,10 +399,9 @@ def trsm_panel(side: str, uplo: str, op_a: str, diag: str, a, b, *,
     ``inv_a``: optional precomputed refined inverse of ``a``'s triangle
     (from ``mixed.potrf_inv_refined`` — the fused factor+inverse step),
     consumed only on the mixed path; saves re-deriving the f32 seed solve."""
-    from ..config import get_configuration
+    from ..config import resolved_f64_trsm
 
-    cfg = get_configuration()
-    if (cfg.f64_trsm == "mixed" and a.ndim == 2
+    if (resolved_f64_trsm() == "mixed" and a.ndim == 2
             and a.dtype in (jnp.float64, jnp.complex128)
             and b.dtype == a.dtype):
         from . import mixed as mx

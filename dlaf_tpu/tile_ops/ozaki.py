@@ -101,34 +101,6 @@ _K_CHUNK = 1 << 18
 _K_F32_EXACT = 1 << 12
 
 
-#: one announcement per (backend, choice) resolution of each "auto"
-#: knob, mirroring blas._announced_tiers (round-2 advisory: auto
-#: decisions must not be silent)
-_announced_dot: set = set()
-_announced_group: set = set()
-
-
-def _resolve_auto(value: str, *, tpu_choice: str, other_choice: str,
-                  announced: set, knob: str, detail: str) -> str:
-    """Shared resolve-and-announce for the "auto" knobs: pick per the
-    PROCESS default backend (like blas._oz_slices) and print one stderr
-    announcement per (backend, choice) so the decision is never silent."""
-    if value != "auto":
-        return value
-    import jax
-
-    backend = jax.default_backend()
-    choice = tpu_choice if backend == "tpu" else other_choice
-    if (backend, choice) not in announced:
-        announced.add((backend, choice))
-        import sys
-
-        print(f"dlaf_tpu: {knob}=auto resolved to {choice!r} for default "
-              f"backend {backend!r} ({detail}) — set the knob explicitly "
-              "to override", file=sys.stderr, flush=True)
-    return choice
-
-
 def _slice_dot_impl() -> str:
     """"int8" (s8 x s8 -> s32 dot) or "bf16": cast the slices to bf16 —
     every value is a small integer in [-2^6, 2^6], exactly representable —
@@ -140,11 +112,11 @@ def _slice_dot_impl() -> str:
     path. The "auto" default resolves bf16 on TPU, int8 elsewhere, keyed
     on the PROCESS default backend like blas._oz_slices (config
     ``ozaki_dot``)."""
-    from ..config import get_configuration
+    from ..config import get_configuration, resolve_platform_auto
 
-    return _resolve_auto(
-        get_configuration().ozaki_dot, tpu_choice="bf16",
-        other_choice="int8", announced=_announced_dot, knob="ozaki_dot",
+    return resolve_platform_auto(
+        get_configuration().ozaki_dot, knob="ozaki_dot",
+        tpu_choice="bf16", other_choice="int8",
         detail="routes bit-identical ON DEVICE and at performance parity "
                "at the pipeline level — dot_ab, 2026-08-01 v5e session, "
                "BASELINE.md round 4")
@@ -159,12 +131,11 @@ def _group_impl() -> str:
     2026-08-01 dot_ab session measured concat at 16.6 vs 19.1 ms/step
     on chained trailing syrks and 112.1 vs 105.1 GF/s on full config
     #1, confirming the HBM-traffic model — and dots elsewhere."""
-    from ..config import get_configuration
+    from ..config import get_configuration, resolve_platform_auto
 
-    return _resolve_auto(
-        get_configuration().ozaki_group, tpu_choice="concat",
-        other_choice="dots", announced=_announced_group,
-        knob="ozaki_group",
+    return resolve_platform_auto(
+        get_configuration().ozaki_group, knob="ozaki_group",
+        tpu_choice="concat", other_choice="dots",
         detail="concat measured +7% on config #1 and -13% ms/step on "
                "trailing chains, 2026-08-01 v5e session; bit-identical "
                "results")
