@@ -38,13 +38,25 @@ def session_started():
     # the last 4 h — prefix+mtime rather than an exact-name list so new
     # session scripts are covered without editing this guard, while
     # stale dirs from finished windows don't block host walls forever.
+    # DLAF_HOST_WALLS_FORCE=1 bypasses the mtime-dir signal ONLY, for
+    # runs deliberately chained to start the moment a session finishes
+    # (its dirs are still mtime-fresh then); the live-process signal
+    # stays active either way so a session firing mid-run still aborts
+    # the remaining host walls.
+    force = os.environ.get("DLAF_HOST_WALLS_FORCE", "").lower() \
+        in ("1", "true", "yes")
     import subprocess
     try:
-        if subprocess.run(["pgrep", "-f", r"tpu_session.*\.sh"],
+        # "bash .../tpu_sessionX.sh" = an EXECUTING session script; a bare
+        # "SESSION=...tpu_session4d.sh bash tpu_watch.sh" watcher wrapper
+        # (armed but idle) must not match
+        if subprocess.run(["pgrep", "-f", r"bash [^ ]*tpu_session"],
                           stdout=subprocess.DEVNULL).returncode == 0:
             return True
     except OSError:
         pass
+    if force:
+        return False
     now = time.time()
     try:
         entries = os.listdir(REPO)

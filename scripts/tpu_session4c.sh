@@ -58,4 +58,24 @@ run hegst_d_8192_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
     python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
     -m 8192 -b 256 --nruns 3 --nwarmups 1 --check-result last
 
-session_summary
+# 5. the N=16384 config-#1 OOM (nsweep: RESOURCE_EXHAUSTED on both step
+#    forms): capture the allocation dump so the round-5 chunking lever
+#    targets the actual top allocations, and bracket the single-chip
+#    ceiling with an N=12288 point
+run chol_16384_oom_diag 1200 env DLAF_CHOLESKY_TRAILING=ozaki \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 16384 -b 256 --nruns 1 --nwarmups 0
+run chol_12288_ozaki 1800 env DLAF_CHOLESKY_TRAILING=ozaki \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 12288 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+# 6. the one ladder arm lost to a transient remote-compile error
+run chol_8192_bf16_retry 1800 env DLAF_CHOLESKY_TRAILING=ozaki \
+    DLAF_OZAKI_DOT=bf16 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 8192 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+# SKIP_SUMMARY=1 lets a wrapper session (tpu_session4d.sh) that shares
+# this OUT run the one-per-directory summary itself — summarize_session
+# appends duplicates on re-run
+[ -n "${SKIP_SUMMARY:-}" ] || session_summary

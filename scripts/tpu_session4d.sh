@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Retry session for the next healthy window: the tunnel re-wedged at
+# ~19:52 UTC mid-eig_rehearsal (backend init UNAVAILABLE), so session4c's
+# arms all skipped and config #5's TPU point is still missing. This
+# session re-runs the full 4c ladder (red2band/HEGST under the product
+# mxu knobs, the N=16384 OOM diag, the N=12288 ceiling point, the bf16
+# retry) and then the config-#5 single-chip eigensolver rehearsal —
+# short certain wins first, the long rehearsal last so a mid-window
+# wedge costs the least.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4d_$(date +%m%d_%H%M)}
+export OUT
+# the 4c ladder shares this OUT; suppress its summary — session_summary
+# must run exactly once per directory (it appends duplicates on re-run)
+SKIP_SUMMARY=1 bash scripts/tpu_session4c.sh
+
+source "$(dirname "$0")/session_lib.sh"
+
+# config #5 single-chip rehearsal with the phase table (feeds the TPU
+# secular_device_min_k point); knobs now match the product auto defaults
+# but stay pinned for label stability
+run eig_rehearsal 10800 env DLAF_PROFILE_DIR="$OUT/eig_prof" \
+    DLAF_DIST_STEP_MODE=scan DLAF_CHOLESKY_TRAILING=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 8192 -b 512 --nruns 1 --nwarmups 1 --check-result last
+
+session_summary
