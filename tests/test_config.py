@@ -89,3 +89,93 @@ def test_resolve_step_mode(monkeypatch):
     finally:
         monkeypatch.delenv("DLAF_DIST_STEP_MODE", raising=False)
         config.initialize()
+
+
+def test_resolve_platform_auto(monkeypatch, capsys):
+    """The shared platform-auto resolver (config.resolve_platform_auto):
+    non-auto values pass through silently; "auto" picks per the process
+    default backend and announces once per (knob, backend, choice)."""
+    import jax
+
+    # explicit value: passthrough, no announcement
+    out = C.resolve_platform_auto(
+        "native", knob="t_knob", tpu_choice="mxu", other_choice="native",
+        detail="d")
+    assert out == "native" and capsys.readouterr().err == ""
+
+    for backend, expect in (("cpu", "native"), ("tpu", "mxu")):
+        monkeypatch.setattr(jax, "default_backend", lambda b=backend: b)
+        C._announced_auto.discard(("t_knob", backend, expect))
+        try:
+            got = C.resolve_platform_auto(
+                "auto", knob="t_knob", tpu_choice="mxu",
+                other_choice="native", detail="why-detail")
+            assert got == expect
+            msg = capsys.readouterr().err
+            assert f"t_knob=auto resolved to {expect!r}" in msg
+            assert "why-detail" in msg
+            # second resolution: same answer, announced only once
+            assert C.resolve_platform_auto(
+                "auto", knob="t_knob", tpu_choice="mxu",
+                other_choice="native", detail="why-detail") == expect
+            assert capsys.readouterr().err == ""
+        finally:
+            C._announced_auto.discard(("t_knob", backend, expect))
+
+
+def test_resolved_route_accessors(monkeypatch):
+    """resolved_f64_gemm/resolved_f64_trsm: the bare defaults give the
+    native routes off-TPU and the mxu/mixed routes on TPU; explicit knobs
+    outrank auto on any backend. The announce keys these resolutions add
+    are removed on exit so later announcement-capturing tests stay
+    order-independent."""
+    import jax
+
+    keys = [(k, b, c) for k, b, c in
+            (("f64_gemm", "cpu", "native"), ("f64_trsm", "cpu", "native"),
+             ("f64_gemm", "tpu", "mxu"), ("f64_trsm", "tpu", "mixed"))]
+    pre = {k for k in keys if k in C._announced_auto}
+    C.initialize()  # bare defaults (f64_gemm/f64_trsm = "auto")
+    try:
+        assert C.resolved_f64_gemm() == "native"  # suite runs on CPU
+        assert C.resolved_f64_trsm() == "native"
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert C.resolved_f64_gemm() == "mxu"
+        assert C.resolved_f64_trsm() == "mixed"
+
+        # explicit knob outranks auto even on TPU
+        C.initialize(C.Configuration(f64_gemm="native",
+                                     f64_trsm="native"))
+        assert C.resolved_f64_gemm() == "native"
+        assert C.resolved_f64_trsm() == "native"
+    finally:
+        for k in keys:
+            if k not in pre:
+                C._announced_auto.discard(k)
+        C.initialize()
+
+
+def test_cholesky_trailing_auto_still_validates(monkeypatch):
+    """cholesky_trailing="auto" resolves before the VALID_TRAILING gate,
+    so bogus explicit values still fail fast at the driver."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    m = Matrix.from_global(jnp.asarray(np.eye(8)), TileElementSize(4, 4))
+    out = cholesky("L", m)  # auto default resolves (loop on CPU) and runs
+    np.testing.assert_allclose(np.tril(np.asarray(out.to_numpy())),
+                               np.eye(8), atol=1e-12)
+    monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", "bogus")
+    C.initialize()
+    try:
+        with pytest.raises(Exception, match="cholesky_trailing"):
+            cholesky("L", m)
+    finally:
+        monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
+        C.initialize()
