@@ -137,6 +137,19 @@ class Configuration:
     #: even-shift groups keep their diagonal pair as a second dot to
     #: preserve the transpose-mirroring MAC saving.
     ozaki_group: str = "auto"
+    #: Schedule of the concat group form's per-shift accumulation: "xla"
+    #: (straight-line trace — XLA owns the schedule and may keep several
+    #: (m, n) int32 group partials live at once; the suspected config-#1
+    #: N=16384 OOM, where ~13 live partials of the whole trailing block
+    #: would exceed HBM on their own) or "scan" (lax.scan over
+    #: zero-padded uniform shift groups — the carry forces one partial +
+    #: the f64 accumulator live, O(1) in the slice count; zero int8 pad
+    #: columns contribute exactly nothing on either dot route, so the
+    #: results are bit-identical — tests/test_ozaki.py
+    #: TestScanAccumRoute). Default "xla" pending the armed silicon
+    #: A/B (the 4d OOM diag decides whether the partials are the hog and
+    #: what the scan schedule costs at sizes that fit both ways).
+    ozaki_accum: str = "xla"
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
@@ -268,6 +281,7 @@ _VALID_CHOICES = {
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
     "ozaki_group": ("dots", "concat", "auto"),
+    "ozaki_accum": ("xla", "scan"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve"),

@@ -141,6 +141,41 @@ def _group_impl() -> str:
                "results")
 
 
+def _accum_impl() -> str:
+    """Schedule of the per-shift group accumulation under the concat
+    group form (config ``ozaki_accum``): "xla" (straight-line trace; XLA
+    owns the schedule and MAY keep several (m, n) int32 group partials
+    live at once — the suspected config-#1 N=16384 OOM) or "scan"
+    (``lax.scan`` over zero-padded uniform shift groups: the loop carry
+    forces one partial + the f64 accumulator live, O(1) in the slice
+    count). Bit-identical results — zero int8 pad columns contribute
+    exactly nothing on either dot route. The "dots" group form ignores
+    this knob (its partials are per-pair and XLA fuses them well)."""
+    from ..config import get_configuration
+
+    return get_configuration().ozaki_accum
+
+
+def _group_scales(s):
+    """(s,) f64 per-shift-group fold scales ``2^-q(d+2)`` (cf.
+    :func:`_fold_group`)."""
+    import numpy as np
+
+    return jnp.asarray(
+        [2.0 ** (-SLICE_BITS * (d + 2)) for d in range(s)], dtype=np.float64)
+
+
+def _pad_k(x, k_pad, axis):
+    """Zero-pad int8 slice operand ``x`` to ``k_pad`` along ``axis`` —
+    exact on both dot routes (0 * anything accumulates to 0)."""
+    pad = k_pad - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
 def _dot_bf16(ia, ib):
     """Exact slice contraction over the native bf16 MXU path: bf16
     operands (exact for 7-bit slices), f32 accumulation (exact while
@@ -245,6 +280,25 @@ def _matmul_f64_2d(a, b, *, slices=DEFAULT_SLICES):
         # of the per-pair contractions — so chunking/exactness bounds in
         # _dot_i8/_dot_bf16 apply to (d+1)*k unchanged, and they chunk
         # at depths far above s*k for every supported shape)
+        if _accum_impl() == "scan":
+            # uniform zero-padded groups scanned with an f64 carry: one
+            # int32 partial live instead of (potentially) all s
+            k_pad = s * k
+            ga = jnp.stack([_pad_k(jnp.concatenate(
+                [ia[t] for t in range(d + 1)], axis=-1), k_pad, -1)
+                for d in range(s)])
+            gb = jnp.stack([_pad_k(jnp.concatenate(
+                [ib[d - t] for t in range(d + 1)], axis=-2), k_pad, -2)
+                for d in range(s)])
+
+            def body(carry, xs):
+                a_d, b_d, scale = xs
+                p = _dot_i8(a_d, b_d)
+                return carry + p.astype(jnp.float64) * scale, None
+
+            acc0 = jnp.zeros((a.shape[-2], b.shape[-1]), jnp.float64)
+            acc, _ = lax.scan(body, acc0, (ga, gb, _group_scales(s)))
+            return _apply_scales(acc, sa, sb)
         for d in range(s):
             ga = jnp.concatenate([ia[t] for t in range(d + 1)], axis=-1)
             gb = jnp.concatenate([ib[d - t] for t in range(d + 1)], axis=-2)
@@ -304,16 +358,55 @@ def _syrk_f64_2d(a, *, slices=DEFAULT_SLICES):
         # (mirrored once), plus the even-shift diagonal pair separately —
         # keeps the syrk MAC halving while the pair sums ride the MXU
         # accumulator; exactness as in _matmul_f64_2d's concat branch
+        if _accum_impl() == "scan":
+            # scan form of the same math: half-pair concats zero-padded
+            # to the widest group, the diagonal pair as a zeroed operand
+            # on odd shifts (its dot is then exactly zero — one wasted
+            # (m, k) pass per odd shift, ~1/s of a group's MACs)
+            halves = [[t for t in range(d // 2 + 1) if t != d - t]
+                      for d in range(s)]
+            h_pad = max(max((len(h) for h in halves), default=0), 1) * k
+            zero = jnp.zeros_like(ia[0])
+
+            def half_cat(idx):
+                return _pad_k(jnp.concatenate([ia[t] for t in idx], axis=-1)
+                              if idx else zero, h_pad, -1)
+
+            ga = jnp.stack([half_cat(halves[d]) for d in range(s)])
+            gb = jnp.stack([half_cat([d - t for t in halves[d]])
+                            for d in range(s)])
+            gd = jnp.stack([ia[d // 2] if d % 2 == 0 else zero
+                            for d in range(s)])
+
+            def body(carry, xs):
+                a_d, b_d, d_d, scale = xs
+                # cast BEFORE the elementwise pair sum when the group
+                # magnitude bound exceeds int32 (same guard as the
+                # "dots" branch): g + g.T + diag can wrap in the window
+                # where s*k*2^12 >= 2^31 but the half-concat depth is
+                # still below _dot_i8's own f64-chunking threshold
+                g = cast(_dot_i8(a_d, jnp.swapaxes(b_d, -1, -2)))
+                p = g + jnp.swapaxes(g, -1, -2) \
+                    + cast(_dot_i8(d_d, jnp.swapaxes(d_d, -1, -2)))
+                return carry + p.astype(jnp.float64) * scale, None
+
+            m = a.shape[-2]
+            acc, _ = lax.scan(body, jnp.zeros((m, m), jnp.float64),
+                              (ga, gb, gd, _group_scales(s)))
+            return _apply_scales(acc, sa, jnp.swapaxes(sa, -1, -2))
         for d in range(s):
             half = [t for t in range(d // 2 + 1) if t != d - t]
             p = None
             if half:
                 ga = jnp.concatenate([ia[t] for t in half], axis=-1)
                 gb = jnp.concatenate([ia[d - t] for t in half], axis=-1)
-                g = _dot_i8(ga, jnp.swapaxes(gb, -1, -2))
+                # cast before the elementwise pair sum (see the scan
+                # body above): int32 g + g.T + diag can wrap where
+                # s*k*2^12 >= 2^31 but _dot_i8 still returns int32
+                g = cast(_dot_i8(ga, jnp.swapaxes(gb, -1, -2)))
                 p = g + jnp.swapaxes(g, -1, -2)
             if d % 2 == 0:
-                g = _dot_i8(ia[d // 2], jnp.swapaxes(ia[d // 2], -1, -2))
+                g = cast(_dot_i8(ia[d // 2], jnp.swapaxes(ia[d // 2], -1, -2)))
                 p = g if p is None else p + g
             acc = _fold_group(acc, d, p)
         return _apply_scales(acc, sa, jnp.swapaxes(sa, -1, -2))

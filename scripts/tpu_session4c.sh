@@ -75,6 +75,18 @@ run chol_8192_bf16_retry 1800 env DLAF_CHOLESKY_TRAILING=ozaki \
     python -m dlaf_tpu.miniapp.miniapp_cholesky \
     -m 8192 -b 256 --nruns 2 --nwarmups 1 --check-result last
 
+# 7. ozaki_accum=scan A/B: does the O(1)-live-partials scan schedule fit
+#    the N=16384 config #1 that OOMs under the default XLA schedule, and
+#    what does it cost at a size that fits both ways?
+run chol_16384_accum_scan 2400 env DLAF_CHOLESKY_TRAILING=ozaki \
+    DLAF_OZAKI_ACCUM=scan \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+run chol_4096_accum_scan 1200 env DLAF_CHOLESKY_TRAILING=ozaki \
+    DLAF_OZAKI_ACCUM=scan \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 4096 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
 # SKIP_SUMMARY=1 lets a wrapper session (tpu_session4d.sh) that shares
 # this OUT run the one-per-directory summary itself — summarize_session
 # appends duplicates on re-run

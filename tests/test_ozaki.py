@@ -829,3 +829,99 @@ class TestConcatGroupRoute:
                       "DLAF_F64_TRSM", "DLAF_OZAKI_GROUP"):
                 monkeypatch.delenv(k)
             config.initialize()
+
+
+class TestScanAccumRoute:
+    """ozaki_accum="scan" (lax.scan'd zero-padded shift groups, O(1) live
+    partials) must be BIT-IDENTICAL to the straight-line "xla" schedule
+    under the concat group form — the padded columns are int8 zeros,
+    which contribute exactly nothing on either dot route, and the f64
+    carry folds groups in the same order with the same scales."""
+
+    def _ab(self, monkeypatch, fn, *args, dot):
+        from dlaf_tpu import config
+
+        monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+        monkeypatch.setenv("DLAF_OZAKI_DOT", dot)
+        monkeypatch.setenv("DLAF_OZAKI_ACCUM", "xla")
+        config.initialize()
+        try:
+            ref = np.asarray(fn(*args))
+            monkeypatch.setenv("DLAF_OZAKI_ACCUM", "scan")
+            config.initialize()
+            got = np.asarray(fn(*args))
+        finally:
+            for k in ("DLAF_OZAKI_GROUP", "DLAF_OZAKI_DOT",
+                      "DLAF_OZAKI_ACCUM"):
+                monkeypatch.delenv(k, raising=False)
+            config.initialize()
+        assert got.tobytes() == ref.tobytes()
+
+    @pytest.mark.parametrize("dot", ["int8", "bf16"])
+    @pytest.mark.parametrize("m,k,s", [(64, 48, 7), (33, 256, 8),
+                                       (16, 700, 6)])
+    def test_matmul_bitwise_equal(self, m, k, s, dot, monkeypatch):
+        rng = np.random.default_rng(21)
+        a = rng.standard_normal((m, k)) * 10.0 ** rng.integers(-6, 6, (m, 1))
+        b = rng.standard_normal((k, m)) * 10.0 ** rng.integers(-6, 6, (1, m))
+        self._ab(monkeypatch, lambda x, y: matmul_f64(x, y, slices=s),
+                 jnp.asarray(a), jnp.asarray(b), dot=dot)
+
+    @pytest.mark.parametrize("dot", ["int8", "bf16"])
+    @pytest.mark.parametrize("s", [7, 8])
+    def test_syrk_bitwise_equal(self, s, dot, monkeypatch):
+        rng = np.random.default_rng(22)
+        a = rng.standard_normal((96, 128)) * 10.0 ** rng.integers(-4, 4,
+                                                                  (96, 1))
+        self._ab(monkeypatch, lambda x: syrk_f64(x, slices=s),
+                 jnp.asarray(a), dot=dot)
+
+    def test_accuracy_under_jit(self, monkeypatch):
+        """The scan schedule composes with jit and stays f64-grade."""
+        import jax
+
+        from dlaf_tpu import config
+
+        monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+        monkeypatch.setenv("DLAF_OZAKI_ACCUM", "scan")
+        config.initialize()
+        try:
+            rng = np.random.default_rng(23)
+            a = rng.standard_normal((64, 96))
+            got = np.asarray(jax.jit(
+                lambda x: syrk_f64(x, slices=8))(jnp.asarray(a)))
+            np.testing.assert_allclose(got, a @ a.T, rtol=1e-14, atol=1e-12)
+        finally:
+            monkeypatch.delenv("DLAF_OZAKI_GROUP")
+            monkeypatch.delenv("DLAF_OZAKI_ACCUM")
+            config.initialize()
+
+
+@pytest.mark.parametrize("accum", ["xla", "scan"])
+def test_concat_syrk_int32_wrap_window(accum, monkeypatch):
+    """The concat syrk's elementwise pair sum (g + g.T + diag) must not
+    wrap int32 in the window where s*k*2^12 >= 2^31 but the half-concat
+    depth stays below _dot_i8's own f64-chunking threshold. Adversarial
+    rows: a decoy max of 129/128 makes every unit element normalize to
+    64/129, whose base-128 expansion has balanced digits of EXACTLY
+    +-64 at every level — so each pair dot reaches ~2^28 and a 4-pair
+    half-group sum crosses 2^31 on the unguarded path."""
+    from dlaf_tpu import config
+
+    monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+    monkeypatch.setenv("DLAF_OZAKI_ACCUM", accum)
+    config.initialize()
+    try:
+        # 65543 unit columns: the d=7 half-group sum reaches
+        # -2*4*4096*65543 = -(2^31) - 229376, strictly past INT32_MIN
+        # (65536 columns land at exactly -2^31, which still represents)
+        k = (1 << 16) + 8
+        a = np.ones((8, k))
+        a[:, 0] = 129.0 / 128.0
+        got = np.asarray(syrk_f64(jnp.asarray(a), slices=8))
+        ref = a @ a.T
+        np.testing.assert_allclose(got, ref, rtol=1e-12)
+    finally:
+        monkeypatch.delenv("DLAF_OZAKI_GROUP")
+        monkeypatch.delenv("DLAF_OZAKI_ACCUM")
+        config.initialize()
