@@ -238,6 +238,30 @@ def test_eigensolver_deep_mxu_mixed(grid, monkeypatch):
     assert np.linalg.norm(q.T @ q - np.eye(N)) < 1e-11 * N
 
 
+def test_cholesky_deep_mxu_accum_scan(grid, monkeypatch):
+    """Distributed Cholesky under the full TPU product route (mxu gemms,
+    mixed panels, concat group sums) with ozaki_accum="scan" — the
+    O(1)-live-partials schedule armed as the N=16384 OOM fix must
+    reproduce the same factorization the "xla" schedule gives through
+    the REAL distributed path (shard_map + contract + trsm_panel), not
+    just the 2D tile ops the bitwise unit tests cover."""
+    monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+    monkeypatch.setenv("DLAF_F64_TRSM", "mixed")
+    monkeypatch.setenv("DLAF_OZAKI_GROUP", "concat")
+    a = hpd(N, seed=4)
+    outs = {}
+    for accum in ("xla", "scan"):
+        monkeypatch.setenv("DLAF_OZAKI_ACCUM", accum)
+        config.initialize()
+        outs[accum] = np.tril(cholesky(
+            "L", Matrix.from_global(a, TileElementSize(NB, NB),
+                                    grid=grid)).to_numpy())
+    # bit-identical schedules end to end
+    assert outs["scan"].tobytes() == outs["xla"].tobytes()
+    np.testing.assert_allclose(outs["scan"],
+                               sla.cholesky(a, lower=True), atol=1e-8 * N)
+
+
 def test_slot_alignment_net_has_teeth(grid, monkeypatch):
     """Sabotage check (VERDICT r3 item 6): shift the telescoped segment
     windows one slot late (`uniform_slot_start + 1`) and assert the deep
