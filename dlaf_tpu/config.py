@@ -199,6 +199,23 @@ class Configuration:
     #: ceil(log2 p) hop latencies, the candidate for small diagonal-tile
     #: payloads). First multi-chip ICI access must A/B these.
     bcast_impl: str = "psum"
+    #: Panel Householder-QR factorization route (reduction_to_band's
+    #: reflector panels — the sole geqrf consumer; the QR T-factor
+    #: algorithm takes precomputed reflectors): "geqrf" (the XLA
+    #: primitive — LAPACK on CPU, an XLA-internal expansion on TPU),
+    #: "householder" (tile_ops/qr_panel.py: the same column-Householder
+    #: algorithm in plain jnp ops, which hold the TPU 2xf32 f64-emulation
+    #: grade), or "auto": householder on TPU, geqrf elsewhere. Context:
+    #: the 2026-08-01 session-4d red2band arms FAILED their eigenvalue
+    #: checks at ~1e-5 residual (228x over the 2^-45 budget,
+    #: size-independent — one under-precise factorization step, not
+    #: compounding gemm error) while the identical pipeline on CPU gives
+    #: 8e-16. Default stays "geqrf" until scripts/tpu_geqrf_probe.py
+    #: isolates the culprit on silicon (a small-panel on-device compare
+    #: showed the routes agreeing to 1.4e-13 at (64,16) — the failure may
+    #: live at real panel shapes or in another primitive); flip to "auto"
+    #: when the probe confirms.
+    qr_panel: str = "geqrf"
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -282,6 +299,7 @@ _VALID_CHOICES = {
     "ozaki_dot": ("int8", "bf16", "auto"),
     "ozaki_group": ("dots", "concat", "auto"),
     "ozaki_accum": ("xla", "scan"),
+    "qr_panel": ("geqrf", "householder", "auto"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve"),

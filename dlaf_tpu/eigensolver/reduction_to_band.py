@@ -10,8 +10,9 @@ reflectors column-by-column with dot/scal/gemv/ger micro-kernels on the CPU
 with per-column all-reduces. The TPU-native design replaces all of that with
 dense MXU primitives:
 
-* panel reflectors: ONE ``geqrf`` (XLA's blocked Householder QR) on the whole
-  panel — no column loop, no host round-trip;
+* panel reflectors: ONE ``panel_qr`` (tile_ops/qr_panel.py: XLA geqrf or
+  the jnp householder sweep, per config) on the whole panel — no
+  per-column host round-trip;
 * T factor: closed-form ``larft`` (one gemm + small triangular solve);
 * trailing two-sided update: W = A (V T); M = V^H W; X = W - 1/2 V (T^H M);
   A <- A - X V^H - V X^H — three big gemms (the reference's hemmComputeX /
@@ -40,7 +41,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from jax._src.lax.linalg import geqrf  # public in newer jax; stable primitive
+from ..tile_ops.qr_panel import panel_qr  # geqrf-convention; route per config
 
 from ..config import register_program_cache
 from ..comm import collectives as cc
@@ -84,7 +85,7 @@ def _red2band_local(a, *, nb: int):
         k0, k1 = k * nb, (k + 1) * nb
         m_p = n - k1
         panel = a[k1:, k0:k1]
-        vfull, taus = geqrf(panel)
+        vfull, taus = panel_qr(panel)
         a = a.at[k1:, k0:k1].set(vfull)          # R in upper part, V below
         ntau = taus.shape[0]
         taus_out = taus_out.at[k, :ntau].set(taus)
@@ -134,9 +135,9 @@ def _red2band_local_scan(a, *, nb: int):
             below = rows >= bdy            # (m,)
             raw = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
             pan = jnp.roll(jnp.where(below[:, None], raw, 0), -bdy, axis=0)
-            # pan has m >= 2*nb rows whenever a step runs, so geqrf
+            # pan has m >= 2*nb rows whenever a step runs, so panel_qr
             # returns exactly nb taus; dead columns masked below
-            vfull, taus = geqrf(pan)
+            vfull, taus = panel_qr(pan)
             col_live = jnp.arange(nb) < (n - (k + 1) * nb)
             taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
             taus_out = taus_out.at[k].set(taus)
@@ -211,12 +212,12 @@ def _build_dist_red2band(dist, mesh, dtype, band):
         nrows = ctx.ltr - lu
         arange_nb = jnp.arange(nb)
         m_p = (nt - tr0) * nb - ro
-        vfull, taus = geqrf(pan)
+        vfull, taus = panel_qr(pan)
         ntau = taus.shape[0]
         if ntau < b:
             taus = jnp.pad(taus, (0, b - ntau))
         # null out reflectors beyond the real row count (zero-padded rows
-        # produce tau=0 from geqrf already; this is belt-and-braces)
+        # produce tau=0 from panel_qr already; this is belt-and-braces)
         col_live = jnp.arange(b) < (n - bdy)
         taus = jnp.where(col_live, taus, jnp.zeros_like(taus))
         taus_out = taus_out.at[p].set(taus)
@@ -301,7 +302,7 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
     Uniform-shape scheme: the panel's tile column and in-tile offset are
     traced; the window-height masked column is gathered in static global
     order, top-aligned with a traced ``jnp.roll`` (zero rows below a
-    Householder panel do not perturb its reflectors, so ``geqrf`` of the
+    Householder panel do not perturb its reflectors, so ``panel_qr`` of the
     rolled (nt_w*nb, b) column equals the shrunken panel's factorization
     zero-padded), and the two-sided update runs over the window's slots
     under traced element masks. TELESCOPED like the scan Cholesky: panel
@@ -331,7 +332,7 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
             pan, bdy, tc, co, row_val_e, g_rows, raw = gather_sub_panel_dyn(
                 ctx, lt, p=p, b=b, n=n, row_off=lu_off, col_off=lc_off)
             kc = ctx.kc(tc) - lc_off
-            vfull, taus = geqrf(pan)
+            vfull, taus = panel_qr(pan)
             ntau = taus.shape[0]
             if ntau < b:
                 taus = jnp.pad(taus, (0, b - ntau))

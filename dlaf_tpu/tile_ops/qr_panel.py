@@ -1,0 +1,135 @@
+"""Panel Householder QR with a TPU-trustworthy precision path.
+
+The framework's panel factorizations (reduction_to_band's reflector
+panels — its sole consumer; the QR T-factor algorithm takes already-
+computed reflectors and is unaffected) previously rode XLA's ``geqrf``
+primitive. On CPU that is LAPACK — f64-grade. On TPU the primitive is an
+XLA-internal expansion whose building blocks do not all honor the 2xf32
+f64 emulation: the 2026-08-01 v5e session measured red2band eigenvalue
+residuals of ~1e-5 (roughly size-INdependent — 1.07e-5 at n=4096, 5.3e-6
+at n=8192 — i.e. one under-precise factorization step, not compounding
+ozaki error), while the identical algorithm + knobs on CPU give 8e-16
+(``scripts/tpu_geqrf_probe.py`` localizes the primitive).
+
+The fix is this module's ``householder_qr``: the classical column
+Householder sweep (LAPACK ``geqrf``'s own algorithm — reference tile op
+``dlaf/lapack/tile.h`` geqrf wrapper) expressed in plain jnp elementwise /
+reduction / outer-product ops, which measurably DO hold emulated-f64 grade
+on TPU (the mixed-precision panel machinery and the whole ozaki combine
+path are built on them). One ``lax.fori_loop`` iteration per column keeps
+the compile cost O(1) in the panel width; the per-column work is one
+masked column reduction + one rank-1 update of the trailing columns —
+``m*k`` elements each, the same flop count as any Householder QR. A
+width-``k`` panel costs ``k`` sequential steps; red2band panels are
+``k = band`` (128-512) on ``m`` up to the matrix size.
+
+``panel_qr`` is the drop-in ``geqrf`` replacement used by the algorithm
+layer: it dispatches per the ``qr_panel`` config knob ("auto" = the
+householder sweep on TPU, the LAPACK-backed primitive elsewhere).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["householder_qr", "panel_qr"]
+
+
+def _qr_panel_impl() -> str:
+    """"geqrf" (XLA primitive) or "householder" (this module); "auto"
+    resolves householder on TPU — where the primitive's expansion measured
+    ~1e-5-grade reflectors against this sweep's emulated-f64 grade — and
+    geqrf (= LAPACK) elsewhere."""
+    from ..config import get_configuration, resolve_platform_auto
+
+    return resolve_platform_auto(
+        get_configuration().qr_panel, knob="qr_panel",
+        tpu_choice="householder", other_choice="geqrf",
+        detail="XLA's geqrf expansion measured ~1e-5-grade reflectors on "
+               "the v5e (red2band residuals 228x over budget, session 4d "
+               "2026-08-01); the jnp householder sweep holds emulated-f64 "
+               "grade")
+
+
+@functools.partial(jnp.vectorize, signature="(m,k)->(m,k),(p)")
+def householder_qr(a):
+    """Column Householder QR of a panel ``a``, in ``geqrf``'s output
+    convention: R in the upper triangle (diagonal = the real beta
+    values), the reflector tails strictly below it, and ``taus`` of
+    shape (min(m, k),) with ``H_j = I - tau_j v_j v_j^H``
+    (``v_j[j] = 1``). Matches LAPACK ``*larfg``'s sign choice
+    (``beta = -sign(Re alpha) * ||x||``), zero-tail columns produce
+    ``tau = 0`` exactly as LAPACK does; wide panels (m < k — the ragged
+    final panel of a reduction) reduce min(m, k) columns like geqrf.
+
+    Scope note (documented like tile_ops/ozaki.py): no lassq-style
+    rescaling against overflow of ``sum |x|^2`` — on TPU the f64
+    emulation is range-limited to f32's exponents anyway, and panels here
+    are slices of already well-scaled matrices.
+    """
+    m, k = a.shape
+    kk = min(m, k)                      # columns that get a reflector
+    dtype = a.dtype
+    cplx = jnp.issubdtype(dtype, jnp.complexfloating)
+    rows = jnp.arange(m)
+    cols = jnp.arange(k)
+    taus0 = jnp.zeros((kk,), dtype=dtype)
+
+    def body(j, carry):
+        a, taus = carry
+        col = lax.dynamic_slice_in_dim(a, j, 1, axis=1)[:, 0]   # (m,)
+        alpha = lax.dynamic_slice_in_dim(col, j, 1)[0]
+        below = rows > j
+        tail = jnp.where(below, col, jnp.zeros_like(col))
+        sigma = jnp.sum(jnp.abs(tail) ** 2)                     # real
+        alphr = jnp.real(alpha)
+        norm2 = jnp.abs(alpha) ** 2 + sigma
+        beta_r = -jnp.sign(jnp.where(alphr == 0, jnp.ones_like(alphr),
+                                     alphr)) * jnp.sqrt(norm2)
+        # tau = 0 (null reflector, column already reduced): zero tail and,
+        # for complex, a real diagonal entry
+        null = (sigma == 0) & ((jnp.imag(alpha) == 0) if cplx else True)
+        beta = beta_r.astype(dtype)
+        tau = jnp.where(null, jnp.zeros((), dtype),
+                        ((beta - alpha) / beta).astype(dtype))
+        denom = alpha - beta
+        scale = jnp.where(null, jnp.zeros((), dtype), 1.0 / denom)
+        v = jnp.where(below, col * scale, jnp.zeros_like(col))
+        v = jnp.where(rows == j, jnp.ones((), dtype), v)        # v_j = 1
+        v = jnp.where(rows < j, jnp.zeros((), dtype), v)
+        # apply H^H = I - conj(tau) v v^H to the trailing columns (cols >
+        # j) — LAPACK zgeqr2 applies the ADJOINT reflector there while
+        # storing tau itself for Q = H_1 ... H_k (real: conj is identity).
+        # Earlier columns hold stored reflectors; later rows of col j are
+        # written as the stored tail below.
+        vha = jnp.conj(v) @ a                                    # (k,)
+        upd = jnp.conj(tau) * v[:, None] * vha[None, :]
+        a = a - jnp.where(cols[None, :] > j, upd, jnp.zeros_like(upd))
+        # column j: R above (rows < j untouched), beta on the diagonal
+        # (alpha when null), stored tail below
+        dcol = jnp.where(rows < j, col,
+                         jnp.where(rows == j,
+                                   jnp.where(null, alpha, beta),
+                                   jnp.where(null, col, col * scale)))
+        a = lax.dynamic_update_slice_in_dim(a, dcol[:, None], j, axis=1)
+        taus = jnp.where(jnp.arange(kk) == j, tau, taus)
+        return a, taus
+
+    a, taus = lax.fori_loop(0, kk, body, (a, taus0))
+    return a, taus
+
+
+def panel_qr(a):
+    """Drop-in ``geqrf`` replacement for panel factorizations: returns
+    ``(vfull, taus)`` with R in ``vfull``'s upper triangle and reflector
+    tails below. Dispatches per config ``qr_panel`` (see
+    :func:`_qr_panel_impl`); both routes share output convention, so call
+    sites are route-agnostic."""
+    if _qr_panel_impl() == "householder":
+        return householder_qr(a)
+    from jax._src.lax.linalg import geqrf
+
+    return geqrf(a)

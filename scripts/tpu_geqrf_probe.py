@@ -1,0 +1,136 @@
+"""Localize the red2band residual-check failure (session 4d, 2026-08-01).
+
+Observed: red2band under the product mxu knobs runs 51-107 GF/s on the v5e
+but FAILS its eigenvalue check with a roughly size-independent residual
+(1.07e-5 at n=4096, 5.3e-6 at n=8192, tol ~1e-8), while the identical
+algorithm + knobs on CPU give 8e-16. A size-independent ~100x-f32-eps error
+points at one under-precise building block, and the prime suspect is XLA's
+``geqrf`` primitive (the panel-reflector factorization,
+eigensolver/reduction_to_band.py) — the one primitive in the pipeline the
+(check-passing) cholesky config never exercises.
+
+Probes, each on device with f64 (= 2xf32 emulation on TPU):
+
+1. ``geqrf`` backward error ||A - QR|| / ||A|| and orthogonality
+   ||Q^T Q - I|| on random panels at red2band's shapes — measures the
+   primitive in isolation.
+2. closed-form ``larft`` T-factor consistency: || (I - V T V^T) A_panel -
+   (apply via geqrf's Q) || — separates larft from geqrf.
+3. one full red2band panel+trailing step at n=1024, band=128 on device vs
+   the same step on CPU — end-to-end localization if 1 and 2 come back
+   clean.
+
+Writes one JSON line per probe to stdout; run standalone on a healthy
+tunnel (NOT concurrently with a session arm — HBM is shared).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax._src.lax.linalg import geqrf
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}")
+    rng = np.random.default_rng(7)
+
+    # --- probe 1: geqrf in isolation at red2band panel shapes ------------
+    for (m, k) in [(1024, 128), (4096, 128), (8064, 128), (1024, 512)]:
+        a = rng.standard_normal((m, k))
+        av = jnp.asarray(a, dtype=jnp.float64)
+        v, taus = jax.jit(geqrf)(av)
+        v, taus = np.asarray(v), np.asarray(taus)
+        r = np.triu(v[:k])
+        # accumulate Q explicitly from the reflectors (host, true f64):
+        # any precision loss in v/taus shows up as backward error
+        q = np.eye(m, k)
+        for j in reversed(range(k)):
+            w = np.zeros(m)
+            w[j] = 1.0
+            w[j + 1:] = v[j + 1:, j]
+            q -= taus[j] * np.outer(w, np.conj(w) @ q)
+        back = np.linalg.norm(a - q @ r) / np.linalg.norm(a)
+        orth = np.linalg.norm(q.T @ q - np.eye(k))
+        print(json.dumps({"probe": "geqrf", "m": m, "k": k,
+                          "backward": float(back), "orth": float(orth),
+                          "platform": platform}), flush=True)
+
+    # --- probe 2: larft consistency with geqrf's reflectors -------------
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from dlaf_tpu.tile_ops.lapack import larft
+
+    m, k = 1024, 128
+    a = rng.standard_normal((m, k))
+    av = jnp.asarray(a, dtype=jnp.float64)
+
+    def panel_t(av):
+        vfull, taus = geqrf(av)
+        v = jnp.tril(vfull, -1) + jnp.eye(m, k, dtype=av.dtype)
+        t = larft(v, taus)
+        return vfull, taus, v, t
+
+    vfull, taus, v, t = jax.jit(panel_t)(av)
+    vn, tn = np.asarray(v), np.asarray(t)
+    # (I - V T V^T) A should equal [R; 0] (the QR annihilation)
+    applied = a - vn @ (tn @ (vn.T @ a))
+    resid_below = np.linalg.norm(np.tril(applied, -1)) / np.linalg.norm(a)
+    print(json.dumps({"probe": "larft_apply", "m": m, "k": k,
+                      "below_band": float(resid_below),
+                      "platform": platform}), flush=True)
+
+    # --- probe 3: red2band end-to-end, geqrf vs householder panels ------
+
+    from dlaf_tpu import config
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    n, nb, band = 2048, 512, 128
+
+    def fn(i, j):
+        return np.cos(0.001 * (i * 31 + j * 17)) + np.cos(0.001 * (j * 31 + i * 17))
+
+    for route in ("geqrf", "householder"):
+        os.environ["DLAF_QR_PANEL"] = route
+        config.initialize()
+        ref = Matrix.from_element_fn(fn, GlobalElementSize(n, n),
+                                     TileElementSize(nb, nb),
+                                     dtype=np.float64)
+        red = reduction_to_band(ref, band_size=band)
+        full = red.matrix.to_numpy()
+        aref = ref.to_numpy()
+        bd = np.zeros_like(aref)
+        for rr in range(band + 1):
+            d = np.diagonal(full, -rr)
+            bd += np.diag(d, -rr)
+            if rr:
+                bd += np.diag(d.conj(), rr)
+        w1 = np.linalg.eigvalsh(bd)
+        w2 = np.linalg.eigvalsh(aref)
+        resid = np.abs(w1 - w2).max() / max(np.abs(w2).max(), 1e-30)
+        # how big is what the band extraction silently drops?
+        dropped = np.linalg.norm(np.tril(full, -(band + 1)))
+        print(json.dumps({"probe": f"red2band_n{n}_{route}",
+                          "eig_resid": float(resid),
+                          "dropped_below_band": float(dropped),
+                          "platform": platform}), flush=True)
+    del os.environ["DLAF_QR_PANEL"]
+
+
+if __name__ == "__main__":
+    main()
