@@ -146,10 +146,16 @@ class Configuration:
     #: the f64 accumulator live, O(1) in the slice count; zero int8 pad
     #: columns contribute exactly nothing on either dot route, so the
     #: results are bit-identical — tests/test_ozaki.py
-    #: TestScanAccumRoute). Default "xla" pending the armed silicon
-    #: A/B (the 4d OOM diag decides whether the partials are the hog and
-    #: what the scan schedule costs at sizes that fit both ways).
-    ozaki_accum: str = "xla"
+    #: TestScanAccumRoute). "auto" (default): scan on TPU, xla
+    #: elsewhere. The 2026-08-02 session-4d A/B: at N=4096 (fits both
+    #: ways) the scan schedule measured 119.6 GF/s vs the 112.8
+    #: xla-schedule best (+6% — fewer live int32 partials = less HBM
+    #: traffic), identical residual; the 4d OOM diag confirmed the
+    #: straight-line schedule keeps ~13 GB of ~1 GB trailing-block
+    #: planes live at N=16384 (13.95G program ask vs 15.75G HBM; scan
+    #: still OOMs there via other buffers, but is never worse). Off-TPU
+    #: stays on the straight-line trace (XLA CPU schedules it fine).
+    ozaki_accum: str = "auto"
     #: Ozaki slice-reduction implementation: "jnp" (per-shift int32 groups +
     #: full-f64 combine — f64-grade dots at f64_gemm_slices >= 8) or
     #: "pallas" (fused per-tile kernel, double-f32 fold: ~48 mantissa bits,
@@ -298,7 +304,7 @@ _VALID_CHOICES = {
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
     "ozaki_group": ("dots", "concat", "auto"),
-    "ozaki_accum": ("xla", "scan"),
+    "ozaki_accum": ("xla", "scan", "auto"),
     "qr_panel": ("geqrf", "householder", "auto"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
@@ -403,7 +409,8 @@ _announced_auto: set = set()
 def resolve_platform_auto(value: str, *, knob: str, tpu_choice: str,
                           other_choice: str, detail: str) -> str:
     """Shared resolve-and-announce for the platform-keyed "auto" knobs
-    (ozaki_dot, ozaki_group, f64_gemm, f64_trsm, cholesky_trailing):
+    (ozaki_dot, ozaki_group, ozaki_accum, qr_panel, f64_gemm, f64_trsm,
+    cholesky_trailing — grep for callers rather than trusting this list):
     pick per the PROCESS
     default jax backend — a trace explicitly placed on a non-default
     backend inherits the process choice; set the knob explicitly for

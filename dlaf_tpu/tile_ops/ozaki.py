@@ -145,15 +145,24 @@ def _accum_impl() -> str:
     """Schedule of the per-shift group accumulation under the concat
     group form (config ``ozaki_accum``): "xla" (straight-line trace; XLA
     owns the schedule and MAY keep several (m, n) int32 group partials
-    live at once — the suspected config-#1 N=16384 OOM) or "scan"
-    (``lax.scan`` over zero-padded uniform shift groups: the loop carry
-    forces one partial + the f64 accumulator live, O(1) in the slice
-    count). Bit-identical results — zero int8 pad columns contribute
-    exactly nothing on either dot route. The "dots" group form ignores
-    this knob (its partials are per-pair and XLA fuses them well)."""
-    from ..config import get_configuration
+    live at once — measured at ~13 GB of live ~1 GB planes in the
+    N=16384 OOM diag) or "scan" (``lax.scan`` over zero-padded uniform
+    shift groups: the loop carry forces one partial + the f64
+    accumulator live, O(1) in the slice count). Bit-identical results —
+    zero int8 pad columns contribute exactly nothing on either dot
+    route. "auto" resolves scan on TPU (session-4d A/B: 119.6 vs 112.8
+    GF/s on config #1 at N=4096 — the bounded live set is also the
+    faster HBM schedule) and xla elsewhere. The "dots" group form
+    ignores this knob (its partials are per-pair and XLA fuses them
+    well)."""
+    from ..config import get_configuration, resolve_platform_auto
 
-    return get_configuration().ozaki_accum
+    return resolve_platform_auto(
+        get_configuration().ozaki_accum, knob="ozaki_accum",
+        tpu_choice="scan", other_choice="xla",
+        detail="scan schedule measured 119.6 vs 112.8 GF/s on config #1 "
+               "at N=4096 with an O(1) live-partials bound — session 4d, "
+               "2026-08-02; bit-identical results")
 
 
 def _group_scales(s):

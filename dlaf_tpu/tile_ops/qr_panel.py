@@ -122,6 +122,26 @@ def householder_qr(a):
     return a, taus
 
 
+def rebuild_q(vfull, taus):
+    """Host-side (numpy, true f64) accumulation of the first ``k`` columns
+    of ``Q = H_0 H_1 ... H_{k-1}`` from stored reflectors — the
+    verification oracle shared by the unit tests and
+    ``scripts/tpu_geqrf_probe.py``: any precision loss in ``vfull``/
+    ``taus`` shows up as backward error against the input panel."""
+    import numpy as np
+
+    v = np.asarray(vfull)
+    taus = np.asarray(taus)
+    m, k = v.shape
+    q = np.eye(m, k, dtype=v.dtype)
+    for j in reversed(range(len(taus))):
+        w = np.zeros(m, dtype=v.dtype)
+        w[j] = 1.0
+        w[j + 1:] = v[j + 1:, j]
+        q -= taus[j] * np.outer(w, np.conj(w) @ q)
+    return q
+
+
 def panel_qr(a):
     """Drop-in ``geqrf`` replacement for panel factorizations: returns
     ``(vfull, taus)`` with R in ``vfull``'s upper triangle and reflector

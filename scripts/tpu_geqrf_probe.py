@@ -14,11 +14,13 @@ Probes, each on device with f64 (= 2xf32 emulation on TPU):
 1. ``geqrf`` backward error ||A - QR|| / ||A|| and orthogonality
    ||Q^T Q - I|| on random panels at red2band's shapes — measures the
    primitive in isolation.
-2. closed-form ``larft`` T-factor consistency: || (I - V T V^T) A_panel -
-   (apply via geqrf's Q) || — separates larft from geqrf.
-3. one full red2band panel+trailing step at n=1024, band=128 on device vs
-   the same step on CPU — end-to-end localization if 1 and 2 come back
-   clean.
+2. closed-form ``larft`` T-factor consistency: the below-diagonal part of
+   ``(I - V T V^T) A_panel`` must vanish — separates larft (and its
+   ``triangular_solve``) from geqrf.
+3. full red2band at n=2048, nb=512, band=128 on device, geqrf vs the new
+   ``qr_panel=householder`` route — the end-to-end A/B: if householder
+   PASSES the eigenvalue budget where geqrf FAILs, the primitive is
+   convicted and the route flip is the fix.
 
 Writes one JSON line per probe to stdout; run standalone on a healthy
 tunnel (NOT concurrently with a session arm — HBM is shared).
@@ -43,6 +45,11 @@ def main() -> None:
     import jax.numpy as jnp
     from jax._src.lax.linalg import geqrf
 
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from dlaf_tpu.tile_ops.qr_panel import rebuild_q
+
     platform = jax.devices()[0].platform
     log(f"platform: {platform}")
     rng = np.random.default_rng(7)
@@ -54,14 +61,7 @@ def main() -> None:
         v, taus = jax.jit(geqrf)(av)
         v, taus = np.asarray(v), np.asarray(taus)
         r = np.triu(v[:k])
-        # accumulate Q explicitly from the reflectors (host, true f64):
-        # any precision loss in v/taus shows up as backward error
-        q = np.eye(m, k)
-        for j in reversed(range(k)):
-            w = np.zeros(m)
-            w[j] = 1.0
-            w[j + 1:] = v[j + 1:, j]
-            q -= taus[j] * np.outer(w, np.conj(w) @ q)
+        q = rebuild_q(v, taus)   # host true-f64 oracle (shared helper)
         back = np.linalg.norm(a - q @ r) / np.linalg.norm(a)
         orth = np.linalg.norm(q.T @ q - np.eye(k))
         print(json.dumps({"probe": "geqrf", "m": m, "k": k,
@@ -69,9 +69,6 @@ def main() -> None:
                           "platform": platform}), flush=True)
 
     # --- probe 2: larft consistency with geqrf's reflectors -------------
-    import os
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from dlaf_tpu.tile_ops.lapack import larft
 
     m, k = 1024, 128

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Post-4d opportunistic arms, value-per-minute order, for whatever window
+# remains after the 4d ladder + eig rehearsal:
+#
+# 1. the geqrf probe — decides the round's top code question (is XLA's
+#    geqrf the source of red2band's 228x-over-budget TPU residual, or is
+#    it larft's triangular_solve?) and A/Bs the new qr_panel=householder
+#    route end-to-end at n=2048;
+# 2. red2band 4096 under qr_panel=householder — the exact failing 4d
+#    config, expected to flip check FAILED -> PASSED if the probe
+#    confirms geqrf;
+# 3. N=16384 config #1 on the scan TRAILING form + scan accumulation —
+#    the one untested fit combination (4d: unrolled+xla 13.95G ask,
+#    unrolled+scan still OOM at runtime; the scan step form re-uses one
+#    step's buffers by construction);
+# 4. HEGST d/16384 twosolve — config-#3-family scaling point on the
+#    measured-winning form (385 GF/s at 8192).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4e_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+run geqrf_probe 2400 python scripts/tpu_geqrf_probe.py
+
+run red2band_4096_householder 1800 env DLAF_DIST_STEP_MODE=scan \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed DLAF_QR_PANEL=householder \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 4096 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+
+run chol_16384_scan_scanaccum 2400 env DLAF_CHOLESKY_TRAILING=scan \
+    DLAF_OZAKI_ACCUM=scan DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run hegst_d_16384_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
+    DLAF_F64_GEMM=mxu DLAF_F64_TRSM=mixed \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 16384 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+session_summary

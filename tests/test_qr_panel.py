@@ -15,23 +15,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from dlaf_tpu.tile_ops.qr_panel import householder_qr, panel_qr
-
-
-def _rebuild_q(vfull, taus):
-    """Accumulate Q = H_0 H_1 ... H_{k-1} (first k columns) on the host in
-    true f64 from the stored reflectors — any precision loss in v/taus
-    becomes backward error."""
-    v = np.asarray(vfull)
-    taus = np.asarray(taus)
-    m, k = v.shape
-    q = np.eye(m, k, dtype=v.dtype)
-    for j in reversed(range(k)):
-        w = np.zeros(m, dtype=v.dtype)
-        w[j] = 1.0
-        w[j + 1:] = v[j + 1:, j]
-        q -= taus[j] * np.outer(w, np.conj(w) @ q)
-    return q
+from dlaf_tpu.tile_ops.qr_panel import (householder_qr, panel_qr,
+                                         rebuild_q)
 
 
 @pytest.mark.parametrize("shape", [(64, 16), (33, 16), (16, 16), (257, 32)])
@@ -44,7 +29,7 @@ def test_householder_qr_backward_error(shape, dtype):
     a = a.astype(dtype)
     vfull, taus = householder_qr(jnp.asarray(a))
     r = np.triu(np.asarray(vfull)[: shape[1]])
-    q = _rebuild_q(vfull, taus)
+    q = rebuild_q(vfull, taus)
     m, k = shape
     assert np.linalg.norm(a - q @ r) / np.linalg.norm(a) < 50 * k * 2.3e-16
     assert np.linalg.norm(np.conj(q.T) @ q - np.eye(k)) < 50 * k * 2.3e-16
