@@ -191,14 +191,19 @@ class Configuration:
     #: HEGST (gen_to_std) formulation: "blocked" (per-k two-sided update —
     #: hegst diag, panel trsm/hemm, her2k trailing, deferred trailing
     #: solve — ~n^3 flops, the reference's flop discipline,
-    #: ``eigensolver/gen_to_std/impl.h:200-740``) or "twosolve" (two
+    #: ``eigensolver/gen_to_std/impl.h:200-740``), "twosolve" (two
     #: whole-matrix triangular solves: ~2x the flops as two dense
-    #: MXU-shaped sweeps with no panel round-trips; kept as the
-    #: fallback/check and as the scan-compatible compile-latency hatch —
-    #: both blocked forms (local and distributed) are unrolled-only, so
-    #: when dist_step_mode resolves to "scan" HEGST routes through
-    #: "twosolve" regardless of this knob).
-    hegst_impl: str = "blocked"
+    #: MXU-shaped sweeps with no panel round-trips; also the
+    #: scan-compatible compile-latency hatch — both blocked forms are
+    #: unrolled-only, so when dist_step_mode resolves to "scan" HEGST
+    #: routes through "twosolve" regardless), or "auto" (default):
+    #: twosolve on TPU, blocked elsewhere. Session-4d silicon (d/8192/
+    #: 256, the config-#3-family dtype this tunnel can run): twosolve
+    #: 385.3 GF/s at 5.2e-11 residual vs blocked 298.4 at 2.2e-9 — the
+    #: dense sweeps beat the latency-bound panel round-trips on wall
+    #: clock (same reference flop model for both labels) AND on
+    #: accuracy; off-TPU the ~n^3 blocked discipline wins as before.
+    hegst_impl: str = "auto"
     #: Broadcast realization in comm.collectives.bcast: "psum"
     #: (mask-then-all-reduce — ~2V(p-1)/p per link, the bandwidth shape
     #: for panel payloads) or "tree" (binomial ppermute doubling —
@@ -210,18 +215,18 @@ class Configuration:
     #: algorithm takes precomputed reflectors): "geqrf" (the XLA
     #: primitive — LAPACK on CPU, an XLA-internal expansion on TPU),
     #: "householder" (tile_ops/qr_panel.py: the same column-Householder
-    #: algorithm in plain jnp ops, which hold the TPU 2xf32 f64-emulation
-    #: grade), or "auto": householder on TPU, geqrf elsewhere. Context:
-    #: the 2026-08-01 session-4d red2band arms FAILED their eigenvalue
-    #: checks at ~1e-5 residual (228x over the 2^-45 budget,
-    #: size-independent — one under-precise factorization step, not
-    #: compounding gemm error) while the identical pipeline on CPU gives
-    #: 8e-16. Default stays "geqrf" until scripts/tpu_geqrf_probe.py
-    #: isolates the culprit on silicon (a small-panel on-device compare
-    #: showed the routes agreeing to 1.4e-13 at (64,16) — the failure may
-    #: live at real panel shapes or in another primitive); flip to "auto"
-    #: when the probe confirms.
-    qr_panel: str = "geqrf"
+    #: algorithm in plain jnp ops), or "auto" (default): householder on
+    #: TPU, geqrf elsewhere. History: built as the accuracy suspect for
+    #: the session-4d red2band ~1e-5 check failures; the silicon probes
+    #: EXONERATED geqrf (backward error ~2e-14 at every panel shape —
+    #: the real culprit was the ozaki peel's emulated round,
+    #: tile_ops/ozaki.py _peel_slices). The TPU auto choice stands on
+    #: PERFORMANCE: red2band 4096/512/band128 scan measured 74.9 GF/s
+    #: under householder vs 49.3 under the geqrf expansion (+52%, equal
+    #: 7e-14-grade residuals, post-peel-fix, 2026-08-02 v5e) — the
+    #: fori_loop sweep beats XLA's expansion on this hardware; off-TPU
+    #: geqrf is LAPACK and stays.
+    qr_panel: str = "auto"
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -308,7 +313,7 @@ _VALID_CHOICES = {
     "qr_panel": ("geqrf", "householder", "auto"),
     "mixed_seed": ("xla", "recursive"),
     "dist_step_mode": ("unrolled", "scan", "auto"),
-    "hegst_impl": ("blocked", "twosolve"),
+    "hegst_impl": ("blocked", "twosolve", "auto"),
     "bcast_impl": ("psum", "tree"),
 }
 

@@ -21,18 +21,20 @@ from __future__ import annotations
 import numpy as np
 
 #: Effective machine epsilon of XLA's double-f32 f64 emulation. Per-op
-#: relative error of float-float add/mul is ~2^-48..2^-49, and isolated
-#: composed steps (round-2 TRSM probes) measured ~2^-47.5-grade — but the
-#: full factorization pipeline on silicon lands at ~2^-45.3-grade: the
-#: 2026-08-01 dot_ab session measured the config-#1 Cholesky residual at
-#: 6.112e-9 (n=4096, c=60) IDENTICALLY across all four (dot route x
-#: group form) arms, with the slice dots proven bit-exact on device
-#: (0/65536 mismatches) and the same pipeline measuring 2.3e-15 (~10 eps)
-#: on native-f64 CPU — so the excess is route-independent emulation error
-#: in the surrounding double-f32 ops, and 2^-45 is the
-#: demanding-but-achievable per-op figure for c*n*eps budgets (the
-#: measured 6.112e-9 sits at 0.88x the resulting n=4096 budget).
-EMULATED_F64_EPS = 2.0 ** -45
+#: relative error of float-float add/mul is ~2^-48..2^-49; isolated
+#: composed steps (round-2 TRSM probes) measured ~2^-47.5-grade. The
+#: round-4 history of this constant: the 2026-08-01 dot_ab session
+#: measured a route-independent 6.112e-9 config-#1 residual and this eps
+#: was temporarily relaxed to 2^-45 on the theory of "composed emulation
+#: error" — but the session-4e root-cause hunt found the true source:
+#: the ozaki peel's use of the emulated-f64 ``round``, which mis-rounds
+#: tie+epsilon values and saturates subsequent int8 slices
+#: (tile_ops/ozaki.py _peel_slices). With the peel fixed, the same
+#: pipelines measure 2.7e-15 (cholesky n=4096), 8.0e-15 (n=8192), and
+#: 3.2e-14 / 6.9e-14 (red2band n=4096 eigenvalues, geqrf / householder
+#: panel routes) ON SILICON — true f64 grade — so eps returns to the
+#: per-op figure 2^-47 the probes support.
+EMULATED_F64_EPS = 2.0 ** -47
 
 
 def _real_dtype(dtype) -> np.dtype:

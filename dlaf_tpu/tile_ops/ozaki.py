@@ -79,16 +79,43 @@ def _normalize(x, scale):
 
 def _peel_slices(xn, s: int):
     """``s`` int8 slices of the normalized block: ``xn ~= sum_t I_t 2^-q(t+1)``
-    with every ``|I_t| <= 2^(q-1)`` (round-to-nearest residual peeling)."""
+    with every ``|I_t| <= 2^(q-1)`` (round-to-nearest residual peeling).
+
+    Two hardening rules, both REQUIRED on TPU's 2xf32 f64 emulation
+    (root-caused on the v5e 2026-08-02, ``scripts/tpu_ozaki_peel_probe.py``
+    + ``tpu_peel_dump.py`` — the source of red2band's 2e-5 eigenvalue
+    residual and the dominant term of cholesky's 6.1e-9):
+
+    * The integer is extracted by a NATIVE f32 round — ``r*sc`` is cast
+      to f32 first, then rounded — never by the emulated-f64 ``round``.
+      The emulated round mis-rounds exact round-to-nearest ties plus an
+      epsilon (measured: ``xn*128 = 17.5000005`` rounded to 19, not 18),
+      and the one-unit overshoot pushes the next residual*scale to ~192:
+      OUTSIDE int8, where the f32->s8 conversion saturates at +-127 and
+      every later slice stays pinned at the rail — the decomposition is
+      permanently off by ``~2^-q(t+1)``. The f32 cast loses at most
+      2^-24-relative of ``r*sc`` (|values| <= ~64), which moves the
+      integer choice by at most one unit off a tie — exactly what the
+      next slice absorbs (|I| <= 65, well inside int8).
+    * The residual subtracts the STORED slice value (int8 cast back
+      through f32 — exact for |I| <= 127), so slice and residual cannot
+      disagree whatever the rounding path did; any quantization surprise
+      flows into the next slice instead of corrupting the sum.
+
+    On platforms with true f64 the f32 round differs from an f64 round
+    only by tie-vs-cast-noise unit choices that the residual re-absorbs:
+    accuracy is unchanged (property-tested), though slice values may
+    differ from a pure-f64 peel."""
     out = []
     r = xn
     for t in range(s):
         sc = float(2.0 ** (SLICE_BITS * (t + 1)))
-        it = jnp.round(r * sc)
-        # f32 bridge: small integers cast exactly, and f64->s8 directly
-        # could route through s64 ops the TPU emulation pipeline lacks
-        out.append(it.astype(jnp.float32).astype(jnp.int8))
-        r = r - it * (1.0 / sc)
+        # f32 bridge both ways: native f32 round (see above), and small
+        # integers cast exactly; f64->s8 directly could also route
+        # through s64 ops the TPU emulation pipeline lacks
+        it8 = jnp.round((r * sc).astype(jnp.float32)).astype(jnp.int8)
+        out.append(it8)
+        r = r - it8.astype(jnp.float32).astype(xn.dtype) * (1.0 / sc)
     return out
 
 

@@ -2,26 +2,30 @@
 
 The framework's panel factorizations (reduction_to_band's reflector
 panels — its sole consumer; the QR T-factor algorithm takes already-
-computed reflectors and is unaffected) previously rode XLA's ``geqrf``
-primitive. On CPU that is LAPACK — f64-grade. On TPU the primitive is an
-XLA-internal expansion whose building blocks do not all honor the 2xf32
-f64 emulation: the 2026-08-01 v5e session measured red2band eigenvalue
-residuals of ~1e-5 (roughly size-INdependent — 1.07e-5 at n=4096, 5.3e-6
-at n=8192 — i.e. one under-precise factorization step, not compounding
-ozaki error), while the identical algorithm + knobs on CPU give 8e-16
-(``scripts/tpu_geqrf_probe.py`` localizes the primitive).
+computed reflectors and is unaffected) ride XLA's ``geqrf`` primitive by
+default off-TPU (LAPACK — f64-grade) and this module's ``householder_qr``
+on TPU.
 
-The fix is this module's ``householder_qr``: the classical column
-Householder sweep (LAPACK ``geqrf``'s own algorithm — reference tile op
-``dlaf/lapack/tile.h`` geqrf wrapper) expressed in plain jnp elementwise /
-reduction / outer-product ops, which measurably DO hold emulated-f64 grade
-on TPU (the mixed-precision panel machinery and the whole ozaki combine
-path are built on them). One ``lax.fori_loop`` iteration per column keeps
-the compile cost O(1) in the panel width; the per-column work is one
-masked column reduction + one rank-1 update of the trailing columns —
-``m*k`` elements each, the same flop count as any Householder QR. A
-width-``k`` panel costs ``k`` sequential steps; red2band panels are
-``k = band`` (128-512) on ``m`` up to the matrix size.
+History: built while chasing the session-4d red2band ~1e-5 TPU check
+failures, as the prime-suspect replacement for geqrf. The silicon probes
+(``scripts/tpu_geqrf_probe.py``) then EXONERATED geqrf — its expansion is
+f64-grade on device (backward error ~2e-14 at every red2band panel
+shape); the real culprit was the ozaki peel's use of the emulated-f64
+``round`` (see ``tile_ops/ozaki.py _peel_slices``). The sweep earned the
+TPU default anyway on throughput: red2band 4096/512/band128 scan measured
+74.9 GF/s under it vs 49.3 under the geqrf expansion (+52%, equal
+7e-14-grade residuals, post-peel-fix, 2026-08-02 v5e) — XLA's expansion
+pays per-block dispatch this single fused loop avoids.
+
+``householder_qr`` is the classical column Householder sweep (LAPACK
+``geqrf``'s own algorithm — reference tile op ``dlaf/lapack/tile.h``
+geqrf wrapper) in plain jnp elementwise / reduction / outer-product ops.
+One ``lax.fori_loop`` iteration per column keeps the compile cost O(1) in
+the panel width; the per-column work is one masked column reduction + one
+rank-1 update of the trailing columns — ``m*k`` elements each, the same
+flop count as any Householder QR. A width-``k`` panel costs ``k``
+sequential steps; red2band panels are ``k = band`` (128-512) on ``m`` up
+to the matrix size.
 
 ``panel_qr`` is the drop-in ``geqrf`` replacement used by the algorithm
 layer: it dispatches per the ``qr_panel`` config knob ("auto" = the
@@ -35,23 +39,22 @@ import functools
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["householder_qr", "panel_qr"]
+__all__ = ["householder_qr", "panel_qr", "rebuild_q"]
 
 
 def _qr_panel_impl() -> str:
     """"geqrf" (XLA primitive) or "householder" (this module); "auto"
-    resolves householder on TPU — where the primitive's expansion measured
-    ~1e-5-grade reflectors against this sweep's emulated-f64 grade — and
-    geqrf (= LAPACK) elsewhere."""
+    resolves householder on TPU — a pure PERFORMANCE choice: red2band
+    panels measured +52% under this sweep vs the geqrf expansion at
+    equal (7e-14) accuracy — and geqrf (= LAPACK) elsewhere."""
     from ..config import get_configuration, resolve_platform_auto
 
     return resolve_platform_auto(
         get_configuration().qr_panel, knob="qr_panel",
         tpu_choice="householder", other_choice="geqrf",
-        detail="XLA's geqrf expansion measured ~1e-5-grade reflectors on "
-               "the v5e (red2band residuals 228x over budget, session 4d "
-               "2026-08-01); the jnp householder sweep holds emulated-f64 "
-               "grade")
+        detail="the jnp householder sweep measured 74.9 GF/s vs 49.3 for "
+               "XLA's geqrf expansion on red2band 4096 scan at equal "
+               "7e-14-grade residuals — 2026-08-02 v5e")
 
 
 @functools.partial(jnp.vectorize, signature="(m,k)->(m,k),(p)")

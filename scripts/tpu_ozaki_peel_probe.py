@@ -1,0 +1,152 @@
+"""Bisect INSIDE the ozaki f64 gemm on TPU: peel vs dot vs recombine.
+
+tpu_ozaki_shape_probe.py (2026-08-02 v5e) showed matmul_f64 itself dirty
+at deep contractions on device (3.9e-4 at (1920,1920)@(1920,128), 4.4e-5
+on syrk-2048) while k=128 products are clean — slice-count-independent,
+data-dependent. This splits the route into its three stages:
+
+1. REPRESENTATION: peel slices on device, reconstruct
+   ``sum_t I_t 2^-q(t+1)`` on the host in true f64, compare against the
+   device-normalized operand — is the peel/round/residual loop (all
+   emulated-f64 elementwise ops) producing a faithful decomposition?
+2. DOT+RECOMBINE on KNOWN-GOOD slices: peel on the HOST in true f64,
+   push the int8 slices to device, run the group dots + f64 fold there,
+   compare against the host int-exact oracle — are the MXU dots / int32
+   sums / emulated-f64 fold clean when fed exact slices?
+3. cross: device peel + host-exact dot of those slices — closes the
+   matrix: whichever stage carries the ~1e-4 is convicted.
+
+One JSON line per measurement. Standalone on a healthy tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLICE_BITS = 7
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def host_peel(xn, s):
+    """True-f64 host peeling (the reference decomposition)."""
+    out = []
+    r = xn.copy()
+    for t in range(s):
+        sc = float(2.0 ** (SLICE_BITS * (t + 1)))
+        it = np.round(r * sc)
+        out.append(it.astype(np.int8))
+        r = r - it * (1.0 / sc)
+    return out
+
+
+def host_recombine(ia, ib, s):
+    """Int-exact host oracle of the group dots + fold (f64 throughout)."""
+    acc = np.zeros((ia[0].shape[0], ib[0].shape[1]))
+    for d in range(s):
+        p = np.zeros_like(acc)
+        for t in range(d + 1):
+            p += ia[t].astype(np.int64).T.astype(np.float64).T @ \
+                ib[d - t].astype(np.float64)
+        acc += p * float(2.0 ** (-SLICE_BITS * (d + 2)))
+    return acc
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dlaf_tpu import config
+
+    config.initialize()
+    from dlaf_tpu.tile_ops import ozaki as oz
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}")
+    rng = np.random.default_rng(3)
+    m, k, ncols, s = 1920, 1920, 128, 7
+
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, ncols))
+
+    # device-side normalize + peel (jitted exactly like the product path)
+    def dev_peel(x, axis):
+        sx = oz._scale(x, axis=axis)
+        xn = oz._normalize(x, sx)
+        return oz._peel_slices(xn, s), sx, xn
+
+    (ia_d, sa_d, an_d) = jax.jit(lambda x: dev_peel(x, -1))(jnp.asarray(a))
+    (ib_d, sb_d, bn_d) = jax.jit(lambda x: dev_peel(x, -2))(jnp.asarray(b))
+    ia_d = [np.asarray(x) for x in ia_d]
+    ib_d = [np.asarray(x) for x in ib_d]
+    an_d, bn_d = np.asarray(an_d), np.asarray(bn_d)
+    sa_d, sb_d = np.asarray(sa_d), np.asarray(sb_d)
+
+    # host reference peel of the same normalized operands
+    an_h = (a / sa_d) * 0.5
+    ia_h = host_peel(an_h, s)
+    bn_h = (b / sb_d) * 0.5
+    ib_h = host_peel(bn_h, s)
+
+    # --- probe 1: representation error of the device peel ----------------
+    for label, sl, xn, host_sl in [("peel_A", ia_d, an_d, ia_h),
+                                   ("peel_B", ib_d, bn_d, ib_h)]:
+        recon = sum(sl[t].astype(np.float64) * 2.0 ** (-SLICE_BITS * (t + 1))
+                    for t in range(s))
+        err = np.abs(recon - xn).max()          # vs the DEVICE-stored xn
+        # theoretical floor: dropped mantissa below s*q bits of 1/2-scaled
+        print(json.dumps({"probe": label, "repr_err": float(err),
+                          "budget": 2.0 ** (-SLICE_BITS * (s + 1)),
+                          "platform": platform}), flush=True)
+        # slice agreement with host peel (first diverging slice tells
+        # where the emulated-f64 loop drifts)
+        diverge = next((t for t in range(s)
+                        if not np.array_equal(sl[t], host_sl[t])), None)
+        mism = 0 if diverge is None else int(
+            (sl[diverge] != host_sl[diverge]).sum())
+        print(json.dumps({"probe": label + "_vs_host",
+                          "first_diverging_slice": diverge,
+                          "mismatches_there": mism,
+                          "platform": platform}), flush=True)
+
+    # --- probe 2: device dots+fold on HOST-exact slices -------------------
+    want = host_recombine(ia_h, ib_h, s)
+
+    def dev_dot(ia, ib):
+        acc = None
+        for d in range(s):
+            ga = jnp.concatenate([ia[t] for t in range(d + 1)], axis=-1)
+            gb = jnp.concatenate([ib[d - t] for t in range(d + 1)], axis=-2)
+            p = oz._dot_i8(ga, gb)
+            acc = oz._fold_group(acc, d, p)
+        return acc
+
+    got = jax.jit(dev_dot)(
+        [jnp.asarray(x) for x in ia_h], [jnp.asarray(x) for x in ib_h])
+    err = np.abs(np.asarray(got) - want).max() / max(np.abs(want).max(), 1e-30)
+    print(json.dumps({"probe": "dots_fold_on_exact_slices",
+                      "rel_err": float(err), "platform": platform}),
+          flush=True)
+
+    # --- probe 3: host-exact dot of the DEVICE-peeled slices --------------
+    want_dev = host_recombine(ia_d, ib_d, s)
+    full_host = an_h @ bn_h
+    err = np.abs(want_dev - full_host).max() / max(np.abs(full_host).max(),
+                                                   1e-30)
+    print(json.dumps({"probe": "exact_dot_of_device_slices",
+                      "rel_err_vs_true_product": float(err),
+                      "platform": platform}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

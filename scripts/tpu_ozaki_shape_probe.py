@@ -1,0 +1,128 @@
+"""Isolate WHERE the mxu (ozaki) gemm route loses ~1e-5 in red2band.
+
+The knob bisect (tpu_red2band_bisect.py, 2026-08-02 v5e) convicted
+``f64_gemm=mxu``: native restores 2.5e-14, and the error is
+slice-count-INDEPENDENT (s=7 vs 8 changes digit 8) — not the ozaki
+mantissa bound, but something structural for these operands on device.
+
+Probes, each vs a host-numpy true-f64 oracle:
+
+1. ``matmul_f64`` / ``syrk_f64`` on random operands at red2band's exact
+   shapes ((1920,1920)@(1920,128), (128,1920)@(1920,128), syrk
+   (2048,2048)) — is the routed op itself dirty at shape, or only on the
+   pipeline's actual data?
+2. the first red2band panel step's ACTUAL operands (trail, v, t built on
+   device exactly as _red2band_local does), each product stage compared
+   mxu-vs-host: W = trail @ (v t);  M = v^T W;  X = W - 1/2 v (t^T M);
+   the two-sided update terms X v^T + v X^T.
+
+One JSON line per measurement. Standalone on a healthy tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def rel(got, want):
+    got = np.asarray(got)
+    want = np.asarray(want)
+    scale = max(np.abs(want).max(), 1e-30)
+    return float(np.abs(got - want).max() / scale)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dlaf_tpu import config
+
+    config.initialize()
+    from dlaf_tpu.tile_ops.ozaki import matmul_f64, syrk_f64
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}")
+    rng = np.random.default_rng(3)
+
+    # --- probe 1: routed ops on random operands at shape -----------------
+    m, k = 1920, 128
+    big = rng.standard_normal((m, m))
+    thin = rng.standard_normal((m, k))
+    for label, fn, args, want in [
+        ("matmul_big_thin", matmul_f64, (big, thin), big @ thin),
+        ("matmul_thin_T_big", matmul_f64, (thin.T, big), thin.T @ big),
+        ("syrk_2048", syrk_f64, (big,), big @ big.T),
+    ]:
+        got = jax.jit(fn)(*(jnp.asarray(x) for x in args))
+        print(json.dumps({"probe": label, "rel_err": rel(got, want),
+                          "platform": platform}), flush=True)
+
+    # --- probe 2: the first panel step's actual operands -----------------
+    from jax._src.lax.linalg import geqrf
+
+    from dlaf_tpu.tile_ops.lapack import larft
+
+    n, nb = 2048, 128
+
+    def fn(i, j):
+        return np.cos(0.001 * (i * 31 + j * 17)) + np.cos(0.001 * (j * 31 + i * 17))
+
+    i, j = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+    a_host = fn(i, j)
+    av = jnp.asarray(a_host, dtype=jnp.float64)
+
+    def first_panel(av):
+        panel = av[nb:, 0:nb]
+        vfull, taus = geqrf(panel)
+        v = jnp.tril(vfull, -1) + jnp.eye(n - nb, nb, dtype=av.dtype)
+        t = larft(v, taus)
+        trail = av[nb:, nb:]
+        return v, t, trail
+
+    v, t, trail = jax.jit(first_panel)(av)
+    vh, th, trailh = (np.asarray(x) for x in (v, t, trail))
+
+    vt_h = vh @ th
+    w_h = trailh @ vt_h
+    m_h = vh.T @ w_h
+    x_h = w_h - 0.5 * vh @ (th.T @ m_h)
+    upd_h = trailh - x_h @ vh.T - vh @ x_h.T
+
+    vt = jax.jit(jnp.matmul)(v, t)
+    w = jax.jit(matmul_f64)(trail, vt)
+    print(json.dumps({"probe": "step_W", "rel_err": rel(w, w_h),
+                      "vt_rel": rel(vt, vt_h),
+                      "platform": platform}), flush=True)
+    mm = jax.jit(matmul_f64)(jnp.swapaxes(v, -1, -2), jnp.asarray(w_h))
+    print(json.dumps({"probe": "step_M", "rel_err": rel(mm, m_h),
+                      "platform": platform}), flush=True)
+
+    def xupd(v, t, trail, w, m_):
+        x = w - 0.5 * v @ (t.T @ m_)
+        return trail - matmul_f64(x, jnp.swapaxes(v, -1, -2)) \
+            - matmul_f64(v, jnp.swapaxes(x, -1, -2))
+
+    upd = jax.jit(xupd)(v, t, trail, jnp.asarray(w_h), jnp.asarray(m_h))
+    print(json.dumps({"probe": "step_update", "rel_err": rel(upd, upd_h),
+                      "platform": platform}), flush=True)
+    # the annihilation quality: rows that should be eliminated (band
+    # boundary at nb) — absolute mass below the band in the updated block
+    below = np.tril(np.asarray(upd), -1)[nb:, :]  # noqa - context only
+    print(json.dumps({"probe": "step_done", "platform": platform}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

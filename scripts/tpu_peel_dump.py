@@ -1,0 +1,74 @@
+"""Dump the actual bad entries of the TPU ozaki peel (follow-up to
+tpu_ozaki_peel_probe.py: 6/3.7M entries reconstruct 2^-8 off even with
+the self-consistent residual subtraction — the truncation hypothesis is
+dead; this prints everything about those entries so the real mechanism is
+read off, not guessed)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLICE_BITS = 7
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dlaf_tpu import config
+
+    config.initialize()
+    from dlaf_tpu.tile_ops import ozaki as oz
+
+    rng = np.random.default_rng(3)
+    m, k, s = 1920, 1920, 7
+    a = rng.standard_normal((m, k))
+
+    # device normalize+peel, also return the per-step residuals
+    def dev_peel_debug(x):
+        sx = oz._scale(x, axis=-1)
+        xn = oz._normalize(x, sx)
+        out, resids = [], []
+        r = xn
+        for t in range(s):
+            sc = float(2.0 ** (SLICE_BITS * (t + 1)))
+            it8 = jnp.round(r * sc).astype(jnp.float32).astype(jnp.int8)
+            out.append(it8)
+            r = r - it8.astype(jnp.float32).astype(xn.dtype) * (1.0 / sc)
+            resids.append(r)
+        return xn, sx, out, resids
+
+    xn_d, sx_d, slices_d, resids_d = jax.jit(dev_peel_debug)(jnp.asarray(a))
+    xn_d = np.asarray(xn_d)
+    slices_d = [np.asarray(x) for x in slices_d]
+    resids_d = [np.asarray(x) for x in resids_d]
+
+    recon = sum(slices_d[t].astype(np.float64) * 2.0 ** (-SLICE_BITS * (t + 1))
+                for t in range(s))
+    err = np.abs(recon - xn_d)
+    bad = np.argwhere(err > 1e-6)
+    print(json.dumps({"n_bad": int(len(bad))}), flush=True)
+    for (i, j) in bad[:10]:
+        print(json.dumps({
+            "i": int(i), "j": int(j),
+            "a": repr(float(a[i, j])),
+            "xn_dev": repr(float(xn_d[i, j])),
+            "xn_host_from_a": repr(float((a[i, j] / np.abs(a[i]).max()) * 0.5)),
+            "err": float(err[i, j]),
+            "slices": [int(slices_d[t][i, j]) for t in range(s)],
+            "resids_dev": [repr(float(resids_d[t][i, j])) for t in range(s)],
+            "rowmax": repr(float(np.abs(a[i]).max())),
+            "is_rowmax": bool(np.abs(a[i, j]) == np.abs(a[i]).max()),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

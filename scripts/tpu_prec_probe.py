@@ -1,0 +1,104 @@
+"""Pin the red2band ~1e-5 TPU residual to a specific op (round 4).
+
+Established so far (scripts/tpu_geqrf_probe.py on the v5e, 2026-08-02):
+``geqrf`` is CLEAN on TPU (backward error ~2e-14 at every red2band panel
+shape) and the jnp householder panel sweep reproduces the same ~2e-5
+end-to-end residual — the defect is in the SHARED path after the panel
+factorization. Remaining suspects, probed here in isolation against host
+true-f64 oracles:
+
+1. plain (non-ozaki) f64 ``jnp.matmul`` on device — red2band's larft
+   Gram (V^H V), ``v @ t``, ``t^H @ m`` ride it; the (check-passing)
+   cholesky pipeline routes its big products through ozaki instead. XLA
+   TPU matmul precision semantics make this the top suspect.
+2. the same matmul under ``jax.default_matmul_precision('highest')`` —
+   if 1 is dirty and this is clean, the fix is a precision pin.
+3. ``lax.linalg.triangular_solve`` f64 on device — larft's T-solve.
+4. ``larft`` end-to-end vs a host-numpy T oracle.
+
+One JSON line per probe. Run standalone on a healthy tunnel, not
+concurrently with a session arm (shared HBM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    platform = jax.devices()[0].platform
+    log(f"platform: {platform}")
+    rng = np.random.default_rng(11)
+
+    # --- probe 1+2: plain f64 matmul vs precision pin --------------------
+    m, k = 1024, 128
+    a = rng.standard_normal((m, k))
+    ga_host = a.T @ a
+    av = jnp.asarray(a, dtype=jnp.float64)
+    for label, fn in [
+        ("matmul_default", lambda x: x.T @ x),
+        ("matmul_highest", lambda x: jnp.matmul(
+            x.T, x, precision=lax.Precision.HIGHEST)),
+    ]:
+        g = np.asarray(jax.jit(fn)(av))
+        rel = np.abs(g - ga_host).max() / np.abs(ga_host).max()
+        print(json.dumps({"probe": label, "m": m, "k": k,
+                          "rel_err": float(rel), "platform": platform}),
+              flush=True)
+
+    # small (m,k)@(k,k) like v @ t
+    t_small = rng.standard_normal((k, k))
+    vt_host = a @ t_small
+    got = np.asarray(jax.jit(jnp.matmul)(av, jnp.asarray(t_small)))
+    rel = np.abs(got - vt_host).max() / np.abs(vt_host).max()
+    print(json.dumps({"probe": "matmul_mk_kk_default", "rel_err": float(rel),
+                      "platform": platform}), flush=True)
+
+    # --- probe 3: triangular_solve in isolation ---------------------------
+    # well-conditioned upper triangular (unit-ish diagonal)
+    u = np.triu(rng.standard_normal((k, k)) * 0.1) + np.eye(k)
+    x_host = np.linalg.solve(u, np.eye(k))
+    got = np.asarray(jax.jit(lambda m_: lax.linalg.triangular_solve(
+        m_, jnp.eye(k, dtype=m_.dtype), left_side=True, lower=False))(
+        jnp.asarray(u)))
+    rel = np.abs(got - x_host).max() / np.abs(x_host).max()
+    print(json.dumps({"probe": "triangular_solve", "k": k,
+                      "rel_err": float(rel), "platform": platform}),
+          flush=True)
+
+    # --- probe 4: larft vs host oracle ------------------------------------
+    from jax._src.lax.linalg import geqrf
+
+    from dlaf_tpu.tile_ops.lapack import larft
+
+    vfull, taus = jax.jit(geqrf)(av)
+    v = jnp.tril(vfull, -1) + jnp.eye(m, k, dtype=av.dtype)
+    t_dev = np.asarray(jax.jit(larft)(v, taus))
+    vn = np.asarray(v)
+    tn = np.asarray(taus)
+    tinv = np.triu(vn.T @ vn, 1) + np.diag(1.0 / tn)
+    t_host = np.linalg.solve(tinv, np.eye(k))
+    rel = np.abs(t_dev - t_host).max() / np.abs(t_host).max()
+    print(json.dumps({"probe": "larft", "m": m, "k": k,
+                      "rel_err": float(rel), "platform": platform}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Knob-bisect the red2band ~1e-5 TPU residual (round 4).
+
+Prior probes (tpu_geqrf_probe.py, tpu_prec_probe.py, 2026-08-02 v5e):
+geqrf, larft, triangular_solve, and plain f64 matmul are ALL f64-grade in
+isolation on device, and the panel-QR route swap does not move the ~2e-5
+end-to-end residual. The remaining differences between the failing TPU
+run and the clean CPU control are the ROUTE KNOBS — TPU auto-resolves
+f64_gemm=mxu (slices=7, bf16 dots, concat groups, scan accum) and
+f64_trsm=mixed where CPU used slices=8/int8/dots/xla — plus the platform
+arithmetic itself. This script runs red2band n=2048/nb=512/band=128 on
+device under a knob grid, one subprocess per arm (route knobs are
+trace-time), and prints one JSON line per arm: the first knob whose flip
+restores the ~1e-8 budget is the culprit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+ARMS = [
+    # label, env overrides (on top of the product TPU auto defaults)
+    ("auto_defaults", {}),
+    ("gemm_native", {"DLAF_F64_GEMM": "native"}),
+    ("trsm_native", {"DLAF_F64_TRSM": "native"}),
+    ("slices_8", {"DLAF_F64_GEMM_SLICES": "8"}),
+    ("dot_int8", {"DLAF_OZAKI_DOT": "int8"}),
+    ("group_dots", {"DLAF_OZAKI_GROUP": "dots"}),
+    ("accum_xla", {"DLAF_OZAKI_ACCUM": "xla"}),
+    ("both_native", {"DLAF_F64_GEMM": "native", "DLAF_F64_TRSM": "native"}),
+]
+
+CHILD = r"""
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, %(repo)r)
+from dlaf_tpu import config
+from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+from dlaf_tpu.matrix.matrix import Matrix
+config.initialize()
+n, nb, band = 2048, 512, 128
+def fn(i, j):
+    return np.cos(0.001 * (i * 31 + j * 17)) + np.cos(0.001 * (j * 31 + i * 17))
+ref = Matrix.from_element_fn(fn, GlobalElementSize(n, n),
+                             TileElementSize(nb, nb), dtype=np.float64)
+red = reduction_to_band(ref, band_size=band)
+full = red.matrix.to_numpy()
+aref = ref.to_numpy()
+bd = np.zeros_like(aref)
+for rr in range(band + 1):
+    d = np.diagonal(full, -rr)
+    bd += np.diag(d, -rr)
+    if rr:
+        bd += np.diag(d.conj(), rr)
+w1 = np.linalg.eigvalsh(bd)
+w2 = np.linalg.eigvalsh(aref)
+resid = np.abs(w1 - w2).max() / np.abs(w2).max()
+print(json.dumps({"resid": float(resid),
+                  "platform": jax.devices()[0].platform}), flush=True)
+"""
+
+
+def main() -> None:
+    os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR",
+                          os.path.join(REPO, ".jax_cache"))
+    code = CHILD % {"repo": REPO}
+    for label, overrides in ARMS:
+        env = dict(os.environ)
+        env.update(overrides)
+        try:
+            out = subprocess.run([sys.executable, "-c", code], env=env,
+                                 timeout=900, stdout=subprocess.PIPE,
+                                 stderr=subprocess.DEVNULL)
+            line = out.stdout.decode().strip().splitlines()[-1:]
+            r = json.loads(line[0]) if (out.returncode == 0 and line) else \
+                {"error": f"rc={out.returncode}"}
+        except subprocess.TimeoutExpired:
+            r = {"error": "timeout"}
+        r["arm"] = label
+        r.update(overrides)
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,9 +66,9 @@ def test_tpu_info():
 
 def test_effective_eps_platform_calibration(monkeypatch):
     """Residual-check eps: true dtype eps off-TPU; the double-f32
-    emulation eps (2^-45, labeled — silicon-calibrated, see
-    checks.EMULATED_F64_EPS) for 64-bit dtypes on TPU, where no code
-    path can deliver 2^-53-grade results (miniapp/checks.py)."""
+    emulation eps (2^-47, labeled — silicon-calibrated post peel-fix,
+    see checks.EMULATED_F64_EPS) for 64-bit dtypes on TPU, where no
+    code path can deliver 2^-53-grade results (miniapp/checks.py)."""
     from dlaf_tpu.miniapp import checks
 
     # CPU backend (this suite): nothing widened, no label
@@ -79,7 +79,7 @@ def test_effective_eps_platform_calibration(monkeypatch):
 
     monkeypatch.setattr(checks, "f64_is_emulated", lambda of=None: True)
     eps, label = checks.effective_eps(np.float64)
-    assert eps == checks.EMULATED_F64_EPS and "2^-45" in label
+    assert eps == checks.EMULATED_F64_EPS and "2^-47" in label
     eps_c, label_c = checks.effective_eps(np.complex128)
     assert eps_c == checks.EMULATED_F64_EPS and label_c == label
     # f32 is native on TPU: untouched even when f64 is emulated
