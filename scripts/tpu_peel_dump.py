@@ -32,7 +32,12 @@ def main() -> None:
     m, k, s = 1920, 1920, 7
     a = rng.standard_normal((m, k))
 
-    # device normalize+peel, also return the per-step residuals
+    # device normalize+peel, also return the per-step residuals.
+    # NOTE: this deliberately re-implements the PRE-FIX peel (emulated-f64
+    # jnp.round on the f64 product) rather than calling oz._peel_slices —
+    # the probe exists to reproduce the tie+epsilon mis-round mechanism
+    # the shipped peel no longer has; on a post-fix tunnel n_bad > 0 here
+    # is expected and does NOT indicate product corruption.
     def dev_peel_debug(x):
         sx = oz._scale(x, axis=-1)
         xn = oz._normalize(x, sx)

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Post-peel-fix arms that never got a window, value-per-minute order:
+#
+# 1. N=16384 config #1 on scan TRAILING + scan ACCUM — the one untested
+#    fit combination for the single-chip HBM ceiling (4d: unrolled+xla
+#    asked 13.95G, unrolled+scan still runtime-OOM; the scan step form
+#    re-uses one step's buffers by construction and scan-accum bounds
+#    the live per-shift partials).
+# 2. HEGST d/16384 twosolve — the config-#3-family scaling point that
+#    confirms (or reverts) the hegst_impl=auto twosolve flip measured
+#    at 8192 (364-385 GF/s vs 298 blocked).
+# 3. red2band 16384 retry under the now-default scan accumulation —
+#    config #4 full-size single-chip attempt (4d runtime-OOMed before
+#    ozaki_accum=scan existed).
+# 4. N=12288 config #1 post-fix — re-pin the measured single-chip
+#    ceiling point (188.9 GF/s pre-fix) at true f64 grade.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4f_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+run chol_16384_scan_scanaccum 2400 env DLAF_CHOLESKY_TRAILING=scan \
+    DLAF_OZAKI_ACCUM=scan \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run hegst_d_16384_twosolve 2400 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 16384 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run red2band_16384_scanaccum 2400 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 1 --nwarmups 1 \
+    --check-result last
+
+run chol_12288_postfix 1800 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 12288 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+session_summary
