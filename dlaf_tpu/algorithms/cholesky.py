@@ -45,7 +45,9 @@ from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, pad_diag_identity_dyn,
                             transpose_col_to_rows, transpose_row_to_cols,
                             uniform_slot_start)
-from ..matrix.tiling import storage_tile_grid, tiles_to_global, global_to_tiles
+from ..matrix.tiling import (storage_tile_grid, tiles_to_global,
+                             global_to_tiles, global_to_tiles_donated,
+                             to_global, quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
 from ..tile_ops import mixed as mx
@@ -72,7 +74,8 @@ VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki", "scan")
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"))
+@functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"),
+                   donate_argnums=0)
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
     n = a.shape[0]
     # "ozaki": route the flops-dominant trailing update through int8 MXU
@@ -192,7 +195,8 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
 
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
-                                             "use_mixed"))
+                                             "use_mixed"),
+                   donate_argnums=0)
 def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                          use_mixed: bool = False):
     """``lax.scan`` formulation of the local factorization: ONE compiled
@@ -734,33 +738,46 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
 @functools.lru_cache(maxsize=64)
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
-                          use_oz_pallas=False, scan=False):
+                          use_oz_pallas=False, scan=False, donate=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
+    donate_kw = donate_argnums_kw(donate, 0)
     if scan:
         return jax.jit(_build_dist_cholesky_scan(
             dist, mesh, uplo, use_mxu=use_mxu, use_mixed=use_mixed,
             cplx=dtype.startswith("complex"),
             use_oz_pallas=use_oz_pallas,
-            pallas_interpret=pallas_interpret))
+            pallas_interpret=pallas_interpret), **donate_kw)
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
                                         cplx=dtype.startswith("complex"),
-                                        use_oz_pallas=use_oz_pallas))
+                                        use_oz_pallas=use_oz_pallas),
+                   **donate_kw)
+
+
 
 
 # ---------------------------------------------------------------------------
 # Public API (reference factorization/cholesky.h:36,62)
 # ---------------------------------------------------------------------------
 
-def cholesky(uplo: str, mat: Matrix) -> Matrix:
+def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
     """Factorize the Hermitian positive-definite ``mat`` in the ``uplo``
     triangle: L L^H (uplo='L') or U^H U (uplo='U').
 
     Local (1x1 grid) or distributed over ``mat.grid``'s mesh, like the
     reference's two overloads. Returns a new Matrix whose ``uplo`` triangle
     holds the factor; the other triangle passes through.
+
+    ``donate=True`` donates ``mat``'s device storage to the factorization
+    (the reference's in-place semantics, ``factorization/cholesky.h:36``:
+    its ``mat_a`` IS overwritten): ``mat`` must not be used afterwards.
+    This removes one full-matrix HBM buffer from the peak live set — the
+    difference between fitting and OOM near the single-chip ceiling
+    (N=16384 asked ~14-16 GB of 15.75 with all step forms pre-donation).
+    Internal stage hand-offs (layout transform -> factorization -> layout
+    transform) are always donated; they are owned by this function.
     """
     dlaf_assert(uplo in ("L", "U"), f"cholesky: uplo must be 'L' or 'U', got {uplo!r}")
     from ..config import get_configuration, resolve_platform_auto
@@ -783,14 +800,17 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
     use_mxu = tb.f64_gemm_uses_mxu(dt, mat.block_size.row)
     use_mixed = tb.trsm_panel_uses_mixed(dt)
     if mat.grid is None or mat.grid.num_devices == 1:
-        a = tiles_to_global(mat.storage, mat.dist)
-        if trailing == "scan":
-            out = _cholesky_local_scan(a, uplo=uplo, nb=mat.block_size.row,
-                                       use_mxu=use_mxu, use_mixed=use_mixed)
-        else:
-            out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
-                                  trailing=trailing)
-        return mat.with_storage(global_to_tiles(out, mat.dist))
+        with quiet_donation():
+            a = to_global(mat.storage, mat.dist, donate)
+            if trailing == "scan":
+                out = _cholesky_local_scan(a, uplo=uplo,
+                                           nb=mat.block_size.row,
+                                           use_mxu=use_mxu,
+                                           use_mixed=use_mixed)
+            else:
+                out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
+                                      trailing=trailing)
+            return mat.with_storage(global_to_tiles_donated(out, mat.dist))
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
     # exact-flop predicated contraction (ozaki_impl="pallas"): real f64
     # only (complex keeps the 4-real-product composition), within the
@@ -812,5 +832,6 @@ def cholesky(uplo: str, mat: Matrix) -> Matrix:
                                platform != "tpu",
                                use_mxu, use_mixed,
                                use_oz_pallas,
-                               scan=scan_mode)
-    return mat.with_storage(fn(mat.storage))
+                               scan=scan_mode, donate=donate)
+    with quiet_donation():
+        return mat.with_storage(fn(mat.storage))

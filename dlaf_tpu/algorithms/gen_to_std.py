@@ -59,7 +59,8 @@ from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, transpose_col_to_rows,
                             transpose_row_to_cols)
 from ..matrix.tiling import (global_to_tiles, storage_tile_grid,
-                             tiles_to_global)
+                             tiles_to_global, global_to_tiles_donated,
+                             quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops import mixed as mx
 from ..tile_ops import ozaki as oz
@@ -67,16 +68,24 @@ from ..types import ceil_div
 from .triangular import triangular_solve
 
 
-def _gen_to_std_twosolve(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
-    """Two-whole-solve formulation (see module docstring)."""
+def _gen_to_std_twosolve(uplo: str, a: Matrix, b_factor: Matrix,
+                         donate: bool = False) -> Matrix:
+    """Two-whole-solve formulation (see module docstring). ``ah`` and ``x``
+    are owned intermediates — each solve consumes its rhs, so at most two
+    full matrices of this chain are live at once; ``donate`` additionally
+    consumes ``a`` at the final triangle merge."""
     ah = mops.hermitianize(a, uplo)
     if uplo == "L":
-        x = triangular_solve("L", "L", "N", "N", 1.0, b_factor, ah)
-        y = triangular_solve("R", "L", "C", "N", 1.0, b_factor, x)
+        x = triangular_solve("L", "L", "N", "N", 1.0, b_factor, ah,
+                             donate_b=True)
+        y = triangular_solve("R", "L", "C", "N", 1.0, b_factor, x,
+                             donate_b=True)
     else:
-        x = triangular_solve("L", "U", "C", "N", 1.0, b_factor, ah)
-        y = triangular_solve("R", "U", "N", "N", 1.0, b_factor, x)
-    return mops.merge_triangle(y, a, uplo)
+        x = triangular_solve("L", "U", "C", "N", 1.0, b_factor, ah,
+                             donate_b=True)
+        y = triangular_solve("R", "U", "N", "N", 1.0, b_factor, x,
+                             donate_b=True)
+    return mops.merge_triangle(y, a, uplo, donate_orig=donate)
 
 
 # ---------------------------------------------------------------------------
@@ -111,7 +120,10 @@ def _step_inv(uplo: str, lkk):
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("uplo", "nb"))
+# both operands are the entry point's freshly built global-layout copies
+# (the caller's matrices are re-read only at the final triangle merge)
+@functools.partial(jax.jit, static_argnames=("uplo", "nb"),
+                   donate_argnums=(0, 1))
 def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
     """Unrolled blocked two-sided transform on the global 2D array.
 
@@ -435,15 +447,22 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
 
 @register_program_cache
 @functools.lru_cache(maxsize=64)
-def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu):
+def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu, donate=False):
     return jax.jit(_build_dist_hegst(dist, mesh, uplo, use_mxu=use_mxu,
-                                     cplx=dtype.startswith("complex")))
+                                     cplx=dtype.startswith("complex")),
+                   **donate_argnums_kw(donate, 0))
 
 
-def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
+def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
+               donate: bool = False) -> Matrix:
     """Transform ``a`` (Hermitian, stored in ``uplo``) using ``b_factor`` =
     the Cholesky factor of B (same ``uplo``). Returns the transformed A with
-    its opposite triangle passing through unchanged."""
+    its opposite triangle passing through unchanged.
+
+    ``donate=True`` permits consuming ``a``'s device storage (the
+    reference transforms mat_a in place, ``eigensolver/gen_to_std``);
+    ``a`` must not be used afterwards. ``b_factor`` is never consumed
+    (callers reuse the factor across runs)."""
     dlaf_assert(uplo in ("L", "U"), f"gen_to_std: bad uplo {uplo!r}")
     dlaf_assert(a.size == b_factor.size, "gen_to_std: A/B size mismatch")
     dlaf_assert(a.block_size == b_factor.block_size, "gen_to_std: block mismatch")
@@ -468,19 +487,22 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix) -> Matrix:
         # reroute — at ~19 s/step on the TPU AOT toolchain an unrolled
         # local blocked run would pay the exact O(nt) cold compile the
         # auto step mode exists to avoid (round-3 advisory)
-        return _gen_to_std_twosolve(uplo, a, b_factor)
+        return _gen_to_std_twosolve(uplo, a, b_factor, donate=donate)
     if not distributed:
-        g = tiles_to_global(a.storage, a.dist)
-        lg = tiles_to_global(b_factor.storage, b_factor.dist)
-        out = _hegst_local_blocked(g, lg, uplo=uplo,
-                                   nb=a.block_size.row)
-        out_m = a.with_storage(global_to_tiles(out, a.dist))
-        return mops.merge_triangle(out_m, a, uplo)
+        with quiet_donation():
+            g = tiles_to_global(a.storage, a.dist)
+            lg = tiles_to_global(b_factor.storage, b_factor.dist)
+            out = _hegst_local_blocked(g, lg, uplo=uplo,
+                                       nb=a.block_size.row)
+            out_m = a.with_storage(global_to_tiles_donated(out, a.dist))
+        return mops.merge_triangle(out_m, a, uplo, donate_orig=donate)
     # the blocked builder shares one set of slot indices between A and L
     # (diag/panel reads of ll at A's kr/kc) — both axes must align
     assert_slot_aligned(a.dist, b_factor.dist, rows=True, cols=True,
                         what="gen_to_std(A, B_factor)")
     dt = np.dtype(a.dtype)
     use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
-    fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu)
-    return a.with_storage(fn(a.storage, b_factor.storage))
+    fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu,
+                            donate=donate)
+    with quiet_donation():
+        return a.with_storage(fn(a.storage, b_factor.storage))

@@ -38,7 +38,9 @@ from ..matrix.panel import (DistContext, bcast_diag, bcast_diag_dyn, col_panel,
                             pad_diag_identity_dyn, row_panel, row_panel_dyn,
                             transpose_col_to_rows, transpose_row_to_cols,
                             uniform_slot_start)
-from ..matrix.tiling import global_to_tiles, tiles_to_global
+from ..matrix.tiling import (global_to_tiles, tiles_to_global,
+                             global_to_tiles_donated, to_global,
+                             quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..types import telescope_windows
 
@@ -54,14 +56,18 @@ def _tile_op(t, op: str):
 # Local: direct XLA lowering
 # ---------------------------------------------------------------------------
 
+# the rhs operand (argnum 1) is always the entry point's freshly built
+# global-layout array — donating it bounds peak HBM by one full matrix
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"))
+@functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"),
+                   donate_argnums=1)
 def _solve_local(a, b, alpha, *, side, uplo, op, diag):
     return tb.trsm(side, uplo, op, diag, a, b, alpha=alpha)
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"))
+@functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"),
+                   donate_argnums=1)
 def _mult_local(a, b, alpha, *, side, uplo, op, diag):
     return tb.trmm(side, uplo, op, diag, a, b, alpha=alpha)
 
@@ -473,9 +479,10 @@ def _unit_diag(t, diag):
 @register_program_cache
 @functools.lru_cache(maxsize=128)
 def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
-                       scan=False):
+                       scan=False, donate_b=False):
     build = _build_dist_solve_scan if scan else _build_dist_solve
-    return jax.jit(build(dist_a, dist_b, mesh, side, uplo, op, diag, dtype))
+    return jax.jit(build(dist_a, dist_b, mesh, side, uplo, op, diag, dtype),
+                   **donate_argnums_kw(donate_b, 1))
 
 
 @register_program_cache
@@ -496,16 +503,21 @@ def _check_args(side, a: Matrix, b: Matrix):
 
 
 def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
-                     a: Matrix, b: Matrix) -> Matrix:
+                     a: Matrix, b: Matrix, *, donate_b: bool = False) -> Matrix:
     """``X: op(A) X = alpha B`` (side='L') or ``X op(A) = alpha B`` ('R');
-    all 8 combos, local + distributed (reference ``solver::triangular``)."""
+    all 8 combos, local + distributed (reference ``solver::triangular``).
+
+    ``donate_b=True`` donates ``b``'s device storage (the reference solves
+    in place into ``mat_b``, ``solver/triangular/impl.h``); ``b`` must not
+    be used afterwards. Internal stage hand-offs are always donated."""
     _check_args(side, a, b)
     if a.grid is None or a.grid.num_devices == 1:
-        am = tiles_to_global(a.storage, a.dist)
-        bm = tiles_to_global(b.storage, b.dist)
-        out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
-                           side=side, uplo=uplo, op=op, diag=diag)
-        return b.with_storage(global_to_tiles(out, b.dist))
+        with quiet_donation():
+            bm = to_global(b.storage, b.dist, donate_b)
+            am = tiles_to_global(a.storage, a.dist)
+            out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
+                               side=side, uplo=uplo, op=op, diag=diag)
+            return b.with_storage(global_to_tiles_donated(out, b.dist))
     # the distributed builders combine A's per-slot panels with B's slots
     # on the swept axis — misalignment corrupts silently, so contract it
     assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
@@ -515,8 +527,10 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
                             np.dtype(a.dtype).name,
                             scan=resolve_step_mode(a.dist.nr_tiles.row)
-                            == "scan")
-    return b.with_storage(fn(a.storage, b.storage, jnp.asarray(alpha, b.dtype)))
+                            == "scan", donate_b=donate_b)
+    with quiet_donation():
+        return b.with_storage(fn(a.storage, b.storage,
+                                 jnp.asarray(alpha, b.dtype)))
 
 
 def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
@@ -526,11 +540,12 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
     transposed forms distributed)."""
     _check_args(side, a, b)
     if a.grid is None or a.grid.num_devices == 1:
-        am = tiles_to_global(a.storage, a.dist)
-        bm = tiles_to_global(b.storage, b.dist)
-        out = _mult_local(am, bm, jnp.asarray(alpha, bm.dtype),
-                          side=side, uplo=uplo, op=op, diag=diag)
-        return b.with_storage(global_to_tiles(out, b.dist))
+        with quiet_donation():
+            am = tiles_to_global(a.storage, a.dist)
+            bm = tiles_to_global(b.storage, b.dist)
+            out = _mult_local(am, bm, jnp.asarray(alpha, bm.dtype),
+                              side=side, uplo=uplo, op=op, diag=diag)
+            return b.with_storage(global_to_tiles_donated(out, b.dist))
     assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
                         what="triangular_multiply(A, B)")
     from ..config import resolve_step_mode

@@ -72,7 +72,9 @@ def eigensolver(uplo: str, a: Matrix,
     distributed = a.grid is not None and a.grid.num_devices > 1
     with pt.phase("reduction_to_band"):
         ah = mops.hermitianize(a, uplo)
-        red = reduction_to_band(ah, band_size=band_size)
+        # ah is a fresh hermitianized copy owned by this driver — donate
+        # its storage to the reduction (one full matrix off peak HBM)
+        red = reduction_to_band(ah, band_size=band_size, donate=True)
         fence(red.matrix.storage)
     with pt.phase("band_to_tridiag"):
         band = extract_band(red)

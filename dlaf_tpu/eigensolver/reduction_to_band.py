@@ -52,7 +52,9 @@ from ..matrix.panel import (DistContext, gather_col_panel_ordered,
                             gather_sub_panel, gather_sub_panel_dyn,
                             pad_sub_panel_to_tiles, tiles_of_rolled,
                             uniform_slot_start)
-from ..matrix.tiling import global_to_tiles, storage_tile_grid, tiles_to_global
+from ..matrix.tiling import (global_to_tiles, storage_tile_grid,
+                             tiles_to_global, global_to_tiles_donated,
+                             to_global, quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft
 from ..types import ceil_div, telescope_segments, telescope_windows
@@ -73,7 +75,7 @@ class BandReduction:
 # ---------------------------------------------------------------------------
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("nb",))
+@functools.partial(jax.jit, static_argnames=("nb",), donate_argnums=0)
 def _red2band_local(a, *, nb: int):
     """Panels of width ``nb`` = the target bandwidth (any 1 <= nb <= n; the
     reference's local variant likewise supports band_size < block size,
@@ -103,7 +105,7 @@ def _red2band_local(a, *, nb: int):
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("nb",))
+@functools.partial(jax.jit, static_argnames=("nb",), donate_argnums=0)
 def _red2band_local_scan(a, *, nb: int):
     """``lax.scan`` form of the local reduction (``dist_step_mode="scan"``):
     one compiled panel step — the local unrolled trace costs ~19 s/panel
@@ -418,16 +420,18 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
 
 @register_program_cache
 @functools.lru_cache(maxsize=32)
-def _dist_red2band_cached(dist, mesh, dtype, band, scan=False):
+def _dist_red2band_cached(dist, mesh, dtype, band, scan=False, donate=False):
     build = _build_dist_red2band_scan if scan else _build_dist_red2band
-    return jax.jit(build(dist, mesh, dtype, band))
+    return jax.jit(build(dist, mesh, dtype, band),
+                   **donate_argnums_kw(donate, 0))
 
 
 # ---------------------------------------------------------------------------
 # Public API (reference eigensolver/reduction_to_band.h)
 # ---------------------------------------------------------------------------
 
-def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
+def reduction_to_band(a: Matrix, band_size: int | None = None, *,
+                      donate: bool = False) -> BandReduction:
     """Reduce Hermitian ``a`` (FULL storage — both triangles) to band form.
 
     ``band_size`` (default: block size) sets the bandwidth; it must divide
@@ -437,6 +441,11 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
     requires band == block size (``miniapp_reduction_to_band.cpp:60``).
     Smaller bands shift work from the host bulge-chasing stage (O(n^2 b))
     into this stage's device gemms — the standard two-stage tradeoff knob.
+
+    ``donate=True`` donates ``a``'s device storage to the reduction (the
+    reference's in-place semantics — its ``mat_a`` holds V/R on return);
+    ``a`` must not be used afterwards. One full-matrix HBM buffer off the
+    peak live set; internal stage hand-offs are always donated.
     """
     dlaf_assert(a.size.row == a.size.col, "reduction_to_band: square only")
     dlaf_assert(a.block_size.row == a.block_size.col, "square blocks only")
@@ -452,17 +461,21 @@ def reduction_to_band(a: Matrix, band_size: int | None = None) -> BandReduction:
     # ceil(n/band) - 1 panel steps (the last panel has no trailing block)
     steps = max(-(-a.size.row // band) - 1, 1)
     if a.grid is None or a.grid.num_devices == 1:
-        g = tiles_to_global(a.storage, a.dist)
-        if resolve_step_mode(steps) == "scan":
-            out, taus = _red2band_local_scan(g, nb=band)
-        else:
-            out, taus = _red2band_local(g, nb=band)
-        return BandReduction(a.with_storage(global_to_tiles(out, a.dist)),
-                             taus, band)
+        with quiet_donation():
+            g = to_global(a.storage, a.dist, donate)
+            if resolve_step_mode(steps) == "scan":
+                out, taus = _red2band_local_scan(g, nb=band)
+            else:
+                out, taus = _red2band_local(g, nb=band)
+            return BandReduction(
+                a.with_storage(global_to_tiles_donated(out, a.dist)),
+                taus, band)
     fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name,
                                band,
-                               scan=resolve_step_mode(steps) == "scan")
-    storage, taus = fn(a.storage)
+                               scan=resolve_step_mode(steps) == "scan",
+                               donate=donate)
+    with quiet_donation():
+        storage, taus = fn(a.storage)
     return BandReduction(a.with_storage(storage), taus, band)
 
 

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from ..common.asserts import dlaf_assert
 from .matrix import Matrix
-from .tiling import global_to_tiles, tiles_to_global
+from .tiling import (global_to_tiles, tiles_to_global,
+                     quiet_donation, donate_argnums_kw)
 
 
 def _global_op_jit(dist, sharding, fn):
@@ -78,15 +79,21 @@ def hermitianize(mat: Matrix, uplo: str) -> Matrix:
     return mat.with_storage(fn(mat.storage))
 
 
-def merge_triangle(new: Matrix, orig: Matrix, uplo: str) -> Matrix:
+def merge_triangle(new: Matrix, orig: Matrix, uplo: str, *,
+                   donate_orig: bool = False) -> Matrix:
     """``uplo`` triangle from ``new``, opposite strict triangle from ``orig``
-    (LAPACK in-place update semantics at matrix scope)."""
-    fn = _merge_cached(new.dist, _sharding(new), uplo)
-    return new.with_storage(fn(new.storage, orig.storage))
+    (LAPACK in-place update semantics at matrix scope).
+
+    ``new``'s storage is always donated (every caller passes a freshly
+    computed intermediate); ``donate_orig=True`` also consumes ``orig``'s
+    storage — the final step of an in-place-semantics algorithm entry."""
+    fn = _merge_cached(new.dist, _sharding(new), uplo, donate_orig)
+    with quiet_donation():
+        return new.with_storage(fn(new.storage, orig.storage))
 
 
 @functools.lru_cache(maxsize=128)
-def _merge_cached(dist, sharding, uplo):
+def _merge_cached(dist, sharding, uplo, donate_orig=False):
     def prog(sn, so):
         gn = tiles_to_global(sn, dist)
         go = tiles_to_global(so, dist)
@@ -94,9 +101,9 @@ def _merge_cached(dist, sharding, uplo):
             else jnp.triu(gn) + jnp.tril(go, -1)
         return global_to_tiles(out, dist)
 
-    kw = {}
+    kw = dict(donate_argnums_kw(True, (0, 1) if donate_orig else (0,)))
     if sharding is not None:
-        kw = dict(in_shardings=(sharding, sharding), out_shardings=sharding)
+        kw.update(in_shardings=(sharding, sharding), out_shardings=sharding)
     return jax.jit(prog, **kw)
 
 

@@ -22,8 +22,13 @@ permutations are trace-time constants derived from :class:`Distribution`.
 
 from __future__ import annotations
 
+import contextlib
+import functools
+import warnings
+
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..types import ceil_div
@@ -100,6 +105,53 @@ def tiles_to_global(t, dist: Distribution):
     t = jnp.take(t, jnp.array(pc, dtype=jnp.int32), axis=1)
     a = t.transpose(0, 2, 1, 3).reshape(nt.row * mb, nt.col * nb)
     return a[:m, :n]
+
+
+# Donated jit forms of the two layout transforms, shared by the algorithm
+# entry points for their internal stage hand-offs (layout -> factorize ->
+# layout) and for opt-in input donation (the reference's in-place matrix
+# semantics). Donation removes one full-matrix HBM buffer per hand-off —
+# at the single-chip ceiling (config #1 N=16384 = 2.1 GB/buffer on a
+# 15.75 GB chip) that is the difference between fitting and OOM. No
+# config dependence: these never need program-cache invalidation.
+
+@functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+def global_to_tiles_donated(a, dist: Distribution):
+    return global_to_tiles(a, dist)
+
+
+@functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+def tiles_to_global_donated(t, dist: Distribution):
+    return tiles_to_global(t, dist)
+
+
+@contextlib.contextmanager
+def quiet_donation():
+    """Scope for dispatching donated programs: suppresses jax's
+    "Some donated buffers were not usable" warning INSIDE the library's
+    own calls only (backends that cannot alias a given buffer — e.g.
+    complex128 on XLA:CPU — fall back to a copy, which is exactly the
+    pre-donation behavior; per-call noise, not signal). Donation warnings
+    from the application's own jax code are left untouched."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def to_global(storage, dist: Distribution, donate: bool):
+    """Entry-point helper: tile storage -> global array, optionally
+    consuming ``storage`` (the caller's opt-in input donation). Callers
+    dispatch inside their own :func:`quiet_donation` scope."""
+    if donate:
+        return tiles_to_global_donated(storage, dist)
+    return tiles_to_global(storage, dist)
+
+
+def donate_argnums_kw(donate: bool, argnums) -> dict:
+    """``jax.jit`` kwargs for an optionally donated build (shared by the
+    per-algorithm program caches, which key on the donate flag)."""
+    return {"donate_argnums": argnums} if donate else {}
 
 
 def global_tile_to_storage_index(dist: Distribution, row: int, col: int) -> tuple[int, int]:

@@ -77,7 +77,11 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
         hard_fence(mat.storage)                   # start fence (:134-136)
         t0 = time.perf_counter()
         with ptimer.phase(f"cholesky[{run_i}]"):
-            out = cholesky(args.uplo, mat)
+            # donate: the reference's cholesky overwrites mat_a in place
+            # (factorization/cholesky.h:36); this run's fresh copy is dead
+            # after the call, and the freed buffer is what lets N=16384
+            # fit the single chip
+            out = cholesky(args.uplo, mat, donate=True)
             hard_fence(out.storage)               # end fence (:142-144)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, n**3 / 6, n**3 / 6) / t / 1e9

@@ -68,7 +68,10 @@ def run(argv=None) -> list[dict]:
         mat = ref.with_storage(ref.storage + 0)
         hard_fence(mat.storage)
         t0 = time.perf_counter()
-        red = reduction_to_band(mat, band_size=band)
+        # donate: this run's fresh copy is dead after the call (the
+        # reference overwrites mat_a with V/R in place); frees one
+        # full-matrix HBM buffer — needed headroom at n=16384 single-chip
+        red = reduction_to_band(mat, band_size=band, donate=True)
         hard_fence(red.matrix.storage)
         t = time.perf_counter() - t0
         gflops = total_ops(opts.dtype, 2 * n**3 / 3, 2 * n**3 / 3) / t / 1e9

@@ -75,7 +75,9 @@ def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
     line = {"variant": variant, "platform": platform, "dtype": dtype,
             "n": n, "nb": nb, "gflops": round(float(gflops), 2),
             "t": float(t),
-            "ts": _time.strftime("%Y-%m-%dT%H:%M:%S"), "source": source}
+            # UTC: bench.py's PEEL_FIX_TS pre/post-fix cutoff is UTC-anchored
+            "ts": _time.strftime("%Y-%m-%dT%H:%M:%S", _time.gmtime()),
+            "source": source}
     try:
         with open(os.path.join(repo_root(), ".bench_history.jsonl"),
                   "a") as f:

@@ -1,0 +1,96 @@
+"""AOT memory probe for the single-chip HBM ceiling (config #1 N=16384).
+
+Compiles the local cholesky factorization programs WITHOUT executing them
+and prints ``compiled.memory_analysis()`` — the allocator's own accounting
+of argument/output/temp/alias sizes — so OOM-vs-fit questions are answered
+from the compile service instead of burning measurement-window minutes on
+RESOURCE_EXHAUSTED runs (4b/4d/4f each lost an arm to one).
+
+The probe A/Bs input donation (``cholesky(..., donate=True)``, the
+reference's in-place semantics) against the pre-donation layout on the
+scan trailing + scan accumulation form, the one whose straight-line
+buffers are already bounded.
+
+Usage:  python scripts/tpu_mem_probe.py [-n 16384] [--nb 256] [--unrolled]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fmt(analysis) -> str:
+    gb = 1024 ** 3
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+    parts = []
+    for f in fields:
+        v = getattr(analysis, f, None)
+        if v is not None:
+            parts.append(f"{f.replace('_size_in_bytes', '')}={v / gb:.2f}G")
+    return " ".join(parts) or repr(analysis)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("-n", type=int, default=16384)
+    p.add_argument("--nb", type=int, default=256)
+    p.add_argument("--unrolled", action="store_true",
+                   help="also compile the unrolled ozaki form (pays the "
+                        "~19 s/step AOT constant unless the persistent "
+                        "compilation cache has it)")
+    args = p.parse_args()
+
+    os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR",
+                          os.path.join(os.getcwd(), ".jax_cache"))
+    import jax
+    import jax.numpy as jnp
+
+    from dlaf_tpu import config
+    config.initialize(argv=[])
+    import importlib
+
+    # the algorithms package re-exports the cholesky FUNCTION under the
+    # module's name; go through sys.modules for the module itself
+    C = importlib.import_module("dlaf_tpu.algorithms.cholesky")
+
+    n, nb = args.n, args.nb
+    spec = jax.ShapeDtypeStruct((n, n), jnp.float64)
+    hbm = 15.75  # v5e per-chip budget, GB
+
+    def probe(name, jitted, *a, **kw):
+        try:
+            comp = jitted.lower(*a, **kw).compile()
+        except Exception as e:  # report, keep probing the other arms
+            print(f"{name}: COMPILE FAILED: {type(e).__name__}: "
+                  f"{str(e)[:300]}")
+            return
+        m = comp.memory_analysis()
+        gb = 1024 ** 3
+        tot = sum(getattr(m, f, 0) or 0
+                  for f in ("argument_size_in_bytes", "output_size_in_bytes",
+                            "temp_size_in_bytes"))
+        alias = getattr(m, "alias_size_in_bytes", 0) or 0
+        print(f"{name}: {fmt(m)}  est_live={(tot - alias) / gb:.2f}G "
+              f"(budget {hbm}G)", flush=True)
+
+    # the donated jit IS _cholesky_local_scan since the donation lever;
+    # the undonated control is a fresh jit of the same traced fn
+    probe(f"scan+scanaccum n={n} DONATED", C._cholesky_local_scan,
+          spec, uplo="L", nb=nb, use_mxu=True, use_mixed=True)
+    undonated = jax.jit(
+        C._cholesky_local_scan.__wrapped__,
+        static_argnames=("uplo", "nb", "use_mxu", "use_mixed"))
+    probe(f"scan+scanaccum n={n} undonated", undonated,
+          spec, uplo="L", nb=nb, use_mxu=True, use_mixed=True)
+
+    if args.unrolled:
+        probe(f"unrolled-ozaki n={n} DONATED", C._cholesky_local,
+              spec, uplo="L", nb=nb, trailing="ozaki")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# The donation-lever ladder. Every arm runs the working tree's donated
+# miniapps (cholesky/gen_to_std/red2band entries consume their per-run
+# input copy — the reference's in-place semantics — and internal stage
+# hand-offs are always donated). The 4f N=16384 failures all predate
+# the lever; each full-matrix buffer returned is 2.1 GB at that size.
+#
+# 1. N=16384 config #1, default (unrolled ozaki) knobs — 4d asked
+#    13.95G of 15.75G; donation frees ~4.2G of that ask.
+# 2. N=16384 on scan trailing + scan accum — the bounded-live-set form.
+# 3. N=4096 + N=8192 re-pins under donation (program changed: aliasing)
+#    — headline continuity for bench.py.
+# 4. HEGST d/16384 twosolve donated — 4f runtime-OOMed pre-donation;
+#    twosolve now consumes ah/x at each solve and B at the factor.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4g_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+run chol_16384_donated 2700 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run chol_16384_scan_donated 2400 env DLAF_CHOLESKY_TRAILING=scan \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run chol_4096_donated 1200 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 4096 -b 256 --nruns 3 --nwarmups 1 --check-result last
+
+run chol_8192_donated 1800 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 8192 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run hegst_d_16384_donated 2700 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 16384 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+session_summary

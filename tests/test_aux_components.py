@@ -279,6 +279,36 @@ def test_bench_best_recorded_real_history():
     hist = bench.best_recorded(platform="tpu", n=4096, nb=256)
     assert hist is not None and hist["gflops"] >= 103.0
     assert hist["dtype"] == "float64"
+    # post-peel-fix preference: the config #1 replay must NOT pick a
+    # pre-fix entry (they measured a corrupted decomposition; the stale
+    # best is 119.6 pre-fix vs 117.7 post-fix)
+    assert hist["ts"] >= bench.PEEL_FIX_TS
+
+
+def test_bench_best_recorded_prefix_fallback(tmp_path):
+    # a config only ever measured pre-fix still replays (labeled by its
+    # own ts), rather than silently falling back to the CPU sidecar
+    import json as _json
+    bench = _load_bench_module()
+    rows = [
+        {"platform": "tpu", "n": 2048, "nb": 256, "dtype": "float64",
+         "gflops": 50.0, "ts": "2026-07-31T03:30:00"},
+        {"platform": "tpu", "n": 2048, "nb": 256, "dtype": "float64",
+         "gflops": 40.0, "ts": "2026-08-01T09:00:00"},
+    ]
+    hist_file = tmp_path / ".bench_history.jsonl"
+    hist_file.write_text("\n".join(_json.dumps(r) for r in rows) + "\n")
+    got = bench.best_recorded(platform="tpu", n=2048, nb=256,
+                              path=str(hist_file))
+    assert got is not None and got["gflops"] == 50.0
+    # ...but one post-fix row beats every pre-fix row regardless of gflops
+    with hist_file.open("a") as f:
+        f.write(_json.dumps(
+            {"platform": "tpu", "n": 2048, "nb": 256, "dtype": "float64",
+             "gflops": 45.0, "ts": "2026-08-02T05:00:00"}) + "\n")
+    got = bench.best_recorded(platform="tpu", n=2048, nb=256,
+                              path=str(hist_file))
+    assert got is not None and got["gflops"] == 45.0
 
 
 @pytest.mark.parametrize("uplo", ["G", "L"])

@@ -7,6 +7,7 @@ m<=mb, non-divisible m/mb), both uplos, several grid shapes, and non-zero
 source-rank offsets.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -56,6 +57,68 @@ def test_cholesky_local(uplo, n, nb, dtype):
     mat = Matrix_from(a, nb)
     out = cholesky(uplo, mat).to_numpy()
     check_factor(uplo, a, out, dtype)
+
+
+@pytest.mark.parametrize("grid_shape", [None, (2, 4)])
+def test_cholesky_donate_matches_and_invalidates(grid_shape, devices8):
+    """``donate=True`` (the reference's in-place semantics,
+    factorization/cholesky.h:36) must produce bit-identical factors while
+    consuming the input's device storage — the HBM lever that fits
+    N=16384 on one chip."""
+    n, nb = 24, 4
+    a = hpd_matrix(n, np.float64)
+    grid = Grid(*grid_shape) if grid_shape else None
+    kept = cholesky("L", Matrix_from(a, nb, grid=grid)).to_numpy()
+    mat = Matrix_from(a, nb, grid=grid)
+    donated = cholesky("L", mat, donate=True)
+    np.testing.assert_array_equal(donated.to_numpy(), kept)
+    with pytest.raises(RuntimeError):
+        # the donated storage is dead — any later read must fail loudly
+        np.asarray(jax.device_get(mat.storage))
+
+
+@pytest.mark.parametrize("grid_shape", [None, (2, 4)])
+def test_triangular_solve_donate_b(grid_shape, devices8):
+    """``donate_b=True`` is bit-identical and consumes only ``b``."""
+    import jax
+
+    from dlaf_tpu.algorithms.triangular import triangular_solve
+
+    n, nb = 24, 4
+    rng = np.random.default_rng(11)
+    a = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    b = rng.standard_normal((n, n))
+    grid = Grid(*grid_shape) if grid_shape else None
+    am = Matrix_from(a, nb, grid=grid)
+    kept = triangular_solve("L", "L", "N", "N", 1.0, am,
+                            Matrix_from(b, nb, grid=grid)).to_numpy()
+    bm = Matrix_from(b, nb, grid=grid)
+    donated = triangular_solve("L", "L", "N", "N", 1.0, am, bm,
+                               donate_b=True)
+    np.testing.assert_array_equal(donated.to_numpy(), kept)
+    with pytest.raises(RuntimeError):
+        np.asarray(jax.device_get(bm.storage))
+    # the triangular operand is never consumed
+    np.asarray(jax.device_get(am.storage))
+
+
+@pytest.mark.parametrize("grid_shape", [None, (2, 4)])
+def test_red2band_donate_matches_and_invalidates(grid_shape, devices8):
+    from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+
+    n, nb = 24, 4
+    a = hpd_matrix(n, np.float64)
+    ah = a + a.T - np.diag(np.diag(a))
+    grid = Grid(*grid_shape) if grid_shape else None
+    kept = reduction_to_band(Matrix_from(ah, nb, grid=grid))
+    am = Matrix_from(ah, nb, grid=grid)
+    donated = reduction_to_band(am, donate=True)
+    np.testing.assert_array_equal(donated.matrix.to_numpy(),
+                                  kept.matrix.to_numpy())
+    np.testing.assert_array_equal(np.asarray(donated.taus),
+                                  np.asarray(kept.taus))
+    with pytest.raises(RuntimeError):
+        np.asarray(jax.device_get(am.storage))
 
 
 @pytest.mark.parametrize("uplo", ["L", "U"])
