@@ -56,13 +56,73 @@ def _tile_op(t, op: str):
 # Local: direct XLA lowering
 # ---------------------------------------------------------------------------
 
+def _rhs_chunk_width(side: str, b_shape, dtype) -> int:
+    """Trace-time: free-axis chunk width for a local whole-matrix solve,
+    0 = unchunked (config ``trsm_rhs_chunk``; see the knob docstring).
+    rhs free-axis slices are mathematically independent in a triangular
+    solve, so mapping over chunks is bitwise-identical — it only bounds
+    the live mxu-route workspaces (slices/partials/products) to one
+    chunk's width."""
+    from ..config import get_configuration
+
+    cfg = get_configuration()
+    cw = cfg.trsm_rhs_chunk
+    if cw == 0:
+        return 0
+    m, n = b_shape
+    free, solve_dim = (n, m) if side == "L" else (m, n)
+    if cw > 0:
+        if tb.f64_gemm_uses_mxu(dtype, solve_dim):
+            # bitwise identity requires the chunk width to stay above the
+            # per-gemm mxu gate (blas f64_gemm_min_dim ANDs over ALL gemm
+            # dims incl. the rhs width): a narrower chunk would flip those
+            # gemms to the native route and change the numerics
+            cw = max(cw, cfg.f64_gemm_min_dim)
+        return cw if free > cw else 0
+    # auto: only where the measured OOM lives — TPU, mxu-routed emulated
+    # dtypes, both dimensions large (session 4g: HEGST d/16384 twosolve
+    # RESOURCE_EXHAUSTED with donation already applied)
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return 0
+    if not tb.f64_gemm_uses_mxu(dtype, solve_dim):
+        return 0
+    return 4096 if (solve_dim >= 8192 and free >= 8192) else 0
+
+
 # the rhs operand (argnum 1) is always the entry point's freshly built
 # global-layout array — donating it bounds peak HBM by one full matrix
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("side", "uplo", "op", "diag"),
                    donate_argnums=1)
 def _solve_local(a, b, alpha, *, side, uplo, op, diag):
-    return tb.trsm(side, uplo, op, diag, a, b, alpha=alpha)
+    cw = _rhs_chunk_width(side, b.shape, b.dtype)
+    if not cw:
+        return tb.trsm(side, uplo, op, diag, a, b, alpha=alpha)
+    from jax import lax
+
+    m, n = b.shape
+    free = n if side == "L" else m
+    nc = -(-free // cw)
+    pad = nc * cw - free          # zero columns/rows solve to zero
+    if side == "L":
+        bp = jnp.pad(b, ((0, 0), (0, pad)))
+        # slice each column chunk on the fly (a transposed (nc, m, cw)
+        # operand stack would be a second full-matrix HBM temp — on the
+        # exact path built to avoid one)
+        out = lax.map(
+            lambda i: tb.trsm(side, uplo, op, diag, a,
+                              lax.dynamic_slice(bp, (jnp.zeros((), i.dtype), i),
+                                                (m, cw)),
+                              alpha=alpha),
+            jnp.arange(nc, dtype=jnp.int32) * cw)
+        return jnp.moveaxis(out, 0, 1).reshape(m, nc * cw)[:, :free]
+    bp = jnp.pad(b, ((0, pad), (0, 0)))
+    out = lax.map(
+        lambda bc: tb.trsm(side, uplo, op, diag, a, bc, alpha=alpha),
+        bp.reshape(nc, cw, n))
+    return out.reshape(nc * cw, n)[:free]
 
 
 @register_program_cache

@@ -227,6 +227,20 @@ class Configuration:
     #: fori_loop sweep beats XLA's expansion on this hardware; off-TPU
     #: geqrf is LAPACK and stays.
     qr_panel: str = "auto"
+    #: Column-chunk width for LARGE local triangular solves (elements of
+    #: the rhs free axis; rhs columns — rows for side='R' — are
+    #: mathematically independent, so the solve maps bitwise-identically
+    #: over free-axis chunks). 0 disables; -1 (default) = auto: on TPU,
+    #: chunk at 4096 when both solve dimensions are >= 8192 and the mxu
+    #: route is active — the whole-matrix emulated-f64 solves (HEGST
+    #: twosolve, eigensolver back-substitution) otherwise materialize
+    #: their int8/bf16 operand slices, int32 partials, and f64 products
+    #: at the FULL rhs width simultaneously, the measured single-chip
+    #: OOM at n=16384 (session 4g: HEGST d/16384 RESOURCE_EXHAUSTED with
+    #: donation already applied). lax.map over chunks bounds that live
+    #: set to one chunk's worth; off-TPU the native solves have no such
+    #: workspaces and chunking only costs fusion.
+    trsm_rhs_chunk: int = -1
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -323,6 +337,9 @@ def _validate(cfg: Configuration) -> None:
         v = getattr(cfg, name)
         if v not in allowed:
             raise ValueError(f"configuration {name}={v!r}: must be one of {allowed}")
+    if cfg.trsm_rhs_chunk < -1:
+        raise ValueError(f"trsm_rhs_chunk={cfg.trsm_rhs_chunk}: must be -1 "
+                         "(auto), 0 (off), or a positive chunk width")
     if not 0 <= cfg.f64_gemm_slices <= 9:
         raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in "
                          "[1, 9], or 0 for the platform-adaptive default")

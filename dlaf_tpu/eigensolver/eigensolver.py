@@ -47,7 +47,8 @@ class EigensolverResult:
 
 def eigensolver(uplo: str, a: Matrix,
                 phases: Optional[PhaseTimer] = None,
-                band_size: int | None = None) -> EigensolverResult:
+                band_size: int | None = None, *,
+                donate: bool = False) -> EigensolverResult:
     """Eigendecomposition of Hermitian ``a`` stored in ``uplo``
     (reference ``eigensolver::eigensolver``, ``api.h:28-31``).
 
@@ -58,6 +59,10 @@ def eigensolver(uplo: str, a: Matrix,
 
     ``phases`` (optional :class:`PhaseTimer`) collects per-stage wall times —
     the per-algorithm phase instrumentation SURVEY §5 calls for.
+
+    ``donate=True`` permits consuming ``a``'s device storage at the first
+    stage (the reference pipeline overwrites mat_a throughout); ``a`` must
+    not be used afterwards.
     """
     dlaf_assert(a.size.row == a.size.col, "eigensolver: square only")
     n = a.size.row
@@ -71,9 +76,10 @@ def eigensolver(uplo: str, a: Matrix,
              else (lambda x: None))
     distributed = a.grid is not None and a.grid.num_devices > 1
     with pt.phase("reduction_to_band"):
-        ah = mops.hermitianize(a, uplo)
-        # ah is a fresh hermitianized copy owned by this driver — donate
-        # its storage to the reduction (one full matrix off peak HBM)
+        # ``donate`` consumes a's storage at the hermitianize; ah itself
+        # is always a fresh copy owned by this driver — donate it to the
+        # reduction (one full matrix off peak HBM either way)
+        ah = mops.hermitianize(a, uplo, donate=donate)
         red = reduction_to_band(ah, band_size=band_size, donate=True)
         fence(red.matrix.storage)
     with pt.phase("band_to_tridiag"):
@@ -113,10 +119,14 @@ def eigensolver(uplo: str, a: Matrix,
 
 def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
                     phases: Optional[PhaseTimer] = None,
-                    band_size: int | None = None) -> EigensolverResult:
+                    band_size: int | None = None, *,
+                    donate: bool = False) -> EigensolverResult:
     """Generalized problem ``A x = lambda B x`` with Hermitian ``a`` and
     HPD ``b`` (reference ``eigensolver::genEigensolver``, ``api.h:17-21``;
-    LOCAL-only in the reference — here every stage also runs distributed)."""
+    LOCAL-only in the reference — here every stage also runs distributed).
+
+    ``donate=True`` permits consuming ``a``'s storage; ``b`` is never
+    consumed (its factor is formed from an undonated read)."""
     dlaf_assert(a.size == b.size, "gen_eigensolver: A/B size mismatch")
     pt = phases if phases is not None else PhaseTimer()
     fence = (hard_fence if phases is not None
@@ -125,16 +135,21 @@ def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
         bf = cholesky(uplo, b)
         fence(bf.storage)
     with pt.phase("gen_to_std"):
-        astd = gen_to_std(uplo, a, bf)
+        astd = gen_to_std(uplo, a, bf, donate=donate)
         fence(astd.storage)
-    res = eigensolver(uplo, astd, phases=phases, band_size=band_size)
+    # astd is owned by this driver — always donated into the pipeline
+    res = eigensolver(uplo, astd, phases=phases, band_size=band_size,
+                      donate=True)
     # back-substitute eigenvectors (reference gen_eigensolver/impl.h:24-35):
     # uplo=L: B = L L^H, standard vec y -> x = L^-H y
     # uplo=U: B = U^H U,                x = U^-1 y
     with pt.phase("back_substitution"):
+        # res.eigenvectors is owned by this driver — donated into the solve
         if uplo == "L":
-            vecs = triangular_solve("L", "L", "C", "N", 1.0, bf, res.eigenvectors)
+            vecs = triangular_solve("L", "L", "C", "N", 1.0, bf,
+                                    res.eigenvectors, donate_b=True)
         else:
-            vecs = triangular_solve("L", "U", "N", "N", 1.0, bf, res.eigenvectors)
+            vecs = triangular_solve("L", "U", "N", "N", 1.0, bf,
+                                    res.eigenvectors, donate_b=True)
         fence(vecs.storage)
     return EigensolverResult(res.eigenvalues, vecs)

@@ -24,20 +24,20 @@ from .tiling import (global_to_tiles, tiles_to_global,
                      quiet_donation, donate_argnums_kw)
 
 
-def _global_op_jit(dist, sharding, fn):
+def _global_op_jit(dist, sharding, fn, donate=False):
     """jit storage->storage running ``fn`` on the global view."""
     def prog(storage):
         g = tiles_to_global(storage, dist)
         return global_to_tiles(fn(g), dist)
 
-    kw = {}
+    kw = dict(donate_argnums_kw(donate, 0))
     if sharding is not None:
-        kw = dict(in_shardings=sharding, out_shardings=sharding)
+        kw.update(in_shardings=sharding, out_shardings=sharding)
     return jax.jit(prog, **kw)
 
 
 @functools.lru_cache(maxsize=256)
-def _cached_global_op(dist, sharding, name, extra=None):
+def _cached_global_op(dist, sharding, name, extra=None, donate=False):
     fns = {
         "transpose": lambda g: jnp.swapaxes(g, 0, 1),
         "conj_transpose": lambda g: jnp.conj(jnp.swapaxes(g, 0, 1)),
@@ -47,7 +47,7 @@ def _cached_global_op(dist, sharding, name, extra=None):
         "triu": lambda g: jnp.triu(g),
         "copy": lambda g: g,
     }
-    return _global_op_jit(dist, sharding, fns[name])
+    return _global_op_jit(dist, sharding, fns[name], donate)
 
 
 def _herm(g, uplo):
@@ -72,11 +72,14 @@ def transpose(mat: Matrix, conj: bool = True) -> Matrix:
     return mat.with_storage(fn(mat.storage))
 
 
-def hermitianize(mat: Matrix, uplo: str) -> Matrix:
+def hermitianize(mat: Matrix, uplo: str, *, donate: bool = False) -> Matrix:
     """Full Hermitian matrix from its stored ``uplo`` triangle
-    (the whole-matrix ``hermitian_from``)."""
-    fn = _cached_global_op(mat.dist, _sharding(mat), f"hermitianize_{uplo}")
-    return mat.with_storage(fn(mat.storage))
+    (the whole-matrix ``hermitian_from``). ``donate=True`` permits
+    consuming ``mat``'s storage."""
+    fn = _cached_global_op(mat.dist, _sharding(mat), f"hermitianize_{uplo}",
+                           donate=donate)
+    with quiet_donation():
+        return mat.with_storage(fn(mat.storage))
 
 
 def merge_triangle(new: Matrix, orig: Matrix, uplo: str, *,

@@ -78,8 +78,10 @@ def run(argv=None) -> list[dict]:
         b_in = bm.with_storage(bm.storage + 0)
         hard_fence(b_in.storage)
         t0 = time.perf_counter()
+        # donate_b: the reference solves in place into mat_b; this run's
+        # fresh copy is dead after the call
         out = triangular_solve(args.side, args.uplo, args.op, args.diag, 1.0,
-                               am, b_in)
+                               am, b_in, donate_b=True)
         hard_fence(out.storage)
         t = time.perf_counter() - t0
         gflops = trsm_flops(opts.dtype, args.side, m, n) / t / 1e9

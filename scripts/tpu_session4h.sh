@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Donation re-pins + the newly-fitting scaling points, value-per-minute:
+#
+# 1. red2band 12288/512/band128 — first-ever config-#4-family point
+#    above 8192 on one chip (16384 asked 19.28G; ~(12/16)^2 scaling
+#    puts 12288 inside budget with donation).
+# 2. HEGST d/12288 twosolve — same logic for the config-#3 family
+#    (16384 still OOMs donated; 12288 should fit).
+# 3. TRSM config #2 re-pin under donate_b (131 GF/s pre-donation).
+# 4. red2band 8192 donated re-pin (142.4 pre-donation).
+# 5. eigensolver 8192 rehearsal re-pin (donation now rides the
+#    dominant red2band stage; 158.5 s pre-donation).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session4h_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+run red2band_12288 2700 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 12288 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+
+run hegst_d_12288_twosolve 2700 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 12288 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run trsm_8192_donated 1800 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1 --check-result last
+
+run red2band_8192_donated 1800 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 8192 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+
+run eig_8192_donated 2700 \
+    python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 8192 -b 512 --nruns 1 --check-result last
+
+session_summary

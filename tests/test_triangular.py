@@ -248,3 +248,42 @@ def test_solve_distributed_misaligned_sources_raise(devices8):
         triangular_solve("R", "L", "C", "N", 1.0, am, bm)
     with pytest.raises(DlafAssertError, match="misaligned"):
         triangular_multiply("L", "L", "N", "N", 1.0, am, bm)
+
+@pytest.mark.parametrize("side,uplo,op,diag",
+                         [("L", "L", "N", "N"), ("R", "U", "C", "N")])
+@pytest.mark.parametrize("mxu", [False, True])
+def test_trsm_rhs_chunk_bitwise_identical(side, uplo, op, diag, mxu,
+                                          monkeypatch):
+    """Free-axis chunking of the local whole-matrix solve (config
+    ``trsm_rhs_chunk``) is bitwise-identical to the unchunked form —
+    rhs columns (rows for side='R') are independent — on both the
+    native and the mxu route, including a non-divisible free axis."""
+    import dlaf_tpu.config as config
+
+    n, m = (48, 37) if side == "L" else (37, 48)
+    a, b = make_ab(n, m, np.float64, side, seed=7)
+    nb = 8
+    if mxu:
+        monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+        # min_dim=32 > the requested chunk width of 16: the mxu arm also
+        # verifies the clamp that keeps chunking from flipping per-gemm
+        # routes (blas gates on min over ALL gemm dims incl. rhs width)
+        monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "32")
+    config.initialize()
+    try:
+        am, bm = mats(a, b, nb, nb)
+        kept = triangular_solve(side, uplo, op, diag, 1.0, am, bm).to_numpy()
+        monkeypatch.setenv("DLAF_TRSM_RHS_CHUNK", "16")
+        config.initialize()
+        if mxu:
+            from dlaf_tpu.algorithms.triangular import _rhs_chunk_width
+            assert _rhs_chunk_width(side, b.shape, np.float64) == 32
+        am, bm = mats(a, b, nb, nb)
+        chunked = triangular_solve(side, uplo, op, diag, 1.0, am,
+                                   bm).to_numpy()
+        np.testing.assert_array_equal(chunked, kept)
+    finally:
+        monkeypatch.delenv("DLAF_TRSM_RHS_CHUNK", raising=False)
+        monkeypatch.delenv("DLAF_F64_GEMM", raising=False)
+        monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM", raising=False)
+        config.initialize()
