@@ -63,32 +63,13 @@ def _rhs_chunk_width(side: str, b_shape, dtype) -> int:
     solve, so mapping over chunks is bitwise-identical — it only bounds
     the live mxu-route workspaces (slices/partials/products) to one
     chunk's width."""
-    from ..config import get_configuration
-
-    cfg = get_configuration()
-    cw = cfg.trsm_rhs_chunk
-    if cw == 0:
-        return 0
     m, n = b_shape
     free, solve_dim = (n, m) if side == "L" else (m, n)
-    if cw > 0:
-        if tb.f64_gemm_uses_mxu(dtype, solve_dim):
-            # bitwise identity requires the chunk width to stay above the
-            # per-gemm mxu gate (blas f64_gemm_min_dim ANDs over ALL gemm
-            # dims incl. the rhs width): a narrower chunk would flip those
-            # gemms to the native route and change the numerics
-            cw = max(cw, cfg.f64_gemm_min_dim)
-        return cw if free > cw else 0
-    # auto: only where the measured OOM lives — TPU, mxu-routed emulated
-    # dtypes, both dimensions large (session 4g: HEGST d/16384 twosolve
-    # RESOURCE_EXHAUSTED with donation already applied)
-    import jax
-
-    if jax.default_backend() != "tpu":
-        return 0
-    if not tb.f64_gemm_uses_mxu(dtype, solve_dim):
-        return 0
-    return 4096 if (solve_dim >= 8192 and free >= 8192) else 0
+    # auto chunks only where the measured OOM lives — TPU, mxu-routed
+    # emulated dtypes, both dimensions large (session 4g: HEGST d/16384
+    # twosolve RESOURCE_EXHAUSTED with donation already applied)
+    return tb.resolve_chunk_width("trsm_rhs_chunk", dtype, solve_dim,
+                                  free, solve_dim, free)
 
 
 # the rhs operand (argnum 1) is always the entry point's freshly built

@@ -241,6 +241,23 @@ class Configuration:
     #: set to one chunk's worth; off-TPU the native solves have no such
     #: workspaces and chunking only costs fusion.
     trsm_rhs_chunk: int = -1
+    #: Row-chunk width for the LOCAL reduction-to-band trailing update
+    #: (rows of the trailing block; W = A(VT) and the rank-2 update
+    #: A -= XV^H + VX^H are row-independent in A, so both map over row
+    #: chunks with the chunked gemms bitwise-identical; whole-step
+    #: results match to ~1 ulp — XLA re-fuses the small interleaved
+    #: panel matmuls, reassociating their reductions). 0 disables; -1
+    #: (default) =
+    #: auto: on TPU, chunk at 4096 when the trailing dimension is
+    #: >= 8192 and the mxu route is active — the trailing gemms
+    #: otherwise materialize the emulated-f64 operand slice planes and
+    #: per-group product partials at the FULL trailing size (the
+    #: measured 19.28 GB compile ask of red2band n=16384/band=128 on
+    #: the 15.75 GB chip, session 4f). Chunk widths are clamped so the
+    #: per-gemm route gate (f64_gemm_min_dim over ALL gemm dims) cannot
+    #: flip; off-TPU the native gemms have no slice workspaces and
+    #: chunking only costs fusion.
+    red2band_trail_chunk: int = -1
     #: Conditioning guard for the "mixed" fast path, as a limit on the
     #: squared diagonal ratio of the f32 seed factor (empirically
     #: residual ~ 3.5e-14 * estimate for one Newton step; blocks estimated
@@ -340,6 +357,10 @@ def _validate(cfg: Configuration) -> None:
     if cfg.trsm_rhs_chunk < -1:
         raise ValueError(f"trsm_rhs_chunk={cfg.trsm_rhs_chunk}: must be -1 "
                          "(auto), 0 (off), or a positive chunk width")
+    if cfg.red2band_trail_chunk < -1:
+        raise ValueError(f"red2band_trail_chunk={cfg.red2band_trail_chunk}: "
+                         "must be -1 (auto), 0 (off), or a positive chunk "
+                         "width")
     if not 0 <= cfg.f64_gemm_slices <= 9:
         raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in "
                          "[1, 9], or 0 for the platform-adaptive default")

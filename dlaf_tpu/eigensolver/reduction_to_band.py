@@ -74,6 +74,53 @@ class BandReduction:
 # Local
 # ---------------------------------------------------------------------------
 
+def _trail_chunk(m: int, nb: int, dtype) -> int:
+    """Trace-time: row-chunk width for the local trailing update, 0 =
+    unchunked (config ``red2band_trail_chunk``; see the knob docstring).
+    The trailing gemms' A-rows are independent — W = A(VT) row i reads
+    only A[i, :], and the rank-2 update writes row i from X[i]/V[i] — so
+    the chunked gemms are bitwise-identical to the unchunked ones (the
+    emulated-f64 decomposition's scales are per-LHS-row and the
+    contraction axes are untouched); whole-step results match to ~1 ulp
+    (XLA re-fuses the small interleaved panel matmuls — v@t, the x
+    correction — reassociating their reductions across program
+    variants). Chunking only bounds the live mxu-route workspaces
+    (operand slice planes, per-group product partials) to one chunk of
+    rows."""
+    # auto chunks only where the measured compile-OOM lives — TPU,
+    # mxu-routed emulated dtypes, large trailing block (session 4f:
+    # red2band n=16384/band=128 asked 19.28 GB of 15.75 at compile)
+    return tb.resolve_chunk_width("red2band_trail_chunk", dtype,
+                                  min(m, nb), m, m)
+
+
+def _map_row_chunks(fn, cw: int, *arrs):
+    """``lax.map`` of ``fn`` over row chunks (axis 0, width ``cw``) of
+    ``arrs``, concatenating the outputs back along rows. A ragged final
+    chunk is handled by clamping its start to ``m - cw`` instead of
+    zero-padding (the pad would copy the full m x m operand — the exact
+    buffer this lever exists to bound), so its leading rows overlap the
+    previous chunk; ``fn`` must be row-local (output row i depends only
+    on row i of each input — true of the trailing gemms), making the
+    overlap a bitwise-identical recompute whose duplicate rows are
+    dropped on reassembly."""
+    from jax import lax
+
+    m = arrs[0].shape[0]
+    nc = -(-m // cw)   # callers guarantee m > cw, so nc >= 2
+    starts = jnp.minimum(jnp.arange(nc, dtype=jnp.int32) * cw, m - cw)
+
+    def body(i):
+        zero = jnp.zeros((), i.dtype)
+        return fn(*(lax.dynamic_slice(x, (i,) + (zero,) * (x.ndim - 1),
+                                      (cw,) + x.shape[1:]) for x in arrs))
+
+    out = lax.map(body, starts)
+    tail = m - (nc - 1) * cw          # static: rows only the last chunk has
+    head = out[:-1].reshape(((nc - 1) * cw,) + out.shape[2:])
+    return jnp.concatenate([head, out[-1, cw - tail:]], axis=0)
+
+
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("nb",), donate_argnums=0)
 def _red2band_local(a, *, nb: int):
@@ -96,11 +143,22 @@ def _red2band_local(a, *, nb: int):
             taus = jnp.pad(taus, (0, nb - ntau))
         t = larft(v, taus)
         trail = a[k1:, k1:]                       # full Hermitian
-        w = tb.mm(trail, v @ t)                   # A V T
+        vt = v @ t
+        cw = _trail_chunk(m_p, nb, a.dtype)
+        if cw:
+            w = _map_row_chunks(lambda tr: tb.mm(tr, vt), cw, trail)
+        else:
+            w = tb.mm(trail, vt)                  # A V T
         m = tb.mm(v.conj().T, w)                  # V^H W  (pw x pw)
         x = w - 0.5 * v @ (t.conj().T @ m)
-        a = a.at[k1:, k1:].set(trail - tb.mm(x, v.conj().T)
-                               - tb.mm(v, x.conj().T))
+        vh, xh = v.conj().T, x.conj().T
+        if cw:
+            new_trail = _map_row_chunks(
+                lambda tr, xr, vr: tr - tb.mm(xr, vh) - tb.mm(vr, xh),
+                cw, trail, x, v)
+        else:
+            new_trail = trail - tb.mm(x, vh) - tb.mm(v, xh)
+        a = a.at[k1:, k1:].set(new_trail)
     return a, taus_out
 
 
@@ -129,6 +187,7 @@ def _red2band_local_scan(a, *, nb: int):
         two-sided update only touches rows/cols past the (absolute)
         elimination boundary, so the telescoped segments are exact."""
         rows = jnp.arange(m)
+        cw = _trail_chunk(m, nb, a.dtype)
 
         def step(carry, k):
             acc, taus_out = carry
@@ -149,11 +208,27 @@ def _red2band_local_scan(a, *, nb: int):
             vr = jnp.roll(vfull, bdy, axis=0)
             newcol = jnp.where(below[:, None], vr, raw)
             acc = jax.lax.dynamic_update_slice(acc, newcol, (0, k0))
-            trail = jnp.where(below[:, None] & below[None, :], acc, 0)
-            w = tb.mm(trail, v @ t)
+            vt = v @ t
+            if cw:
+                # mask fused into the chunk body: the full m x m masked
+                # trail temp is exactly the buffer this lever exists to
+                # avoid materializing
+                w = _map_row_chunks(
+                    lambda ar, br: tb.mm(
+                        jnp.where(br[:, None] & below[None, :], ar, 0), vt),
+                    cw, acc, below)
+            else:
+                trail = jnp.where(below[:, None] & below[None, :], acc, 0)
+                w = tb.mm(trail, vt)
             mm = tb.mm(v.conj().T, w)
             x = w - 0.5 * v @ (t.conj().T @ mm)
-            acc = acc - tb.mm(x, v.conj().T) - tb.mm(v, x.conj().T)
+            vh, xh = v.conj().T, x.conj().T
+            if cw:
+                acc = _map_row_chunks(
+                    lambda ar, xr, vr: ar - tb.mm(xr, vh) - tb.mm(vr, xh),
+                    cw, acc, x, v)
+            else:
+                acc = acc - tb.mm(x, vh) - tb.mm(v, xh)
             return (acc, taus_out), None
 
         return step

@@ -372,6 +372,38 @@ def f64_gemm_uses_mxu(dtype, dim: int) -> bool:
             and dim >= get_configuration().f64_gemm_min_dim)
 
 
+def resolve_chunk_width(knob: str, dtype, gate_dim: int, chunk_axis: int,
+                        *auto_dims: int) -> int:
+    """Shared trace-time resolution for the workspace-bounding chunk knobs
+    (``trsm_rhs_chunk``, ``red2band_trail_chunk``), which agree on
+    everything but their dims. Returns the chunk width, or 0 for
+    unchunked — including whenever the resolved width would not be
+    shorter than ``chunk_axis``. Knob semantics: 0 = off; explicit widths
+    are clamped to ``f64_gemm_min_dim`` when the mxu route is active at
+    ``gate_dim`` (the per-gemm route gate takes min over ALL gemm dims —
+    a narrower chunk would flip routes and change numerics); -1 = auto,
+    which chunks at ``max(4096, f64_gemm_min_dim)`` only where the
+    measured OOMs live — TPU, mxu route, every dim of ``auto_dims``
+    >= 8192."""
+    from ..config import get_configuration
+
+    cfg = get_configuration()
+    cfg_width = getattr(cfg, knob)
+    mxu = f64_gemm_uses_mxu(dtype, gate_dim)
+    if cfg_width > 0:
+        cw = max(cfg_width, cfg.f64_gemm_min_dim) if mxu else cfg_width
+    elif cfg_width == 0:
+        return 0
+    else:
+        import jax
+
+        if jax.default_backend() != "tpu" or not mxu \
+                or any(d < 8192 for d in auto_dims):
+            return 0
+        cw = max(4096, cfg.f64_gemm_min_dim)
+    return cw if cw < chunk_axis else 0
+
+
 def trsm_panel_uses_mixed(dtype) -> bool:
     """Will :func:`trsm_panel` route this dtype through the refined-inverse
     mixed path under the current config? For callers that precompute
