@@ -97,8 +97,9 @@ def _cache_dir() -> str:
 
 def run_variant() -> None:
     """Child: measure ONE trailing variant (env DLAF_BENCH_VARIANT), print
-    one JSON line {variant, platform, dtype, n, nb, gflops, t, ts} on
-    stdout (same schema as the .bench_history.jsonl append)."""
+    one JSON line {variant, platform, dtype, n, nb, gflops, t, ts, source,
+    donate} on stdout (the exact dict measure_common.append_history wrote
+    to .bench_history.jsonl — single schema owner)."""
     variant = os.environ["DLAF_BENCH_VARIANT"]
     dtype_name = os.environ.get("DLAF_BENCH_DTYPE", "float64")
     t_start = time.time()
@@ -177,20 +178,19 @@ def run_variant() -> None:
         log(f"[{variant}] run {i}: {t:.4f}s {g:.1f} GFlop/s")
         if i > 0 and g > best_g:
             best_g, best_t = g, t
-    line = {"variant": variant, "platform": platform,
-            "dtype": np.dtype(dtype).name, "n": n, "nb": nb,
-            "gflops": round(best_g, 2), "t": best_t,
-            # UTC: compared against the UTC-anchored PEEL_FIX_TS cutoff
-            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())}
     # append-only measurement log: tunnel wedges must never cost an
     # already-landed hardware number (BASELINE.md cites this file).
-    # measure_common.append_history is the single schema owner.
+    # measure_common.append_history is the single schema owner; the line it
+    # returns (donate=True: this sweep's program aliases its input, a
+    # different measured program from pre-donation entries — round-4
+    # advisory) is also this child's stdout protocol.
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "scripts"))
     from measure_common import append_history
 
-    append_history(platform, n, nb, best_g, best_t, source="bench.py",
-                   variant=variant, dtype=np.dtype(dtype).name)
+    line = append_history(platform, n, nb, best_g, best_t, source="bench.py",
+                          variant=variant, dtype=np.dtype(dtype).name,
+                          donate=True)
     print(json.dumps(line), flush=True)
 
 

@@ -107,7 +107,12 @@ def _map_row_chunks(fn, cw: int, *arrs):
     from jax import lax
 
     m = arrs[0].shape[0]
-    nc = -(-m // cw)   # callers guarantee m > cw, so nc >= 2
+    # the clamp-start scheme needs at least one full chunk inside the
+    # operand; resolve_chunk_width enforces cw < chunk_axis at the caller,
+    # but only indirectly (different module) — fail loudly here instead of
+    # via a dynamic_slice size error (round-4 advisory)
+    assert 0 < cw < m, f"_map_row_chunks: need 0 < cw < m, got cw={cw} m={m}"
+    nc = -(-m // cw)   # cw < m, so nc >= 2
     starts = jnp.minimum(jnp.arange(nc, dtype=jnp.int32) * cw, m - cw)
 
     def body(i):

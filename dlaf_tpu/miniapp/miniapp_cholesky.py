@@ -29,7 +29,9 @@ from ..common.index2d import GlobalElementSize, TileElementSize
 from ..matrix.matrix import Matrix
 from ..types import total_ops, type_letter
 from .generators import hpd_element_fn
-from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+from .options import (CheckIterFreq, add_miniapp_arguments,
+                      announce_donation, parse_miniapp_options,
+                      select_devices)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -72,6 +74,7 @@ def run(argv=None) -> list[dict]:
 
 def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
     n, nb = args.matrix_size, args.block_size
+    announce_donation()   # timed runs consume their input copies
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)   # fresh copy per run (:127-128)
         hard_fence(mat.storage)                   # start fence (:134-136)

@@ -27,7 +27,9 @@ from ..common.index2d import GlobalElementSize, TileElementSize
 from ..matrix.matrix import Matrix
 from ..types import total_ops, type_letter
 from .generators import hpd_element_fn
-from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+from .options import (CheckIterFreq, add_miniapp_arguments,
+                      announce_donation, parse_miniapp_options,
+                      select_devices)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -65,6 +67,7 @@ def run(argv=None) -> list[dict]:
 
     backend = devices[0].platform
     results = []
+    announce_donation()   # timed runs consume their input copies
     for run_i in range(-opts.nwarmups, opts.nruns):
         a_in = am.with_storage(am.storage + 0)
         hard_fence(a_in.storage)

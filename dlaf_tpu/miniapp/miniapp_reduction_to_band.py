@@ -26,7 +26,9 @@ from ..common.index2d import GlobalElementSize, TileElementSize
 from ..eigensolver.reduction_to_band import reduction_to_band
 from ..matrix.matrix import Matrix
 from ..types import total_ops, type_letter
-from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+from .options import (CheckIterFreq, add_miniapp_arguments,
+                      announce_donation, parse_miniapp_options,
+                      select_devices)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -64,6 +66,7 @@ def run(argv=None) -> list[dict]:
                                  dtype=opts.dtype)
     backend = devices[0].platform
     results = []
+    announce_donation()   # timed runs consume their input copies
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)
         hard_fence(mat.storage)

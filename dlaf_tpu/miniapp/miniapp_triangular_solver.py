@@ -25,7 +25,9 @@ from ..comm.grid import Grid
 from ..common.index2d import GlobalElementSize, TileElementSize
 from ..matrix.matrix import Matrix
 from ..types import total_ops, type_letter
-from .options import CheckIterFreq, add_miniapp_arguments, parse_miniapp_options, select_devices
+from .options import (CheckIterFreq, add_miniapp_arguments,
+                      announce_donation, parse_miniapp_options,
+                      select_devices)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -74,6 +76,7 @@ def run(argv=None) -> list[dict]:
                                 dtype=opts.dtype)
     backend = devices[0].platform
     results = []
+    announce_donation()   # timed runs consume their input copies
     for run_i in range(-opts.nwarmups, opts.nruns):
         b_in = bm.with_storage(bm.storage + 0)
         hard_fence(b_in.storage)

@@ -54,6 +54,17 @@ def add_miniapp_arguments(parser: argparse.ArgumentParser) -> None:
                         help="'mc' forces the XLA-CPU backend, 'tpu' a TPU device")
 
 
+def announce_donation() -> None:
+    """Print the donation marker line. Miniapps whose timed runs donate
+    their per-run input copies (the reference's in-place semantics) call
+    this once before the run loop; ``scripts/summarize_session.py`` keys
+    the history log's ``donate`` provenance flag on this marker, so
+    harvested sessions record the flag only when the measured program
+    actually aliased its input (round-4 advisory: donated and undonated
+    timings must stay distinguishable)."""
+    print("[meta] donate=1", flush=True)
+
+
 def parse_miniapp_options(args: argparse.Namespace) -> MiniappOptions:
     return MiniappOptions(
         grid_rows=args.grid_rows, grid_cols=args.grid_cols,

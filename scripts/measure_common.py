@@ -63,9 +63,10 @@ def best_time(fn, *args, reps: int = None, return_last: bool = False):
 
 def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
                    source: str, variant: str = "ozaki",
-                   dtype: str = "float64"):
+                   dtype: str = "float64", donate: bool = None):
     """Append one measurement to the git-tracked append-only history log
-    (same schema as bench.py's run_variant): a later tunnel wedge or
+    and return the line dict (single schema owner — bench.py prints the
+    returned dict rather than rebuilding it): a later tunnel wedge or
     container reset must never cost an already-landed hardware number —
     bench.py's CPU-fallback path surfaces the best recorded TPU entry
     from this file."""
@@ -78,12 +79,18 @@ def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
             # UTC: bench.py's PEEL_FIX_TS pre/post-fix cutoff is UTC-anchored
             "ts": _time.strftime("%Y-%m-%dT%H:%M:%S", _time.gmtime()),
             "source": source}
+    if donate is not None:
+        # the donated program aliases its input (different measured program
+        # from the pre-donation entries in this log — round-4 advisory):
+        # record the flag so cross-round comparisons can tell them apart
+        line["donate"] = bool(donate)
     try:
         with open(os.path.join(repo_root(), ".bench_history.jsonl"),
                   "a") as f:
             f.write(json.dumps(line) + "\n")
     except OSError as e:
         log(f"history append failed: {e!r}")
+    return line
 
 
 def peel(x, s: int):

@@ -37,10 +37,18 @@ DTYPES = {"z": "complex128", "c": "complex64", "d": "float64",
 
 
 def parse_file(path):
-    """Best (highest-GFlop/s) schema line in one step's stdout capture."""
+    """Best (highest-GFlop/s) schema line in one step's stdout capture.
+    Also picks up the ``[meta] donate=1`` marker (printed by miniapps whose
+    timed runs consume their input copies) so the history entry records
+    which program — donated or not — was measured; absent marker (older
+    session dirs, non-donating miniapps) leaves the flag unrecorded."""
     best = None
+    donate = None
     with open(path, errors="replace") as f:
         for line in f:
+            if line.strip() == "[meta] donate=1":
+                donate = True
+                continue
             m = LINE.match(line.strip())
             if not m:
                 continue
@@ -53,6 +61,8 @@ def parse_file(path):
             if best is None or g > best["gflops"]:
                 best = {"t": t, "gflops": g, "n": n, "nb": nb,
                         "dtype": dtype, "backend": backend}
+    if best is not None:
+        best["donate"] = donate
     return best
 
 
@@ -72,7 +82,8 @@ def main():
         if platform == "tpu":
             append_history(platform, best["n"], best["nb"], best["gflops"],
                            best["t"], source=f"session {out_dir} step {step}",
-                           variant=step, dtype=best["dtype"])
+                           variant=step, dtype=best["dtype"],
+                           donate=best["donate"])
     for step, platform, best in rows:
         log(f"{step}: {best['gflops']:.1f} GF/s [{platform}] "
             f"n={best['n']} nb={best['nb']} {best['dtype']}")

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Measure the nt=64 -> nt=128 compile/run frontier on the REAL toolchain.
+
+Round-4 verdict item 5: ``STEP_MODE_AUTO_SCAN_AT`` (config.py) rests on a
+chipless-AOT ~19 s/step estimate; no session ever timed the unrolled
+compile wall or the scan run premium at north-star step counts (nt=128 is
+BASELINE config #1 at nb=128, and the nb=256 form of N=32768). This probe
+produces the missing (compile_cost, run_premium) pairs on-tunnel:
+
+  for (nb, nt) in [(256, 64), (128, 128)] at N=16384:
+      cold trace+compile wall of the unrolled ozaki local cholesky
+      cold trace+compile wall of the scan local cholesky
+      one fenced execution of each compiled program (donated input)
+
+Compile timings use a throwaway compilation-cache dir so the "cold" label
+is honest even after prior sessions populated ``.jax_cache``. Execution
+reuses the just-compiled executables (AOT), so the run premium rides the
+same programs the compile walls describe.
+
+The results document is re-printed to stdout after every step so a tunnel
+wedge mid-probe keeps everything already measured.
+
+Usage: python scripts/tpu_compile_frontier.py [out.json] [--skip-exec]
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from measure_common import log, setup_env  # noqa: E402
+
+N = int(os.environ.get("DLAF_FRONTIER_N", "16384"))
+
+
+def main():
+    out_path = next((a for a in sys.argv[1:] if not a.startswith("-")), None)
+    skip_exec = "--skip-exec" in sys.argv
+
+    # throwaway cache so the "cold" label is honest: set BEFORE setup_env
+    # (it setdefaults the same var to the shared .jax_cache)
+    cache_dir = tempfile.mkdtemp(prefix="frontier_cache_")
+    os.environ["DLAF_COMPILATION_CACHE_DIR"] = cache_dir
+    jax = setup_env()
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    log(f"devices: {jax.devices()} (cache: {cache_dir})")
+    try:
+        _probe(jax, out_path, skip_exec)
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def _probe(jax, out_path, skip_exec):
+
+    import jax.numpy as jnp
+
+    from dlaf_tpu.algorithms.cholesky import (_cholesky_local,
+                                              _cholesky_local_scan)
+    from dlaf_tpu.common.sync import hard_fence
+
+    results = {"n": N, "platform": jax.devices()[0].platform, "points": []}
+
+    def dump():
+        doc = json.dumps(results)
+        print(doc, flush=True)
+        if out_path:
+            with open(out_path, "w") as f:
+                f.write(doc + "\n")
+
+    # one O(N^2) analytic HPD host matrix shared by every probe point
+    # (donation only consumes the device copy; device_put per point)
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+
+    fn_el = hpd_element_fn(N, np.float64)
+    idx = np.arange(N)
+    a_host = np.asarray(fn_el(idx[:, None], idx[None, :]), np.float64)
+
+    for nb in (256, 128):
+        nt = N // nb
+        for mode in ("scan", "unrolled"):
+            point = {"nb": nb, "nt": nt, "mode": mode}
+            results["points"].append(point)
+            try:
+                if mode == "unrolled":
+                    fn = lambda a, nb=nb: _cholesky_local(
+                        a, uplo="L", nb=nb, trailing="ozaki")
+                else:
+                    fn = lambda a, nb=nb: _cholesky_local_scan(
+                        a, uplo="L", nb=nb, use_mxu=True, use_mixed=True)
+                jfn = jax.jit(fn, donate_argnums=0)
+                spec = jax.ShapeDtypeStruct((N, N), jnp.float64)
+                t0 = time.perf_counter()
+                lowered = jfn.lower(spec)
+                point["trace_s"] = round(time.perf_counter() - t0, 2)
+                log(f"[{mode} nb={nb}] traced in {point['trace_s']}s; "
+                    "compiling...")
+                t0 = time.perf_counter()
+                compiled = lowered.compile()
+                point["compile_s"] = round(time.perf_counter() - t0, 2)
+                log(f"[{mode} nb={nb}] compiled in {point['compile_s']}s")
+                dump()
+                if skip_exec:
+                    continue
+                # one fenced execution of the just-compiled program
+                a = jax.device_put(a_host)
+                hard_fence(a)
+                t0 = time.perf_counter()
+                r = compiled(a)
+                hard_fence(r)
+                point["run_s"] = round(time.perf_counter() - t0, 3)
+                point["gflops"] = round(N**3 / 3 / point["run_s"] / 1e9, 1)
+                log(f"[{mode} nb={nb}] ran in {point['run_s']}s "
+                    f"({point['gflops']} GF/s)")
+                del a, r, compiled
+            except Exception as e:  # keep probing the other points
+                point["error"] = f"{type(e).__name__}: {e}"[:400]
+                log(f"[{mode} nb={nb}] FAILED: {point['error']}")
+            dump()
+
+    dump()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Round-5 opening session: every verdict item that needs NO new code,
+# value-per-minute ordered (the 4h window closed mid-first-arm; assume
+# short windows and put the scored-metric + first-ever data up front).
+#
+# 1. prec probe — the standing emulated-f64 primitive assertion arm
+#    (verdict item 10): round/trunc/cast/fma at boundary values.
+# 2. config #1 headline re-pin (donated, default knobs) — live TPU
+#    number for the driver's bench replay.
+# 3. config #1 WITH profile_dir — the perfetto trace that answers the
+#    panel/trailing overlap question (verdict item 2). Separate arm:
+#    phase fences change the timing methodology.
+# 4. z-cholesky 4096 — first complex silicon datum (verdict item 3);
+#    exercises the pair-transfer path end-to-end.
+# 5. pallas probe — silicon execution or retire (verdict item 6).
+# 6. HEGST d/8192 blocked-vs-twosolve A/B (verdict item 7 at 8192).
+# 7. z-HEGST 8192 — config #3's type on silicon (verdict item 3).
+# 8. eigensolver 8192 with phase table (verdict item 4).
+# 9. compile frontier nt=64/128 (verdict item 5) — heavyweight, last.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session5a_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+run prec_probe 300 \
+    python scripts/tpu_prec_probe.py "$OUT/prec_probe.json"
+
+run chol_4096_donated 1200 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 4096 -b 256 --nruns 3 --nwarmups 1 --check-result last
+
+run chol_4096_profiled 1200 env DLAF_PROFILE_DIR="$OUT/profile_4096" \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky \
+    -m 4096 -b 256 --nruns 2 --nwarmups 1
+
+run zchol_4096 2400 \
+    python -m dlaf_tpu.miniapp.miniapp_cholesky --type z \
+    -m 4096 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run pallas_probe 1500 \
+    python scripts/tpu_pallas_probe.py "$OUT/pallas_probe.json"
+
+run hegst_d_8192_blocked 1800 env DLAF_HEGST_IMPL=blocked \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run hegst_d_8192_twosolve 1800 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 8192 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run zhegst_8192 2700 \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std --type z \
+    -m 8192 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run eig_8192_phases 2700 env DLAF_PROFILE_DIR="$OUT/profile_eig" \
+    python -m dlaf_tpu.miniapp.miniapp_eigensolver \
+    -m 8192 -b 512 --nruns 1 --check-result last
+
+run compile_frontier 7200 \
+    python scripts/tpu_compile_frontier.py "$OUT/compile_frontier.json"
+
+session_summary
