@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Chain the round-5 sessions through one healthy window: 5a (verdict
+# items needing no new code) then 5b (the N=16384 holdouts + 4h
+# leftovers). Each session's run() helper re-probes health before every
+# arm, so a mid-chain wedge skips cleanly instead of hanging.
+set -u
+cd "$(dirname "$0")/.."
+OUT="$(pwd)/.session5a_live" bash scripts/tpu_session5a.sh
+OUT="$(pwd)/.session5b_live" bash scripts/tpu_session5b.sh

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Round-5 second session: the two N=16384 single-chip holdouts under the
+# round-4-final levers they have never run with (donation landed in 4g,
+# free-axis trsm chunking + red2band trail chunking landed AFTER the last
+# healthy window), plus the session-4h arms the wedge swallowed.
+#
+# 1. HEGST d/16384 twosolve — 4g runtime-OOMed pre-chunking; the
+#    whole-matrix solves now ride the chunked _solve_local
+#    (trsm_rhs_chunk auto = 4096 on TPU at this size).
+# 2. HEGST d/16384 blocked — the flop-parity form at the same size
+#    (verdict item 7's A/B partner; never attempted at 16384).
+# 3. red2band 16384/512/band128 — 4f compile-asked 19.28 GB of 15.75
+#    pre-donation pre-chunking; scan + chunked trailing now bounds the
+#    mxu workspaces and donation frees one full matrix.
+# 4-6. 4h leftovers: red2band 12288 + HEGST d/12288 twosolve (first
+#    >8192 family points), TRSM 8192 re-pin under donate_b.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${OUT:-$(pwd)/.session5b_$(date +%m%d_%H%M)}
+source "$(dirname "$0")/session_lib.sh"
+
+run hegst_d_16384_twosolve 3600 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run hegst_d_16384_blocked 3600 env DLAF_HEGST_IMPL=blocked \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 16384 -b 256 --nruns 1 --nwarmups 1 --check-result last
+
+run red2band_16384 3600 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 16384 -b 512 --band-size 128 --nruns 1 --nwarmups 1 \
+    --check-result last
+
+run red2band_12288 2700 env DLAF_DIST_STEP_MODE=scan \
+    python -m dlaf_tpu.miniapp.miniapp_reduction_to_band \
+    -m 12288 -b 512 --band-size 128 --nruns 2 --nwarmups 1 \
+    --check-result last
+
+run hegst_d_12288_twosolve 2700 env DLAF_HEGST_IMPL=twosolve \
+    python -m dlaf_tpu.miniapp.miniapp_gen_to_std \
+    -m 12288 -b 256 --nruns 2 --nwarmups 1 --check-result last
+
+run trsm_8192_donated 1800 \
+    python -m dlaf_tpu.miniapp.miniapp_triangular_solver \
+    -m 8192 -b 256 --nruns 3 --nwarmups 1 --check-result last
+
+session_summary

@@ -953,3 +953,65 @@ def test_concat_syrk_int32_wrap_window(accum, monkeypatch):
         monkeypatch.delenv("DLAF_OZAKI_GROUP")
         monkeypatch.delenv("DLAF_OZAKI_ACCUM")
         config.initialize()
+
+
+class TestPeelBoundaryRegression:
+    """Regression net for the round-4 peel-corruption class (commit
+    0807ec7): the TPU f64-emulation's `round` mis-rounds tie+epsilon
+    values (measured on-silicon: round(17.5000005) = 19), the one-unit
+    overshoot pushed the next residual*scale outside int8, and the
+    f32->s8 saturation rail then pinned every later slice — shipping a
+    ~2^-8 decomposition error through three rounds of green CPU tests.
+    The hardened peel (native f32 round + subtracting the STORED slice
+    value) is platform-independent code; these properties pin its two
+    invariants at exactly the boundary values that broke, so any future
+    peel change that reopens the class fails HERE, not on silicon.
+    (The per-window primitive behavior itself is asserted on hardware by
+    scripts/tpu_prec_probe.py's prim_* arm.)
+    """
+
+    def _reconstruct(self, sl):
+        from dlaf_tpu.tile_ops.ozaki import SLICE_BITS
+
+        return sum(sl[t].astype(np.float64) * 2.0 ** (-SLICE_BITS * (t + 1))
+                   for t in range(sl.shape[0]))
+
+    @pytest.mark.parametrize("eps", [0.0, 5e-7, -5e-7, 1e-9, -1e-9])
+    def test_tie_epsilon_values_stay_inside_rail(self, eps):
+        """Every first-slice tie (k+1/2)/128 plus the measured corruption
+        epsilons: all 8 slices inside the +-65 rail (|I|<=64 plus at most
+        one absorbable overshoot unit — NOT pinned at the +-127 cast
+        rail), and the stored slices reconstruct xn to the 56-bit
+        budget."""
+        import jax
+
+        from dlaf_tpu.tile_ops import ozaki as oz
+
+        ks = np.arange(-64, 64)
+        xn_host = np.clip((ks + 0.5 + eps) / 128.0, -0.5, 0.5)
+        slices = jax.jit(lambda v: jnp.stack(oz._peel_slices(v, 8)))(
+            jnp.asarray(xn_host))
+        sl = np.asarray(slices, dtype=np.int64)
+        assert np.abs(sl).max() <= 65, \
+            f"slice outside rail: {np.abs(sl).max()} (saturation cascade)"
+        err = np.abs(self._reconstruct(sl) - xn_host).max()
+        assert err < 2.0 ** -53, f"reconstruction off budget: {err}"
+
+    def test_slice_residual_consistency_random(self):
+        """Random normalized blocks: slice/residual consistency means the
+        stored int8 values alone reconstruct xn to the budget — whatever
+        unit choices the platform's rounding made along the way."""
+        import jax
+
+        from dlaf_tpu.tile_ops import ozaki as oz
+
+        rng = np.random.default_rng(23)
+        xn_host = rng.uniform(-0.5, 0.5, size=(64, 64))
+        slices = jax.jit(lambda v: jnp.stack(oz._peel_slices(v, 8)))(
+            jnp.asarray(xn_host))
+        sl = np.asarray(slices, dtype=np.int64)
+        assert np.abs(sl).max() <= 65
+        err = np.abs(self._reconstruct(sl) - xn_host).max()
+        # 8 slices x 7 bits = 56 kept bits; the dropped residual is
+        # < 2^-57 of the normalized scale
+        assert err < 2.0 ** -56, f"reconstruction off budget: {err}"

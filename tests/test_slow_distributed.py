@@ -38,9 +38,18 @@ def hpd(n, seed=0):
     return x @ x.T + n * np.eye(n)
 
 
-@pytest.fixture
-def grid(devices8):
-    return Grid(2, 4)
+@pytest.fixture(params=[(2, 4, "row-major"), (4, 2, "row-major"),
+                        (2, 4, "col-major")],
+                ids=["2x4r", "4x2r", "2x4c"])
+def grid(devices8, request):
+    """Rotate the deep configs through distinct grid shapes AND orderings
+    (VERDICT r4 item 8; reference analog: the 6-rank fixtures sweep
+    3x2 row-major / 2x3 col-major / split-comm sets per test,
+    ``test/include/dlaf_test/comm_grids/grids_6_ranks.h:12-58``) — a
+    deep-tier slot-alignment or owner-mapping bug specific to tall
+    grids or col-major fill must fail here, not on silicon."""
+    rows, cols, ordering = request.param
+    return Grid(rows, cols, ordering=ordering)
 
 
 def set_step_mode(monkeypatch, mode):
