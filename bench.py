@@ -191,6 +191,15 @@ def run_variant() -> None:
     line = append_history(platform, n, nb, best_g, best_t, source="bench.py",
                           variant=variant, dtype=np.dtype(dtype).name,
                           donate=True)
+    # primary result channel: the obs JSONL artifact (the parent points
+    # DLAF_METRICS_PATH at a per-variant file and reads the bench_result
+    # record back — structured, alongside this child's spans/counters —
+    # instead of scraping the stdout tail). The stdout line stays for
+    # humans and as the no-artifact fallback.
+    from dlaf_tpu import obs
+
+    obs.emit_event("bench_result", payload=line)
+    obs.flush()
     print(json.dumps(line), flush=True)
 
 
@@ -279,6 +288,22 @@ def assemble_headline(results, n, nb, hist_lookup=None) -> dict:
     return result
 
 
+def read_bench_result(path: str):
+    """Last ``bench_result`` payload from a child's obs JSONL artifact, or
+    None (missing/invalid file, or a child that died before emitting)."""
+    try:
+        from dlaf_tpu.obs import read_records
+    except Exception:
+        return None
+    try:
+        payloads = [r.get("payload") for r in read_records(path)
+                    if r.get("type") == "bench_result"]
+    except (OSError, ValueError):
+        return None
+    return payloads[-1] if payloads and isinstance(payloads[-1], dict) \
+        else None
+
+
 def sweep(platform: str) -> None:
     """Parent: run the variant sweep, each variant in a timeout-guarded
     subprocess; print the driver's single JSON line from the best result."""
@@ -310,6 +335,14 @@ def sweep(platform: str) -> None:
     budget_s = float(os.environ.get("DLAF_BENCH_BUDGET", "1800"))
     sweep_t0 = time.perf_counter()
     results = []
+    import tempfile
+
+    # per-variant obs artifacts: the child's spans, collective byte
+    # counters, and its bench_result record (the parent's result channel)
+    art_dir = os.environ.get("DLAF_BENCH_OBS_DIR") or tempfile.mkdtemp(
+        prefix="dlaf_bench_obs_")
+    os.makedirs(art_dir, exist_ok=True)
+    log(f"obs artifacts: {art_dir}")
     for vi, variant in enumerate(variants):
         if vi > 0 and time.perf_counter() - sweep_t0 > budget_s:
             log(f"budget {budget_s}s exhausted; skipping {variants[vi:]}")
@@ -321,13 +354,28 @@ def sweep(platform: str) -> None:
             continue
         env = dict(os.environ)
         env["DLAF_BENCH_VARIANT"] = variant
+        art = os.path.join(art_dir, f"{variant}.jsonl")
+        # the sink appends: drop any artifact from a previous sweep in a
+        # reused DLAF_BENCH_OBS_DIR so a child that dies before emitting
+        # can't inherit a stale bench_result record
+        if os.path.exists(art):
+            os.unlink(art)
+        env["DLAF_METRICS_PATH"] = art
         try:
             proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                   env=env, timeout=VARIANT_TIMEOUT_S,
                                   stdout=subprocess.PIPE)
-            line = proc.stdout.decode().strip().splitlines()[-1:]
-            if proc.returncode == 0 and line:
-                results.append(json.loads(line[0]))
+            line = read_bench_result(art)
+            if line is None:
+                # no artifact (old child, crash before flush): stdout tail
+                tail = proc.stdout.decode().strip().splitlines()[-1:]
+                if proc.returncode == 0 and tail:
+                    try:
+                        line = json.loads(tail[0])
+                    except ValueError:
+                        line = None   # stray non-JSON final line
+            if proc.returncode == 0 and line is not None:
+                results.append(line)
             else:
                 log(f"[{variant}] child rc={proc.returncode}, no result")
         except subprocess.TimeoutExpired:

@@ -24,7 +24,18 @@ export JAX_PLATFORMS=cpu
 
 case "$TIER" in
   smoke)
-    python -m pytest tests/ -q -m quick ;;
+    python -m pytest tests/ -q -m quick
+    echo "== smoke: miniapp_cholesky observability artifact =="
+    # distributed run on a 2x2 virtual-CPU grid so the artifact carries
+    # real collective byte counters; the validator fails the tier on any
+    # missing or non-finite field (NaN GFlop/s must not scrape as data)
+    OBS_ART=$(mktemp -d)/miniapp_cholesky_metrics.jsonl
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+      DLAF_METRICS_PATH="$OBS_ART" \
+      python -m dlaf_tpu.miniapp.miniapp_cholesky -m 256 -b 64 \
+        --grid-rows 2 --grid-cols 2 --nruns 2
+    python -m dlaf_tpu.obs.validate "$OBS_ART" \
+      --require-spans --require-gflops --require-collectives ;;
   main)
     python -m pytest tests/ -q -m "not slow" ;;
   full)

@@ -16,6 +16,7 @@ Layer map (reference → here):
   L7 miniapps         → :mod:`dlaf_tpu.miniapp`
 """
 
+from . import obs  # noqa: F401  (observability layer; docs/observability.md)
 from .config import Configuration, finalize, get_configuration, initialize
 from .types import Backend, Device, SizeType, total_ops
 

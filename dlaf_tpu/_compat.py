@@ -1,0 +1,45 @@
+"""Version shims for the JAX surface this framework sits on.
+
+The algorithms were written against the modern ``jax.shard_map`` export
+(whose replication check is spelled ``check_vma``); older installations —
+including the jax 0.4.x line this container ships — only have
+``jax.experimental.shard_map.shard_map`` with the same semantics under the
+``check_rep`` spelling. Every ``shard_map`` consumer in the tree imports
+from here so the version probe happens exactly once.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # modern export (jax >= 0.6)
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - exercised on the 0.4.x container
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+_HAS_CHECK_VMA = "check_vma" in _PARAMS
+_HAS_CHECK_REP = "check_rep" in _PARAMS
+
+
+def axis_size(axis):
+    """``lax.axis_size`` where available; the classic ``psum(1, axis)``
+    constant-fold on JAX versions predating the explicit primitive."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return lax.psum(1, axis)
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, *args, **kwargs):
+    """``jax.shard_map`` with the ``check_vma`` spelling accepted on every
+    JAX version (mapped to ``check_rep`` where that is the installed
+    name; dropped if the installed API has neither)."""
+    if "check_vma" in kwargs and not _HAS_CHECK_VMA:
+        check = kwargs.pop("check_vma")
+        if _HAS_CHECK_REP:
+            kwargs["check_rep"] = check
+    return _shard_map(f, *args, **kwargs)

@@ -33,9 +33,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
@@ -53,7 +54,7 @@ from ..tile_ops import lapack as tl
 from ..tile_ops import mixed as mx
 from ..tile_ops import ozaki as oz
 from ..tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
-from ..types import ceil_div, telescope_segments, telescope_windows
+from ..types import ceil_div, telescope_segments, telescope_windows, total_ops
 
 # back-compat alias (tests import the old private name)
 _telescope_segments = telescope_segments
@@ -100,6 +101,19 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         return jnp.triu(jnp.conj(l).T) + jnp.tril(a, -1)
     nt = ceil_div(n, nb) if n else 0
     for k in range(nt):
+        if obs.metrics_active():
+            # trace-time tile-op accounting (once per compiled program):
+            # one potrf + (nt-k-1) panel-solve tiles per step, and the
+            # trailing update's tile-pair count under the loop schedule
+            tail = nt - k - 1
+            obs.counter("dlaf_algo_tile_ops_total", algo="cholesky",
+                        op="potrf").inc()
+            obs.counter("dlaf_algo_tile_ops_total", algo="cholesky",
+                        op="trsm").inc(tail)
+            obs.counter("dlaf_algo_tile_ops_total", algo="cholesky",
+                        op="herk").inc(tail)
+            obs.counter("dlaf_algo_tile_ops_total", algo="cholesky",
+                        op="gemm").inc(tail * (tail - 1) // 2)
         k0, k1 = k * nb, min((k + 1) * nb, n)
         blk = a[k0:k1, k0:k1]
         if use_oz:
@@ -552,7 +566,17 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
 
     def factorize(lt):
         for k in range(nt):
-            lt = step(lt, k)
+            # phase name on the compiled program's op metadata (device
+            # timeline) + per-step tile-slot accounting; all trace-time
+            with obs.named_span(f"cholesky.k{k:03d}"):
+                if obs.metrics_active():
+                    obs.counter("dlaf_algo_tile_ops_total",
+                                algo="cholesky_dist", op="potrf").inc()
+                    obs.counter("dlaf_algo_tile_ops_total",
+                                algo="cholesky_dist", op="trailing_pairs"
+                                ).inc((ltr - max(0, -(-(k + 2 - Pr) // Pr)))
+                                      * (ltc - max(0, -(-(k + 2 - Qc) // Qc))))
+                lt = step(lt, k)
         return lt
 
     return shard_map(factorize, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),
@@ -794,13 +818,22 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
                 "cholesky: block must be square")
     cfg = get_configuration()
     dt = np.dtype(mat.dtype)
+    n = mat.size.row
+    grid_shape = (mat.dist.grid_size.row, mat.dist.grid_size.col)
+    # entry span: host wall around trace+dispatch, unfenced (device
+    # completion is the caller's fence — the miniapp span carries the
+    # honest GFlop/s); attrs and the reference flop model build lazily
+    entry_span = obs.entry_span("cholesky", lambda: dict(
+        flops=total_ops(dt, n**3 / 6, n**3 / 6),
+        n=n, nb=mat.block_size.row, uplo=uplo, dtype=dt.name,
+        trailing=trailing, grid=f"{grid_shape[0]}x{grid_shape[1]}"))
     # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
     # resolution local and distributed, single owner in tile_ops.blas);
     # the unrolled local path selects its route via cholesky_trailing
     use_mxu = tb.f64_gemm_uses_mxu(dt, mat.block_size.row)
     use_mixed = tb.trsm_panel_uses_mixed(dt)
     if mat.grid is None or mat.grid.num_devices == 1:
-        with quiet_donation():
+        with entry_span, quiet_donation():
             a = to_global(mat.storage, mat.dist, donate)
             if trailing == "scan":
                 out = _cholesky_local_scan(a, uplo=uplo,
@@ -833,5 +866,5 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
                                use_mxu, use_mixed,
                                use_oz_pallas,
                                scan=scan_mode, donate=donate)
-    with quiet_donation():
+    with entry_span, quiet_donation():
         return mat.with_storage(fn(mat.storage))

@@ -45,9 +45,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..config import get_configuration, register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
@@ -469,6 +470,7 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     from ..config import resolve_step_mode
 
     from ..config import resolve_platform_auto
+    from ..types import total_ops
 
     cfg = get_configuration()
     hegst_impl = resolve_platform_auto(
@@ -479,17 +481,28 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
                "beat latency-bound panel round-trips; session 4d, "
                "2026-08-02 v5e")
     distributed = a.grid is not None and a.grid.num_devices > 1
-    if hegst_impl == "twosolve" or \
-            resolve_step_mode(a.dist.nr_tiles.row) == "scan":
-        # the scan step mode's O(1)-compile guarantee flows through the
-        # triangular solver's scan form; BOTH blocked builders (local and
-        # distributed) unroll all nt per-k steps inside one jit, so both
-        # reroute — at ~19 s/step on the TPU AOT toolchain an unrolled
-        # local blocked run would pay the exact O(nt) cold compile the
-        # auto step mode exists to avoid (round-3 advisory)
-        return _gen_to_std_twosolve(uplo, a, b_factor, donate=donate)
+    # reference HEGST flop model (miniapp_gen_to_std): n^3/2 muls+adds —
+    # the model, not the route's actual flops (twosolve spends ~2x)
+    n = a.size.row
+    # the scan step mode's O(1)-compile guarantee flows through the
+    # triangular solver's scan form; BOTH blocked builders (local and
+    # distributed) unroll all nt per-k steps inside one jit, so both
+    # reroute — at ~19 s/step on the TPU AOT toolchain an unrolled
+    # local blocked run would pay the exact O(nt) cold compile the
+    # auto step mode exists to avoid (round-3 advisory)
+    use_twosolve = hegst_impl == "twosolve" or \
+        resolve_step_mode(a.dist.nr_tiles.row) == "scan"
+    entry_span = obs.entry_span("gen_to_std", lambda: dict(
+        flops=total_ops(np.dtype(a.dtype), n**3 / 2, n**3 / 2),
+        n=n, nb=a.block_size.row, uplo=uplo,
+        dtype=np.dtype(a.dtype).name,
+        impl="twosolve" if use_twosolve else hegst_impl,
+        grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
+    if use_twosolve:
+        with entry_span:
+            return _gen_to_std_twosolve(uplo, a, b_factor, donate=donate)
     if not distributed:
-        with quiet_donation():
+        with entry_span, quiet_donation():
             g = tiles_to_global(a.storage, a.dist)
             lg = tiles_to_global(b_factor.storage, b_factor.dist)
             out = _hegst_local_blocked(g, lg, uplo=uplo,
@@ -504,5 +517,5 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
     fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu,
                             donate=donate)
-    with quiet_donation():
+    with entry_span, quiet_donation():
         return a.with_storage(fn(a.storage, b_factor.storage))

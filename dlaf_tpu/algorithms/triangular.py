@@ -25,9 +25,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
 from ..comm.grid import COL_AXIS, ROW_AXIS
@@ -42,7 +43,7 @@ from ..matrix.tiling import (global_to_tiles, tiles_to_global,
                              global_to_tiles_donated, to_global,
                              quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
-from ..types import telescope_windows
+from ..types import telescope_windows, total_ops
 
 
 def _tile_op(t, op: str):
@@ -552,8 +553,18 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
     in place into ``mat_b``, ``solver/triangular/impl.h``); ``b`` must not
     be used afterwards. Internal stage hand-offs are always donated."""
     _check_args(side, a, b)
+    # reference flop model (miniapp_triangular_solver): m n^2/2 muls+adds
+    # on the solve dimension n = A's order, free dimension the other
+    sdim = a.size.row
+    free = b.size.col if side == "L" else b.size.row
+    entry_span = obs.entry_span("triangular_solve", lambda: dict(
+        flops=total_ops(np.dtype(b.dtype), free * sdim**2 / 2,
+                        free * sdim**2 / 2),
+        side=side, uplo=uplo, op=op, diag=diag, m=b.size.row,
+        n=b.size.col, nb=b.block_size.row, dtype=np.dtype(b.dtype).name,
+        grid=f"{b.dist.grid_size.row}x{b.dist.grid_size.col}"))
     if a.grid is None or a.grid.num_devices == 1:
-        with quiet_donation():
+        with entry_span, quiet_donation():
             bm = to_global(b.storage, b.dist, donate_b)
             am = tiles_to_global(a.storage, a.dist)
             out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
@@ -569,7 +580,7 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
                             np.dtype(a.dtype).name,
                             scan=resolve_step_mode(a.dist.nr_tiles.row)
                             == "scan", donate_b=donate_b)
-    with quiet_donation():
+    with entry_span, quiet_donation():
         return b.with_storage(fn(a.storage, b.storage,
                                  jnp.asarray(alpha, b.dtype)))
 
@@ -580,8 +591,16 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
     reference ``multiplication::triangular`` (8 local, LLN/LUN/RLN/RUN + the
     transposed forms distributed)."""
     _check_args(side, a, b)
+    sdim = a.size.row
+    free = b.size.col if side == "L" else b.size.row
+    entry_span = obs.entry_span("triangular_multiply", lambda: dict(
+        flops=total_ops(np.dtype(b.dtype), free * sdim**2 / 2,
+                        free * sdim**2 / 2),
+        side=side, uplo=uplo, op=op, diag=diag, m=b.size.row,
+        n=b.size.col, nb=b.block_size.row, dtype=np.dtype(b.dtype).name,
+        grid=f"{b.dist.grid_size.row}x{b.dist.grid_size.col}"))
     if a.grid is None or a.grid.num_devices == 1:
-        with quiet_donation():
+        with entry_span, quiet_donation():
             am = tiles_to_global(a.storage, a.dist)
             bm = tiles_to_global(b.storage, b.dist)
             out = _mult_local(am, bm, jnp.asarray(alpha, bm.dtype),
@@ -595,4 +614,6 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
                            np.dtype(a.dtype).name,
                            scan=resolve_step_mode(a.dist.nr_tiles.row)
                            == "scan")
-    return b.with_storage(fn(a.storage, b.storage, jnp.asarray(alpha, b.dtype)))
+    with entry_span:
+        return b.with_storage(fn(a.storage, b.storage,
+                                 jnp.asarray(alpha, b.dtype)))

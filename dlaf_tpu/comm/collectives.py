@@ -22,6 +22,25 @@ import jax.numpy as jnp
 from jax import lax
 
 from .grid import COL_AXIS, ROW_AXIS  # re-export for convenience  # noqa: F401
+from .. import _compat
+from .. import obs
+
+
+def _record(kind: str, axis: str, x) -> None:
+    """Per-collective accounting (the per-kind/per-axis byte counters
+    arXiv:2112.09017 credits its ICI tuning to): payload element count ×
+    itemsize, attributed to the mesh axis. Shapes/dtypes are static even
+    for traced operands, so this costs nothing at run time — counts
+    accumulate when a program is TRACED (once per compiled program), which
+    is exactly the per-program traffic model the tuning sessions need.
+    With metrics off this is one attribute read and a return."""
+    if not obs.metrics_active():
+        return
+    nbytes = int(x.size) * x.dtype.itemsize if hasattr(x, "size") else 0
+    obs.counter("dlaf_comm_collective_count_total",
+                kind=kind, axis=axis).inc()
+    obs.counter("dlaf_comm_collective_bytes_total",
+                kind=kind, axis=axis).inc(nbytes)
 
 
 def this_rank(axis: str):
@@ -31,7 +50,7 @@ def this_rank(axis: str):
 
 def axis_size(axis: str) -> int:
     """Number of ranks along ``axis`` (reference ``Communicator::size``)."""
-    return lax.axis_size(axis)
+    return _compat.axis_size(axis)
 
 
 def bcast(x, axis: str, src: int):
@@ -61,6 +80,7 @@ def bcast(x, axis: str, src: int):
     """
     from ..config import get_configuration
 
+    _record("bcast", axis, x)
     if get_configuration().bcast_impl == "tree":
         return _bcast_tree(x, axis, src)
     mask = (this_rank(axis) == src).astype(x.dtype)
@@ -87,7 +107,9 @@ def _bcast_tree(x, axis: str, src: int):
 
 def all_reduce(x, axis: str, op: str = "sum"):
     """All-reduce along ``axis`` (reference ``scheduleAllReduce``,
-    ``kernels/all_reduce.h:67-138``)."""
+    ``kernels/all_reduce.h:67-138``). The rooted :func:`reduce` lowers
+    through here, so its traffic is accounted under this kind too."""
+    _record("all_reduce", axis, x)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "max":
@@ -120,6 +142,7 @@ def send_recv(x, axis: str, src: int, dst: int):
     Returns the sent value on ``dst``; other ranks get zeros. Lowered to an
     XLA collective-permute (one ICI hop for neighbours).
     """
+    _record("send_recv", axis, x)
     return lax.ppermute(x, axis, perm=[(src, dst)])
 
 
@@ -127,6 +150,7 @@ def all_sum_p2p(x, axis: str):
     """Sum over an axis intended for the 2-rank case (reference
     ``scheduleAllSumP2P``, ``kernels/p2p_allsum.h:39-60``: a send/recv pair
     plus local add). XLA's psum already specializes the 2-rank ring."""
+    _record("all_sum_p2p", axis, x)
     return lax.psum(x, axis)
 
 
@@ -136,6 +160,7 @@ def all_gather(x, axis: str, *, tiled: bool = False, concat_axis: int = 0):
     ``concat_axis`` when ``tiled``. Used by panel broadcast to give every rank
     the full panel (reference ``broadcast_panel.h`` achieves the same with
     per-tile bcasts)."""
+    _record("all_gather", axis, x)
     return lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
 
 
@@ -146,5 +171,7 @@ def barrier_value(x, axis: str):
     (``miniapp_cholesky.cpp:134-146``); inside one traced program XLA order
     suffices, so this exists for cross-program fencing in miniapps.
     """
-    token = lax.psum(jnp.zeros((), x.dtype), axis)
+    z = jnp.zeros((), x.dtype)
+    _record("barrier", axis, z)
+    token = lax.psum(z, axis)
     return x + token

@@ -1,10 +1,12 @@
 """Wall-clock timing and phase profiling.
 
 TPU-native counterpart of the reference's ``common::Timer``
-(``common/timer.h``) plus the green-field profiling hook SURVEY §5 calls for:
-the reference delegates profiling to pika's runtime; here phase timers can
-additionally emit XLA/PJRT execution profiles via ``jax.profiler`` when a
-trace directory is configured.
+(``common/timer.h``). Phase profiling is now a thin veneer over the
+:mod:`dlaf_tpu.obs` span tracer: each ``phase(...)`` region is an obs span
+(structured JSONL record + duration histogram when ``DLAF_METRICS_PATH``
+is set, ``jax.profiler.TraceAnnotation`` names on the profiler timeline
+when a trace dir is active) while the familiar ``report()`` {name:
+seconds} aggregation is kept for existing callers.
 """
 
 from __future__ import annotations
@@ -12,6 +14,8 @@ from __future__ import annotations
 import contextlib
 import time
 from typing import Optional
+
+from .. import obs
 
 
 class Timer:
@@ -30,10 +34,17 @@ class Timer:
 class PhaseTimer:
     """Named phase timings for multi-stage algorithms (eigensolver pipeline).
 
-    Use ``with phases.phase("reduction_to_band"): ...``; ``report()`` returns
-    {name: seconds}. When ``profile_dir`` is set, each phase is additionally
-    wrapped in a ``jax.profiler.TraceAnnotation`` so device timelines carry
-    the phase names.
+    Use ``with phases.phase("stage.reduction_to_band"): ...``; ``report()``
+    returns {name: seconds}. Phase names should stay distinct from the
+    algorithms' own entry-span names (hence the ``stage.`` prefix in the
+    pipeline) — a fenced stage wall-time span sharing a name with an
+    unfenced dispatch-time entry span would aggregate two different
+    populations under one histogram. Phases are obs spans, so with
+    observability configured
+    they also land in the JSONL artifact and on profiler timelines. When
+    ``profile_dir`` is set (the pre-obs knob), a ``jax.profiler`` trace is
+    additionally started for the timer's lifetime — even if the obs layer
+    itself is off — preserving the original contract.
     """
 
     def __init__(self, profile_dir: Optional[str] = None):
@@ -42,30 +53,63 @@ class PhaseTimer:
         self._tracing = False
 
     @contextlib.contextmanager
-    def phase(self, name: str):
-        ctx = contextlib.nullcontext()
-        if self.profile_dir is not None:
+    def phase(self, name: str, **attrs):
+        # keep the span name constant across repeats (one histogram per
+        # phase, aggregable durations) and put per-call context — run
+        # index and the like — in span attrs instead
+        from ..obs._state import STATE
+
+        ann = contextlib.nullcontext()
+        if self.profile_dir is not None and STATE.trace_dir \
+                and STATE.trace_dir != self.profile_dir:
+            # jax.profiler supports one trace per process: the obs layer's
+            # DLAF_TRACE_DIR wins and this timer's directory stays empty —
+            # say so rather than silently dropping the requested output
+            obs.get_logger("timer").warning_once(
+                ("profile_dir_superseded", self.profile_dir),
+                f"profile_dir={self.profile_dir!r} superseded by "
+                f"DLAF_TRACE_DIR={STATE.trace_dir!r}; the trace lands there",
+                profile_dir=self.profile_dir, trace_dir=STATE.trace_dir)
+        if self.profile_dir is not None and not STATE.trace_dir:
+            # pre-obs contract: this timer owns a jax.profiler trace. Only
+            # when the obs layer has no trace dir of its own — otherwise
+            # the spans below start/annotate exactly one process trace
+            # (a second start_trace would fail).
             import jax
 
-            if not self._tracing:
-                # perfetto trace alongside the xplane: a gzipped JSON this
-                # container can post-process WITHOUT tensorboard
-                # (scripts/profile_summary.py aggregates op durations)
-                jax.profiler.start_trace(self.profile_dir,
-                                         create_perfetto_trace=True)
+            if not self._tracing and obs.start_profiler(self.profile_dir):
+                # claimed via the obs layer's single-owner protocol, so a
+                # later configure(trace_dir=...) mid-phase (lazy config
+                # init inside an algorithm call) can't start_trace again
+                # over this live trace
                 self._tracing = True
-            ctx = jax.profiler.TraceAnnotation(name)
-        t0 = time.perf_counter()
-        with ctx:
+            # the obs span won't annotate (no obs trace dir): keep the
+            # profiler timeline labeled ourselves
+            ann = jax.profiler.TraceAnnotation(name)
+        sp = obs.span(name, **attrs)
+        with sp, ann:
+            # t0 after span entry: one-time jax.profiler.start_trace cost
+            # (possibly hundreds of ms, paid by the first phase) stays out
+            # of the reported per-phase seconds, as pre-obs
+            t0 = time.perf_counter()
             yield
-        self.times[name] = self.times.get(name, 0.0) + time.perf_counter() - t0
+            self.times[name] = self.times.get(name, 0.0) \
+                + time.perf_counter() - t0
 
     def stop(self) -> None:
-        if self._tracing:
-            import jax
+        from ..obs._state import STATE
 
-            jax.profiler.stop_trace()
+        if self._tracing:
+            # routed through the obs layer so its profiler_started flag
+            # clears with the trace (we claimed it at start)
+            obs.stop_profiler()
             self._tracing = False
+        elif self.profile_dir is not None \
+                and STATE.trace_dir == self.profile_dir:
+            # the obs layer started the profiler on this timer's behalf
+            # (profile_dir doubles as the obs trace dir); stopping here
+            # keeps the pre-obs contract that stop() lands the trace files
+            obs.stop_profiler()
 
     def report(self) -> dict[str, float]:
         return dict(self.times)

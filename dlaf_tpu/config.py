@@ -277,6 +277,24 @@ class Configuration:
     #: (jax.profiler traces with named phases) into this directory
     #: (the green-field tracing hook SURVEY §5 calls for).
     profile_dir: str = ""
+    #: Structured-log level for the dlaf_tpu.obs logger ("debug" | "info" |
+    #: "warning" | "error" | "off"): the one-shot auto-knob resolution
+    #: notices and all other library diagnostics route through it, so CI
+    #: and pytest output can silence them with DLAF_LOG=off.
+    log: str = "info"
+    #: When non-empty, the observability layer (dlaf_tpu.obs) appends
+    #: span records, metrics snapshots (collective byte counters, tile-op
+    #: counts, span-duration histograms), and log events to this JSON-lines
+    #: file; schema-checked by ``python -m dlaf_tpu.obs.validate``. Empty
+    #: (default) keeps every instrumented call site a zero-allocation
+    #: no-op.
+    metrics_path: str = ""
+    #: When non-empty, host spans start one jax.profiler trace into this
+    #: directory (TraceAnnotation phase names on the profiler timeline;
+    #: named_scope phase names in compiled-program op metadata). The
+    #: pre-obs ``profile_dir`` knob keeps working; this is the obs-layer
+    #: spelling, and the two may point at the same directory.
+    trace_dir: str = ""
     #: When non-empty, compiled XLA programs persist here across processes
     #: (jax persistent compilation cache). The unrolled factorizations cost
     #: minutes to compile and seconds to run — a disk cache turns every
@@ -346,6 +364,7 @@ _VALID_CHOICES = {
     "dist_step_mode": ("unrolled", "scan", "auto"),
     "hegst_impl": ("blocked", "twosolve", "auto"),
     "bcast_impl": ("psum", "tree"),
+    "log": ("debug", "info", "warning", "error", "off"),
 }
 
 
@@ -429,6 +448,14 @@ def initialize(user: Optional[Configuration] = None,
         import jax
 
         jax.config.update("jax_compilation_cache_dir", None)
+    # bring the observability layer in line with the resolved knobs
+    # (DLAF_LOG / DLAF_METRICS_PATH / DLAF_TRACE_DIR; the legacy
+    # profile_dir knob doubles as a trace dir so pre-obs profiling
+    # configurations keep annotating)
+    from . import obs
+
+    obs.configure(log_level=cfg.log, metrics_path=cfg.metrics_path,
+                  trace_dir=cfg.trace_dir or cfg.profile_dir)
     if cfg.print_config:
         print(cfg)
     _active = cfg
@@ -441,12 +468,6 @@ def get_configuration() -> Configuration:
     if _active is None:
         _active = initialize()
     return _active
-
-
-#: (knob, backend, choice) resolutions already announced on stderr — the
-#: platform-auto knobs log once per distinct outcome so the route in
-#: effect is visible, not silent (round-2 advisory).
-_announced_auto: set = set()
 
 
 def resolve_platform_auto(value: str, *, knob: str, tpu_choice: str,
@@ -465,14 +486,16 @@ def resolve_platform_auto(value: str, *, knob: str, tpu_choice: str,
 
     backend = jax.default_backend()
     choice = tpu_choice if backend == "tpu" else other_choice
-    key = (knob, backend, choice)
-    if key not in _announced_auto:
-        _announced_auto.add(key)
-        import sys
+    from .obs import get_logger
 
-        print(f"dlaf_tpu: {knob}=auto resolved to {choice!r} for default "
-              f"backend {backend!r} ({detail}) — set the knob explicitly "
-              "to override", file=sys.stderr, flush=True)
+    # once per (knob, backend, choice) — the route in effect is visible,
+    # not silent (round-2 advisory), via the obs layer's shared one-shot
+    # registry (reset/forget hooks live there for tests)
+    get_logger("config").warning_once(
+        (knob, backend, choice),
+        f"{knob}=auto resolved to {choice!r} for default backend "
+        f"{backend!r} ({detail}) — set the knob explicitly to override",
+        knob=knob, backend=backend, choice=choice)
     return choice
 
 

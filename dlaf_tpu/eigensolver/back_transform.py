@@ -43,7 +43,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..tile_ops import blas as tb

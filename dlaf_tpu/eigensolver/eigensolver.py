@@ -75,17 +75,33 @@ def eigensolver(uplo: str, a: Matrix,
     fence = (hard_fence if phases is not None
              else (lambda x: None))
     distributed = a.grid is not None and a.grid.num_devices > 1
-    with pt.phase("reduction_to_band"):
+    from .. import obs
+    from ..types import total_ops
+
+    # canonical full-EVP flop model (miniapp_eigensolver): 5n^3/3
+    # muls+adds; the five stage spans below nest under this one
+    pipeline_span = obs.entry_span("eigensolver", lambda: dict(
+        flops=total_ops(np.dtype(a.dtype), 5 * n**3 / 3, 5 * n**3 / 3),
+        n=n, nb=nb, uplo=uplo, dtype=np.dtype(a.dtype).name,
+        grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
+    with pipeline_span:
+        return _eigensolver_pipeline(uplo, a, pt, fence, distributed,
+                                     band_size, donate, n, nb)
+
+
+def _eigensolver_pipeline(uplo, a, pt, fence, distributed, band_size,
+                          donate, n, nb):
+    with pt.phase("stage.reduction_to_band"):
         # ``donate`` consumes a's storage at the hermitianize; ah itself
         # is always a fresh copy owned by this driver — donate it to the
         # reduction (one full matrix off peak HBM either way)
         ah = mops.hermitianize(a, uplo, donate=donate)
         red = reduction_to_band(ah, band_size=band_size, donate=True)
         fence(red.matrix.storage)
-    with pt.phase("band_to_tridiag"):
+    with pt.phase("stage.band_to_tridiag"):
         band = extract_band(red)
         tri = band_to_tridiag(band, red.band)
-    with pt.phase("tridiag_solver"):
+    with pt.phase("stage.tridiag_solver"):
         # distributed: the merge-tree gemms, qc workspaces, and Q run
         # sharded over the grid's mesh (beyond the local-only reference) —
         # the (n, n) merge arrays never have to fit one device's HBM
@@ -93,7 +109,7 @@ def eigensolver(uplo: str, a: Matrix,
         lam, z = tridiag_solver(tri.d, tri.e, nb,
                                 mesh=a.grid.mesh if distributed else None)
         fence(z)
-    with pt.phase("bt_band_to_tridiag"):
+    with pt.phase("stage.bt_band_to_tridiag"):
         if distributed:
             # z is a device-resident jax.Array (tridiag_solver keeps Q on
             # device across the merge tree); from_global re-tiles it ON
@@ -106,7 +122,7 @@ def eigensolver(uplo: str, a: Matrix,
         else:
             zb = bt_band_to_tridiag(tri, z)
             fence(zb)
-    with pt.phase("bt_reduction_to_band"):
+    with pt.phase("stage.bt_reduction_to_band"):
         out = bt_reduction_to_band(red, zb)
         if distributed:
             vecs = out
@@ -131,10 +147,23 @@ def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
     pt = phases if phases is not None else PhaseTimer()
     fence = (hard_fence if phases is not None
              else (lambda x: None))
-    with pt.phase("cholesky"):
+    from .. import obs
+
+    pipeline_span = obs.entry_span("gen_eigensolver", lambda: dict(
+        n=a.size.row, nb=a.block_size.row, uplo=uplo,
+        dtype=np.dtype(a.dtype).name,
+        grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
+    with pipeline_span:
+        return _gen_eigensolver_pipeline(uplo, a, b, pt, phases, fence,
+                                         band_size, donate)
+
+
+def _gen_eigensolver_pipeline(uplo, a, b, pt, phases, fence, band_size,
+                              donate):
+    with pt.phase("stage.cholesky"):
         bf = cholesky(uplo, b)
         fence(bf.storage)
-    with pt.phase("gen_to_std"):
+    with pt.phase("stage.gen_to_std"):
         astd = gen_to_std(uplo, a, bf, donate=donate)
         fence(astd.storage)
     # astd is owned by this driver — always donated into the pipeline
@@ -143,7 +172,7 @@ def gen_eigensolver(uplo: str, a: Matrix, b: Matrix,
     # back-substitute eigenvectors (reference gen_eigensolver/impl.h:24-35):
     # uplo=L: B = L L^H, standard vec y -> x = L^-H y
     # uplo=U: B = U^H U,                x = U^-1 y
-    with pt.phase("back_substitution"):
+    with pt.phase("stage.back_substitution"):
         # res.eigenvectors is owned by this driver — donated into the solve
         if uplo == "L":
             vecs = triangular_solve("L", "L", "C", "N", 1.0, bf,

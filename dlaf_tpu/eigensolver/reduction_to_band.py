@@ -38,11 +38,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..tile_ops.qr_panel import panel_qr  # geqrf-convention; route per config
 
+from .. import obs
 from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
@@ -540,8 +541,16 @@ def reduction_to_band(a: Matrix, band_size: int | None = None, *,
     # the traced step count is the PANEL count: the builders run
     # ceil(n/band) - 1 panel steps (the last panel has no trailing block)
     steps = max(-(-a.size.row // band) - 1, 1)
+    from ..types import total_ops
+
+    n = a.size.row
+    # reference flop model (miniapp_reduction_to_band): 2n^3/3 muls+adds
+    entry_span = obs.entry_span("reduction_to_band", lambda: dict(
+        flops=total_ops(np.dtype(a.dtype), 2 * n**3 / 3, 2 * n**3 / 3),
+        n=n, nb=nb, band=band, dtype=np.dtype(a.dtype).name,
+        grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
     if a.grid is None or a.grid.num_devices == 1:
-        with quiet_donation():
+        with entry_span, quiet_donation():
             g = to_global(a.storage, a.dist, donate)
             if resolve_step_mode(steps) == "scan":
                 out, taus = _red2band_local_scan(g, nb=band)
@@ -554,7 +563,7 @@ def reduction_to_band(a: Matrix, band_size: int | None = None, *,
                                band,
                                scan=resolve_step_mode(steps) == "scan",
                                donate=donate)
-    with quiet_donation():
+    with entry_span, quiet_donation():
         storage, taus = fn(a.storage)
     return BandReduction(a.with_storage(storage), taus, band)
 

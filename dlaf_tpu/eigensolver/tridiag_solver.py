@@ -46,11 +46,6 @@ _SHARD_MERGE_MIN_N = 512
 _DEVICE_SECULAR_MIN_K_NO_NATIVE = 1024
 
 
-#: one announcement per (backend, threshold) resolution of the 0 "auto"
-#: default (auto decisions must not be silent — round-2 advisory pattern)
-_announced_secular: set = set()
-
-
 def _device_secular_min_k() -> int:
     from ..config import get_configuration
 
@@ -82,16 +77,18 @@ def _device_secular_min_k() -> int:
         import jax
 
         backend = jax.default_backend()
-        if (backend, s) not in _announced_secular:
-            _announced_secular.add((backend, s))
-            import sys
+        from ..obs import get_logger
 
-            label = "host-always" if s >= (1 << 62) else str(s)
-            print(f"dlaf_tpu: secular_device_min_k=0 (auto) resolved to "
-                  f"{label} for default backend {backend!r}"
-                  f"{'' if have_native else ' (no native secular solver)'}"
-                  " — set the knob explicitly to override",
-                  file=sys.stderr, flush=True)
+        label = "host-always" if s >= (1 << 62) else str(s)
+        # once per (backend, threshold) — auto decisions must not be
+        # silent (round-2 advisory pattern)
+        get_logger("config").warning_once(
+            ("secular_device_min_k", backend, s),
+            f"secular_device_min_k=0 (auto) resolved to {label} for "
+            f"default backend {backend!r}"
+            f"{'' if have_native else ' (no native secular solver)'}"
+            " — set the knob explicitly to override",
+            knob="secular_device_min_k", backend=backend, choice=label)
     return s
 
 

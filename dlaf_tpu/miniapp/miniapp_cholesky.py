@@ -73,13 +73,25 @@ def run(argv=None) -> list[dict]:
 
 
 def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
+    from .. import obs
+
     n, nb = args.matrix_size, args.block_size
+    flops = total_ops(opts.dtype, n**3 / 6, n**3 / 6)
     announce_donation()   # timed runs consume their input copies
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)   # fresh copy per run (:127-128)
         hard_fence(mat.storage)                   # start fence (:134-136)
         t0 = time.perf_counter()
-        with ptimer.phase(f"cholesky[{run_i}]"):
+        # per-step span: fenced device wall per timed run, with the
+        # reference flop model attached so the JSONL record derives
+        # GFlop/s — the per-step artifact the CI smoke gate validates
+        step_span = obs.span("miniapp_cholesky.run", flops=flops,
+                             run=run_i, warmup=run_i < 0, n=n, nb=nb,
+                             uplo=args.uplo,
+                             dtype=np.dtype(opts.dtype).name,
+                             grid=f"{opts.grid_rows}x{opts.grid_cols}",
+                             backend=backend)
+        with step_span, ptimer.phase("cholesky.factor", run=run_i):
             # donate: the reference's cholesky overwrites mat_a in place
             # (factorization/cholesky.h:36); this run's fresh copy is dead
             # after the call, and the freed buffer is what lets N=16384
@@ -87,7 +99,7 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
             out = cholesky(args.uplo, mat, donate=True)
             hard_fence(out.storage)               # end fence (:142-144)
         t = time.perf_counter() - t0
-        gflops = total_ops(opts.dtype, n**3 / 6, n**3 / 6) / t / 1e9
+        gflops = flops / t / 1e9
         if run_i < 0:
             continue
         line = (f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
@@ -98,6 +110,10 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
         last = run_i == opts.nruns - 1
         if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
             check_cholesky(args.uplo, ref, out)
+    # land the counters (collective bytes, tile ops, span histograms) in
+    # the artifact now — not at interpreter exit — so library callers and
+    # the CI gate read a complete file as soon as run() returns
+    obs.flush()
     return results
 
 

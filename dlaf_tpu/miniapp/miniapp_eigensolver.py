@@ -75,6 +75,7 @@ def run(argv=None) -> list[dict]:
 
     backend = devices[0].platform
     results = []
+    from .. import obs
     from ..common.timer import PhaseTimer
 
     # phase instrumentation is opt-in (profile_dir set): its per-stage device
@@ -88,6 +89,14 @@ def run(argv=None) -> list[dict]:
         a_in = am.with_storage(am.storage + 0)
         hard_fence(a_in.storage)
         t0 = time.perf_counter()
+        flops = total_ops(opts.dtype, 5 * n**3 / 3, 5 * n**3 / 3)
+        step_span = obs.span(
+            "miniapp_eigensolver.run", flops=flops, run=run_i,
+            warmup=run_i < 0, n=n, nb=nb, uplo=args.uplo,
+            generalized=bool(args.generalized),
+            dtype=np.dtype(opts.dtype).name,
+            grid=f"{opts.grid_rows}x{opts.grid_cols}", backend=backend)
+        step_span.__enter__()
         try:
             # donate: this run's fresh copy of A is dead after the call
             # (reference in-place pipeline); B is reused across runs and
@@ -100,9 +109,10 @@ def run(argv=None) -> list[dict]:
                                   band_size=band, donate=True)
             hard_fence(res.eigenvectors.storage)
         finally:
+            step_span.__exit__(None, None, None)
             ptimer.stop()
         t = time.perf_counter() - t0
-        gflops = total_ops(opts.dtype, 5 * n**3 / 3, 5 * n**3 / 3) / t / 1e9
+        gflops = flops / t / 1e9
         if run_i < 0:
             continue
         name = "gen_evp" if args.generalized else "evp"
@@ -117,6 +127,7 @@ def run(argv=None) -> list[dict]:
         last = run_i == opts.nruns - 1
         if opts.check is CheckIterFreq.ALL or (opts.check is CheckIterFreq.LAST and last):
             check(args, am, bm, res)
+    obs.flush()   # complete the JSONL artifact before returning
     return results
 
 

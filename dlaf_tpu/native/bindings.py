@@ -86,10 +86,12 @@ def get_lib():
             lib.dlaf_deflate_scan_d.restype = ctypes.c_int64
         except Exception as e:
             _load_error = e
-            import sys
+            from ..obs import get_logger
 
-            print(f"dlaf_tpu.native: build/load failed ({e!r}); "
-                  "numpy fallbacks in effect", file=sys.stderr)
+            # error level: an order-of-magnitude perf cliff must stay
+            # visible even under DLAF_LOG=error deployments
+            get_logger("native").error(
+                f"build/load failed ({e!r}); numpy fallbacks in effect")
             raise
         _lib = lib
         return lib

@@ -1,0 +1,191 @@
+"""dlaf_tpu.obs — structured tracing, metrics, and logging.
+
+The observability layer ISSUE 1 calls for (and SURVEY §5 maps from the
+reference's pika-delegated profiling): one subsystem under three knobs,
+layered like every other :class:`dlaf_tpu.config.Configuration` field
+(default < user struct < env < ``--dlaf:`` CLI):
+
+* ``DLAF_LOG`` (``Configuration.log``) — leveled structured logging
+  (debug/info/warning/error/off), :mod:`dlaf_tpu.obs.logging`.
+* ``DLAF_METRICS_PATH`` (``Configuration.metrics_path``) — JSON-lines
+  artifact receiving span records, metrics snapshots, and log events
+  (:mod:`dlaf_tpu.obs.sinks`; schema validated by
+  ``python -m dlaf_tpu.obs.validate``). Setting it turns the tracer and
+  the metrics registry on.
+* ``DLAF_TRACE_DIR`` (``Configuration.trace_dir``) — ``jax.profiler``
+  trace directory; host spans then also carry
+  ``jax.profiler.TraceAnnotation`` names onto the profiler timeline, and
+  trace-time :func:`named_span` phases land in compiled-program op
+  metadata.
+
+Cost contract: with all three unset, every instrumented call site
+resolves to a module-level no-op singleton — no allocation, one attribute
+read — so the instrumentation in comm/algorithms/eigensolver hot paths is
+free when off (verified by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from typing import Optional
+
+from . import logging as _logging
+from . import metrics as _metrics
+from . import sinks as _sinks
+from . import trace as _trace
+from ._state import LOG_LEVELS, STATE
+from .logging import Logger, get_logger
+from .metrics import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
+                      Gauge, Histogram, Registry, prometheus_text)
+from .sinks import (SCHEMA_VERSION, JsonlSink, read_records, validate_file,
+                    validate_records)
+from .trace import (NOOP_CTX, NOOP_SPAN, Span, current_span, entry_span,
+                    named_span, span, start_profiler, stop_profiler)
+
+__all__ = [
+    "configure", "enabled", "metrics_active", "span", "entry_span",
+    "named_span",
+    "current_span", "counter", "gauge", "histogram", "registry",
+    "get_logger", "emit_event", "emit_metrics_snapshot", "flush",
+    "prometheus_text", "prometheus_snapshot_text", "validate_file",
+    "validate_records", "read_records", "Span", "Counter", "Gauge",
+    "Histogram", "Registry", "Logger", "JsonlSink", "SCHEMA_VERSION",
+    "NOOP_SPAN", "NOOP_CTX", "NOOP_COUNTER", "NOOP_GAUGE", "NOOP_HISTOGRAM",
+    "LOG_LEVELS", "start_profiler", "stop_profiler",
+]
+
+
+def configure(log_level: str = "info", metrics_path: str = "",
+              trace_dir: str = "") -> None:
+    """(Re)configure the layer — called by ``config.initialize()`` with the
+    resolved knobs, or lazily from the env by the first logging call in a
+    process that never initializes the runtime.
+
+    Reconfiguring with a different ``metrics_path`` closes the old sink
+    (its file stays, a complete artifact); counters persist across
+    reconfiguration within a process — they are process-lifetime
+    accumulators, like the reference's performance counters.
+    """
+    level = str(log_level or "info").strip().lower()
+    if level not in LOG_LEVELS:
+        raise ValueError(f"DLAF_LOG={log_level!r}: must be one of "
+                         f"{tuple(LOG_LEVELS)}")
+    STATE.log_level = level
+    STATE.log_level_num = LOG_LEVELS[level]
+    if STATE.sink is not None and STATE.sink.path != metrics_path:
+        emit_metrics_snapshot()
+        STATE.sink.close()
+        STATE.sink = None
+    if metrics_path and STATE.sink is None:
+        STATE.sink = _sinks.JsonlSink(metrics_path)
+    STATE.trace_dir = trace_dir or ""
+    STATE.metrics_on = STATE.sink is not None
+    STATE.annotate = bool(trace_dir)
+    if STATE.registry is None and (STATE.metrics_on or STATE.annotate):
+        STATE.registry = _metrics.Registry()
+    if (STATE.metrics_on or STATE.annotate) and not STATE.atexit_registered:
+        STATE.atexit_registered = True
+        atexit.register(_shutdown)
+    STATE.configured = True
+
+
+def _shutdown() -> None:
+    """Process exit: flush a final metrics snapshot and stop the profiler
+    so artifacts are complete even when drivers forget to call flush()."""
+    try:
+        emit_metrics_snapshot()
+    finally:
+        _trace.stop_profiler()
+        if STATE.sink is not None:
+            STATE.sink.close()
+
+
+def enabled() -> bool:
+    """True when any observability output is active."""
+    return STATE.metrics_on or STATE.annotate
+
+
+def metrics_active() -> bool:
+    """Fast-path gate for instrumentation call sites (one attribute read)."""
+    return STATE.metrics_on
+
+
+def registry() -> Registry:
+    """The process registry (created on first use — usable directly even
+    with the sinks off, e.g. for tests or embedding applications)."""
+    if STATE.registry is None:
+        STATE.registry = _metrics.Registry()
+    return STATE.registry
+
+
+def counter(name: str, **labels):
+    """Registry counter handle, or the no-op singleton when metrics are
+    off (zero per-call allocation at disabled call sites)."""
+    if not STATE.metrics_on:
+        return NOOP_COUNTER
+    return STATE.registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels):
+    if not STATE.metrics_on:
+        return NOOP_GAUGE
+    return STATE.registry.gauge(name, **labels)
+
+
+def histogram(name: str, **labels):
+    if not STATE.metrics_on:
+        return NOOP_HISTOGRAM
+    return STATE.registry.histogram(name, **labels)
+
+
+def emit_event(rtype: str, **payload) -> None:
+    """Append a free-form record (e.g. ``bench_result``) to the JSONL
+    artifact; no-op when the sink is off."""
+    if STATE.sink is not None:
+        rec = {"type": rtype}
+        rec.update(payload)
+        STATE.sink.write(rec)
+
+
+def emit_metrics_snapshot() -> None:
+    """Write the registry's current state as one ``metrics`` record."""
+    if STATE.sink is not None and STATE.registry is not None:
+        snap = STATE.registry.snapshot()
+        if snap:
+            STATE.sink.write({"type": "metrics", "metrics": snap})
+
+
+def flush() -> None:
+    """Snapshot metrics now (drivers call this at the end of a run so the
+    artifact is complete without relying on interpreter shutdown)."""
+    emit_metrics_snapshot()
+
+
+def prometheus_snapshot_text() -> str:
+    """Prometheus text exposition of the live registry."""
+    if STATE.registry is None:
+        return ""
+    return prometheus_text(STATE.registry.snapshot())
+
+
+def _reset_for_tests() -> None:
+    """Tear the layer back to the unconfigured default (tests only)."""
+    try:
+        # a test that left the process trace live must not leak it into
+        # the rest of the session (it would record everything until exit)
+        _trace.stop_profiler()
+    except Exception:
+        pass
+    if STATE.sink is not None:
+        STATE.sink.close()
+    STATE.sink = None
+    STATE.metrics_on = False
+    STATE.annotate = False
+    STATE.trace_dir = ""
+    STATE.registry = None
+    STATE.configured = False
+    STATE.log_level = "info"
+    STATE.log_level_num = LOG_LEVELS["info"]
+    _logging.reset_once()

@@ -1,0 +1,64 @@
+"""Shared mutable state of the observability layer.
+
+One module-level :data:`STATE` object, mutated only by
+:func:`dlaf_tpu.obs.configure` (driven by ``config.initialize()``) and by
+the lazy env-var fallback for processes that use the library without ever
+initializing the runtime. Every hot-path check in the tracer/metrics/logger
+is a read of one attribute here — no locks, no dict lookups — so call sites
+stay allocation-free when observability is off.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: DLAF_LOG levels, lowest first. "off" silences everything.
+LOG_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "off": 99}
+
+
+class _ObsState:
+    __slots__ = ("configured", "log_level", "log_level_num", "metrics_on",
+                 "annotate", "trace_dir", "sink", "registry",
+                 "profiler_started", "atexit_registered")
+
+    def __init__(self):
+        self.configured = False
+        self.log_level = "info"
+        self.log_level_num = LOG_LEVELS["info"]
+        self.metrics_on = False          # counters/spans record + JSONL sink
+        self.annotate = False            # jax named_scope/TraceAnnotation on
+        self.trace_dir = ""              # jax.profiler trace output dir
+        self.sink = None                 # type: Optional[object]  # JsonlSink
+        self.registry = None             # type: Optional[object]  # Registry
+        self.profiler_started = False
+        self.atexit_registered = False
+
+
+STATE = _ObsState()
+
+
+def ensure_env_defaults() -> None:
+    """Lazy fallback: pick up ``DLAF_LOG``/``DLAF_METRICS_PATH``/
+    ``DLAF_TRACE_DIR`` straight from the environment when nothing has
+    called :func:`dlaf_tpu.obs.configure` yet (library use without
+    ``config.initialize()``). A later real configure() overrides this."""
+    if STATE.configured:
+        return
+    from . import configure
+
+    level = os.environ.get("DLAF_LOG", "info")
+    if str(level).strip().lower() not in LOG_LEVELS:
+        # this path is reached from informational log calls deep inside
+        # library code (a knob-resolution notice, a native-load warning):
+        # a misspelled env var must not turn those into a crash. The
+        # explicit config.initialize() path still rejects bad values.
+        import sys
+
+        print(f"dlaf_tpu[warning] obs: DLAF_LOG={level!r} is not one of "
+              f"{tuple(LOG_LEVELS)}; using 'info'", file=sys.stderr,
+              flush=True)
+        level = "info"
+    configure(log_level=level,
+              metrics_path=os.environ.get("DLAF_METRICS_PATH", ""),
+              trace_dir=os.environ.get("DLAF_TRACE_DIR", ""))

@@ -1,0 +1,237 @@
+"""Metrics registry: counters, gauges, histograms + Prometheus exposition.
+
+The reference delegates runtime counters to pika's performance counters
+(SURVEY §5); the TPU rebuild wants the per-collective byte accounting that
+arXiv:2112.09017 credits its ICI tuning wins to, so the registry is a
+first-class subsystem here. Semantics:
+
+* **Counter** — monotone accumulator (``inc``). Collective counts/bytes,
+  tile-op counts.
+* **Gauge** — last-write-wins scalar (``set``).
+* **Histogram** — count/sum/min/max plus cumulative bucket counts over
+  fixed upper bounds (powers of two by default, Prometheus ``le``
+  convention). Span durations.
+
+Handles are cheap objects bound to their registry slot: call sites fetch
+them via :func:`Registry.counter` etc. (get-or-create keyed on
+``(kind, name, labels)``). The module-level no-op twins (``NOOP_COUNTER``
+...) are what :mod:`dlaf_tpu.obs` hands out when observability is off —
+method calls on them do nothing and allocate nothing.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional
+
+#: Default histogram upper bounds: powers of two from 1 us to ~17 min,
+#: in seconds — span durations from tile ops to whole-pipeline runs.
+DEFAULT_BUCKETS = tuple(2.0 ** e for e in range(-20, 11))
+
+
+class Counter:
+    __slots__ = ("name", "labels", "value", "lock")
+
+    def __init__(self, name: str, labels: dict, lock=None):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        # the owning registry shares its lock so mutation excludes
+        # snapshot(); spans run on arbitrary threads (trace.py keeps a
+        # per-thread span stack) and bare ``+=`` would lose increments
+        self.lock = lock or threading.Lock()
+
+    def inc(self, n=1) -> None:
+        with self.lock:
+            self.value += n
+
+    def snapshot(self) -> dict:
+        # callers serialize via the registry lock (Registry.snapshot)
+        return {"name": self.name, "kind": "counter", "labels": self.labels,
+                "value": self.value}
+
+
+class Gauge:
+    __slots__ = ("name", "labels", "value", "lock")
+
+    def __init__(self, name: str, labels: dict, lock=None):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self.lock = lock or threading.Lock()
+
+    def set(self, v) -> None:
+        v = float(v)
+        with self.lock:
+            self.value = v
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "kind": "gauge", "labels": self.labels,
+                "value": self.value}
+
+
+class Histogram:
+    __slots__ = ("name", "labels", "bounds", "bucket_counts", "count",
+                 "sum", "min", "max", "lock")
+
+    def __init__(self, name: str, labels: dict, bounds=DEFAULT_BUCKETS,
+                 lock=None):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(bounds)
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # +inf overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.lock = lock or threading.Lock()
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self.lock:
+            # count/sum/buckets move together, or a concurrent snapshot
+            # breaks the Prometheus invariant bucket{le="+Inf"} == count
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+            for i, b in enumerate(self.bounds):
+                if v <= b:
+                    self.bucket_counts[i] += 1
+                    return
+            self.bucket_counts[-1] += 1
+
+    def cumulative_buckets(self):
+        """Prometheus-convention cumulative ``[le, count]`` pairs, the
+        final one ``["+Inf", count]``."""
+        out, acc = [], 0
+        for b, c in zip(self.bounds, self.bucket_counts):
+            acc += c
+            out.append([b, acc])
+        out.append(["+Inf", acc + self.bucket_counts[-1]])
+        return out
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "kind": "histogram", "labels": self.labels,
+                "count": self.count, "sum": self.sum,
+                "min": self.min if self.count else 0.0,
+                "max": self.max if self.count else 0.0,
+                "buckets": self.cumulative_buckets()}
+
+
+class _NoopCounter:
+    __slots__ = ()
+
+    def inc(self, n=1) -> None:
+        pass
+
+
+class _NoopGauge:
+    __slots__ = ()
+
+    def set(self, v) -> None:
+        pass
+
+
+class _NoopHistogram:
+    __slots__ = ()
+
+    def observe(self, v) -> None:
+        pass
+
+
+#: Singletons the facade returns when observability is off: no state, no
+#: per-call allocation (the acceptance criterion's no-op fast path).
+NOOP_COUNTER = _NoopCounter()
+NOOP_GAUGE = _NoopGauge()
+NOOP_HISTOGRAM = _NoopHistogram()
+
+
+def _labels_key(labels: dict):
+    return tuple(sorted(labels.items()))
+
+
+class Registry:
+    """Get-or-create metric store keyed on ``(kind, name, labels)``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict = {}
+
+    def _get(self, kind, cls, name, labels, **kw):
+        key = (kind, name, _labels_key(labels))
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    # metrics share the registry lock: snapshot() holds it,
+                    # so no update can tear a histogram mid-serialization
+                    m = cls(name, labels, lock=self._lock, **kw)
+                    self._metrics[key] = m
+        return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, labels)
+
+    def histogram(self, name: str, bounds: Optional[tuple] = None,
+                  **labels) -> Histogram:
+        kw = {"bounds": bounds} if bounds is not None else {}
+        return self._get("histogram", Histogram, name, labels, **kw)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return [m.snapshot() for m in self._metrics.values()]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _prom_num(v) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(snapshot: list) -> str:
+    """Prometheus text exposition (format 0.0.4) of a registry snapshot
+    (the list :func:`Registry.snapshot` returns)."""
+    by_name: dict = {}
+    for m in snapshot:
+        by_name.setdefault((m["name"], m["kind"]), []).append(m)
+    lines = []
+    for (name, kind), entries in sorted(by_name.items()):
+        lines.append(f"# TYPE {name} {kind}")
+        for m in entries:
+            labels = m.get("labels", {})
+            if kind == "histogram":
+                for le, cnt in m["buckets"]:
+                    lb = dict(labels)
+                    lb["le"] = le if isinstance(le, str) else _prom_num(le)
+                    lines.append(f"{name}_bucket{_prom_labels(lb)} {cnt}")
+                lines.append(f"{name}_sum{_prom_labels(labels)} "
+                             f"{_prom_num(m['sum'])}")
+                lines.append(f"{name}_count{_prom_labels(labels)} "
+                             f"{m['count']}")
+            else:
+                lines.append(f"{name}{_prom_labels(labels)} "
+                             f"{_prom_num(m['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,189 @@
+"""Event sinks and the JSONL artifact schema.
+
+Two output formats, per the observability design (ISSUE 1):
+
+* **JSON lines** (:class:`JsonlSink`) — one self-describing event object
+  per line, append-only, the same artifact convention as the repo's
+  ``BENCH_*.json`` round files. Everything the tracer/metrics/logger emit
+  flows through here when ``DLAF_METRICS_PATH`` is set.
+* **Prometheus text exposition** (:func:`prometheus_text`, over a registry
+  snapshot) — for scraping; see :mod:`dlaf_tpu.obs.metrics`.
+
+Schema (version 1). Every record carries ``v`` (int schema version),
+``type`` (str) and ``ts`` (float, unix seconds). Per type:
+
+``span``
+    ``name`` str, ``dur_s`` finite float >= 0, ``depth`` int >= 0,
+    ``parent`` str or null, ``attrs`` object. Optional ``flops`` (finite
+    number) and ``gflops`` (finite number, derived = flops / dur_s / 1e9).
+    Optional ``fenced: false`` marks spans whose wall clock is host
+    trace+dispatch only (async JAX work, no device fence inside the
+    region) — such records never carry ``gflops``.
+``metrics``
+    ``metrics``: list of snapshot entries — ``name`` str, ``kind``
+    "counter" | "gauge" | "histogram", ``labels`` object; counters/gauges
+    carry finite ``value``; histograms carry ``count``/``sum``/``min``/
+    ``max`` and ``buckets`` (list of [le, count]).
+``log``
+    ``level`` str, ``logger`` str, ``msg`` str, ``fields`` object.
+``bench_result``
+    ``payload`` object (free-form; bench.py's measurement line).
+
+:func:`validate_file` is the single schema owner consumed by tests and the
+CI gate (``python -m dlaf_tpu.obs.validate``): it rejects unparsable lines,
+missing fields, and non-finite numerics (a NaN GFlop/s must fail the tier,
+not scrape as a number).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+KNOWN_TYPES = ("span", "metrics", "log", "bench_result")
+
+
+class JsonlSink:
+    """Append-only JSON-lines writer; thread-safe, line-buffered so a
+    killed process still leaves a readable prefix."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = None
+
+    def write(self, record: dict) -> None:
+        record.setdefault("v", SCHEMA_VERSION)
+        record.setdefault("ts", time.time())
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._f is None:
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(line + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool) \
+        and math.isfinite(x)
+
+
+def _validate_span(r: dict, where: str, errors: list) -> None:
+    if not isinstance(r.get("name"), str) or not r.get("name"):
+        errors.append(f"{where}: span without a name")
+    if not _finite(r.get("dur_s")) or r.get("dur_s", -1) < 0:
+        errors.append(f"{where}: span dur_s missing/non-finite/negative")
+    if not isinstance(r.get("depth"), int) or r.get("depth", -1) < 0:
+        errors.append(f"{where}: span depth missing or negative")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: span attrs must be an object")
+    for key in ("flops", "gflops"):
+        if key in r and not _finite(r[key]):
+            errors.append(f"{where}: span {key} non-finite")
+    if r.get("fenced") is False and "gflops" in r:
+        # the tracer never derives throughput from unfenced dispatch
+        # wall; hold third-party emitters to the same contract
+        errors.append(f"{where}: unfenced span must not carry gflops")
+
+
+def _validate_metrics(r: dict, where: str, errors: list) -> None:
+    entries = r.get("metrics")
+    if not isinstance(entries, list):
+        errors.append(f"{where}: metrics record without a metrics list")
+        return
+    for i, m in enumerate(entries):
+        w = f"{where} metric[{i}]"
+        if not isinstance(m.get("name"), str) or not m.get("name"):
+            errors.append(f"{w}: missing name")
+        kind = m.get("kind")
+        if kind not in ("counter", "gauge", "histogram"):
+            errors.append(f"{w}: bad kind {kind!r}")
+        elif kind == "histogram":
+            for key in ("count", "sum"):
+                if not _finite(m.get(key)):
+                    errors.append(f"{w}: histogram {key} non-finite")
+        elif not _finite(m.get("value")):
+            errors.append(f"{w}: {kind} value non-finite")
+        if not isinstance(m.get("labels", {}), dict):
+            errors.append(f"{w}: labels must be an object")
+
+
+def validate_records(records, require_spans=False, require_gflops=False,
+                     require_collectives=False) -> list:
+    """Validate parsed records; returns a list of error strings (empty =
+    valid). ``require_*`` add the CI smoke-tier artifact obligations:
+    at least one span, at least one span with finite derived gflops, and
+    collective byte counters in some metrics snapshot."""
+    errors = []
+    n_spans = n_gflops = n_coll = 0
+    for i, r in enumerate(records):
+        where = f"record {i}"
+        if not isinstance(r, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        rtype = r.get("type")
+        if rtype not in KNOWN_TYPES:
+            errors.append(f"{where}: unknown type {rtype!r}")
+            continue
+        if not _finite(r.get("ts")):
+            errors.append(f"{where}: missing/non-finite ts")
+        if r.get("v") != SCHEMA_VERSION:
+            errors.append(f"{where}: schema version {r.get('v')!r} != "
+                          f"{SCHEMA_VERSION}")
+        if rtype == "span":
+            _validate_span(r, where, errors)
+            n_spans += 1
+            if _finite(r.get("gflops")):
+                n_gflops += 1
+        elif rtype == "metrics":
+            _validate_metrics(r, where, errors)
+            for m in r.get("metrics") or []:
+                if isinstance(m, dict) and \
+                        m.get("name") == "dlaf_comm_collective_bytes_total" \
+                        and _finite(m.get("value")) and m["value"] > 0:
+                    n_coll += 1
+        elif rtype == "log":
+            if not isinstance(r.get("msg"), str):
+                errors.append(f"{where}: log without msg")
+    if require_spans and n_spans == 0:
+        errors.append("artifact contains no span records")
+    if require_gflops and n_gflops == 0:
+        errors.append("artifact contains no span with finite derived gflops")
+    if require_collectives and n_coll == 0:
+        errors.append("artifact contains no positive "
+                      "dlaf_comm_collective_bytes_total counter")
+    return errors
+
+
+def read_records(path: str) -> list:
+    """Parse a JSONL artifact; raises ValueError on an unparsable line."""
+    records = []
+    with open(path) as f:
+        for ln, raw in enumerate(f, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                records.append(json.loads(raw))
+            except ValueError as e:
+                raise ValueError(f"{path}:{ln}: unparsable JSON ({e})")
+    return records
+
+
+def validate_file(path: str, **require) -> list:
+    """Errors for the artifact at ``path`` (empty list = schema-valid)."""
+    try:
+        records = read_records(path)
+    except (OSError, ValueError) as e:
+        return [str(e)]
+    return validate_records(records, **require)

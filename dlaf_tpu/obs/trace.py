@@ -1,0 +1,213 @@
+"""Span-based tracer: nested host-side spans + device-timeline names.
+
+A span measures host wall clock around a region (an algorithm entry, a
+pipeline stage, a timed miniapp run) and, when active, also enters a
+``jax.profiler.TraceAnnotation`` so profiler timelines carry the same
+names. Builders that run at *trace time* (the unrolled per-``k`` loops)
+use :func:`named_span` instead — a ``jax.named_scope`` whose cost is paid
+once at trace time and whose names land in the compiled program's op
+metadata (the device timeline), never in the runtime hot path.
+
+Nesting is tracked per-thread; each emitted span record carries its
+``depth`` and ``parent`` so ``scripts/profile_summary.py`` can rebuild the
+call tree from the flat JSONL. Spans given ``flops`` derive GFlop/s at
+exit — the per-step records BENCH rounds previously reverse-engineered
+from stdout.
+
+When observability is off, :func:`span`/:func:`named_span` return
+module-level no-op singletons: zero per-call allocation (ISSUE 1
+acceptance criterion).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ._state import STATE
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, key, value) -> None:
+        pass
+
+
+#: Singletons for the disabled fast path. NOOP_CTX doubles as the
+#: trace-time named_span no-op.
+NOOP_SPAN = _NoopSpan()
+NOOP_CTX = NOOP_SPAN
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class Span:
+    """Reentrant context manager: one Span object per region entry (the
+    same name may be nested or repeated freely)."""
+
+    __slots__ = ("name", "attrs", "flops", "fenced", "t0", "dur_s", "depth",
+                 "parent", "_ann")
+
+    def __init__(self, name: str, flops=None, fenced=True, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self.flops = flops
+        self.fenced = fenced
+        self.t0 = None
+        self.dur_s = None
+        self._ann = None
+
+    def set_attr(self, key, value) -> None:
+        """Attach/override an attribute after entry (e.g. a route resolved
+        mid-region)."""
+        self.attrs[key] = value
+
+    def __enter__(self):
+        st = _stack()
+        self.depth = len(st)
+        self.parent = st[-1].name if st else None
+        st.append(self)
+        if STATE.annotate:
+            _maybe_start_profiler()
+            import jax
+
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.dur_s = time.perf_counter() - self.t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        elif self in st:       # exotic exit order; keep the stack sane
+            st.remove(self)
+        self._emit()
+        return False
+
+    def _emit(self) -> None:
+        if STATE.registry is not None:
+            STATE.registry.histogram("dlaf_span_seconds",
+                                     span=self.name).observe(self.dur_s)
+        if STATE.sink is None:
+            return
+        rec = {"type": "span", "name": self.name, "dur_s": self.dur_s,
+               "depth": self.depth, "parent": self.parent,
+               "attrs": self.attrs}
+        if not self.fenced:
+            rec["fenced"] = False
+        if self.flops is not None:
+            rec["flops"] = float(self.flops)
+            # derive GFlop/s only when the region's wall is honest work
+            # (fenced): an unfenced span around async JAX dispatch would
+            # report dispatch time as throughput — numbers past hardware
+            # peak that then outrank the real ones in summaries
+            if self.fenced and self.dur_s > 0:
+                rec["gflops"] = float(self.flops) / self.dur_s / 1e9
+        STATE.sink.write(rec)
+
+
+def span(name: str, flops=None, fenced=True, **attrs):
+    """A host-side span, or the no-op singleton when observability is off.
+
+    ``flops``: flop count of the region — the emitted record then carries
+    derived ``gflops`` (only when ``fenced``; callers whose region does not
+    block on device completion pass ``fenced=False`` so the record keeps
+    the flop model but never a dispatch-time throughput). Other keyword
+    arguments become the span's attrs.
+    """
+    if not (STATE.metrics_on or STATE.annotate):
+        return NOOP_SPAN
+    return Span(name, flops=flops, fenced=fenced, **attrs)
+
+
+def entry_span(name: str, attrs_fn):
+    """Algorithm-entry span: unfenced (the library dispatches async work;
+    device completion is the caller's fence, so no derived gflops), with
+    lazily built attrs — ``attrs_fn`` is a zero-argument callable returning
+    the attr dict (``flops`` allowed as a key) that is never invoked when
+    observability is off, keeping flop models and attr strings off the
+    disabled path (the cost contract)."""
+    if not (STATE.metrics_on or STATE.annotate):
+        return NOOP_SPAN
+    kw = dict(attrs_fn())
+    return Span(name, flops=kw.pop("flops", None), fenced=False, **kw)
+
+
+def named_span(name: str):
+    """Trace-time phase name for code inside ``jit``/``shard_map``: a
+    ``jax.named_scope`` (op-metadata names on the device timeline, zero
+    runtime cost) when observability is on; the no-op singleton otherwise.
+    """
+    if not (STATE.metrics_on or STATE.annotate):
+        return NOOP_CTX
+    import jax
+
+    return jax.named_scope(name)
+
+
+def current_span():
+    """Innermost live Span of this thread, or None (attrs can be attached
+    to it from helper layers without plumbing the object through)."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def start_profiler(path: str) -> bool:
+    """Start THE process-wide ``jax.profiler`` trace at ``path`` unless
+    some owner (an obs span via ``DLAF_TRACE_DIR``, or a
+    ``PhaseTimer(profile_dir=...)``) already claimed it; returns whether
+    this call started it. The single ``STATE.profiler_started`` flag is
+    the ownership protocol — every start/stop goes through here and
+    :func:`stop_profiler` so two owners can never double-start the one
+    trace jax allows per process."""
+    if STATE.profiler_started:
+        return False
+    import jax
+
+    # perfetto trace alongside the xplane: a gzipped JSON this container
+    # can post-process WITHOUT tensorboard (scripts/profile_summary.py)
+    jax.profiler.start_trace(path, create_perfetto_trace=True)
+    STATE.profiler_started = True
+    return True
+
+
+def _maybe_start_profiler() -> None:
+    """Start the process trace when a trace dir is configured (the
+    green-field hook SURVEY §5 calls for); stopped by
+    :func:`stop_profiler` (atexit-registered by configure)."""
+    if STATE.trace_dir:
+        start_profiler(STATE.trace_dir)
+
+
+def stop_profiler() -> None:
+    if STATE.profiler_started:
+        import jax
+
+        jax.profiler.stop_trace()
+        STATE.profiler_started = False
+        # the process trace is over: retire the trace config too, or the
+        # next span in a long-lived process (pytest, a library caller)
+        # silently starts a NEW trace into the same — possibly dead —
+        # directory and keeps it open until interpreter exit. A fresh
+        # configure(trace_dir=...) re-arms tracing explicitly.
+        STATE.trace_dir = ""
+        STATE.annotate = False

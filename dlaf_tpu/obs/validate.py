@@ -1,0 +1,61 @@
+"""CLI validator for DLAF_METRICS_PATH artifacts (the CI gate).
+
+    python -m dlaf_tpu.obs.validate <artifact.jsonl> [flags]
+
+Flags:
+    --require-spans         fail unless >= 1 span record
+    --require-gflops        fail unless >= 1 span has finite derived gflops
+    --require-collectives   fail unless a metrics snapshot carries a
+                            positive dlaf_comm_collective_bytes_total
+    --prom                  print the last metrics snapshot as Prometheus
+                            text exposition after validating
+
+Exit status 0 = schema-valid (and all required content present); 1 =
+errors (printed one per line). ``ci/run.sh smoke`` runs this over the
+miniapp_cholesky artifact — missing or NaN fields fail the tier.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .metrics import prometheus_text
+from .sinks import read_records, validate_records
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    flags = {a for a in argv if a.startswith("--")}
+    paths = [a for a in argv if not a.startswith("--")]
+    known = {"--require-spans", "--require-gflops", "--require-collectives",
+             "--prom"}
+    if len(paths) != 1 or flags - known:
+        print(__doc__, file=sys.stderr)
+        return 2
+    path = paths[0]
+    try:
+        records = read_records(path)
+    except (OSError, ValueError) as e:
+        print(f"INVALID {path}: {e}", file=sys.stderr)
+        return 1
+    errors = validate_records(
+        records,
+        require_spans="--require-spans" in flags,
+        require_gflops="--require-gflops" in flags,
+        require_collectives="--require-collectives" in flags)
+    if errors:
+        for e in errors:
+            print(f"INVALID {path}: {e}", file=sys.stderr)
+        return 1
+    n_spans = sum(r.get("type") == "span" for r in records)
+    n_logs = sum(r.get("type") == "log" for r in records)
+    snaps = [r for r in records if r.get("type") == "metrics"]
+    print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
+          f"{len(snaps)} metrics snapshots, {n_logs} logs)")
+    if "--prom" in flags and snaps:
+        sys.stdout.write(prometheus_text(snaps[-1]["metrics"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,12 +50,6 @@ def _mxu_f64(*arrs, dims) -> bool:
     return min(dims) >= get_configuration().f64_gemm_min_dim
 
 
-#: (backend, slices) pairs already announced — the auto-tier resolution
-#: logs once per distinct outcome so the accuracy tier in effect (56 vs
-#: 49 mantissa bits) is visible, not silent (round-2 advisory).
-_announced_tiers: set = set()
-
-
 def _oz_slices() -> int:
     """Resolved slice count: the configured value, or — for the 0 "auto"
     default — 7 on f64-emulating backends (TPU: the platform's ~47-48-bit
@@ -76,14 +70,16 @@ def _oz_slices() -> int:
 
     backend = jax.default_backend()
     s = 7 if backend == "tpu" else 8
-    if (backend, s) not in _announced_tiers:
-        _announced_tiers.add((backend, s))
-        import sys
+    from ..obs import get_logger
 
-        print(f"dlaf_tpu: f64_gemm_slices=0 (auto) resolved to {s} for "
-              f"default backend {backend!r} (~{7 * s} mantissa bits); "
-              "traces placed on other backends inherit this — set the knob "
-              "explicitly to override", file=sys.stderr, flush=True)
+    # once per (backend, slices): the accuracy tier in effect (56 vs 49
+    # mantissa bits) is visible, not silent (round-2 advisory)
+    get_logger("config").warning_once(
+        ("f64_gemm_slices", backend, s),
+        f"f64_gemm_slices=0 (auto) resolved to {s} for default backend "
+        f"{backend!r} (~{7 * s} mantissa bits); traces placed on other "
+        "backends inherit this — set the knob explicitly to override",
+        knob="f64_gemm_slices", backend=backend, choice=s)
     return s
 
 

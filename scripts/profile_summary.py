@@ -1,15 +1,22 @@
 #!/usr/bin/env python
-"""Offline summary of a jax.profiler trace — no tensorboard needed.
+"""Offline summary of dlaf_tpu observability artifacts.
 
-Reads the newest ``plugins/profile/<ts>/*.trace.json.gz`` (Chrome trace
-event format; written alongside the xplane by ``--dlaf:profile-dir`` runs
-since PhaseTimer enables ``create_perfetto_trace``) under the given
-directory and prints, per process track (device vs host threads), the
-top-N ops by total duration. This is the instrument for deciding WHERE
-config #1's 0.2 s actually goes — per-op tunnel probes sit on the ~140 ms
-RTT floor and cannot (BASELINE.md round 4).
+Two input shapes, auto-detected:
 
-Usage: python scripts/profile_summary.py <profile_dir> [top_n]
+* a ``DLAF_METRICS_PATH`` JSON-lines artifact (``dlaf_tpu.obs`` schema) —
+  prints per-span aggregates (count/total/mean, best derived GFlop/s from
+  the structured records, no stdout scraping), the collective byte/count
+  counters per (kind, axis) from the last metrics snapshot, and any
+  captured log events;
+* a ``--dlaf:profile-dir`` / ``DLAF_TRACE_DIR`` directory — reads the
+  newest ``plugins/profile/<ts>/*.trace.json.gz`` (Chrome trace event
+  format; written alongside the xplane since the span tracer enables
+  ``create_perfetto_trace``) and prints, per process track (device vs
+  host threads), the top-N ops by total duration. This is the instrument
+  for deciding WHERE config #1's 0.2 s actually goes — per-op tunnel
+  probes sit on the ~140 ms RTT floor and cannot (BASELINE.md round 4).
+
+Usage: python scripts/profile_summary.py <profile_dir | metrics.jsonl> [top_n]
 """
 import collections
 import glob
@@ -34,9 +41,55 @@ def newest_trace(root: str) -> str:
     return (chrome or cands)[-1]
 
 
+def summarize_jsonl(path: str, top_n: int) -> None:
+    """Aggregate a dlaf_tpu.obs JSONL artifact (schema: obs.sinks)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from dlaf_tpu.obs import read_records
+
+    records = read_records(path)
+    spans = [r for r in records if r.get("type") == "span"]
+    snaps = [r for r in records if r.get("type") == "metrics"]
+    logs = [r for r in records if r.get("type") == "log"]
+
+    agg = collections.defaultdict(lambda: {"count": 0, "total": 0.0,
+                                           "best_gflops": None})
+    for s in spans:
+        a = agg[s.get("name", "?")]
+        a["count"] += 1
+        a["total"] += s.get("dur_s", 0.0)
+        g = s.get("gflops")
+        if isinstance(g, (int, float)) and \
+                (a["best_gflops"] is None or g > a["best_gflops"]):
+            a["best_gflops"] = g
+    print(f"== spans ({len(spans)} records) ==")
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1]["total"])[:top_n]
+    for name, a in ranked:
+        gf = (f"  best {a['best_gflops']:8.1f} GFlop/s"
+              if a["best_gflops"] is not None else "")
+        print(f"  {a['total'] * 1e3:10.2f} ms  x{a['count']:<4d} "
+              f"mean {a['total'] / a['count'] * 1e3:8.2f} ms  {name}{gf}")
+
+    if snaps:
+        print("\n== counters (last snapshot) ==")
+        for m in snaps[-1]["metrics"]:
+            if m.get("kind") != "counter":
+                continue
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted(m.get("labels", {}).items()))
+            print(f"  {m['value']:>16.0f}  {m['name']}{{{labels}}}")
+    if logs:
+        print(f"\n== logs ({len(logs)}) ==")
+        for r in logs[:top_n]:
+            print(f"  [{r.get('level')}] {r.get('logger')}: {r.get('msg')}")
+
+
 def main():
     root = sys.argv[1]
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    if os.path.isfile(root):
+        summarize_jsonl(root, top_n)
+        return
     path = newest_trace(root)
     print(f"trace: {path}")
     with gzip.open(path, "rt") as f:

@@ -29,10 +29,18 @@ jax.config.update("jax_enable_x64", True)
 # bench/product path does (bench.py _cache_dir -> the
 # config.compilation_cache_dir knob). Cache key includes platform +
 # device count, so TPU/product entries never collide with these.
+#
+# Threshold 5 s (not 0.5): on this container's jaxlib, cache-LOADED small
+# custom-call-dense programs (the local red2band family) intermittently
+# compute garbage when many deserialized executables run in one session —
+# reproduced as random test_reduction_to_band scan-vs-unrolled mismatches
+# that vanish with the cache off and never occur on cold (writing) runs.
+# Keeping sub-5s compiles out of the cache sidesteps the corruption where
+# it was observed while retaining the big-program compile savings.
 _cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                       ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 import pytest  # noqa: E402
 
@@ -80,16 +88,96 @@ _QUICK_TESTS = {
     ("test_types.py", "test_flop_weights"),
     ("test_aux_components.py", "test_max_norm_local_and_distributed"),
     ("test_aux_components.py", "test_bench_headline_fallback_replays_history"),
+    ("test_obs.py", "test_noop_fast_path_when_disabled"),
+    ("test_obs.py", "test_jsonl_schema_roundtrip"),
+    ("test_obs.py", "test_miniapp_cholesky_metrics_integration"),
+}
+
+
+#: Tier-1 wall-clock budget control. Fixing the `jax.shard_map` imports
+#: (PR 1 satellite) grew the collected ``not slow`` selection from ~400
+#: to ~1340 tests, and the suite is compile-dominated with sub-5s
+#: compiles deliberately kept out of the persistent cache (see above) —
+#: running every distributed parametrization per push no longer fits the
+#: ~15 min tier budget. For the heavy algorithm files, keep every
+#: STRIDE-th parametrization of each test function in the default tier
+#: and move the rest to the ``slow`` deep tier (``ci/run.sh full`` still
+#: runs everything). Selection is deterministic (sorted by nodeid, so
+#: independent of collection order), tracks parametrize changes, and
+#: never demotes a ``quick``-marked item.
+_TIER1_STRIDE = {
+    "test_cholesky.py": 8,
+    "test_eigensolver.py": 6,
+    "test_reduction_to_band.py": 6,
+    "test_gen_to_std.py": 4,
+    "test_triangular.py": 4,
+    "test_ozaki.py": 2,
 }
 
 
 def pytest_collection_modifyitems(config, items):
     seen = set()
+    thinned = {}
     for item in items:
         key = (item.path.name, getattr(item, "originalname", item.name))
         if key in _QUICK_TESTS and key not in seen:
             seen.add(key)
             item.add_marker(pytest.mark.quick)
+        if item.path.name in _TIER1_STRIDE:
+            # group by class too: same-named methods in different classes
+            # (e.g. test_ozaki.py's per-route Test* classes) must stride
+            # independently, or one class's parametrize edits shift which
+            # of another's parametrizations stay in the default tier
+            cls = getattr(item, "cls", None)
+            gkey = (item.path.name, cls.__name__ if cls else None,
+                    getattr(item, "originalname", item.name))
+            thinned.setdefault(gkey, []).append(item)
+    for key, group in thinned.items():
+        stride = _TIER1_STRIDE[key[0]]
+        for i, item in enumerate(sorted(group, key=lambda it: it.nodeid)):
+            if i % stride and \
+                    not any(m.name == "quick" for m in item.own_markers):
+                item.add_marker(pytest.mark.slow)
+
+
+_exit_status = None
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_sessionfinish(session, exitstatus):
+    global _exit_status
+    _exit_status = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    # Interpreter teardown of a full-tier session — hundreds of live XLA
+    # executables plus the 8-device virtual CPU client — costs 1-2 min of
+    # pure destructor time AFTER the summary prints, real wall the tier
+    # budget cannot spare. Everything durable (persistent compile cache,
+    # obs JSONL artifacts, junit files) has been written synchronously by
+    # now (trylast: the terminal reporter's summary is already out), so
+    # skip the teardown. Embedders that call pytest.main() in-process and
+    # need control back (IDE runners, meta-runners) opt out via
+    # DLAF_PYTEST_TEARDOWN=1; coverage saves its data via atexit, which
+    # os._exit would bypass, so a live coverage module also opts out.
+    import sys
+
+    if _exit_status is not None and \
+            not os.environ.get("DLAF_PYTEST_TEARDOWN") and \
+            "coverage" not in sys.modules:
+
+        try:
+            # what the obs layer's atexit hook would have done (os._exit
+            # skips atexit): land the profiler trace + final snapshot of
+            # a session run with DLAF_TRACE_DIR/DLAF_METRICS_PATH set
+            from dlaf_tpu import obs
+
+            obs._shutdown()
+        except Exception:
+            pass
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(_exit_status)
 
 
 @pytest.fixture(scope="session")

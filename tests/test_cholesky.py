@@ -32,6 +32,30 @@ def _eps(dtype):
     return np.finfo(np.dtype(dtype).type(0).real.dtype).eps
 
 
+#: XLA:CPU under the jax 0.4.x line cannot alias buffers through the
+#: local path's layout transform, so donation documentedly degrades to a
+#: copy there (matrix.tiling.quiet_donation). Only that environment may
+#: skip the invalidation assertion — anywhere else an unconsumed donated
+#: buffer is a regression of the OOM-headroom property and must FAIL.
+_CPU_DONATION_COPY_FALLBACK = (
+    jax.default_backend() == "cpu"
+    and tuple(int(p) for p in jax.__version__.split(".")[:2]) < (0, 5))
+
+
+def assert_storage_consumed(storage):
+    """Donated storage must be dead; results were already checked
+    bit-identical before this is called."""
+    if storage.is_deleted():
+        with pytest.raises(RuntimeError):
+            np.asarray(jax.device_get(storage))
+    elif _CPU_DONATION_COPY_FALLBACK:
+        pytest.skip("old-jax XLA:CPU copy fallback; donation invalidation "
+                    "not observable")
+    else:
+        pytest.fail("donated storage was not consumed — donation plumbing "
+                    "regressed on a backend that can alias")
+
+
 def check_factor(uplo, a, out, dtype):
     n = a.shape[0]
     if n == 0:
@@ -72,9 +96,8 @@ def test_cholesky_donate_matches_and_invalidates(grid_shape, devices8):
     mat = Matrix_from(a, nb, grid=grid)
     donated = cholesky("L", mat, donate=True)
     np.testing.assert_array_equal(donated.to_numpy(), kept)
-    with pytest.raises(RuntimeError):
-        # the donated storage is dead — any later read must fail loudly
-        np.asarray(jax.device_get(mat.storage))
+    # the donated storage is dead — any later read must fail loudly
+    assert_storage_consumed(mat.storage)
 
 
 @pytest.mark.parametrize("grid_shape", [None, (2, 4)])
@@ -96,10 +119,10 @@ def test_triangular_solve_donate_b(grid_shape, devices8):
     donated = triangular_solve("L", "L", "N", "N", 1.0, am, bm,
                                donate_b=True)
     np.testing.assert_array_equal(donated.to_numpy(), kept)
-    with pytest.raises(RuntimeError):
-        np.asarray(jax.device_get(bm.storage))
-    # the triangular operand is never consumed
+    # the triangular operand is never consumed — checked BEFORE the
+    # consumed-storage helper, which may skip on backends that can't alias
     np.asarray(jax.device_get(am.storage))
+    assert_storage_consumed(bm.storage)
 
 
 @pytest.mark.parametrize("grid_shape", [None, (2, 4)])
@@ -117,8 +140,7 @@ def test_red2band_donate_matches_and_invalidates(grid_shape, devices8):
                                   kept.matrix.to_numpy())
     np.testing.assert_array_equal(np.asarray(donated.taus),
                                   np.asarray(kept.taus))
-    with pytest.raises(RuntimeError):
-        np.asarray(jax.device_get(am.storage))
+    assert_storage_consumed(am.storage)
 
 
 @pytest.mark.parametrize("uplo", ["L", "U"])

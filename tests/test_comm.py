@@ -12,7 +12,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from dlaf_tpu._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dlaf_tpu.comm import collectives as cc

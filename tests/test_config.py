@@ -1,6 +1,7 @@
 """Tests for layered configuration (reference: src/init.cpp:117-177 behavior)."""
 
 import dlaf_tpu.config as C
+from dlaf_tpu.obs.logging import forget_once, once_seen_keys
 
 
 def test_defaults():
@@ -105,7 +106,7 @@ def test_resolve_platform_auto(monkeypatch, capsys):
 
     for backend, expect in (("cpu", "native"), ("tpu", "mxu")):
         monkeypatch.setattr(jax, "default_backend", lambda b=backend: b)
-        C._announced_auto.discard(("t_knob", backend, expect))
+        forget_once("config", ("t_knob", backend, expect))
         try:
             got = C.resolve_platform_auto(
                 "auto", knob="t_knob", tpu_choice="mxu",
@@ -120,7 +121,7 @@ def test_resolve_platform_auto(monkeypatch, capsys):
                 other_choice="native", detail="why-detail") == expect
             assert capsys.readouterr().err == ""
         finally:
-            C._announced_auto.discard(("t_knob", backend, expect))
+            forget_once("config", ("t_knob", backend, expect))
 
 
 def test_resolved_route_accessors(monkeypatch):
@@ -134,7 +135,7 @@ def test_resolved_route_accessors(monkeypatch):
     keys = [(k, b, c) for k, b, c in
             (("f64_gemm", "cpu", "native"), ("f64_trsm", "cpu", "native"),
              ("f64_gemm", "tpu", "mxu"), ("f64_trsm", "tpu", "mixed"))]
-    pre = {k for k in keys if k in C._announced_auto}
+    pre = {k for k in keys if k in once_seen_keys("config")}
     C.initialize()  # bare defaults (f64_gemm/f64_trsm = "auto")
     try:
         assert C.resolved_f64_gemm() == "native"  # suite runs on CPU
@@ -152,7 +153,7 @@ def test_resolved_route_accessors(monkeypatch):
     finally:
         for k in keys:
             if k not in pre:
-                C._announced_auto.discard(k)
+                forget_once("config", k)
         C.initialize()
 
 

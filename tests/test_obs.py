@@ -1,0 +1,491 @@
+"""Tests for the observability subsystem (dlaf_tpu.obs — ISSUE 1).
+
+Covers: span nesting/reentrancy, counter/gauge/histogram semantics, the
+JSONL schema round-trip (including NaN rejection — the CI gate's reason
+to exist), the Prometheus exposition, DLAF_LOG level handling, the
+zero-allocation no-op fast path when observability is off (acceptance
+criterion), and the miniapp_cholesky integration: metrics enabled must
+emit per-step records whose derived GFlop/s is finite, locally and —
+with collective byte counters — on a 2x2 grid.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+
+
+@pytest.fixture(autouse=True)
+def obs_reset():
+    """Leave every test with the suite's default unobserved config."""
+    yield
+    os.environ.pop("DLAF_METRICS_PATH", None)
+    os.environ.pop("DLAF_TRACE_DIR", None)
+    os.environ.pop("DLAF_LOG", None)
+    obs._reset_for_tests()
+    C.finalize()
+    C.initialize()
+
+
+def _configure_metrics(tmp_path, name="obs.jsonl"):
+    path = str(tmp_path / name)
+    C.initialize(C.Configuration(metrics_path=path))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# no-op fast path (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_noop_fast_path_when_disabled():
+    """With observability unset every instrumented call site resolves to
+    the same module-level no-op singleton — no per-call allocation."""
+    C.initialize()   # defaults: no metrics path, no trace dir
+    assert not obs.enabled()
+    assert obs.span("a") is obs.NOOP_SPAN
+    assert obs.span("b", flops=1.0, n=5) is obs.NOOP_SPAN
+    assert obs.named_span("c") is obs.NOOP_CTX
+    assert obs.counter("x", k="v") is obs.NOOP_COUNTER
+    assert obs.gauge("y") is obs.NOOP_GAUGE
+    assert obs.histogram("z") is obs.NOOP_HISTOGRAM
+    # the singletons accept their whole API silently
+    with obs.span("a") as sp:
+        sp.set_attr("k", 1)
+    obs.counter("x").inc(3)
+    obs.gauge("y").set(2.0)
+    obs.histogram("z").observe(0.1)
+    # the comm instrumentation's gate
+    assert not obs.metrics_active()
+
+
+def test_collectives_record_is_noop_when_disabled(devices8):
+    """comm.collectives._record with metrics off touches no registry."""
+    from dlaf_tpu.comm import collectives as cc
+
+    C.initialize()
+    cc._record("bcast", "row", np.zeros((4, 4)))
+    assert not obs.metrics_active()
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_reentrancy(tmp_path):
+    path = _configure_metrics(tmp_path)
+    with obs.span("outer", n=1):
+        with obs.span("inner"):
+            with obs.span("inner"):     # same name re-entered
+                pass
+    with obs.span("outer"):             # same name reused sequentially
+        pass
+    obs.flush()
+    recs = [r for r in obs.read_records(path) if r["type"] == "span"]
+    # spans emit on exit: innermost first
+    names = [(r["name"], r["depth"], r["parent"]) for r in recs]
+    assert names == [("inner", 2, "inner"), ("inner", 1, "outer"),
+                     ("outer", 0, None), ("outer", 0, None)]
+    for r in recs:
+        assert r["dur_s"] >= 0 and math.isfinite(r["dur_s"])
+    assert recs[2]["attrs"] == {"n": 1}
+
+
+def test_span_gflops_derivation(tmp_path):
+    path = _configure_metrics(tmp_path)
+    with obs.span("work", flops=3e9):
+        pass
+    recs = [r for r in obs.read_records(path) if r["type"] == "span"]
+    assert recs[0]["flops"] == 3e9
+    assert math.isfinite(recs[0]["gflops"]) and recs[0]["gflops"] > 0
+    # derived value consistent with the record's own duration
+    assert recs[0]["gflops"] == pytest.approx(
+        3e9 / recs[0]["dur_s"] / 1e9)
+
+
+def test_entry_span_lazy_and_unfenced(tmp_path):
+    """entry_span: attrs thunk never runs when off; when on, the record
+    is marked unfenced and carries the flop model but no derived gflops
+    (dispatch wall must not masquerade as throughput)."""
+    C.initialize()
+    calls = []
+    assert obs.entry_span("algo", lambda: calls.append(1)) is obs.NOOP_SPAN
+    assert calls == []
+
+    path = _configure_metrics(tmp_path)
+    with obs.entry_span("algo", lambda: dict(flops=1e9, n=64)):
+        pass
+    recs = [r for r in obs.read_records(path) if r["type"] == "span"]
+    assert recs[0]["fenced"] is False
+    assert recs[0]["flops"] == 1e9
+    assert "gflops" not in recs[0]
+    assert recs[0]["attrs"] == {"n": 64}
+    # schema-valid, but does not satisfy the gflops requirement
+    assert obs.validate_file(path, require_spans=True) == []
+    assert obs.validate_file(path, require_gflops=True) != []
+
+
+def test_bad_dlaf_log_env_is_lenient_on_lazy_path(monkeypatch, capsys):
+    """A misspelled DLAF_LOG env must not crash informational log calls
+    reached without config.initialize() (library use); it falls back to
+    'info' with a note. The explicit initialize() path still raises."""
+    obs._reset_for_tests()
+    monkeypatch.setenv("DLAF_LOG", "warn")
+    obs.get_logger("lenient").info("still works")
+    err = capsys.readouterr().err
+    assert "DLAF_LOG='warn'" in err and "using 'info'" in err
+    assert "still works" in err
+    with pytest.raises(ValueError):
+        C.initialize()
+
+
+def test_current_span_attrs(tmp_path):
+    path = _configure_metrics(tmp_path)
+    with obs.span("outer"):
+        obs.current_span().set_attr("route", "mxu")
+    recs = [r for r in obs.read_records(path) if r["type"] == "span"]
+    assert recs[0]["attrs"] == {"route": "mxu"}
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    reg = obs.Registry()
+    c = reg.counter("hits", kind="bcast", axis="row")
+    c.inc()
+    c.inc(41)
+    # same (name, labels) -> same accumulator; different labels -> distinct
+    assert reg.counter("hits", kind="bcast", axis="row") is c
+    other = reg.counter("hits", kind="bcast", axis="col")
+    assert other is not c and other.value == 0.0
+    snap = {(m["name"], tuple(sorted(m["labels"].items()))): m["value"]
+            for m in reg.snapshot()}
+    assert snap[("hits", (("axis", "row"), ("kind", "bcast")))] == 42.0
+
+
+def test_gauge_and_histogram_semantics():
+    reg = obs.Registry()
+    g = reg.gauge("depth")
+    g.set(3)
+    g.set(7.5)
+    assert reg.gauge("depth").value == 7.5
+
+    h = reg.histogram("lat", bounds=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    s = h.snapshot()
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(56.05)
+    assert s["min"] == 0.05 and s["max"] == 50.0
+    # cumulative Prometheus-style buckets, +Inf last
+    assert s["buckets"] == [[0.1, 1], [1.0, 3], [10.0, 4], ["+Inf", 5]]
+
+
+def test_prometheus_exposition():
+    reg = obs.Registry()
+    reg.counter("dlaf_comm_collective_bytes_total",
+                kind="bcast", axis="row").inc(4096)
+    reg.histogram("dlaf_span_seconds", bounds=(1.0,), span="x").observe(0.5)
+    text = obs.prometheus_text(reg.snapshot())
+    assert "# TYPE dlaf_comm_collective_bytes_total counter" in text
+    assert ('dlaf_comm_collective_bytes_total{axis="row",kind="bcast"} '
+            "4096.0") in text
+    assert 'dlaf_span_seconds_bucket{le="1.0",span="x"} 1' in text
+    assert 'dlaf_span_seconds_bucket{le="+Inf",span="x"} 1' in text
+    assert 'dlaf_span_seconds_count{span="x"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# JSONL schema round-trip + validation
+# ---------------------------------------------------------------------------
+
+def test_jsonl_schema_roundtrip(tmp_path):
+    path = _configure_metrics(tmp_path)
+    with obs.span("region", flops=1e9, n=64):
+        obs.counter("dlaf_comm_collective_bytes_total",
+                    kind="bcast", axis="row").inc(1 << 20)
+    obs.get_logger("test").warning("note", key="val")
+    obs.emit_event("bench_result", payload={"gflops": 1.5})
+    obs.flush()
+    errs = obs.validate_file(path, require_spans=True, require_gflops=True,
+                             require_collectives=True)
+    assert errs == []
+    by_type = {}
+    for r in obs.read_records(path):
+        by_type.setdefault(r["type"], []).append(r)
+        assert r["v"] == obs.SCHEMA_VERSION
+        assert math.isfinite(r["ts"])
+    assert set(by_type) == {"span", "log", "bench_result", "metrics"}
+    assert by_type["bench_result"][0]["payload"] == {"gflops": 1.5}
+    assert by_type["log"][0]["msg"] == "note"
+    assert by_type["log"][0]["fields"] == {"key": "val"}
+
+
+def test_validator_rejects_nan_and_missing_fields(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    sink = obs.JsonlSink(path)
+    sink.write({"type": "span", "name": "x", "dur_s": float("nan"),
+                "depth": 0, "parent": None, "attrs": {}})
+    sink.write({"type": "span", "dur_s": 0.5, "depth": 0, "parent": None,
+                "attrs": {}})                     # missing name
+    sink.write({"type": "span", "name": "ok", "dur_s": 0.1, "depth": 0,
+                "parent": None, "attrs": {}, "gflops": float("inf")})
+    sink.write({"type": "mystery"})               # unknown type
+    sink.write({"type": "span", "name": "d", "dur_s": 0.1, "depth": 0,
+                "parent": None, "attrs": {}, "fenced": False,
+                "gflops": 99999.0})   # dispatch wall masquerading as rate
+    sink.close()
+    errs = obs.validate_file(path)
+    assert len(errs) == 5
+    assert any("dur_s" in e for e in errs)
+    assert any("without a name" in e for e in errs)
+    assert any("gflops non-finite" in e for e in errs)
+    assert any("unknown type" in e for e in errs)
+    assert any("unfenced span must not carry gflops" in e for e in errs)
+
+
+def test_validator_requires_content(tmp_path):
+    path = str(tmp_path / "empty.jsonl")
+    open(path, "w").close()
+    errs = obs.validate_file(path, require_spans=True, require_gflops=True,
+                             require_collectives=True)
+    assert len(errs) == 3
+
+
+def test_validate_cli(tmp_path, capsys):
+    from dlaf_tpu.obs.validate import main
+
+    path = _configure_metrics(tmp_path)
+    with obs.span("r", flops=1e6):
+        pass
+    obs.flush()
+    assert main([path, "--require-spans", "--require-gflops"]) == 0
+    assert main([path, "--require-collectives"]) == 1
+    assert main(["--nonsense", path]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# logging / DLAF_LOG
+# ---------------------------------------------------------------------------
+
+def test_log_levels(capsys):
+    C.initialize(C.Configuration(log="warning"))
+    lg = obs.get_logger("lvl")
+    lg.info("hidden")
+    lg.warning("shown", a=1)
+    err = capsys.readouterr().err
+    assert "hidden" not in err
+    assert "dlaf_tpu[warning] lvl: shown [a=1]" in err
+
+    C.initialize(C.Configuration(log="off"))
+    lg.error("silent")
+    assert capsys.readouterr().err == ""
+
+
+def test_log_env_layering(monkeypatch):
+    monkeypatch.setenv("DLAF_LOG", "error")
+    cfg = C.update_configuration(C.Configuration(log="debug"))
+    assert cfg.log == "error"            # env over user struct
+    cfg = C.update_configuration(argv=["--dlaf:log=off"])
+    assert cfg.log == "off"              # CLI over env
+    monkeypatch.delenv("DLAF_LOG")
+    with pytest.raises(ValueError):
+        C.initialize(C.Configuration(log="loud"))
+
+
+def test_warning_once(capsys):
+    C.initialize()
+    lg = obs.get_logger("once")
+    lg.warning_once("k1", "first")
+    lg.warning_once("k1", "first")
+    lg.warning_once("k2", "second")
+    err = capsys.readouterr().err
+    assert err.count("first") == 1 and err.count("second") == 1
+
+
+def test_warning_once_not_consumed_while_suppressed(capsys):
+    """A suppressed one-shot key stays unconsumed: raising the log level
+    later must still produce the single announcement (a process that
+    starts with DLAF_LOG=error would otherwise permanently lose the
+    auto-knob resolution notices)."""
+    C.initialize(C.Configuration(log="error"))
+    lg = obs.get_logger("once_lvl")
+    lg.warning_once("k", "notice")
+    assert capsys.readouterr().err == ""
+    C.initialize(C.Configuration(log="info"))
+    lg.warning_once("k", "notice")
+    lg.warning_once("k", "notice")
+    assert capsys.readouterr().err.count("notice") == 1
+
+
+def test_resolution_notices_respect_dlaf_log(capsys):
+    """The auto-knob notices (satellite: config.py print -> logger) are
+    silenceable — DLAF_LOG=off in CI/pytest output."""
+    C.initialize(C.Configuration(log="off"))
+    key = ("t_obs_knob", "cpu", "native")
+    from dlaf_tpu.obs.logging import forget_once
+
+    forget_once("config", key)
+    try:
+        out = C.resolve_platform_auto("auto", knob="t_obs_knob",
+                                      tpu_choice="mxu",
+                                      other_choice="native", detail="d")
+        assert out == "native"
+        assert capsys.readouterr().err == ""
+    finally:
+        forget_once("config", key)
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimer migration
+# ---------------------------------------------------------------------------
+
+def test_phase_timer_emits_spans(tmp_path):
+    from dlaf_tpu.common.timer import PhaseTimer
+
+    path = _configure_metrics(tmp_path)
+    pt = PhaseTimer()
+    with pt.phase("stage_a"):
+        pass
+    with pt.phase("stage_a"):
+        pass
+    assert set(pt.report()) == {"stage_a"}
+    names = [r["name"] for r in obs.read_records(path)
+             if r["type"] == "span"]
+    assert names == ["stage_a", "stage_a"]
+
+
+def test_phase_timer_profiler_single_owner(tmp_path, monkeypatch):
+    """A timer-owned jax.profiler trace claims the obs layer's
+    profiler_started flag, so a configure(trace_dir=...) landing mid-phase
+    (lazy config init inside an algorithm call) cannot start_trace a
+    second time over the live trace."""
+    import jax
+
+    from dlaf_tpu.common.timer import PhaseTimer
+    from dlaf_tpu.obs._state import STATE
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+
+    pt = PhaseTimer(profile_dir=str(tmp_path / "timer_trace"))
+    with pt.phase("stage_a"):
+        # mid-phase: the obs layer comes up with its own trace dir and a
+        # span triggers its lazy profiler start — must see the claim
+        C.initialize(C.Configuration(trace_dir=str(tmp_path / "obs_trace")))
+        with obs.span("inner"):
+            pass
+    assert calls["start"] == 1 and STATE.profiler_started
+    pt.stop()
+    assert calls["stop"] == 1 and not STATE.profiler_started
+
+
+def test_stopped_profiler_does_not_restart(tmp_path, monkeypatch):
+    """Once the process trace is stopped, later spans must not silently
+    start a new one into the stale directory — in a long-lived process
+    (pytest was the victim) that trace would record everything until
+    interpreter exit."""
+    import jax
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: calls.__setitem__(
+                            "start", calls["start"] + 1))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.__setitem__("stop", calls["stop"] + 1))
+
+    C.initialize(C.Configuration(trace_dir=str(tmp_path / "t")))
+    with obs.span("a"):
+        pass
+    assert calls["start"] == 1
+    obs.stop_profiler()
+    assert calls["stop"] == 1
+    with obs.span("b"):
+        pass
+    assert calls["start"] == 1, "span restarted a stopped process trace"
+
+
+def test_pipeline_phase_names_avoid_entry_span_collision():
+    """Pipeline stage spans must not reuse algorithm entry-span names: a
+    fenced stage wall-time span sharing a name with an unfenced
+    dispatch-time entry span would merge two different populations into
+    one dlaf_span_seconds histogram."""
+    import importlib
+    import inspect
+    import re
+
+    # importlib: the packages re-export same-named functions that shadow
+    # the submodule attribute on plain ``import a.b.c as c``
+    es = importlib.import_module("dlaf_tpu.eigensolver.eigensolver")
+    mods = [es] + [importlib.import_module(m) for m in (
+        "dlaf_tpu.algorithms.cholesky",
+        "dlaf_tpu.algorithms.gen_to_std",
+        "dlaf_tpu.algorithms.triangular",
+        "dlaf_tpu.eigensolver.reduction_to_band",
+    )]
+    phases = set(re.findall(r'\.phase\(\s*"([^"]+)"',
+                            inspect.getsource(es)))
+    entries = set()
+    for mod in mods:
+        entries |= set(re.findall(r'entry_span\(\s*"([^"]+)"',
+                                  inspect.getsource(mod)))
+    assert phases and entries
+    assert phases.isdisjoint(entries), phases & entries
+
+
+# ---------------------------------------------------------------------------
+# miniapp integration (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _run_miniapp_with_metrics(tmp_path, monkeypatch, extra_args=()):
+    from dlaf_tpu.miniapp.miniapp_cholesky import run as crun
+
+    path = str(tmp_path / "mc.jsonl")
+    monkeypatch.setenv("DLAF_METRICS_PATH", path)
+    out = crun(["-m", "128", "-b", "32", "--nruns", "2", *extra_args])
+    assert len(out) == 2
+    return path
+
+
+def test_miniapp_cholesky_metrics_integration(tmp_path, monkeypatch):
+    """miniapp_cholesky with metrics enabled emits per-step records whose
+    derived GFlop/s is finite, and the artifact is schema-valid."""
+    path = _run_miniapp_with_metrics(tmp_path, monkeypatch)
+    assert obs.validate_file(path, require_spans=True,
+                             require_gflops=True) == []
+    runs = [r for r in obs.read_records(path)
+            if r["type"] == "span" and r["name"] == "miniapp_cholesky.run"]
+    timed = [r for r in runs if not r["attrs"]["warmup"]]
+    assert len(timed) == 2                      # one record per timed step
+    for r in runs:
+        assert math.isfinite(r["gflops"]) and r["gflops"] > 0
+        assert r["attrs"]["n"] == 128 and r["attrs"]["nb"] == 32
+
+
+def test_miniapp_cholesky_metrics_distributed(tmp_path, monkeypatch,
+                                              devices8):
+    """The 2x2-grid artifact additionally carries positive per-axis
+    collective byte counters (the CI smoke gate's contract)."""
+    path = _run_miniapp_with_metrics(
+        tmp_path, monkeypatch, ("--grid-rows", "2", "--grid-cols", "2"))
+    assert obs.validate_file(path, require_spans=True, require_gflops=True,
+                             require_collectives=True) == []
+    snaps = [r for r in obs.read_records(path) if r["type"] == "metrics"]
+    bytes_by_axis = {}
+    for m in snaps[-1]["metrics"]:
+        if m["name"] == "dlaf_comm_collective_bytes_total":
+            bytes_by_axis[m["labels"]["axis"]] = \
+                bytes_by_axis.get(m["labels"]["axis"], 0) + m["value"]
+    assert bytes_by_axis.get("row", 0) > 0
+    assert bytes_by_axis.get("col", 0) > 0

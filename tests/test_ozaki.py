@@ -884,11 +884,13 @@ class TestScanAccumRoute:
         import jax
 
         from dlaf_tpu import config
+        from dlaf_tpu.obs.logging import forget_once as _forget_once
+        from dlaf_tpu.obs.logging import once_seen_keys as _once_keys
         from dlaf_tpu.tile_ops.ozaki import _accum_impl
 
         keys = [("ozaki_accum", b, c) for b, c in
                 (("cpu", "xla"), ("tpu", "scan"))]
-        pre = {k for k in keys if k in config._announced_auto}
+        pre = {k for k in keys if k in _once_keys("config")}
         config.initialize()  # bare default: auto
         try:
             assert _accum_impl() == "xla"     # suite runs on CPU
@@ -901,7 +903,7 @@ class TestScanAccumRoute:
             monkeypatch.delenv("DLAF_OZAKI_ACCUM", raising=False)
             for k in keys:
                 if k not in pre:
-                    config._announced_auto.discard(k)
+                    _forget_once("config", k)
             config.initialize()
 
     def test_accuracy_under_jit(self, monkeypatch):
