@@ -107,24 +107,34 @@ def run_variant() -> None:
 
     jax.config.update("jax_enable_x64", True)
     os.environ.setdefault("DLAF_COMPILATION_CACHE_DIR", _cache_dir())
+    # "<base>+la1" = the same trailing form under the PIPELINED step order
+    # (config cholesky_lookahead=1); the plain arm pins lookahead=0 so the
+    # pair is a real serialized-vs-pipelined A/B on every platform (the
+    # auto knob would silently flip the plain arm on TPU). Explicit env
+    # still wins via setdefault.
+    base = variant
+    la = None
+    if variant.endswith("+la1"):
+        base, la = variant[: -len("+la1")], "1"
+    os.environ.setdefault("DLAF_CHOLESKY_LOOKAHEAD", la or "0")
     # "ozaki_concat"/"ozaki_dots" = the ozaki trailing with the group form
     # pinned (config ozaki_group) — labeled separately so the sweep A/Bs
     # the two group forms against the auto default (concat on TPU since
     # the 2026-08-01 dot_ab session) and the headline picks whichever
     # silicon prefers
-    if variant in ("ozaki_concat", "ozaki_dots"):
+    if base in ("ozaki_concat", "ozaki_dots"):
         os.environ["DLAF_CHOLESKY_TRAILING"] = "ozaki"
         os.environ.setdefault("DLAF_OZAKI_GROUP",
-                              variant.removeprefix("ozaki_"))
+                              base.removeprefix("ozaki_"))
     else:
-        os.environ["DLAF_CHOLESKY_TRAILING"] = variant
+        os.environ["DLAF_CHOLESKY_TRAILING"] = base
 
     import dlaf_tpu.config as config
 
     config.initialize()
     platform = jax.devices()[0].platform
     log(f"[{variant}] devices: {jax.devices()} ({time.time() - t_start:.1f}s)")
-    if variant == "scan" and platform == "tpu":
+    if base == "scan" and platform == "tpu":
         # the scan formulation follows the f64_gemm/f64_trsm knobs (it no
         # longer hardwires the MXU route); on TPU the measured scan config
         # is the MXU one, so resolve the knobs the way the product config
@@ -153,10 +163,12 @@ def run_variant() -> None:
     except Exception as e:  # platform without f64 support
         log(f"[{variant}] {dtype_name} unavailable ({e}); using float32")
         dtype = np.float32
-    if dtype != np.float64 and variant.startswith("ozaki"):
+    if dtype != np.float64 and base.startswith("ozaki"):
         # "ozaki*" is the emulated-f64 path; for other dtypes it statically
-        # falls back to biggemm — keep the label truthful
-        os.environ["DLAF_CHOLESKY_TRAILING"] = variant = "biggemm"
+        # falls back to biggemm — keep the label truthful (the lookahead
+        # suffix survives the relabel: the step order is orthogonal)
+        os.environ["DLAF_CHOLESKY_TRAILING"] = base = "biggemm"
+        variant = base + ("+la1" if la else "")
         config.initialize()
     ref = Matrix.from_element_fn(hpd_element_fn(n, dtype),
                                  GlobalElementSize(n, n),
@@ -320,11 +332,23 @@ def sweep(platform: str) -> None:
     # later variant wedges, the best measurement has already landed
     # the group-form A/B arm pins whichever form ozaki_group=auto does
     # NOT resolve to on this platform (concat on TPU, dots elsewhere),
-    # so "ozaki" (the auto default) vs the pinned arm is a real A/B
+    # so "ozaki" (the auto default) vs the pinned arm is a real A/B.
+    # "+la1" arms re-run a form under the pipelined step order
+    # (cholesky_lookahead=1) against the plain serialized arm — the
+    # look-ahead A/B the bench artifact must carry on every run.
+    # (trailing="xla" delegates the whole factorization to one fused XLA
+    # cholesky — no step chain to pipeline, so it has no "+la1" arm; the
+    # unrolled-order A/B rides the stepped forms instead)
     ab_arm = "ozaki_dots" if platform == "tpu" else "ozaki_concat"
-    order = ["ozaki", ab_arm, "xla", "loop", "biggemm", "invgemm"]
+    order = ["ozaki", "ozaki+la1", ab_arm, "xla", "scan", "scan+la1",
+             "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm"]
+
+    def _known(v):
+        b = v[: -len("+la1")] if v.endswith("+la1") else v
+        return b in VALID_TRAILING or v == ab_arm
+
     variants = [pinned] if pinned else \
-        [v for v in order if v in VALID_TRAILING or v == ab_arm] + \
+        [v for v in order if _known(v)] + \
         [v for v in VALID_TRAILING if v not in order]
     if on_cpu and not pinned:
         # the CPU fallback has fast native f64 — the int8-emulation variant

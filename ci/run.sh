@@ -39,7 +39,36 @@ case "$TIER" in
   main)
     python -m pytest tests/ -q -m "not slow" ;;
   full)
-    python -m pytest tests/ -q ;;
+    python -m pytest tests/ -q
+    echo "== armed probe scripts: tiny-N CPU smoke =="
+    # the hardware-session probes must stay runnable between tunnel
+    # windows: trace+compile both step forms at a toy size (no exec) and
+    # run the precision probe end-to-end at tiny shapes. Failures here
+    # mean a probe would die on the next healthy window.
+    PROBE_TMP=$(mktemp -d)
+    DLAF_FRONTIER_N=512 \
+      python scripts/tpu_compile_frontier.py "$PROBE_TMP/frontier.json" \
+        --skip-exec
+    python - "$PROBE_TMP/frontier.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+bad = [p for p in doc["points"] if "error" in p or "compile_s" not in p]
+assert not bad, f"frontier smoke: {bad}"
+print(f"frontier smoke ok: {len(doc['points'])} points compiled")
+EOF
+    DLAF_PREC_M=256 DLAF_PREC_K=32 \
+      python scripts/tpu_prec_probe.py "$PROBE_TMP/prec.json"
+    python - "$PROBE_TMP/prec.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+prims = [p for p in doc if p["probe"].startswith("prim_")]
+rels = [p for p in doc if "rel_err" in p]
+assert prims and rels, f"prec smoke incomplete: {doc}"
+assert all(p.get("ok", True) for p in prims), f"prim findings: {prims}"
+assert all(p["rel_err"] < 1e-10 for p in rels), f"prec smoke: {rels}"
+print(f"prec smoke ok: {len(doc)} probes")
+EOF
+    rm -rf "$PROBE_TMP" ;;
   *)
     echo "usage: ci/run.sh [smoke|main|full]" >&2; exit 2 ;;
 esac

@@ -74,10 +74,33 @@ VALID_TRAILING = ("loop", "biggemm", "invgemm", "xla", "ozaki", "scan")
 
 
 
+def _oz_product(x, y):
+    """``x @ y`` on the error-free int8/bf16 MXU route (complex picks the
+    4-real-product composition) — the lookahead split's column strip, on
+    the same route as the bulk it was split from."""
+    mm = oz.matmul_c128 if jnp.iscomplexobj(x) else oz.matmul_f64
+    return mm(x, y, slices=tb._oz_slices())
+
+
+def _count_step_modes(algo: str, overlapped: int, serialized: int) -> None:
+    """Trace-time tile-step accounting for the lookahead pipeline: how many
+    steps of the compiled program were emitted in the overlapped (next-
+    panel-column-first) order vs the plain serialized order."""
+    if obs.metrics_active():
+        if overlapped:
+            obs.counter("dlaf_cholesky_steps_total", algo=algo,
+                        mode="overlapped").inc(overlapped)
+        if serialized:
+            obs.counter("dlaf_cholesky_steps_total", algo=algo,
+                        mode="serialized").inc(serialized)
+
+
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing"),
+@functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing",
+                                             "lookahead"),
                    donate_argnums=0)
-def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
+def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
+                    lookahead: bool = False):
     n = a.shape[0]
     # "ozaki": route the flops-dominant trailing update through int8 MXU
     # passes (tile_ops.ozaki) — f64 and complex128 (4-real-product form);
@@ -100,6 +123,13 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         l = lax.linalg.cholesky(ah)
         return jnp.triu(jnp.conj(l).T) + jnp.tril(a, -1)
     nt = ceil_div(n, nb) if n else 0
+    # lookahead carry: the next panel column's (diag block, below-diag
+    # block) values as step k's SSA outputs, so step k+1's potrf/trsm
+    # chain consumes them directly instead of reading `a` after the bulk
+    # trailing scatter — the dependency XLA needs to overlap panel k+1
+    # with the bulk herk/gemm of step k (reference look-ahead,
+    # ``factorization/cholesky/impl.h:147-156,187-189``)
+    la = None
     for k in range(nt):
         if obs.metrics_active():
             # trace-time tile-op accounting (once per compiled program):
@@ -114,8 +144,10 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                         op="herk").inc(tail)
             obs.counter("dlaf_algo_tile_ops_total", algo="cholesky",
                         op="gemm").inc(tail * (tail - 1) // 2)
+            _count_step_modes("cholesky", *((1, 0) if lookahead and tail
+                                            else (0, 1)))
         k0, k1 = k * nb, min((k + 1) * nb, n)
-        blk = a[k0:k1, k0:k1]
+        blk = a[k0:k1, k0:k1] if la is None else la[0]
         if use_oz:
             # latency-bound panel ops in mixed precision (f32 seed + Newton,
             # tile_ops.mixed): emulated-f64 potrf/trsm are the wall-clock
@@ -134,33 +166,68 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
         m = n - k1
         if uplo == "L":
             # panel: A[k1:, k] <- A[k1:, k] Lkk^-H   (tile::trsm, high-prio
-            # in the reference impl.h:147-156; here XLA schedules it)
+            # in the reference impl.h:147-156; here XLA schedules it) —
+            # under lookahead the panel source is the carried next-column
+            # value from step k-1, not an `a` read
+            colsrc = a[k1:, k0:k1] if la is None else la[1]
             if use_oz:
                 # refined explicit inverse (from the fused step above) ->
                 # the panel solve is one gemm instead of an emulated trsm;
                 # the gemm itself rides the int8 MXU path like the trailing
                 # update (native emulated-f64 gemm is ~3x slower)
-                panel = tb.mm_mxu(a[k1:, k0:k1], jnp.conj(fac_inv).T)
+                panel = tb.mm_mxu(colsrc, jnp.conj(fac_inv).T)
             elif trailing == "invgemm":
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
-                panel = a[k1:, k0:k1] @ jnp.conj(dinv).T
+                panel = colsrc @ jnp.conj(dinv).T
             else:
-                panel = tb.trsm("R", "L", "C", "N", diag, a[k1:, k0:k1])
+                panel = tb.trsm("R", "L", "C", "N", diag, colsrc)
             a = a.at[k1:, k0:k1].set(panel)
+            la = None
             if trailing == "loop":
                 # trailing per block column: herk on the diagonal block + one
                 # gemm below it — exact n^3/3 flops (reference impl.h:242-271)
                 for j in range(k + 1, nt):
                     j0, j1 = j * nb, min((j + 1) * nb, n)
                     pj = panel[j0 - k1: j1 - k1]
-                    a = a.at[j0:j1, j0:j1].set(
-                        tb.herk("L", "N", pj, a[j0:j1, j0:j1], alpha=-1.0))
+                    dj = tb.herk("L", "N", pj, a[j0:j1, j0:j1], alpha=-1.0)
+                    a = a.at[j0:j1, j0:j1].set(dj)
+                    below = None
                     if j1 < n:
                         below = tb.gemm(panel[j1 - k1:], pj, a[j1:, j0:j1],
                                         alpha=-1.0, beta=1.0, op_b="C")
                         a = a.at[j1:, j0:j1].set(below)
+                    if lookahead and j == k + 1:
+                        # the loop schedule already emits column k+1 first;
+                        # carrying its values is what frees step k+1 from
+                        # the later columns' scatter chain
+                        la = (dj, below)
+            elif lookahead:
+                # next-panel-column strip first (consumed by step k+1 via
+                # the carry), then the remaining trailing as a (m-w)^2
+                # herk of the row-trimmed panel — same dots, same per-cell
+                # application order as the single masked product
+                w = min(nb, m)
+                pj = panel[:w]
+                updc = (_oz_product(panel, jnp.conj(pj).T) if use_oz
+                        else panel @ jnp.conj(pj).T)
+                cmask = jnp.arange(m)[:, None] >= jnp.arange(w)[None, :]
+                # x + where(mask, -upd, 0): the exact per-cell application
+                # the serial masked add performs (bitwise, zeros included)
+                new_col = a[k1:, k1:k1 + w] + jnp.where(cmask, -updc, 0)
+                a = a.at[k1:, k1:k1 + w].set(new_col)
+                la = (new_col[:w], new_col[w:] if k1 + w < n else None)
+                if m > w:
+                    pr = panel[w:]
+                    if use_oz:
+                        upd = (oz.herk_c128(pr, slices=tb._oz_slices())
+                               if jnp.iscomplexobj(pr)
+                               else oz.syrk_f64(pr, slices=tb._oz_slices()))
+                    else:
+                        upd = pr @ jnp.conj(pr).T
+                    mask = jnp.tril(jnp.ones((m - w, m - w), dtype=bool))
+                    a = a.at[k1 + w:, k1 + w:].add(jnp.where(mask, -upd, 0))
             else:
                 # ONE full trailing update, masked to the lower triangle;
                 # "ozaki" forms it with int8 MXU passes instead of the
@@ -175,25 +242,52 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
         else:
             # upper: A = U^H U; panel is a block row
+            rowsrc = a[k0:k1, k1:] if la is None else la[1]
             if use_oz:
-                panel = tb.mm_mxu(jnp.conj(fac_inv).T, a[k0:k1, k1:])
+                panel = tb.mm_mxu(jnp.conj(fac_inv).T, rowsrc)
             elif trailing == "invgemm":
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
-                panel = jnp.conj(dinv).T @ a[k0:k1, k1:]
+                panel = jnp.conj(dinv).T @ rowsrc
             else:
-                panel = tb.trsm("L", "U", "C", "N", diag, a[k0:k1, k1:])
+                panel = tb.trsm("L", "U", "C", "N", diag, rowsrc)
             a = a.at[k0:k1, k1:].set(panel)
+            la = None
             if trailing == "loop":
                 for j in range(k + 1, nt):
                     j0, j1 = j * nb, min((j + 1) * nb, n)
                     pj = panel[:, j0 - k1: j1 - k1]
-                    a = a.at[j0:j1, j0:j1].set(
-                        tb.herk("U", "C", pj, a[j0:j1, j0:j1], alpha=-1.0))
+                    dj = tb.herk("U", "C", pj, a[j0:j1, j0:j1], alpha=-1.0)
+                    a = a.at[j0:j1, j0:j1].set(dj)
+                    right = None
                     if j1 < n:
                         right = tb.gemm(pj, panel[:, j1 - k1:], a[j0:j1, j1:],
                                         alpha=-1.0, beta=1.0, op_a="C")
                         a = a.at[j0:j1, j1:].set(right)
+                    if lookahead and j == k + 1:
+                        la = (dj, right)
+            elif lookahead:
+                # next block-row strip first (carried), rest as the
+                # column-trimmed herk — the mirrored split
+                w = min(nb, m)
+                pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
+                updr = (_oz_product(pt[:w], jnp.conj(pt).T) if use_oz
+                        else jnp.conj(panel[:, :w]).T @ panel)
+                rmask = jnp.arange(w)[:, None] <= jnp.arange(m)[None, :]
+                new_row = a[k1:k1 + w, k1:] + jnp.where(rmask, -updr, 0)
+                a = a.at[k1:k1 + w, k1:].set(new_row)
+                la = (new_row[:, :w], new_row[:, w:] if k1 + w < n else None)
+                if m > w:
+                    ptr = pt[w:]
+                    if use_oz:
+                        upd = (oz.herk_c128(ptr, slices=tb._oz_slices())
+                               if jnp.iscomplexobj(ptr)
+                               else oz.syrk_f64(ptr, slices=tb._oz_slices()))
+                    else:
+                        pr = panel[:, w:]
+                        upd = jnp.conj(pr).T @ pr
+                    mask = jnp.triu(jnp.ones((m - w, m - w), dtype=bool))
+                    a = a.at[k1 + w:, k1 + w:].add(jnp.where(mask, -upd, 0))
             else:
                 if use_oz:
                     pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
@@ -209,10 +303,10 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop"):
 
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
-                                             "use_mixed"),
+                                             "use_mixed", "lookahead"),
                    donate_argnums=0)
 def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
-                         use_mixed: bool = False):
+                         use_mixed: bool = False, lookahead: bool = False):
     """``lax.scan`` formulation of the local factorization: ONE compiled
     step body, looped ``nt`` times with uniform full-size shapes.
 
@@ -306,17 +400,124 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
 
         return step
 
+    def syrk_like(x):
+        """Masked-panel self-product on the configured trailing route: the
+        scan forms' one bulk product (x zeroed above its pivot)."""
+        if use_mxu:
+            return (oz.herk_c128(x, slices=tb._oz_slices())
+                    if jnp.iscomplexobj(x)
+                    else oz.syrk_f64(x, slices=tb._oz_slices()))
+        return x @ jnp.conj(x).T
+
+    def make_step_la(m):
+        """Software-pipelined step body (``cholesky_lookahead=1``): the
+        bulk trailing product of step k-1 is DEFERRED into body k, where
+        it carries no dependency on body k's latency-bound potrf/trsm
+        chain — XLA overlaps the two inside one iteration, which a
+        sequential ``lax.scan`` body can never do across iterations. The
+        next panel column's strip is updated eagerly (it is what frees
+        the following body's panel chain), so per-cell application order
+        — bulk(k-1) before strip(k) — matches the serial body exactly
+        and results stay bitwise identical."""
+        rows = jnp.arange(m)
+
+        def step(carry, k):
+            acc, pp = carry      # pp: previous step's masked panel
+            k0 = k * nb
+            blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
+            if use_mixed:
+                fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
+                diag = fac + tb.tri_mask(blk, other, k=-1)
+            else:
+                fac_inv = None
+                diag = tl.potrf(uplo, blk)
+            acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
+            below = rows >= k0 + nb
+            tri = (rows[:, None] >= rows[None, :] if uplo == "L"
+                   else rows[:, None] <= rows[None, :])
+            valid1 = k0 + 2 * nb <= m    # next block col/row exists
+            if uplo == "L":
+                col = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
+                if use_mixed:
+                    inv_t = jnp.conj(fac_inv).T
+                    pfull = tb.mm_mxu(col, inv_t) if use_mxu else col @ inv_t
+                else:
+                    pfull = tb.trsm("R", "L", "C", "N", diag, col)
+                panel = jnp.where(below[:, None], pfull, 0)
+                acc = jax.lax.dynamic_update_slice(
+                    acc, jnp.where(below[:, None], pfull, col), (0, k0))
+                # deferred bulk of step k-1: its next-col (block col k)
+                # was applied in body k-1, the rest lands here
+                pupd = syrk_like(pp)
+                pmask = tri & (rows[None, :] >= k0 + nb)
+                acc = acc - jnp.where(pmask, pupd, 0)
+                # eager next-column strip from THIS panel
+                nstrip = jax.lax.dynamic_slice(panel, (k0 + nb, 0),
+                                               (nb, nb))
+                updc = (_oz_product(panel, jnp.conj(nstrip).T) if use_mxu
+                        else panel @ jnp.conj(nstrip).T)
+                ccur = jax.lax.dynamic_slice(acc, (0, k0 + nb), (m, nb))
+                cols1 = k0 + nb + jnp.arange(nb)
+                cmask = (rows[:, None] >= cols1[None, :]) & valid1
+                acc = jax.lax.dynamic_update_slice(
+                    acc, ccur - jnp.where(cmask, updc, 0), (0, k0 + nb))
+            else:
+                row = jax.lax.dynamic_slice(acc, (k0, 0), (nb, m))
+                if use_mixed:
+                    inv_t = jnp.conj(fac_inv).T
+                    pfull = tb.mm_mxu(inv_t, row) if use_mxu else inv_t @ row
+                else:
+                    pfull = tb.trsm("L", "U", "C", "N", diag, row)
+                panel = jnp.where(below[None, :], pfull, 0)
+                acc = jax.lax.dynamic_update_slice(
+                    acc, jnp.where(below[None, :], pfull, row), (k0, 0))
+                ppt = jnp.conj(jnp.swapaxes(pp, -1, -2))
+                pupd = syrk_like(ppt)
+                pmask = tri & (rows[:, None] >= k0 + nb)
+                acc = acc - jnp.where(pmask, pupd, 0)
+                pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
+                nstrip = jax.lax.dynamic_slice(pt, (k0 + nb, 0), (nb, nb))
+                # nstrip = conj(panel_block)^T, so nstrip @ panel IS the
+                # strip of conj(panel)^T @ panel (same dots as serial)
+                updr = (_oz_product(nstrip, jnp.conj(pt).T) if use_mxu
+                        else nstrip @ panel)
+                rcur = jax.lax.dynamic_slice(acc, (k0 + nb, 0), (nb, m))
+                rows1 = k0 + nb + jnp.arange(nb)
+                rmask = (rows1[:, None] <= rows[None, :]) & valid1
+                acc = jax.lax.dynamic_update_slice(
+                    acc, rcur - jnp.where(rmask, updr, 0), (k0 + nb, 0))
+            return (acc, panel), None
+
+        return step
+
     # telescoped segments: each segment scans the SHRINKING trailing
     # submatrix (completed panel columns live outside it and are final),
     # so the uniform full-size masked work tracks the live trailing block
     # instead of the original matrix — premium drops from ~3x toward
     # ~1.7x at O(log nt) step programs instead of O(1) (still far below
     # the unrolled form's O(nt) on the ~19 s/step AOT toolchain).
+    # Under lookahead the pending panel is carried ACROSS segments (the
+    # dropped slots are zero — the panel is masked below its pivot), so
+    # no flush products are ever paid; the last step's pending is
+    # identically zero and simply dropped.
     off = 0
+    pp = None
     for seg_len in telescope_segments(nt):
         m_seg = (nt - off) * nb
         sub = a[off * nb:, off * nb:]
-        sub, _ = jax.lax.scan(make_step(m_seg), sub, jnp.arange(seg_len))
+        if lookahead:
+            _count_step_modes("cholesky_scan", seg_len, 0)
+            if pp is None:
+                pp = (jnp.zeros((m_seg, nb), a.dtype) if uplo == "L"
+                      else jnp.zeros((nb, m_seg), a.dtype))
+            else:
+                pp = pp[-m_seg:] if uplo == "L" else pp[:, -m_seg:]
+            (sub, pp), _ = jax.lax.scan(make_step_la(m_seg), (sub, pp),
+                                        jnp.arange(seg_len))
+        else:
+            _count_step_modes("cholesky_scan", 0, seg_len)
+            sub, _ = jax.lax.scan(make_step(m_seg), sub,
+                                  jnp.arange(seg_len))
         a = a.at[off * nb:, off * nb:].set(sub)
         off += seg_len
     return a[:n, :n]
@@ -353,7 +554,7 @@ def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
 
 def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                          use_mxu=False, use_mixed=False, cplx=False,
-                         use_oz_pallas=False):
+                         use_oz_pallas=False, lookahead=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
@@ -390,7 +591,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
     def local_cols_global(lu, rc, count):
         return (lu + jnp.arange(count)) * Qc + rc
 
-    def step(lt, k):
+    def step(lt, k, la):
         rr = (cc.this_rank(ROW_AXIS) - sr) % Pr   # my cycle position (rows)
         rc = (cc.this_rank(COL_AXIS) - sc) % Qc
         owner_r = ud.rank_global_tile(k, Pr, sr)
@@ -401,7 +602,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         is_owner_c = cc.this_rank(COL_AXIS) == owner_c
 
         # -- diag tile -> everyone (reference: col bcast impl.h:215-219) ----
-        cand = lt[kr, kc]
+        # lookahead carry ``la = (col_tiles, lu)``: step k-1's next-column
+        # values as direct SSA inputs — correct on the owner column (the
+        # only contributor the bcast/keep masks select), so the potrf/trsm
+        # chain of this step never waits on the bulk trailing scatter.
+        # uplo='U' carries a block ROW, indexed by column slots.
+        cand = lt[kr, kc] if la is None \
+            else la[0][(kr if uplo == "L" else kc) - la[1]]
         diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
         ts = min(mb, n - k * mb)
         if ts < mb:  # pad short edge tile with identity to keep potrf defined
@@ -424,10 +631,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         upd_tile = jnp.where(is_owner_r & is_owner_c, lkk, lt[kr, kc])
         lt = lt.at[kr, kc].set(upd_tile)
         if k == nt - 1:
-            return lt
+            return lt, None
         if uplo == "U":
             return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk,
-                                   lkk_inv)
+                                   lkk_inv, la)
 
         # -- panel trsm on owner column (reference impl.h:222-231) ----------
         # uniform local row start: every rank's rows >= k+1 live at slots
@@ -435,13 +642,17 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         lu_r = max(0, -(-(k + 2 - Pr) // Pr))
         nrows = ltr - lu_r
         if nrows == 0:
-            return lt
+            return lt, None
         g_rows = local_rows_global(lu_r, rr, nrows)
         row_valid = (g_rows > k) & (g_rows < nt)
         # trsm_panel: native batched solve, or (f64_trsm="mixed") refined
         # inverse + matmul that follows the f64_gemm routing (inverse
-        # precomputed by the fused potrf step)
-        pan = tb.trsm_panel("R", "L", "C", "N", lkk, lt[lu_r:, kc],
+        # precomputed by the fused potrf step); the panel source is the
+        # carried next-column when pipelined (non-owner ranks' carried
+        # tiles are stale pre-bulk values, but every use of `pan` below
+        # is gated by the owner-column keep/bcast masks)
+        colsrc = lt[lu_r:, kc] if la is None else la[0][lu_r - la[1]:]
+        pan = tb.trsm_panel("R", "L", "C", "N", lkk, colsrc,
                             inv_a=lkk_inv)
         pan = jnp.where(row_valid[:, None, None], pan, jnp.zeros_like(pan))
         # owner column keeps the factored panel (others keep their tiles)
@@ -456,7 +667,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         lu_c = max(0, -(-(k + 2 - Qc) // Qc))
         ncols = ltc - lu_c
         if ncols == 0:
-            return lt
+            return lt, None
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
         vc = transpose_col_to_rows(DistContext(dist), vr, lu_r, g_cols)
@@ -470,6 +681,37 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         # the reference's herk vs gemm split)
         below = pair & (g_rows[:, None] > g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        la_next = None
+        if lookahead and k + 1 < nt:
+            # -- next panel column first (reference's high-priority
+            # first-column herk, impl.h:147-156): one tile-column einsum
+            # against MY kc1-slot transposed-panel tile (exactly the tile
+            # the bulk product would have used — bitwise-identical dots),
+            # emitted before the bulk and carried to step k+1
+            kc1 = ud.local_tile_from_global_tile(k + 1, Qc)
+            owner_c1 = ud.rank_global_tile(k + 1, Qc, sc)
+            pk1 = vc[kc1 - lu_c]
+            own_c1 = cc.this_rank(COL_AXIS) == owner_c1
+            below1 = row_valid & (g_rows > k + 1)
+            ondiag1 = row_valid & (g_rows == k + 1)
+            if use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                updc = mmfn(vr.reshape(nrows * mb, mb), jnp.conj(pk1).T,
+                            slices=tb._oz_slices()).reshape(nrows, mb, mb)
+            else:
+                updc = jnp.einsum("rab,db->rad", vr, jnp.conj(pk1),
+                                  preferred_element_type=vr.dtype)
+            tril1 = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+            m3 = (below1[:, None, None] | (ondiag1[:, None, None] & tril1)) \
+                & own_c1
+            new_col = lt[lu_r:, kc1] - jnp.where(m3, updc,
+                                                 jnp.zeros_like(updc))
+            lt = lt.at[lu_r:, kc1].set(new_col)
+            la_next = (new_col, lu_r)
+            # the bulk below excludes column k+1 (already applied)
+            notnext = g_cols != k + 1
+            below = below & notnext[None, :]
+            ondiag = ondiag & notnext[None, :]
         if use_pallas:
             # predicated Pallas kernel: masked-out tile pairs skip the MXU
             # work entirely (exact flops instead of rectangle-then-mask)
@@ -477,6 +719,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             new_block = masked_trailing_update(lt[lu_r:, lu_c:], vr, vc, mode,
                                                interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
+            return lt, la_next
         else:
             if use_mxu and use_oz_pallas:
                 # predicated fused kernel: dead tile pairs skip the MXU work
@@ -499,9 +742,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
             upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
             lt = lt.at[lu_r:, lu_c:].add(-upd)
-        return lt
+        return lt, la_next
 
-    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk, ukk_inv=None):
+    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk, ukk_inv=None,
+                        la=None):
         """Mirrored sweep for uplo='U' (reference ``call_U``): panel is the
         block row k, trailing update hits upper-triangle tile pairs."""
         is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
@@ -510,10 +754,11 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         lu_c = max(0, -(-(k + 2 - Qc) // Qc))
         ncols = ltc - lu_c
         if ncols == 0:
-            return lt
+            return lt, None
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
-        pan = tb.trsm_panel("L", "U", "C", "N", ukk, lt[kr, lu_c:],
+        rowsrc = lt[kr, lu_c:] if la is None else la[0][lu_c - la[1]:]
+        pan = tb.trsm_panel("L", "U", "C", "N", ukk, rowsrc,
                             inv_a=ukk_inv)
         pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
         keep = (is_owner_r & col_valid)[:, None, None]
@@ -525,7 +770,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         lu_r = max(0, -(-(k + 2 - Pr) // Pr))
         nrows = ltr - lu_r
         if nrows == 0:
-            return lt
+            return lt, None
         g_rows = local_rows_global(lu_r, rr, nrows)
         row_valid = (g_rows > k) & (g_rows < nt)
         vr = transpose_row_to_cols(DistContext(dist), vc, lu_c, g_rows)
@@ -535,6 +780,36 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         pair = row_valid[:, None] & col_valid[None, :]
         above = pair & (g_rows[:, None] < g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        la_next = None
+        if lookahead and k + 1 < nt:
+            # next block row first (mirrored split): my kr1-slot
+            # transposed-panel tile, carried to step k+1
+            kr1 = ud.local_tile_from_global_tile(k + 1, Pr)
+            owner_r1 = ud.rank_global_tile(k + 1, Pr, sr)
+            pk1 = vr[kr1 - lu_r]
+            own_r1 = cc.this_rank(ROW_AXIS) == owner_r1
+            above1 = col_valid & (g_cols > k + 1)
+            ondiag1 = col_valid & (g_cols == k + 1)
+            if use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                updr = mmfn(jnp.swapaxes(jnp.conj(pk1), -1, -2),
+                            jnp.swapaxes(vc, -1, -2).reshape(
+                                ncols * mb, mb).T,
+                            slices=tb._oz_slices()).reshape(
+                                mb, ncols, mb).transpose(1, 0, 2)
+            else:
+                updr = jnp.einsum("ba,cbd->cad", jnp.conj(pk1), vc,
+                                  preferred_element_type=vc.dtype)
+            triu1 = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+            m3 = (above1[:, None, None] | (ondiag1[:, None, None] & triu1)) \
+                & own_r1
+            new_row = lt[kr1, lu_c:] - jnp.where(m3, updr,
+                                                 jnp.zeros_like(updr))
+            lt = lt.at[kr1, lu_c:].set(new_row)
+            la_next = (new_row, lu_c)
+            notnext = g_rows != k + 1
+            above = above & notnext[:, None]
+            ondiag = ondiag & notnext[:, None]
         if use_pallas:
             # transposed tiles keep the kernel's vr @ vc^T contraction;
             # mode 3 = within-tile upper triangle on diagonal tiles
@@ -543,6 +818,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 lt[lu_r:, lu_c:], jnp.swapaxes(vr, -1, -2),
                 jnp.swapaxes(vc, -1, -2), mode, interpret=pallas_interpret)
             lt = lt.at[lu_r:, lu_c:].set(new_block)
+            return lt, la_next
         else:
             if use_mxu and use_oz_pallas:
                 ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
@@ -562,9 +838,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
             upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
             lt = lt.at[lu_r:, lu_c:].add(-upd)
-        return lt
+        return lt, la_next
 
     def factorize(lt):
+        la = None
         for k in range(nt):
             # phase name on the compiled program's op metadata (device
             # timeline) + per-step tile-slot accounting; all trace-time
@@ -576,7 +853,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                                 algo="cholesky_dist", op="trailing_pairs"
                                 ).inc((ltr - max(0, -(-(k + 2 - Pr) // Pr)))
                                       * (ltc - max(0, -(-(k + 2 - Qc) // Qc))))
-                lt = step(lt, k)
+                    _count_step_modes(
+                        "cholesky_dist",
+                        *((1, 0) if lookahead and k + 1 < nt else (0, 1)))
+                lt, la = step(lt, k, la)
         return lt
 
     return shard_map(factorize, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),
@@ -585,7 +865,8 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
 
 def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                               use_mixed=False, cplx=False,
-                              use_oz_pallas=False, pallas_interpret=False):
+                              use_oz_pallas=False, pallas_interpret=False,
+                              lookahead=False):
     """``lax.scan`` form of the distributed factorization: ONE compiled
     step body looped ``nt`` times inside the ``shard_map``.
 
@@ -735,6 +1016,198 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
 
         return step
 
+    def _pair_upd(xr, xc):
+        """All-pairs tile product over (row tiles, transposed-col tiles) on
+        the configured trailing route — shared by the serial body's eager
+        update and the pipelined body's deferred one."""
+        ltr_s, ltc_s = xr.shape[0], xc.shape[0]
+        if use_mxu:
+            mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+            full = mmfn(xr.reshape(ltr_s * mb, mb),
+                        jnp.conj(xc).reshape(ltc_s * mb, mb).T,
+                        slices=tb._oz_slices())
+            return full.reshape(ltr_s, mb, ltc_s, mb).transpose(0, 2, 1, 3)
+        return jnp.einsum("rab,cdb->rcad", xr, jnp.conj(xc),
+                          preferred_element_type=xr.dtype)
+
+    def make_step_la(lu_r0, lu_c0, ltr_s, ltc_s):
+        """Software-pipelined step body (``cholesky_lookahead=1``): carry
+        ``(lt, prev_vr, prev_vc)`` — step k-1's masked panel broadcast +
+        transposed panel — and apply its BULK trailing product inside body
+        k, where it is independent of body k's latency-bound potrf/trsm
+        chain (a sequential scan body can only overlap work within one
+        iteration). The next panel column's tile strip is updated eagerly
+        so body k+1's pivot column is current; per-cell application order
+        matches the serial body (bulk k-1 before strip k), keeping
+        results bitwise identical on the native routes."""
+
+        def step(carry, k):
+            lt, pvr, pvc = carry
+            ctx = DistContext(dist)
+            owner_r, owner_c = ctx.owner_r(k), ctx.owner_c(k)
+            kr = ctx.kr(k) - lu_r0
+            kc = ctx.kc(k) - lu_c0
+            is_owner_r = ctx.rank_r == owner_r
+            is_owner_c = ctx.rank_c == owner_c
+
+            # -- diag tile -> everyone (pivot column is current: it took
+            # the k-1 strip eagerly and the k-2 bulk in body k-1) --------
+            cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0),
+                                         (1, 1, mb, mb))[0, 0]
+            diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r),
+                            COL_AXIS, owner_c)
+            ts = jnp.minimum(mb, n - k * mb)
+            pad = jnp.arange(mb) >= ts
+            diag = pad_diag_identity_dyn(diag, ts)
+            if use_mixed:
+                other = "U" if uplo == "L" else "L"
+                fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
+                lkk = fac + tb.tri_mask(diag, other, k=-1)
+            else:
+                lkk_inv = None
+                lkk = tl.potrf(uplo, diag)
+            lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
+            upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
+            lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
+                                              (kr, kc, 0, 0))
+
+            g_rows = ctx.g_rows(lu_r0, ltr_s)
+            g_cols = ctx.g_cols(lu_c0, ltc_s)
+            row_valid = (g_rows > k) & (g_rows < nt)
+            col_valid = (g_cols > k) & (g_cols < nt)
+            valid1 = k + 1 < nt
+
+            if uplo == "L":
+                colk = jax.lax.dynamic_slice(
+                    lt, (0, kc, 0, 0), (ltr_s, 1, mb, mb))[:, 0]
+                pan = tb.trsm_panel("R", "L", "C", "N", lkk, colk,
+                                    inv_a=lkk_inv)
+                pan = jnp.where(row_valid[:, None, None], pan, 0)
+                keep = (is_owner_c & row_valid)[:, None, None]
+                lt = jax.lax.dynamic_update_slice(
+                    lt, jnp.where(keep, pan, colk)[:, None], (0, kc, 0, 0))
+                vr = cc.bcast(pan, COL_AXIS, owner_c)
+                vc = transpose_col_to_rows(DistContext(dist), vr, lu_r0,
+                                           g_cols)
+                vc = jnp.where(col_valid[:, None, None], vc, 0)
+
+                # -- deferred bulk of step k-1 (its column-k strip was
+                # applied eagerly in body k-1, so exclude column k) ------
+                rv_p = (g_rows > k - 1) & (g_rows < nt)
+                cv_p = (g_cols > k - 1) & (g_cols < nt) & (g_cols != k)
+                pairp = rv_p[:, None] & cv_p[None, :]
+                belowp = pairp & (g_rows[:, None] > g_cols[None, :])
+                ondiagp = pairp & (g_rows[:, None] == g_cols[None, :])
+                if use_mxu and use_oz_pallas:
+                    updp = _masked_oz_update(
+                        pvr.reshape(ltr_s * mb, mb),
+                        jnp.conj(pvc).reshape(ltc_s * mb, mb),
+                        belowp | ondiagp, ltr_s, ltc_s, mb,
+                        pallas_interpret)
+                else:
+                    updp = _pair_upd(pvr, pvc)
+                tri_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+                mask4p = belowp[:, :, None, None] \
+                    | (ondiagp[:, :, None, None] & tri_m)
+                lt = lt - jnp.where(mask4p, updp, 0)
+
+                # -- eager next-column strip from THIS panel -------------
+                kc1 = ctx.kc(k + 1) - lu_c0
+                own_c1 = ctx.rank_c == ctx.owner_c(k + 1)
+                pk1 = jax.lax.dynamic_slice(vc, (kc1, 0, 0),
+                                            (1, mb, mb))[0]
+                below1 = (g_rows > k + 1) & (g_rows < nt)
+                ondiag1 = g_rows == k + 1
+                if use_mxu:
+                    mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                    updc = mmfn(vr.reshape(ltr_s * mb, mb),
+                                jnp.conj(pk1).T,
+                                slices=tb._oz_slices()).reshape(
+                                    ltr_s, mb, mb)
+                else:
+                    updc = jnp.einsum("rab,db->rad", vr, jnp.conj(pk1),
+                                      preferred_element_type=vr.dtype)
+                m3 = (below1[:, None, None]
+                      | (ondiag1[:, None, None] & tri_m)) \
+                    & (own_c1 & valid1)
+                colcur = jax.lax.dynamic_slice(
+                    lt, (0, kc1, 0, 0), (ltr_s, 1, mb, mb))
+                lt = jax.lax.dynamic_update_slice(
+                    lt, colcur - jnp.where(m3, updc, 0)[:, None],
+                    (0, kc1, 0, 0))
+                return (lt, vr, vc), None
+
+            # -- mirrored sweep (uplo='U') ------------------------------
+            rowk = jax.lax.dynamic_slice(
+                lt, (kr, 0, 0, 0), (1, ltc_s, mb, mb))[0]
+            pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowk,
+                                inv_a=lkk_inv)
+            pan = jnp.where(col_valid[:, None, None], pan, 0)
+            keep = (is_owner_r & col_valid)[:, None, None]
+            lt = jax.lax.dynamic_update_slice(
+                lt, jnp.where(keep, pan, rowk)[None], (kr, 0, 0, 0))
+            vcp = cc.bcast(pan, ROW_AXIS, owner_r)
+            vrp = transpose_row_to_cols(DistContext(dist), vcp, lu_c0,
+                                        g_rows)
+            vrp = jnp.where(row_valid[:, None, None], vrp, 0)
+
+            # deferred bulk of step k-1 (row-k strip applied in body k-1)
+            rv_p = (g_rows > k - 1) & (g_rows < nt) & (g_rows != k)
+            cv_p = (g_cols > k - 1) & (g_cols < nt)
+            pairp = rv_p[:, None] & cv_p[None, :]
+            abovep = pairp & (g_rows[:, None] < g_cols[None, :])
+            ondiagp = pairp & (g_rows[:, None] == g_cols[None, :])
+            if use_mxu and use_oz_pallas:
+                ar = jnp.swapaxes(jnp.conj(pvr),
+                                  -1, -2).reshape(ltr_s * mb, mb)
+                bc2 = jnp.swapaxes(pvc, -1, -2).reshape(ltc_s * mb, mb)
+                updp = _masked_oz_update(ar, bc2, abovep | ondiagp,
+                                         ltr_s, ltc_s, mb,
+                                         pallas_interpret)
+            elif use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                ar = jnp.swapaxes(jnp.conj(pvr),
+                                  -1, -2).reshape(ltr_s * mb, mb)
+                bc2 = jnp.swapaxes(pvc, -1, -2).reshape(ltc_s * mb, mb)
+                full = mmfn(ar, bc2.T, slices=tb._oz_slices())
+                updp = full.reshape(ltr_s, mb, ltc_s,
+                                    mb).transpose(0, 2, 1, 3)
+            else:
+                updp = jnp.einsum("rba,cbd->rcad", jnp.conj(pvr), pvc,
+                                  preferred_element_type=pvc.dtype)
+            tri_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+            mask4p = abovep[:, :, None, None] \
+                | (ondiagp[:, :, None, None] & tri_m)
+            lt = lt - jnp.where(mask4p, updp, 0)
+
+            # eager next-row strip from THIS panel
+            kr1 = ctx.kr(k + 1) - lu_r0
+            own_r1 = ctx.rank_r == ctx.owner_r(k + 1)
+            pk1 = jax.lax.dynamic_slice(vrp, (kr1, 0, 0), (1, mb, mb))[0]
+            above1 = (g_cols > k + 1) & (g_cols < nt)
+            ondiag1 = g_cols == k + 1
+            if use_mxu:
+                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+                updr = mmfn(jnp.swapaxes(jnp.conj(pk1), -1, -2),
+                            jnp.swapaxes(vcp, -1, -2).reshape(
+                                ltc_s * mb, mb).T,
+                            slices=tb._oz_slices()).reshape(
+                                mb, ltc_s, mb).transpose(1, 0, 2)
+            else:
+                updr = jnp.einsum("ba,cbd->cad", jnp.conj(pk1), vcp,
+                                  preferred_element_type=vcp.dtype)
+            m3 = (above1[:, None, None]
+                  | (ondiag1[:, None, None] & tri_m)) \
+                & (own_r1 & valid1)
+            rowcur = jax.lax.dynamic_slice(
+                lt, (kr1, 0, 0, 0), (1, ltc_s, mb, mb))
+            lt = jax.lax.dynamic_update_slice(
+                lt, rowcur - jnp.where(m3, updr, 0)[None],
+                (kr1, 0, 0, 0))
+            return (lt, vrp, vcp), None
+
+        return step
+
     def factorize(lt):
         # telescoped segments (see _cholesky_local_scan): each segment
         # scans only the remaining trailing slice of the local grid, so
@@ -743,14 +1216,31 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
         # the local grid can't shrink every halving) coalesce into one
         # scan — no duplicate identically-shaped step programs
         # (types.telescope_windows, shared by all telescoped builders).
+        # Under lookahead the pending panel pair is carried ACROSS
+        # segments (dropped slots hold rows/cols behind the window and
+        # are zero by the panel masks); the final step's pending is
+        # identically zero, so nothing is ever flushed.
+        pvr = pvc = None
         for (lu_r0, lu_c0), k0_seg, seg_len in telescope_windows(
                 nt, lambda k_start, _len: (uniform_slot_start(k_start, Pr),
                                            uniform_slot_start(k_start, Qc))):
             ltr_s, ltc_s = ltr - lu_r0, ltc - lu_c0
             sub = lt[lu_r0:, lu_c0:]
-            sub, _ = jax.lax.scan(
-                make_step(lu_r0, lu_c0, ltr_s, ltc_s), sub,
-                jnp.arange(k0_seg, k0_seg + seg_len))
+            if lookahead:
+                _count_step_modes("cholesky_dist_scan", seg_len, 0)
+                if pvr is None:
+                    pvr = jnp.zeros((ltr_s, mb, mb), lt.dtype)
+                    pvc = jnp.zeros((ltc_s, mb, mb), lt.dtype)
+                else:
+                    pvr, pvc = pvr[-ltr_s:], pvc[-ltc_s:]
+                (sub, pvr, pvc), _ = jax.lax.scan(
+                    make_step_la(lu_r0, lu_c0, ltr_s, ltc_s),
+                    (sub, pvr, pvc), jnp.arange(k0_seg, k0_seg + seg_len))
+            else:
+                _count_step_modes("cholesky_dist_scan", 0, seg_len)
+                sub, _ = jax.lax.scan(
+                    make_step(lu_r0, lu_c0, ltr_s, ltc_s), sub,
+                    jnp.arange(k0_seg, k0_seg + seg_len))
             lt = lt.at[lu_r0:, lu_c0:].set(sub)
         return lt
 
@@ -762,7 +1252,8 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
 @functools.lru_cache(maxsize=64)
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
-                          use_oz_pallas=False, scan=False, donate=False):
+                          use_oz_pallas=False, scan=False, donate=False,
+                          lookahead=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
     donate_kw = donate_argnums_kw(donate, 0)
@@ -771,12 +1262,14 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
             dist, mesh, uplo, use_mxu=use_mxu, use_mixed=use_mixed,
             cplx=dtype.startswith("complex"),
             use_oz_pallas=use_oz_pallas,
-            pallas_interpret=pallas_interpret), **donate_kw)
+            pallas_interpret=pallas_interpret,
+            lookahead=lookahead), **donate_kw)
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
                                         cplx=dtype.startswith("complex"),
-                                        use_oz_pallas=use_oz_pallas),
+                                        use_oz_pallas=use_oz_pallas,
+                                        lookahead=lookahead),
                    **donate_kw)
 
 
@@ -820,13 +1313,20 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
     dt = np.dtype(mat.dtype)
     n = mat.size.row
     grid_shape = (mat.dist.grid_size.row, mat.dist.grid_size.col)
+    # look-ahead step order (docs/lookahead.md): pipelined when the knob
+    # resolves 1; the whole-matrix "xla" delegation has no step structure
+    # to pipeline
+    from ..config import resolved_cholesky_lookahead
+
+    lookahead = resolved_cholesky_lookahead() and trailing != "xla"
     # entry span: host wall around trace+dispatch, unfenced (device
     # completion is the caller's fence — the miniapp span carries the
     # honest GFlop/s); attrs and the reference flop model build lazily
     entry_span = obs.entry_span("cholesky", lambda: dict(
         flops=total_ops(dt, n**3 / 6, n**3 / 6),
         n=n, nb=mat.block_size.row, uplo=uplo, dtype=dt.name,
-        trailing=trailing, grid=f"{grid_shape[0]}x{grid_shape[1]}"))
+        trailing=trailing, lookahead=int(lookahead),
+        grid=f"{grid_shape[0]}x{grid_shape[1]}"))
     # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
     # resolution local and distributed, single owner in tile_ops.blas);
     # the unrolled local path selects its route via cholesky_trailing
@@ -839,10 +1339,12 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
                 out = _cholesky_local_scan(a, uplo=uplo,
                                            nb=mat.block_size.row,
                                            use_mxu=use_mxu,
-                                           use_mixed=use_mixed)
+                                           use_mixed=use_mixed,
+                                           lookahead=lookahead)
             else:
                 out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
-                                      trailing=trailing)
+                                      trailing=trailing,
+                                      lookahead=lookahead)
             return mat.with_storage(global_to_tiles_donated(out, mat.dist))
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
     # exact-flop predicated contraction (ozaki_impl="pallas"): real f64
@@ -865,6 +1367,7 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
                                platform != "tpu",
                                use_mxu, use_mixed,
                                use_oz_pallas,
-                               scan=scan_mode, donate=donate)
+                               scan=scan_mode, donate=donate,
+                               lookahead=lookahead)
     with entry_span, quiet_donation():
         return mat.with_storage(fn(mat.storage))

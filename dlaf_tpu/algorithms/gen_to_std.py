@@ -123,9 +123,9 @@ def _step_inv(uplo: str, lkk):
 @register_program_cache
 # both operands are the entry point's freshly built global-layout copies
 # (the caller's matrices are re-read only at the final triangle merge)
-@functools.partial(jax.jit, static_argnames=("uplo", "nb"),
+@functools.partial(jax.jit, static_argnames=("uplo", "nb", "lookahead"),
                    donate_argnums=(0, 1))
-def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
+def _hegst_local_blocked(a, l, *, uplo: str, nb: int, lookahead: bool = False):
     """Unrolled blocked two-sided transform on the global 2D array.
 
     Per step (uplo='L', LAPACK xHEGST itype=1 structure, which the
@@ -143,6 +143,12 @@ def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
     """
     n = a.shape[0]
     nt = ceil_div(n, nb)
+    # lookahead carry (next diag block, next panel source) — the same
+    # next-panel-column-first her2k split as the pipelined Cholesky
+    # (docs/lookahead.md): step k+1's hegst-diag solves and panel trsm
+    # consume step k's strip values directly instead of reading `a` after
+    # the bulk her2k scatter
+    la = None
     for k in range(nt):
         k0, k1 = k * nb, min((k + 1) * nb, n)
         lkk = l[k0:k1, k0:k1]
@@ -156,16 +162,34 @@ def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
                 a = a.at[k0:k1, :k0].set(rowk)
                 if k1 < n:
                     a = a.at[k1:, :k0].add(-tb.gemm(l[k1:, k0:k1], rowk))
-            w = _hegst_diag(uplo, a[k0:k1, k0:k1], lkk, inv=lkk_inv)
+            w = _hegst_diag(uplo, a[k0:k1, k0:k1] if la is None else la[0],
+                            lkk, inv=lkk_inv)
             a = a.at[k0:k1, k0:k1].set(w)
             if k1 == n:
                 continue
-            p = a[k1:, k0:k1]
+            p = a[k1:, k0:k1] if la is None else la[1]
             l21 = l[k1:, k0:k1]
             p = tb.trsm_panel("R", "L", "C", "N", lkk, p, inv_a=lkk_inv)
             p = p - 0.5 * tb.gemm(l21, w)
-            a = a.at[k1:, k1:].set(
-                tb.her2k("L", "N", p, l21, a[k1:, k1:], alpha=-1.0))
+            la = None
+            if lookahead:
+                # next block column of the her2k first (carried), rest as
+                # a row-trimmed her2k of the remaining trailing block
+                wn = min(nb, n - k1)
+                mt = n - k1
+                strip = tb.gemm(p, l21[:wn], op_b="C") \
+                    + tb.gemm(l21, p[:wn], op_b="C")
+                smask = jnp.arange(mt)[:, None] >= jnp.arange(wn)[None, :]
+                new_col = a[k1:, k1:k1 + wn] - jnp.where(smask, strip, 0)
+                a = a.at[k1:, k1:k1 + wn].set(new_col)
+                la = (new_col[:wn], new_col[wn:])
+                if mt > wn:
+                    a = a.at[k1 + wn:, k1 + wn:].set(
+                        tb.her2k("L", "N", p[wn:], l21[wn:],
+                                 a[k1 + wn:, k1 + wn:], alpha=-1.0))
+            else:
+                a = a.at[k1:, k1:].set(
+                    tb.her2k("L", "N", p, l21, a[k1:, k1:], alpha=-1.0))
             p = p - 0.5 * tb.gemm(l21, w)
             a = a.at[k1:, k0:k1].set(p)
         else:
@@ -175,16 +199,33 @@ def _hegst_local_blocked(a, l, *, uplo: str, nb: int):
                 a = a.at[:k0, k0:k1].set(colk)
                 if k1 < n:
                     a = a.at[:k0, k1:].add(-tb.gemm(colk, l[k0:k1, k1:]))
-            w = _hegst_diag(uplo, a[k0:k1, k0:k1], lkk, inv=lkk_inv)
+            w = _hegst_diag(uplo, a[k0:k1, k0:k1] if la is None else la[0],
+                            lkk, inv=lkk_inv)
             a = a.at[k0:k1, k0:k1].set(w)
             if k1 == n:
                 continue
-            p = a[k0:k1, k1:]
+            p = a[k0:k1, k1:] if la is None else la[1]
             u12 = l[k0:k1, k1:]
             p = tb.trsm_panel("L", "U", "C", "N", lkk, p, inv_a=lkk_inv)
             p = p - 0.5 * tb.gemm(w, u12)
-            a = a.at[k1:, k1:].set(
-                tb.her2k("U", "C", p, u12, a[k1:, k1:], alpha=-1.0))
+            la = None
+            if lookahead:
+                # mirrored: next block row of the her2k first (carried)
+                wn = min(nb, n - k1)
+                mt = n - k1
+                strip = tb.gemm(p[:, :wn], u12, op_a="C") \
+                    + tb.gemm(u12[:, :wn], p, op_a="C")
+                smask = jnp.arange(wn)[:, None] <= jnp.arange(mt)[None, :]
+                new_row = a[k1:k1 + wn, k1:] - jnp.where(smask, strip, 0)
+                a = a.at[k1:k1 + wn, k1:].set(new_row)
+                la = (new_row[:, :wn], new_row[:, wn:])
+                if mt > wn:
+                    a = a.at[k1 + wn:, k1 + wn:].set(
+                        tb.her2k("U", "C", p[:, wn:], u12[:, wn:],
+                                 a[k1 + wn:, k1 + wn:], alpha=-1.0))
+            else:
+                a = a.at[k1:, k1:].set(
+                    tb.her2k("U", "C", p, u12, a[k1:, k1:], alpha=-1.0))
             p = p - 0.5 * tb.gemm(w, u12)
             a = a.at[k0:k1, k1:].set(p)
     return a
@@ -211,7 +252,34 @@ def _pair_product(x_tiles, y_tiles, cplx: bool, use_mxu: bool):
                       preferred_element_type=x_tiles.dtype)
 
 
-def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
+def _col_strip_product(x_tiles, y_tile, cplx: bool, use_mxu: bool):
+    """``out[r] = x_tiles[r] @ conj(y_tile)^T`` — one tile COLUMN of the
+    all-pairs product (the lookahead split's next-column strip), same
+    route as :func:`_pair_product`."""
+    if use_mxu:
+        nr, mb = x_tiles.shape[0], x_tiles.shape[-2]
+        mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+        return mmfn(x_tiles.reshape(nr * mb, -1), jnp.conj(y_tile).T,
+                    slices=tb._oz_slices()).reshape(nr, mb, mb)
+    return jnp.einsum("rab,db->rad", x_tiles, jnp.conj(y_tile),
+                      preferred_element_type=x_tiles.dtype)
+
+
+def _row_strip_product(x_tile, y_tiles, cplx: bool, use_mxu: bool):
+    """``out[c] = x_tile @ conj(y_tiles[c])^T`` — one tile ROW of the
+    all-pairs product (the mirrored uplo='U' strip)."""
+    if use_mxu:
+        nc, mb = y_tiles.shape[0], y_tiles.shape[-2]
+        mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+        full = mmfn(x_tile, jnp.conj(y_tiles).reshape(nc * mb, mb).T,
+                    slices=tb._oz_slices())
+        return full.reshape(mb, nc, mb).transpose(1, 0, 2)
+    return jnp.einsum("ab,cdb->cad", x_tile, jnp.conj(y_tiles),
+                      preferred_element_type=y_tiles.dtype)
+
+
+def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
+                      lookahead=False):
     """shard_map'd blocked HEGST over the 2D mesh, k-loop unrolled.
 
     Per step k (uplo='L'): broadcast the L diag + col-panel (row-wise and
@@ -241,7 +309,7 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
                 + jnp.diag(pad.astype(lkk.dtype))
         return lkk
 
-    def step_L(lt, ll, k, rr, rc):
+    def step_L(lt, ll, k, rr, rc, la=None):
         owner_r = ud.rank_global_tile(k, Pr, sr)
         owner_c = ud.rank_global_tile(k, Qc, sc)
         kr = ud.local_tile_from_global_tile(k, Pr)
@@ -288,17 +356,23 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
                 lt = lt.at[lu_r:, :lc_ub].add(-jnp.where(mask4, upd, 0))
 
         # -- diag hegst (redundant on every rank) --------------------------
-        cand = lt[kr, kc]
+        # lookahead carry (next-column strip of step k-1, docs/lookahead.md):
+        # the hegst-diag chain consumes it directly — correct on the owner
+        # (the only contributor bcast/keep select)
+        cand = lt[kr, kc] if la is None else la[0][kr - la[1]]
         akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
         w = _hegst_diag("L", akk, lkk, inv=lkk_inv)
         lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
                                          tb.tri_mask(w, "L")
-                                         + tb.tri_mask(akk, "U", k=-1), cand))
+                                         + tb.tri_mask(akk, "U", k=-1),
+                                         lt[kr, kc]))
         if k == nt - 1 or nrows == 0:
-            return lt
+            return lt, None
 
         # -- panel: trsm right with Lkk + first half-hemm ------------------
-        pan = tb.trsm_panel("R", "L", "C", "N", lkk, lt[lu_r:, kc],
+        pan = tb.trsm_panel("R", "L", "C", "N", lkk,
+                            lt[lu_r:, kc] if la is None
+                            else la[0][lu_r - la[1]:],
                             inv_a=lkk_inv)
         pan = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
         pan = jnp.where(row_valid[:, None, None], pan, 0)
@@ -313,7 +387,7 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
             pan2 = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
             lt = lt.at[lu_r:, kc].set(
                 jnp.where(keep, pan2, lt[lu_r:, kc]))
-            return lt
+            return lt, None
         g_cols = (lu_c + jnp.arange(ncols)) * Qc + rc
         col_valid = (g_cols > k) & (g_cols < nt)
         ctx = DistContext(dist)
@@ -327,18 +401,39 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
         pair = row_valid[:, None] & col_valid[None, :]
         below = pair & (g_rows[:, None] > g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+        la_next = None
+        if lookahead and k + 1 < nt:
+            # next panel column of the her2k first (my kc1-slot transposed
+            # tiles — the exact tiles the bulk pair product would use),
+            # carried to step k+1's hegst-diag/panel chain
+            kc1 = ud.local_tile_from_global_tile(k + 1, Qc)
+            owner_c1 = ud.rank_global_tile(k + 1, Qc, sc)
+            own_c1 = cc.this_rank(COL_AXIS) == owner_c1
+            updc = _col_strip_product(vr_a, vc_l[kc1 - lu_c], cplx, use_mxu) \
+                + _col_strip_product(vr_l, vc_a[kc1 - lu_c], cplx, use_mxu)
+            below1 = row_valid & (g_rows > k + 1)
+            ondiag1 = row_valid & (g_rows == k + 1)
+            m3 = (below1[:, None, None] | (ondiag1[:, None, None] & tril_m)) \
+                & own_c1
+            new_col = lt[lu_r:, kc1] - jnp.where(m3, updc,
+                                                 jnp.zeros_like(updc))
+            lt = lt.at[lu_r:, kc1].set(new_col)
+            la_next = (new_col, lu_r)
+            notnext = g_cols != k + 1
+            below = below & notnext[None, :]
+            ondiag = ondiag & notnext[None, :]
         upd = _pair_product(vr_a, vc_l, cplx, use_mxu) \
             + _pair_product(vr_l, vc_a, cplx, use_mxu)
-        tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
         mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
         lt = lt.at[lu_r:, lu_c:].add(-jnp.where(mask4, upd, 0))
 
         # -- second half-hemm on the panel ---------------------------------
         pan2 = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
         lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan2, lt[lu_r:, kc]))
-        return lt
+        return lt, la_next
 
-    def step_U(lt, ll, k, rr, rc):
+    def step_U(lt, ll, k, rr, rc, la=None):
         owner_r = ud.rank_global_tile(k, Pr, sr)
         owner_c = ud.rank_global_tile(k, Qc, sc)
         kr = ud.local_tile_from_global_tile(k, Pr)
@@ -381,17 +476,20 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
                          )[:, :, None, None]
                 lt = lt.at[:lr_ub, lu_c:].add(-jnp.where(mask4, upd, 0))
 
-        cand = lt[kr, kc]
+        cand = lt[kr, kc] if la is None else la[0][kc - la[1]]
         akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
         w = _hegst_diag("U", akk, ukk, inv=ukk_inv)
         lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
                                          tb.tri_mask(w, "U")
-                                         + tb.tri_mask(akk, "L", k=-1), cand))
+                                         + tb.tri_mask(akk, "L", k=-1),
+                                         lt[kr, kc]))
         if k == nt - 1 or ncols == 0:
-            return lt
+            return lt, None
 
         # -- panel: trsm left with Ukk^H + first half-hemm -----------------
-        pan = tb.trsm_panel("L", "U", "C", "N", ukk, lt[kr, lu_c:],
+        pan = tb.trsm_panel("L", "U", "C", "N", ukk,
+                            lt[kr, lu_c:] if la is None
+                            else la[0][lu_c - la[1]:],
                             inv_a=ukk_inv)
         pan = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
         pan = jnp.where(col_valid[:, None, None], pan, 0)
@@ -403,7 +501,7 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
         if nrows == 0:
             pan2 = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
             lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan2, lt[kr, lu_c:]))
-            return lt
+            return lt, None
         g_rows = (lu_r + jnp.arange(nrows)) * Pr + rr
         row_valid = (g_rows > k) & (g_rows < nt)
         ctx = DistContext(dist)
@@ -418,27 +516,51 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
         pair = row_valid[:, None] & col_valid[None, :]
         above = pair & (g_rows[:, None] < g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+        triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+        la_next = None
+        if lookahead and k + 1 < nt:
+            # mirrored split: next block row of the her2k first (carried)
+            kr1 = ud.local_tile_from_global_tile(k + 1, Pr)
+            owner_r1 = ud.rank_global_tile(k + 1, Pr, sr)
+            own_r1 = cc.this_rank(ROW_AXIS) == owner_r1
+            xa = jnp.conj(jnp.swapaxes(vr_a[kr1 - lu_r], -1, -2))
+            xu = jnp.conj(jnp.swapaxes(vr_u[kr1 - lu_r], -1, -2))
+            updr = _row_strip_product(
+                xa, jnp.conj(jnp.swapaxes(vc_u, -1, -2)), cplx, use_mxu) \
+                + _row_strip_product(
+                    xu, jnp.conj(jnp.swapaxes(vc_a, -1, -2)), cplx, use_mxu)
+            above1 = col_valid & (g_cols > k + 1)
+            ondiag1 = col_valid & (g_cols == k + 1)
+            m3 = (above1[:, None, None] | (ondiag1[:, None, None] & triu_m)) \
+                & own_r1
+            new_row = lt[kr1, lu_c:] - jnp.where(m3, updr,
+                                                 jnp.zeros_like(updr))
+            lt = lt.at[kr1, lu_c:].set(new_row)
+            la_next = (new_row, lu_c)
+            notnext = g_rows != k + 1
+            above = above & notnext[:, None]
+            ondiag = ondiag & notnext[:, None]
         upd = _pair_product(jnp.conj(jnp.swapaxes(vr_a, -1, -2)),
                             jnp.conj(jnp.swapaxes(vc_u, -1, -2)),
                             cplx, use_mxu) \
             + _pair_product(jnp.conj(jnp.swapaxes(vr_u, -1, -2)),
                             jnp.conj(jnp.swapaxes(vc_a, -1, -2)),
                             cplx, use_mxu)
-        triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
         mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
         lt = lt.at[lu_r:, lu_c:].add(-jnp.where(mask4, upd, 0))
 
         pan2 = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
         lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan2, lt[kr, lu_c:]))
-        return lt
+        return lt, la_next
 
     step = step_L if uplo == "L" else step_U
 
     def transform(lt, ll):
         rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
         rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+        la = None
         for k in range(nt):
-            lt = step(lt, ll, k, rr, rc)
+            lt, la = step(lt, ll, k, rr, rc, la)
         return lt
 
     return shard_map(transform, mesh=mesh,
@@ -448,9 +570,11 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False):
 
 @register_program_cache
 @functools.lru_cache(maxsize=64)
-def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu, donate=False):
+def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu, donate=False,
+                       lookahead=False):
     return jax.jit(_build_dist_hegst(dist, mesh, uplo, use_mxu=use_mxu,
-                                     cplx=dtype.startswith("complex")),
+                                     cplx=dtype.startswith("complex"),
+                                     lookahead=lookahead),
                    **donate_argnums_kw(donate, 0))
 
 
@@ -501,12 +625,19 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     if use_twosolve:
         with entry_span:
             return _gen_to_std_twosolve(uplo, a, b_factor, donate=donate)
+    # blocked forms take the same look-ahead split as the pipelined
+    # Cholesky (docs/lookahead.md); twosolve inherits it through the
+    # triangular solver's own scan-mode gate above
+    from ..config import resolved_cholesky_lookahead
+
+    lookahead = resolved_cholesky_lookahead()
     if not distributed:
         with entry_span, quiet_donation():
             g = tiles_to_global(a.storage, a.dist)
             lg = tiles_to_global(b_factor.storage, b_factor.dist)
             out = _hegst_local_blocked(g, lg, uplo=uplo,
-                                       nb=a.block_size.row)
+                                       nb=a.block_size.row,
+                                       lookahead=lookahead)
             out_m = a.with_storage(global_to_tiles_donated(out, a.dist))
         return mops.merge_triangle(out_m, a, uplo, donate_orig=donate)
     # the blocked builder shares one set of slot indices between A and L
@@ -516,6 +647,6 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     dt = np.dtype(a.dtype)
     use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
     fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu,
-                            donate=donate)
+                            donate=donate, lookahead=lookahead)
     with entry_span, quiet_donation():
         return a.with_storage(fn(a.storage, b_factor.storage))

@@ -201,7 +201,8 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
-def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
+def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
+                           lookahead=False):
     """``lax.scan`` form of the distributed solve (config
     ``dist_step_mode="scan"``): one compiled step body per telescoped
     segment, looped over the segment's steps — the same O(1)-compile /
@@ -285,6 +286,100 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
 
             return step
 
+        def make_step_la(lu0, cnt, lq0, cnt_q):
+            """Software-pipelined step body (``cholesky_lookahead=1`` —
+            the same next-pivot-first split as the pipelined Cholesky):
+            carry ``(sub, pe, pxk)`` = the previous step's masked panel
+            operands, and apply their BULK update inside this body, where
+            it is independent of this body's latency-bound trsm — while
+            the NEXT pivot row/column's strip is updated eagerly so the
+            following body's solve reads current data. Per-slot
+            application order matches the serial body (bulk k-1 before
+            strip k), so results are bitwise identical on the native
+            route."""
+
+            def step(carry, i):
+                sub, pe, pxk = carry
+                k = i if forward else nt - 1 - i
+                knext = k + 1 if forward else k - 1
+                akk = bcast_diag_dyn(ctx_a, lta, k)
+                akk = pad_diag_identity_dyn(akk, jnp.minimum(mb, n - k * mb))
+                if side == "L":
+                    bk = row_panel_dyn(ctx_b, sub, k, row_off=lu0)
+                    xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
+                    own = ctx_b.rank_r == ctx_b.owner_r(k)
+                    row = ctx_b.kr(k) - lu0
+                    cur = jax.lax.dynamic_slice(
+                        sub, (row, 0, 0, 0), (1,) + sub.shape[1:])[0]
+                    sub = jax.lax.dynamic_update_slice(
+                        sub, jnp.where(own, xk, cur)[None], (row, 0, 0, 0))
+                    # deferred bulk of step k-1 (its next-pivot strip was
+                    # applied eagerly there; pe is pre-masked)
+                    sub = sub - tb.contract("rab,cbd->rcad", pe, pxk)
+                    g = ctx_b.g_rows(lu0, cnt)
+                    rem = ((g > k) if forward else (g < k)) & (g < nt)
+                    if op == "N":
+                        e = col_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+                    else:
+                        rk = row_panel_dyn(ctx_a, lta, k, lu=lq0,
+                                           count=cnt_q)
+                        e = _tile_op(
+                            transpose_row_to_cols(ctx_a, rk, lq0, g), op)
+                    e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                    # eager next-pivot-row strip (slot holds global row
+                    # knext only on its owner; gval-gating keeps every
+                    # other rank's slot in the pending set instead)
+                    rnext = ctx_b.kr(knext) - lu0
+                    gval = jax.lax.dynamic_slice(g, (rnext,), (1,))[0]
+                    hit = (gval == knext) & (knext >= 0) & (knext < nt)
+                    er = jax.lax.dynamic_slice(e, (rnext, 0, 0),
+                                               (1, mb, mb))[0]
+                    updn = tb.contract("ab,cbd->cad", er, xk)
+                    rcur = jax.lax.dynamic_slice(
+                        sub, (rnext, 0, 0, 0), (1,) + sub.shape[1:])[0]
+                    sub = jax.lax.dynamic_update_slice(
+                        sub, (rcur - jnp.where(hit, updn, 0))[None],
+                        (rnext, 0, 0, 0))
+                    pe_next = jnp.where((rem & (g != knext))[:, None, None],
+                                        e, jnp.zeros_like(e))
+                    return (sub, pe_next, xk), None
+                bk = col_panel_dyn(ctx_b, sub, k, col_off=lu0)
+                xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
+                own = ctx_b.rank_c == ctx_b.owner_c(k)
+                col = ctx_b.kc(k) - lu0
+                cur = jax.lax.dynamic_slice(
+                    sub, (0, col, 0, 0),
+                    (sub.shape[0], 1) + sub.shape[2:])[:, 0]
+                sub = jax.lax.dynamic_update_slice(
+                    sub, jnp.where(own, xk, cur)[:, None], (0, col, 0, 0))
+                sub = sub - tb.contract("rab,cbd->rcad", pxk, pe)
+                g = ctx_b.g_cols(lu0, cnt)
+                rem = ((g > k) if forward else (g < k)) & (g < nt)
+                if op == "N":
+                    e = row_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+                else:
+                    ck = col_panel_dyn(ctx_a, lta, k, lu=lq0, count=cnt_q)
+                    e = _tile_op(
+                        transpose_col_to_rows(ctx_a, ck, lq0, g), op)
+                e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                cnext = ctx_b.kc(knext) - lu0
+                gval = jax.lax.dynamic_slice(g, (cnext,), (1,))[0]
+                hit = (gval == knext) & (knext >= 0) & (knext < nt)
+                ec = jax.lax.dynamic_slice(e, (cnext, 0, 0),
+                                           (1, mb, mb))[0]
+                updn = tb.contract("rab,bd->rad", xk, ec)
+                ccur = jax.lax.dynamic_slice(
+                    sub, (0, cnext, 0, 0),
+                    (sub.shape[0], 1) + sub.shape[2:])[:, 0]
+                sub = jax.lax.dynamic_update_slice(
+                    sub, (ccur - jnp.where(hit, updn, 0))[:, None],
+                    (0, cnext, 0, 0))
+                pe_next = jnp.where((rem & (g != knext))[:, None, None],
+                                    e, jnp.zeros_like(e))
+                return (sub, pe_next, xk), None
+
+            return step
+
         # telescoped segments over the swept axis (see
         # cholesky._build_dist_cholesky_scan); the transpose-exchange
         # window only splits segments when op != "N" actually uses it
@@ -304,12 +399,30 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                                uniform_slot_start(k_hi, q_orth) + 1))
             return (win, winq if op != "N" else (0, lt_orth))
 
+        # under lookahead the pending operands carry ACROSS segments (the
+        # slots a shrinking window drops are zero by the rem mask — the
+        # serial windows already prove they hold no live tiles); the last
+        # step's pending is identically zero, so nothing is flushed
+        pe = pxk = None
+        prev_lu0 = 0
         for ((lu0, cnt), (lq0, cnt_q)), i0, seg_len in \
                 telescope_windows(nt, window):
             sub = jax.lax.slice_in_dim(ltb, lu0, lu0 + cnt,
                                        axis=0 if side == "L" else 1)
-            sub, _ = jax.lax.scan(make_step(lu0, cnt, lq0, cnt_q), sub,
-                                  jnp.arange(i0, i0 + seg_len))
+            if lookahead:
+                if pe is None:
+                    pe = jnp.zeros((cnt, mb, mb), ltb.dtype)
+                    orth = ltb.shape[1] if side == "L" else ltb.shape[0]
+                    pxk = jnp.zeros((orth, mb, mb), ltb.dtype)
+                else:
+                    pe = pe[lu0 - prev_lu0: lu0 - prev_lu0 + cnt]
+                prev_lu0 = lu0
+                (sub, pe, pxk), _ = jax.lax.scan(
+                    make_step_la(lu0, cnt, lq0, cnt_q), (sub, pe, pxk),
+                    jnp.arange(i0, i0 + seg_len))
+            else:
+                sub, _ = jax.lax.scan(make_step(lu0, cnt, lq0, cnt_q), sub,
+                                      jnp.arange(i0, i0 + seg_len))
             if side == "L":
                 ltb = ltb.at[lu0:lu0 + cnt].set(sub)
             else:
@@ -521,10 +634,14 @@ def _unit_diag(t, diag):
 @register_program_cache
 @functools.lru_cache(maxsize=128)
 def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
-                       scan=False, donate_b=False):
-    build = _build_dist_solve_scan if scan else _build_dist_solve
-    return jax.jit(build(dist_a, dist_b, mesh, side, uplo, op, diag, dtype),
-                   **donate_argnums_kw(donate_b, 1))
+                       scan=False, donate_b=False, lookahead=False):
+    if scan:
+        built = _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op,
+                                       diag, dtype, lookahead=lookahead)
+    else:
+        built = _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op,
+                                  diag, dtype)
+    return jax.jit(built, **donate_argnums_kw(donate_b, 1))
 
 
 @register_program_cache
@@ -574,12 +691,16 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
     # on the swept axis — misalignment corrupts silently, so contract it
     assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
                         what="triangular_solve(A, B)")
-    from ..config import resolve_step_mode
+    from ..config import resolve_step_mode, resolved_cholesky_lookahead
 
+    scan_mode = resolve_step_mode(a.dist.nr_tiles.row) == "scan"
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
                             np.dtype(a.dtype).name,
-                            scan=resolve_step_mode(a.dist.nr_tiles.row)
-                            == "scan", donate_b=donate_b)
+                            scan=scan_mode, donate_b=donate_b,
+                            # the pipelined scan body (same knob as the
+                            # Cholesky look-ahead; docs/lookahead.md)
+                            lookahead=scan_mode
+                            and resolved_cholesky_lookahead())
     with entry_span, quiet_donation():
         return b.with_storage(fn(a.storage, b.storage,
                                  jnp.asarray(alpha, b.dtype)))

@@ -68,6 +68,25 @@ class Configuration:
     #: forms, 2026-08-01) — and loop elsewhere.
     #: Benchmarked per hardware; see bench.py.
     cholesky_trailing: str = "auto"
+    #: Look-ahead (software-pipelined) step formulation for the blocked
+    #: Cholesky (and the analogous panel-chain splits in the triangular
+    #: scan solve and blocked HEGST): "0" = the plain right-looking step
+    #: order, "1" = split every trailing update into "next panel column
+    #: first" + "rest of trailing" so panel k+1's potrf/trsm chain
+    #: consumes the carried next-column values directly and the bulk
+    #: herk/gemm of step k runs concurrently with it (the reference's
+    #: high-priority first-column herk + round-robin panel workspaces,
+    #: ``factorization/cholesky/impl.h:147-156,187-189``, expressed as
+    #: program structure for XLA's scheduler: unrolled forms carry the
+    #: next column between steps, scan forms defer the bulk update one
+    #: iteration so it overlaps the next latency-bound panel chain).
+    #: "auto" (default): 1 on TPU — per-step critical-path latency, not
+    #: flops, dominates blocked factorizations there (N=4096 at 133 GF/s
+    #: vs N=16384 at 514 is the latency-bound-panel signature) — and 0
+    #: elsewhere. Results are bitwise-identical either way on the native
+    #: routes (same tile ops, same per-cell application order; enforced
+    #: by tests/test_cholesky.py lookahead A/Bs). See docs/lookahead.md.
+    cholesky_lookahead: str = "auto"
     #: bt_band_to_tridiag reflector application: "blocked" (compact-WY
     #: staircase groups -> larft + two gemms per step level, the MXU form of
     #: the reference's b x b HH re-tiling) or "sweeps" (one batched rank-1
@@ -353,6 +372,7 @@ _VALID_CHOICES = {
     "band_to_tridiag_impl": ("native", "numpy"),
     "secular_impl": ("native", "numpy"),
     "bt_b2t_impl": ("blocked", "sweeps"),
+    "cholesky_lookahead": ("0", "1", "auto"),
     "f64_gemm": ("native", "mxu", "auto"),
     "f64_trsm": ("native", "mixed", "auto"),
     "ozaki_impl": ("jnp", "pallas"),
@@ -518,6 +538,18 @@ def resolved_f64_trsm() -> str:
         tpu_choice="mixed", other_choice="native",
         detail="f32-seed Newton-refined panel solves measured +0.6 ms/step "
                "vs +15.7 for native-f64 panels — 2026-08-01 v5e session")
+
+
+def resolved_cholesky_lookahead() -> bool:
+    """``cholesky_lookahead`` with "auto" resolved (True = pipelined):
+    1 on TPU, 0 elsewhere (see the knob docstring for the basis)."""
+    return resolve_platform_auto(
+        get_configuration().cholesky_lookahead, knob="cholesky_lookahead",
+        tpu_choice="1", other_choice="0",
+        detail="panel-chain latency dominates blocked factorizations on "
+               "TPU (config #1: 133 GF/s at N=4096 vs 514 at N=16384); "
+               "the pipelined step order exposes panel k+1 to XLA while "
+               "the bulk trailing update of step k is in flight") == "1"
 
 
 #: Step counts at which ``dist_step_mode="auto"`` switches to the scan

@@ -80,7 +80,7 @@ def get_lib():
                 _build()
             lib = ctypes.CDLL(_LIB)
             for name in ("dlaf_band_to_tridiag_d", "dlaf_band_to_tridiag_z",
-                         "dlaf_secular_roots_d"):
+                         "dlaf_secular_roots_d", "dlaf_secular_roots_d_nt"):
                 fn = getattr(lib, name)
                 fn.restype = ctypes.c_int
             lib.dlaf_deflate_scan_d.restype = ctypes.c_int64
@@ -97,10 +97,15 @@ def get_lib():
         return lib
 
 
-def secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
+def secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float,
+                  nthreads: int | None = None):
     """Native counterpart of the host secular solver (safeguarded-Newton
     laed4 analog, ``secular.cpp``): returns ``(anchor, mu)`` with the same
-    contract as ``tridiag_solver._secular_roots``."""
+    contract as ``tridiag_solver._secular_roots``.
+
+    ``nthreads``: None or <= 0 = auto (hardware concurrency, bounded by
+    roots per worker); >= 1 forces the worker count. Any count yields
+    bitwise identical results — each root is independent."""
     ds = np.ascontiguousarray(ds, dtype=np.float64)
     zs = np.ascontiguousarray(zs, dtype=np.float64)
     k = ds.shape[0]
@@ -109,12 +114,14 @@ def secular_roots(ds: np.ndarray, zs: np.ndarray, rho: float):
     if k == 0:
         return anchor, mu
     lib = get_lib()
-    rc = lib.dlaf_secular_roots_d(
+    rc = lib.dlaf_secular_roots_d_nt(
         ds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         zs.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         ctypes.c_double(float(rho)), ctypes.c_long(k),
         anchor.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
-        mu.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        mu.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_long(nthreads if nthreads is not None and nthreads > 0
+                      else 0))
     if rc != 0:
         raise RuntimeError(f"native secular_roots failed rc={rc}")
     return anchor, mu

@@ -109,9 +109,14 @@ void solve_range(const Problem& p, double zsum, std::int64_t i0,
 
 }  // namespace
 
-extern "C" int dlaf_secular_roots_d(const double* d, const double* z,
-                                    double rho, std::int64_t k,
-                                    std::int64_t* anchor, double* mu) {
+// nthreads_req <= 0: auto (hardware concurrency, bounded by roots per
+// thread); >= 1: forced worker count — results are bitwise identical at
+// any count (each root is solved independently from read-only inputs),
+// which tests/test_tridiag_solver.py pins with a forced-4 run.
+extern "C" int dlaf_secular_roots_d_nt(const double* d, const double* z,
+                                       double rho, std::int64_t k,
+                                       std::int64_t* anchor, double* mu,
+                                       std::int64_t nthreads_req) {
   if (k <= 0) return 0;
   std::vector<double> zsq(static_cast<size_t>(k));
   double zsum = 0.0;
@@ -121,10 +126,15 @@ extern "C" int dlaf_secular_roots_d(const double* d, const double* z,
   }
   Problem p{d, zsq.data(), rho, k};
 
-  const unsigned hw = std::thread::hardware_concurrency();
-  const std::int64_t min_per_thread = 64;
-  std::int64_t nthreads =
-      std::min<std::int64_t>(hw ? hw : 1, (k + min_per_thread - 1) / min_per_thread);
+  std::int64_t nthreads;
+  if (nthreads_req >= 1) {
+    nthreads = std::min<std::int64_t>(nthreads_req, k);
+  } else {
+    const unsigned hw = std::thread::hardware_concurrency();
+    const std::int64_t min_per_thread = 64;
+    nthreads = std::min<std::int64_t>(hw ? hw : 1,
+                                      (k + min_per_thread - 1) / min_per_thread);
+  }
   if (nthreads <= 1) {
     solve_range(p, zsum, 0, k, anchor, mu);
     return 0;
@@ -140,4 +150,10 @@ extern "C" int dlaf_secular_roots_d(const double* d, const double* z,
   }
   for (auto& th : threads) th.join();
   return 0;
+}
+
+extern "C" int dlaf_secular_roots_d(const double* d, const double* z,
+                                    double rho, std::int64_t k,
+                                    std::int64_t* anchor, double* mu) {
+  return dlaf_secular_roots_d_nt(d, z, rho, k, anchor, mu, 0);
 }

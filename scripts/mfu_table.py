@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""Regenerate the MFU / roofline table in BASELINE.md.
+
+Every perf PR so far reported bare GF/s; this script supplies the
+*denominator*: a route-specific achievable ceiling per BASELINE config, so
+results read as "% of route ceiling" (MFU) instead of unanchored numbers.
+
+Ceilings are per chip and route-specific, not the marketing peak:
+
+* **ozaki f64-equivalent** — the error-free int8-slice route spends
+  ``s*(s+1)/2`` slice-pair dots per f64 product (s=7 on TPU: 28 — see
+  ``config.f64_gemm_slices``), so the compute ceiling is
+  ``dot-route peak / 28`` (bf16 path on TPU since the dot_ab session;
+  bit-identical to the s8 dot).  A syrk-shaped trailing halves the
+  mirrored pairs, so blocked factorizations can exceed ~½ of this model's
+  denominator-pessimism — the ceiling is the honest matmul-pair model.
+* **HBM roofline** — the jnp slice path is memory-bound well below the
+  MXU ceiling at small N (the r4 sessions measured ~100x below raw dot
+  peak); the traffic model below counts, per factorization step with
+  trailing extent ``m``: 2 int8 slice operand sets (``2*s*m*nb`` bytes),
+  one live int32 partial plane read+written and the f64 accumulator
+  read+written under the scan accumulation schedule
+  (``(4+4+8+8)*m**2``).  ``ceiling_hbm = flops / bytes * BW``.  This is
+  an estimate of the *route's* traffic, stated so future PRs can refine
+  it — not a measured counter.
+* The **effective ceiling** per config is ``min(compute, HBM)``; the
+  table's ``bound`` column names which side binds.
+
+Measured values come from the append-only ``.bench_history.jsonl``
+(post-peel-fix TPU f64 entries only — the pre-fix decomposition was
+numerically corrupted; see bench.py ``PEEL_FIX_TS``).  Multi-chip
+BASELINE configs whose grids this environment has never exposed report
+their single-chip rehearsal number with a note, or "pending".
+
+Usage:
+    python scripts/mfu_table.py            # print the markdown table
+    python scripts/mfu_table.py --write    # splice into BASELINE.md
+                                           # between the mfu-table markers
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+HISTORY = os.path.join(REPO, ".bench_history.jsonl")
+BASELINE_MD = os.path.join(REPO, "BASELINE.md")
+BEGIN, END = "<!-- mfu-table:begin -->", "<!-- mfu-table:end -->"
+
+#: bench.py PEEL_FIX_TS — entries before it measured a corrupted
+#: decomposition and must not feed the MFU table
+PEEL_FIX_TS = "2026-08-02T04:00"
+
+#: Public per-chip peaks. The measured platform is v5e (one chip via the
+#: axon tunnel); v5p is the north-star target part.
+CHIPS = {
+    "v5e": dict(bf16=197e12, int8=394e12, hbm=819e9),
+    "v5p": dict(bf16=459e12, int8=918e12, hbm=2765e9),
+}
+
+#: int8/bf16 slice-pair dots per f64 product at the TPU default
+#: f64_gemm_slices=0 -> s=7 (config.py): s*(s+1)/2.
+OZ_SLICES = 7
+OZ_PAIRS = OZ_SLICES * (OZ_SLICES + 1) // 2
+
+
+def oz_compute_ceiling(chip: str, dot: str = "bf16") -> float:
+    """f64-equivalent GF/s ceiling of the ozaki route on ``chip``."""
+    return CHIPS[chip][dot] / OZ_PAIRS / 1e9
+
+
+def chol_hbm_ceiling(chip: str, n: int, nb: int) -> float:
+    """HBM-roofline GF/s for the blocked Cholesky's ozaki trailing path
+    (traffic model in the module docstring; real-arithmetic flops n^3/3)."""
+    flops = bytes_ = 0.0
+    nt = -(-n // nb)
+    for k in range(nt):
+        m = n - (k + 1) * nb
+        if m <= 0:
+            continue
+        flops += 2.0 * m * m * nb          # trailing herk/gemm adds+muls
+        bytes_ += 2.0 * OZ_SLICES * m * nb + 24.0 * m * m
+    if bytes_ == 0:
+        return float("inf")
+    return flops / bytes_ * CHIPS[chip]["hbm"] / 1e9
+
+
+def trsm_hbm_ceiling(chip: str, n: int, nb: int) -> float:
+    """Same traffic shape for the blocked substitution (free axis = n)."""
+    return chol_hbm_ceiling(chip, n, nb)
+
+
+#: measured-entry classifier: history `variant` labels per workload family
+_FAMILIES = {
+    "cholesky": ("chol_", "ozaki", "scan", "xla", "loop", "biggemm",
+                 "invgemm"),
+    "trsm": ("trsm_",),
+    "hegst": ("hegst_",),
+    "red2band": ("red2band_",),
+    "eigensolver": ("eig_", "eigensolver"),
+}
+
+
+def measured(family: str, n: int, nb: int, path: str = HISTORY):
+    """Best post-peel-fix TPU f64 GF/s for (family, n, nb), or None."""
+    prefixes = _FAMILIES[family]
+    best = None
+    try:
+        with open(path) as f:
+            for raw in f:
+                try:
+                    r = json.loads(raw)
+                except ValueError:
+                    continue
+                v = str(r.get("variant", ""))
+                if not (r.get("platform") == "tpu"
+                        and r.get("dtype") == "float64"
+                        and r.get("n") == n and r.get("nb") == nb
+                        and str(r.get("ts", "")) >= PEEL_FIX_TS
+                        and isinstance(r.get("gflops"), (int, float))
+                        and any(v.startswith(p) or v == p.rstrip("_")
+                                for p in prefixes)):
+                    continue
+                if best is None or r["gflops"] > best:
+                    best = r["gflops"]
+    except OSError:
+        return None
+    return best
+
+
+#: BASELINE configs + the measured single-chip config-#1 ladder. Fields:
+#: (label, family, n, nb, grid, chip, note). ``n_meas``/``nb_meas``
+#: override where the recorded number ran a rehearsal config.
+CONFIGS = [
+    ("#1 cholesky d 4096/256 1x1", "cholesky", 4096, 256, "1x1", "v5e", ""),
+    ("#1 ladder 8192/256 1x1", "cholesky", 8192, 256, "1x1", "v5e", ""),
+    ("#1 ladder 12288/256 1x1", "cholesky", 12288, 256, "1x1", "v5e", ""),
+    ("#1 ladder 16384/256 1x1", "cholesky", 16384, 256, "1x1", "v5e", ""),
+    ("#2 trsm d 8192/256 2x2", "trsm", 8192, 256, "2x2", "v5e",
+     "single-chip local rehearsal (2x2 ICI unexposed); pre-peel-fix "
+     "sessions recorded 128-131 GF/s — re-measure post-fix"),
+    ("#3 hegst z 8192/256 2x2", "hegst", 8192, 256, "2x2", "v5e",
+     "d-dtype twosolve rehearsal (tunnel lacks complex; z is CPU-mesh-"
+     "verified)"),
+    ("#4 red2band d 16384/512 4x4", "red2band", 16384, 512, "4x4", "v5e",
+     "measured at 8192/512 single-chip; 16384 is multi-chip-only"),
+    ("#5 gen_eigensolver d 32768/512 8x8", "eigensolver", 32768, 512,
+     "8x8", "v5e", "pipeline rehearsal at 8192 passed; flops span mixed "
+     "stages, MFU not meaningful as one number"),
+]
+
+#: where the recorded datum ran a different (n, nb) than the config asks
+_MEAS_AT = {"#4 red2band d 16384/512 4x4": (8192, 512)}
+
+
+def build_rows():
+    rows = []
+    for label, family, n, nb, grid, chip, note in CONFIGS:
+        comp = oz_compute_ceiling(chip)
+        hbm = (chol_hbm_ceiling(chip, n, nb)
+               if family in ("cholesky", "trsm", "hegst") else None)
+        ceil = min(comp, hbm) if hbm is not None else comp
+        bound = "hbm" if (hbm is not None and hbm < comp) else "mxu"
+        n_m, nb_m = _MEAS_AT.get(label, (n, nb))
+        got = measured(family, n_m, nb_m)
+        mfu = f"{100.0 * got / ceil:.1f}%" if got else "—"
+        rows.append((label, f"ozaki s={OZ_SLICES} (bf16 dots)",
+                     f"{comp:.0f}", f"{hbm:.0f}" if hbm else "—", bound,
+                     f"{got:.1f}" if got else "pending", mfu, note))
+    return rows
+
+
+def render() -> str:
+    head = (f"{BEGIN}\n"
+            "## MFU / roofline table (scripts/mfu_table.py — regenerate "
+            "with `--write`)\n\n"
+            "Route ceilings per chip (f64-equivalent): ozaki compute = "
+            f"dot-route peak / {OZ_PAIRS} slice pairs (s={OZ_SLICES}); "
+            "HBM roofline from the slice-traffic model in the script "
+            "docstring. `MFU` = measured / min(compute, HBM). Measured "
+            "values: best post-peel-fix TPU f64 entries in "
+            "`.bench_history.jsonl` (v5e, one chip). Single-digit MFU "
+            "with neither roofline binding = the step chain is "
+            "latency/serialization-bound — the gap `cholesky_lookahead` "
+            "(docs/lookahead.md) exists to close; the N-ladder's rising "
+            "MFU is that serial fraction amortizing.\n\n"
+            "| config | route | compute ceil GF/s | HBM ceil GF/s | bound "
+            "| measured GF/s | MFU | note |\n"
+            "|---|---|---|---|---|---|---|---|\n")
+    body = "".join("| " + " | ".join(r) + " |\n" for r in build_rows())
+    return head + body + END
+
+
+def main() -> None:
+    text = render()
+    if "--write" not in sys.argv:
+        print(text)
+        return
+    with open(BASELINE_MD) as f:
+        doc = f.read()
+    if BEGIN in doc and END in doc:
+        pre = doc[: doc.index(BEGIN)]
+        post = doc[doc.index(END) + len(END):]
+        doc = pre + text + post
+    else:
+        doc = doc.rstrip() + "\n\n" + text + "\n"
+    with open(BASELINE_MD, "w") as f:
+        f.write(doc)
+    print(f"wrote MFU table into {BASELINE_MD}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -110,7 +110,10 @@ def main() -> None:
                       "recon_err": float(np.abs(recon - np.asarray(xn)).max())}))
 
     # --- probe 1+2: plain f64 matmul vs precision pin --------------------
-    m, k = 1024, 128
+    # (env-overridable so CI can smoke the probe at tiny shapes on CPU —
+    # ci/run.sh full; the on-silicon defaults are the red2band panel shape)
+    m = int(os.environ.get("DLAF_PREC_M", "1024"))
+    k = int(os.environ.get("DLAF_PREC_K", "128"))
     a = rng.standard_normal((m, k))
     ga_host = a.T @ a
     av = jnp.asarray(a, dtype=jnp.float64)

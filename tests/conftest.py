@@ -37,8 +37,12 @@ jax.config.update("jax_enable_x64", True)
 # that vanish with the cache off and never occur on cold (writing) runs.
 # Keeping sub-5s compiles out of the cache sidesteps the corruption where
 # it was observed while retaining the big-program compile savings.
-_cache = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                      ".jax_cache")
+# An explicit JAX_COMPILATION_CACHE_DIR wins over the repo-local default:
+# CI's slow job restores a cross-run cache there (.github/workflows/ci.yml)
+# and an unconditional override would silently leave that cache empty.
+_cache = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 

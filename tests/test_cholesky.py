@@ -299,3 +299,145 @@ def test_cholesky_distributed_scan_multisegment(dtype, mode, devices8,
                   "DLAF_F64_TRSM", "DLAF_F64_GEMM_MIN_DIM"):
             monkeypatch.delenv(k, raising=False)
         config.initialize()
+
+
+# ---------------------------------------------------------------------------
+# Look-ahead (software-pipelined) step order — docs/lookahead.md
+# ---------------------------------------------------------------------------
+
+def _cholesky_la(uplo, a, nb, la, monkeypatch, trailing=None, grid=None,
+                 src=RankIndex2D(0, 0)):
+    import dlaf_tpu.config as config
+
+    monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", la)
+    if trailing:
+        monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", trailing)
+    config.initialize()
+    try:
+        return cholesky(uplo, Matrix_from(a, nb, grid=grid,
+                                          src=src)).to_numpy()
+    finally:
+        monkeypatch.delenv("DLAF_CHOLESKY_LOOKAHEAD")
+        monkeypatch.delenv("DLAF_CHOLESKY_TRAILING", raising=False)
+        config.initialize()
+
+
+@pytest.mark.parametrize("trailing", [None, "scan"])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_cholesky_lookahead_bitwise_local(uplo, dtype, trailing, monkeypatch):
+    """cholesky_lookahead=1 must be BITWISE identical to =0: the pipelined
+    order computes the same dots and applies them per cell in the same
+    order (docs/lookahead.md) — local, default (loop) + scan step modes,
+    ragged edge tile included."""
+    n, nb = 29, 8
+    a = hpd_matrix(n, dtype, seed=5)
+    r0 = _cholesky_la(uplo, a, nb, "0", monkeypatch, trailing)
+    r1 = _cholesky_la(uplo, a, nb, "1", monkeypatch, trailing)
+    np.testing.assert_array_equal(r1, r0)
+    check_factor(uplo, a, r1, dtype)
+
+
+@pytest.mark.parametrize("trailing", [None, "scan"])
+@pytest.mark.parametrize("rows,cols,sr,sc", [(2, 2, 0, 0), (2, 4, 1, 2)])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_cholesky_lookahead_bitwise_distributed(uplo, rows, cols, sr, sc,
+                                                trailing, devices8,
+                                                monkeypatch):
+    """Distributed bitwise A/B at nt=11 (multi-segment telescoped scan +
+    cross-step carries on an offset grid): the carried next-column values
+    are only trusted where the owner-column masks select them, so every
+    rank's result must still match the serialized order exactly."""
+    n, nb = 41, 4
+    a = hpd_matrix(n, np.float64, seed=n + rows)
+    grid, src = Grid(rows, cols), RankIndex2D(sr % rows, sc % cols)
+    r0 = _cholesky_la(uplo, a, nb, "0", monkeypatch, trailing, grid, src)
+    r1 = _cholesky_la(uplo, a, nb, "1", monkeypatch, trailing, grid, src)
+    np.testing.assert_array_equal(r1, r0)
+    check_factor(uplo, a, r1, np.float64)
+
+
+@pytest.mark.quick
+def test_cholesky_lookahead_quick(monkeypatch, tmp_path):
+    """Smoke-tier pin: pipelined == serialized bitwise on the default
+    route, and the compiled program's trace-time step accounting reports
+    the overlapped step modes (dlaf_cholesky_steps_total)."""
+    import dlaf_tpu.config as config
+    from dlaf_tpu import obs
+
+    n, nb = 16, 4
+    a = hpd_matrix(n, np.float64, seed=2)
+    r0 = _cholesky_la("L", a, nb, "0", monkeypatch)
+    monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", "1")
+    monkeypatch.setenv("DLAF_METRICS_PATH", str(tmp_path / "m.jsonl"))
+    config.initialize()
+    try:
+        r1 = cholesky("L", Matrix_from(a, nb)).to_numpy()
+        snap = obs.registry().snapshot()
+        modes = {m["labels"].get("mode"): m["value"] for m in snap
+                 if m["name"] == "dlaf_cholesky_steps_total"}
+        # nt=4: 3 pipelined steps + the carry-less last one
+        assert modes.get("overlapped", 0) >= 3
+        assert modes.get("serialized", 0) >= 1
+    finally:
+        monkeypatch.delenv("DLAF_CHOLESKY_LOOKAHEAD")
+        monkeypatch.delenv("DLAF_METRICS_PATH")
+        config.initialize()
+        obs._reset_for_tests()
+    np.testing.assert_array_equal(r1, r0)
+    check_factor("L", a, r1, np.float64)
+
+
+def test_lookahead_breaks_serial_chain():
+    """Structural evidence for the pipeline (the bench-level A/B is
+    throughput-noise-bound on CPU, where XLA's thunk executor runs ops
+    serially): in the pipelined program, step k+1's potrf must NOT
+    transitively depend on step k's bulk trailing product, while the
+    serialized program's potrf must. Checked on the traced jaxpr of the
+    local biggemm form (bulk product = the (m-w, m-w)/(m, m) trailing
+    dot), which is exactly the dependency XLA's scheduler sees."""
+    import jax
+
+    from dlaf_tpu.algorithms.cholesky import _cholesky_local
+
+    import jax.numpy as jnp
+
+    n, nb = 24, 8   # 3 blocks: step 0 bulk is (16,16) or (8,8) rest
+    a = jnp.asarray(hpd_matrix(n, np.float64, seed=3))
+
+    def deps_of_second_potrf(lookahead):
+        jaxpr = jax.make_jaxpr(
+            lambda x: _cholesky_local.__wrapped__(
+                x, uplo="L", nb=nb, trailing="biggemm",
+                lookahead=lookahead))(a).jaxpr
+        producers = {}
+        for eq in jaxpr.eqns:
+            for v in eq.outvars:
+                producers[v] = eq
+        chol_eqns = [eq for eq in jaxpr.eqns
+                     if eq.primitive.name == "cholesky"]
+        assert len(chol_eqns) == 3, [e.primitive.name for e in jaxpr.eqns]
+        # transitive producer closure of the SECOND potrf's inputs
+        seen, todo = set(), list(chol_eqns[1].invars)
+        closure = []
+        while todo:
+            v = todo.pop()
+            eq = producers.get(v)
+            if eq is None or id(eq) in seen:
+                continue
+            seen.add(id(eq))
+            closure.append(eq)
+            todo.extend(v2 for v2 in eq.invars
+                        if not isinstance(v2, jax.core.Literal))
+        # step 0's bulk trailing product: a dot_general with a square
+        # output of the trailing(-rest) extent. w=8, m=16: rest is (8,8)
+        # under lookahead, full (16,16) without.
+        bulk_shapes = {(16, 16)} if not lookahead else {(8, 8)}
+        return any(eq.primitive.name == "dot_general"
+                   and tuple(eq.outvars[0].aval.shape) in bulk_shapes
+                   for eq in closure)
+
+    assert deps_of_second_potrf(lookahead=False), \
+        "serialized form lost its bulk dependency — test is stale"
+    assert not deps_of_second_potrf(lookahead=True), \
+        "pipelined potrf still depends on the bulk trailing product"

@@ -180,3 +180,35 @@ def test_cholesky_trailing_auto_still_validates(monkeypatch):
     finally:
         monkeypatch.delenv("DLAF_CHOLESKY_TRAILING")
         C.initialize()
+
+
+def test_cholesky_lookahead_knob(monkeypatch):
+    """cholesky_lookahead: validated enum ("0"/"1"/"auto"), env-layered,
+    auto resolves per backend (1 on TPU, 0 elsewhere)."""
+    import jax
+    import pytest
+
+    from dlaf_tpu.obs.logging import forget_once
+
+    assert C.Configuration().cholesky_lookahead == "auto"
+    with pytest.raises(ValueError, match="cholesky_lookahead"):
+        C.initialize(C.Configuration(cholesky_lookahead="yes"))
+    C.initialize(C.Configuration(cholesky_lookahead="1"))
+    try:
+        assert C.resolved_cholesky_lookahead() is True
+        monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", "0")
+        C.initialize()
+        assert C.resolved_cholesky_lookahead() is False
+        monkeypatch.delenv("DLAF_CHOLESKY_LOOKAHEAD")
+        C.initialize()
+        for backend, expect in (("cpu", False), ("tpu", True)):
+            monkeypatch.setattr(jax, "default_backend",
+                                lambda b=backend: b)
+            key = ("cholesky_lookahead", backend, "1" if expect else "0")
+            forget_once("config", key)
+            try:
+                assert C.resolved_cholesky_lookahead() is expect
+            finally:
+                forget_once("config", key)
+    finally:
+        C.initialize(C.Configuration())

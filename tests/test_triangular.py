@@ -287,3 +287,40 @@ def test_trsm_rhs_chunk_bitwise_identical(side, uplo, op, diag, mxu,
         monkeypatch.delenv("DLAF_F64_GEMM", raising=False)
         monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM", raising=False)
         config.initialize()
+
+
+@pytest.mark.parametrize("side,uplo,op", [("L", "L", "N"), ("R", "U", "C"),
+                                          ("L", "U", "T")])
+def test_solve_scan_lookahead_bitwise(side, uplo, op, devices8, monkeypatch):
+    """The pipelined scan-solve body (cholesky_lookahead=1 — deferred bulk
+    + eager next-pivot strip, docs/lookahead.md) must match the serial
+    scan body BITWISE, at nt=11 (multi-segment windows, both transpose-
+    exchange paths) on an offset grid."""
+    import dlaf_tpu.config as config
+    from dlaf_tpu.matrix.matrix import Matrix
+
+    n, m, nb = 44, 12, 4   # A order 44 -> nt = 11
+    a, b = make_ab(n if side == "L" else m,
+                   m if side == "L" else n, np.float64, side, seed=13)
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "scan")
+    grid, src = Grid(2, 4), RankIndex2D(1, 2)
+    res = {}
+    try:
+        for la in ("0", "1"):
+            monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", la)
+            config.initialize()
+            am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
+                                    source_rank=src)
+            bm = Matrix.from_global(b, TileElementSize(nb, nb), grid=grid,
+                                    source_rank=src)
+            res[la] = triangular_solve(side, uplo, op, "N", 1.0, am,
+                                       bm).to_numpy()
+    finally:
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE", raising=False)
+        monkeypatch.delenv("DLAF_CHOLESKY_LOOKAHEAD", raising=False)
+        config.initialize()
+    np.testing.assert_array_equal(res["1"], res["0"])
+    t = np_op(np_tri(a, uplo, "N"), op)
+    want = np.linalg.solve(t, b) if side == "L" else \
+        np.linalg.solve(t.T, b.T).T
+    np.testing.assert_allclose(res["1"], want, **_tol(np.float64))

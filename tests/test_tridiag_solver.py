@@ -149,6 +149,31 @@ def test_native_secular_matches_numpy():
         assert np.all(np.abs(f) < 1e-6 * np.maximum(fprime * scale * 1e-10, 1.0) + 1e-7)
 
 
+def test_native_secular_threads_bitwise():
+    """The native secular solver's worker threading (``std::thread`` across
+    roots) must give BYTEWISE the single-thread result at a forced count:
+    every root is solved independently from read-only inputs, so no
+    reduction order can change. Forced nthreads=4 on small k also covers
+    the k < min_per_thread regime the auto policy never threads."""
+    from dlaf_tpu.native import bindings
+
+    try:
+        bindings.get_lib()
+    except Exception:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    for k in (3, 64, 257, 1000):
+        ds = np.sort(rng.standard_normal(k)) * 3 + np.arange(k) * 1e-6
+        zs = rng.standard_normal(k)
+        zs[np.abs(zs) < 0.05] = 0.05
+        zs /= np.linalg.norm(zs)
+        rho = abs(rng.standard_normal()) + 0.5
+        a1, mu1 = bindings.secular_roots(ds, zs, rho, nthreads=1)
+        a4, mu4 = bindings.secular_roots(ds, zs, rho, nthreads=4)
+        np.testing.assert_array_equal(a4, a1)
+        assert mu4.tobytes() == mu1.tobytes()
+
+
 def test_native_deflate_scan_matches_python(monkeypatch):
     """C++ deflation scan (deflate.cpp) vs the Python fallback loop: same
     rotations, same mutated z/liveness — on data engineered for chained
