@@ -35,7 +35,44 @@ case "$TIER" in
       python -m dlaf_tpu.miniapp.miniapp_cholesky -m 256 -b 64 \
         --grid-rows 2 --grid-cols 2 --nruns 2
     python -m dlaf_tpu.obs.validate "$OBS_ART" \
-      --require-spans --require-gflops --require-collectives ;;
+      --require-spans --require-gflops --require-collectives
+    echo "== smoke: fault-injection / graceful-degradation artifact =="
+    # drive the robustness layer end-to-end (docs/robustness.md): a tiny
+    # non-SPD robust_cholesky must recover through shift-retry (leaving
+    # robust_cholesky.attempt spans), and an injected native-load failure
+    # must degrade to numpy (leaving a dlaf_fallback_total counter); the
+    # validator fails the tier unless the artifact records BOTH
+    HEALTH_ART=$(mktemp -d)/health_metrics.jsonl
+    DLAF_METRICS_PATH="$HEALTH_ART" python - <<'EOF'
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import health, obs
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.eigensolver.band_to_tridiag import band_to_tridiag
+from dlaf_tpu.health import inject
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((64, 64))
+indef = x @ x.T + 64 * np.eye(64) - 100 * np.eye(64)   # non-SPD
+mat = Matrix.from_global(indef, TileElementSize(16, 16))
+res = health.robust_cholesky("L", mat)
+assert res.attempts > 1 and res.infos[-1] == 0, res
+print(f"robust_cholesky recovered: attempts={res.attempts} "
+      f"shifts={list(res.shifts)}")
+band = np.zeros((3, 16))
+band[0] = np.arange(1, 17); band[1, :-1] = 0.5; band[2, :-2] = 0.1
+with inject.force_native_failure():
+    band_to_tridiag(band, 2)
+c = obs.registry().counter("dlaf_fallback_total", site="band_to_tridiag",
+                           reason="native_unavailable").snapshot()
+assert c["value"] >= 1, c
+print("native-load injection degraded to numpy:", c)
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$HEALTH_ART" \
+      --require-spans --require-retries --require-fallbacks ;;
   main)
     python -m pytest tests/ -q -m "not slow" ;;
   full)

@@ -41,6 +41,7 @@ from ..config import register_program_cache
 from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..common.asserts import dlaf_assert
+from ..health import info as hinfo
 from ..matrix import util_distribution as ud
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, pad_diag_identity_dyn,
@@ -97,10 +98,10 @@ def _count_step_modes(algo: str, overlapped: int, serialized: int) -> None:
 
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing",
-                                             "lookahead"),
+                                             "lookahead", "with_info"),
                    donate_argnums=0)
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
-                    lookahead: bool = False):
+                    lookahead: bool = False, with_info: bool = False):
     n = a.shape[0]
     # "ozaki": route the flops-dominant trailing update through int8 MXU
     # passes (tile_ops.ozaki) — f64 and complex128 (4-real-product form);
@@ -118,10 +119,14 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
         if uplo == "L":
             ah = jnp.tril(a) + jnp.conj(jnp.tril(a, -1)).T
             l = lax.linalg.cholesky(ah)
-            return jnp.tril(l) + jnp.triu(a, 1)
-        ah = jnp.triu(a) + jnp.conj(jnp.triu(a, 1)).T
-        l = lax.linalg.cholesky(ah)
-        return jnp.triu(jnp.conj(l).T) + jnp.tril(a, -1)
+            out = jnp.tril(l) + jnp.triu(a, 1)
+        else:
+            ah = jnp.triu(a) + jnp.conj(jnp.triu(a, 1)).T
+            l = lax.linalg.cholesky(ah)
+            out = jnp.triu(jnp.conj(l).T) + jnp.tril(a, -1)
+        # in-graph info (health.info): a pure extra output on the final
+        # factor — the factor subgraph is untouched either way
+        return (out, hinfo.local_factor_info(out)) if with_info else out
     nt = ceil_div(n, nb) if n else 0
     # lookahead carry: the next panel column's (diag block, below-diag
     # block) values as step k's SSA outputs, so step k+1's potrf/trsm
@@ -298,15 +303,17 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
                     upd = jnp.conj(panel).T @ panel
                 mask = jnp.triu(jnp.ones((m, m), dtype=bool))
                 a = a.at[k1:, k1:].add(jnp.where(mask, -upd, 0))
-    return a
+    return (a, hinfo.local_factor_info(a)) if with_info else a
 
 
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
-                                             "use_mixed", "lookahead"),
+                                             "use_mixed", "lookahead",
+                                             "with_info"),
                    donate_argnums=0)
 def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
-                         use_mixed: bool = False, lookahead: bool = False):
+                         use_mixed: bool = False, lookahead: bool = False,
+                         with_info: bool = False):
     """``lax.scan`` formulation of the local factorization: ONE compiled
     step body, looped ``nt`` times with uniform full-size shapes.
 
@@ -333,7 +340,7 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
     """
     n = a.shape[0]
     if n == 0:
-        return a
+        return (a, jnp.zeros((), jnp.int32)) if with_info else a
     nt = ceil_div(n, nb)
     npad = nt * nb - n
     if npad:
@@ -520,7 +527,8 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                                   jnp.arange(seg_len))
         a = a.at[off * nb:, off * nb:].set(sub)
         off += seg_len
-    return a[:n, :n]
+    out = a[:n, :n]
+    return (out, hinfo.local_factor_info(out)) if with_info else out
 
 
 
@@ -554,7 +562,8 @@ def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
 
 def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                          use_mxu=False, use_mixed=False, cplx=False,
-                         use_oz_pallas=False, lookahead=False):
+                         use_oz_pallas=False, lookahead=False,
+                         with_info=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
@@ -857,16 +866,41 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                         "cholesky_dist",
                         *((1, 0) if lookahead and k + 1 < nt else (0, 1)))
                 lt, la = step(lt, k, la)
+        if with_info:
+            return lt, _dist_factor_info(lt, dist)
         return lt
 
     return shard_map(factorize, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),
-                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+                     out_specs=(P(ROW_AXIS, COL_AXIS), P()) if with_info
+                     else P(ROW_AXIS, COL_AXIS), check_vma=False)
+
+
+def _dist_factor_info(lt, dist):
+    """In-graph distributed info (called INSIDE the factorization's
+    shard_map, after the last step): each rank scans the diagonals of the
+    diagonal tiles it OWNS (health.info owner masks) and the per-rank
+    bad-column vectors merge via an all-reduce max over both mesh axes —
+    disjoint owner masks make max an OR. Pure extra outputs; the factor
+    subgraph is untouched, and nothing here syncs with the host."""
+    Pr, Qc = dist.grid_size.row, dist.grid_size.col
+    sr, sc = dist.source_rank.row, dist.source_rank.col
+    n = dist.size.row
+    if n == 0:
+        return jnp.zeros((), jnp.int32)
+    rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
+    rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+    vec = hinfo.dist_diag_bad(lt, rr, rc, Pr=Pr, Qc=Qc,
+                              nt=dist.nr_tiles.row,
+                              mb=dist.block_size.row, n=n)
+    vec = cc.all_reduce(vec, ROW_AXIS, "max")
+    vec = cc.all_reduce(vec, COL_AXIS, "max")
+    return hinfo.first_bad_info(vec > 0)
 
 
 def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                               use_mixed=False, cplx=False,
                               use_oz_pallas=False, pallas_interpret=False,
-                              lookahead=False):
+                              lookahead=False, with_info=False):
     """``lax.scan`` form of the distributed factorization: ONE compiled
     step body looped ``nt`` times inside the ``shard_map``.
 
@@ -1242,10 +1276,13 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                     make_step(lu_r0, lu_c0, ltr_s, ltc_s), sub,
                     jnp.arange(k0_seg, k0_seg + seg_len))
             lt = lt.at[lu_r0:, lu_c0:].set(sub)
+        if with_info:
+            return lt, _dist_factor_info(lt, dist)
         return lt
 
     return shard_map(factorize, mesh=mesh, in_specs=P(ROW_AXIS, COL_AXIS),
-                     out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
+                     out_specs=(P(ROW_AXIS, COL_AXIS), P()) if with_info
+                     else P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
 @register_program_cache
@@ -1253,7 +1290,7 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
                           use_oz_pallas=False, scan=False, donate=False,
-                          lookahead=False):
+                          lookahead=False, with_info=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
     donate_kw = donate_argnums_kw(donate, 0)
@@ -1263,13 +1300,14 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
             cplx=dtype.startswith("complex"),
             use_oz_pallas=use_oz_pallas,
             pallas_interpret=pallas_interpret,
-            lookahead=lookahead), **donate_kw)
+            lookahead=lookahead, with_info=with_info), **donate_kw)
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
                                         cplx=dtype.startswith("complex"),
                                         use_oz_pallas=use_oz_pallas,
-                                        lookahead=lookahead),
+                                        lookahead=lookahead,
+                                        with_info=with_info),
                    **donate_kw)
 
 
@@ -1279,13 +1317,26 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
 # Public API (reference factorization/cholesky.h:36,62)
 # ---------------------------------------------------------------------------
 
-def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
+def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
+             with_info: bool = False):
     """Factorize the Hermitian positive-definite ``mat`` in the ``uplo``
     triangle: L L^H (uplo='L') or U^H U (uplo='U').
 
     Local (1x1 grid) or distributed over ``mat.grid``'s mesh, like the
     reference's two overloads. Returns a new Matrix whose ``uplo`` triangle
     holds the factor; the other triangle passes through.
+
+    ``with_info=True`` returns ``(factor, info)`` instead — the reference's
+    ``potrfInfo`` contract lifted to the blocked algorithm: ``info`` is an
+    int32 DEVICE scalar, 0 on success or the 1-based first failing global
+    column, computed in-graph inside the same compiled program (no host
+    sync; fetching it — ``int(info)`` — is the caller's explicit decision,
+    e.g. :func:`dlaf_tpu.health.robust_cholesky`'s recovery point). The
+    factor is bitwise identical with the flag on or off: detection is a
+    pure extra output on the final factor's diagonal (distributed: combined
+    across ranks via max over the owner masks). Precision of the column
+    locator follows the backend's NaN semantics — see
+    ``tile_ops/lapack.py:potrf_info`` and docs/robustness.md.
 
     ``donate=True`` donates ``mat``'s device storage to the factorization
     (the reference's in-place semantics, ``factorization/cholesky.h:36``:
@@ -1340,21 +1391,42 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
                                            nb=mat.block_size.row,
                                            use_mxu=use_mxu,
                                            use_mixed=use_mixed,
-                                           lookahead=lookahead)
+                                           lookahead=lookahead,
+                                           with_info=with_info)
             else:
                 out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
                                       trailing=trailing,
-                                      lookahead=lookahead)
-            return mat.with_storage(global_to_tiles_donated(out, mat.dist))
+                                      lookahead=lookahead,
+                                      with_info=with_info)
+            info = None
+            if with_info:
+                out, info = out
+            res = mat.with_storage(global_to_tiles_donated(out, mat.dist))
+            return (res, info) if with_info else res
     platform = next(iter(mat.grid.mesh.devices.flat)).platform
     # exact-flop predicated contraction (ozaki_impl="pallas"): real f64
     # only (complex keeps the 4-real-product composition), within the
     # masked kernel's per-cell VMEM bound
+    from ..health.registry import route_available
     from ..tile_ops.pallas_ozaki import MASKED_MB_MAX
 
-    use_oz_pallas = (use_mxu and cfg.ozaki_impl == "pallas"
-                     and dt == np.dtype(np.float64)
+    want_oz_pallas = use_mxu and cfg.ozaki_impl == "pallas"
+    use_oz_pallas = (want_oz_pallas and dt == np.dtype(np.float64)
                      and mat.block_size.row <= MASKED_MB_MAX)
+    if use_oz_pallas and not route_available("pallas", "ozaki_pallas"):
+        # the pallas -> XLA chain under the unified degradation policy:
+        # counted, announced, and a raise in strict mode
+        use_oz_pallas = False
+    elif want_oz_pallas and not use_oz_pallas:
+        # route POLICY, not degradation (complex keeps the documented
+        # 4-real-product composition; oversized blocks exceed the kernel's
+        # VMEM bound): announce once, never count or strict-raise
+        obs.get_logger("health").warning_once(
+            ("ozaki_pallas_policy", dt.name, mat.block_size.row),
+            f"ozaki_impl=pallas does not apply to dtype={dt.name} "
+            f"mb={mat.block_size.row} (needs float64, mb<={MASKED_MB_MAX});"
+            " using the jnp slice reduction",
+            dtype=dt.name, mb=mat.block_size.row)
     scan_mode = trailing == "scan"
     fn = _dist_cholesky_cached(mat.dist, mat.grid.mesh, dt.name, uplo,
                                # the f32/bf16 pallas trailing kernel is
@@ -1368,6 +1440,9 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False) -> Matrix:
                                use_mxu, use_mixed,
                                use_oz_pallas,
                                scan=scan_mode, donate=donate,
-                               lookahead=lookahead)
+                               lookahead=lookahead, with_info=with_info)
     with entry_span, quiet_donation():
+        if with_info:
+            storage, info = fn(mat.storage)
+            return mat.with_storage(storage), info
         return mat.with_storage(fn(mat.storage))

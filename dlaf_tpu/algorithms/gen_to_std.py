@@ -579,7 +579,7 @@ def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu, donate=False,
 
 
 def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
-               donate: bool = False) -> Matrix:
+               donate: bool = False, with_info: bool = False):
     """Transform ``a`` (Hermitian, stored in ``uplo``) using ``b_factor`` =
     the Cholesky factor of B (same ``uplo``). Returns the transformed A with
     its opposite triangle passing through unchanged.
@@ -587,8 +587,20 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     ``donate=True`` permits consuming ``a``'s device storage (the
     reference transforms mat_a in place, ``eigensolver/gen_to_std``);
     ``a`` must not be used afterwards. ``b_factor`` is never consumed
-    (callers reuse the factor across runs)."""
+    (callers reuse the factor across runs).
+
+    ``with_info=True`` returns ``(out, info)`` — the singular-diagonal
+    detection analogous to the triangular solve's: info is an int32 device
+    scalar, 0 when ``b_factor``'s diagonal is finite and nonzero, else the
+    1-based first singular global column (HEGST solves against that
+    diagonal, so a zero/NaN entry poisons the transform silently).
+    In-graph, no host sync (health.matrix_diag_info)."""
     dlaf_assert(uplo in ("L", "U"), f"gen_to_std: bad uplo {uplo!r}")
+    info = None
+    if with_info:
+        from ..health import matrix_diag_info
+
+        info = matrix_diag_info(b_factor, singular=True)
     dlaf_assert(a.size == b_factor.size, "gen_to_std: A/B size mismatch")
     dlaf_assert(a.block_size == b_factor.block_size, "gen_to_std: block mismatch")
     from ..config import resolve_step_mode
@@ -624,7 +636,8 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
         grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
     if use_twosolve:
         with entry_span:
-            return _gen_to_std_twosolve(uplo, a, b_factor, donate=donate)
+            res = _gen_to_std_twosolve(uplo, a, b_factor, donate=donate)
+            return (res, info) if with_info else res
     # blocked forms take the same look-ahead split as the pipelined
     # Cholesky (docs/lookahead.md); twosolve inherits it through the
     # triangular solver's own scan-mode gate above
@@ -639,7 +652,8 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
                                        nb=a.block_size.row,
                                        lookahead=lookahead)
             out_m = a.with_storage(global_to_tiles_donated(out, a.dist))
-        return mops.merge_triangle(out_m, a, uplo, donate_orig=donate)
+        res = mops.merge_triangle(out_m, a, uplo, donate_orig=donate)
+        return (res, info) if with_info else res
     # the blocked builder shares one set of slot indices between A and L
     # (diag/panel reads of ll at A's kr/kc) — both axes must align
     assert_slot_aligned(a.dist, b_factor.dist, rows=True, cols=True,
@@ -649,4 +663,5 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu,
                             donate=donate, lookahead=lookahead)
     with entry_span, quiet_donation():
-        return a.with_storage(fn(a.storage, b_factor.storage))
+        res = a.with_storage(fn(a.storage, b_factor.storage))
+        return (res, info) if with_info else res

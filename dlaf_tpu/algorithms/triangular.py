@@ -662,14 +662,30 @@ def _check_args(side, a: Matrix, b: Matrix):
 
 
 def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
-                     a: Matrix, b: Matrix, *, donate_b: bool = False) -> Matrix:
+                     a: Matrix, b: Matrix, *, donate_b: bool = False,
+                     with_info: bool = False):
     """``X: op(A) X = alpha B`` (side='L') or ``X op(A) = alpha B`` ('R');
     all 8 combos, local + distributed (reference ``solver::triangular``).
 
     ``donate_b=True`` donates ``b``'s device storage (the reference solves
     in place into ``mat_b``, ``solver/triangular/impl.h``); ``b`` must not
-    be used afterwards. Internal stage hand-offs are always donated."""
+    be used afterwards. Internal stage hand-offs are always donated.
+
+    ``with_info=True`` returns ``(X, info)`` — the singular-diagonal
+    detection analogous to ``cholesky``'s info: an int32 device scalar, 0
+    when every diagonal entry of ``A`` is finite and nonzero, else the
+    1-based first singular global column (a zero/non-finite triangular
+    diagonal makes the solve blow up silently). Computed in-graph from
+    ``A``'s stored diagonal (health.matrix_diag_info) with no host sync;
+    ``diag='U'`` (implicit unit diagonal) is never singular, so info is
+    the constant 0 there."""
     _check_args(side, a, b)
+    info = None
+    if with_info:
+        from ..health import matrix_diag_info
+
+        info = (jnp.zeros((), jnp.int32) if diag == "U"
+                else matrix_diag_info(a, singular=True))
     # reference flop model (miniapp_triangular_solver): m n^2/2 muls+adds
     # on the solve dimension n = A's order, free dimension the other
     sdim = a.size.row
@@ -686,7 +702,8 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
             am = tiles_to_global(a.storage, a.dist)
             out = _solve_local(am, bm, jnp.asarray(alpha, bm.dtype),
                                side=side, uplo=uplo, op=op, diag=diag)
-            return b.with_storage(global_to_tiles_donated(out, b.dist))
+            res = b.with_storage(global_to_tiles_donated(out, b.dist))
+            return (res, info) if with_info else res
     # the distributed builders combine A's per-slot panels with B's slots
     # on the swept axis — misalignment corrupts silently, so contract it
     assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
@@ -702,8 +719,9 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
                             lookahead=scan_mode
                             and resolved_cholesky_lookahead())
     with entry_span, quiet_donation():
-        return b.with_storage(fn(a.storage, b.storage,
-                                 jnp.asarray(alpha, b.dtype)))
+        res = b.with_storage(fn(a.storage, b.storage,
+                                jnp.asarray(alpha, b.dtype)))
+        return (res, info) if with_info else res
 
 
 def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,

@@ -26,6 +26,20 @@ from .. import _compat
 from .. import obs
 
 
+#: Trace-time payload-corruption hook, installed ONLY by
+#: ``health.inject.corrupt_collective`` (fault-injection drills); None in
+#: production, so the cost is one module-attribute check per traced
+#: collective. The hook sees (kind, axis, payload) and returns the —
+#: possibly poisoned — payload.
+_INJECT_HOOK = None
+
+
+def _maybe_inject(kind: str, axis: str, x):
+    if _INJECT_HOOK is None:
+        return x
+    return _INJECT_HOOK(kind, axis, x)
+
+
 def _record(kind: str, axis: str, x) -> None:
     """Per-collective accounting (the per-kind/per-axis byte counters
     arXiv:2112.09017 credits its ICI tuning to): payload element count ×
@@ -81,6 +95,7 @@ def bcast(x, axis: str, src: int):
     from ..config import get_configuration
 
     _record("bcast", axis, x)
+    x = _maybe_inject("bcast", axis, x)
     if get_configuration().bcast_impl == "tree":
         return _bcast_tree(x, axis, src)
     mask = (this_rank(axis) == src).astype(x.dtype)
@@ -110,6 +125,7 @@ def all_reduce(x, axis: str, op: str = "sum"):
     ``kernels/all_reduce.h:67-138``). The rooted :func:`reduce` lowers
     through here, so its traffic is accounted under this kind too."""
     _record("all_reduce", axis, x)
+    x = _maybe_inject("all_reduce", axis, x)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "max":

@@ -48,7 +48,8 @@ from .grid import COL_AXIS, ROW_AXIS, Grid
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> None:
+                         process_id: Optional[int] = None,
+                         timeout: Optional[float] = 300.0) -> None:
     """Establish the cross-host process world (the ``mpi_init`` analog).
 
     On Cloud TPU all arguments are auto-discovered; elsewhere pass the
@@ -56,12 +57,55 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     Must run before any other JAX call in the process (same rule as the
     reference's "MPI_Init before everything", ``communication/init.h``).
     No-op when the world has a single process and no coordinator is given.
+
+    ``timeout`` bounds the coordinator connect (seconds; None = the JAX
+    default). A pod job where one host never starts otherwise hangs the
+    whole world silently at bring-up; with the bound, the failure comes
+    back as a RuntimeError naming the coordinator, the world shape, and
+    the usual causes — actionable from a single host's log.
     """
     if coordinator_address is None and num_processes in (None, 1):
         return  # single-controller run — nothing to establish
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    import inspect
+
+    kwargs = {}
+    if timeout is not None:
+        # older jax lines lack the kwarg; the bound is best-effort there
+        params = inspect.signature(jax.distributed.initialize).parameters
+        if "initialization_timeout" in params:
+            kwargs["initialization_timeout"] = int(timeout)
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id, **kwargs)
+    except Exception as e:
+        if not _is_bringup_failure(e):
+            raise   # caller bugs (double init, bad args) keep their message
+        world = f"{num_processes} process(es)" if num_processes else "auto"
+        raise RuntimeError(
+            f"multi-host bring-up failed: could not establish the process "
+            f"world (coordinator={coordinator_address!r}, world={world}, "
+            f"process_id={process_id!r}"
+            + (f", timeout={int(timeout)}s" if timeout is not None else "")
+            + f"): {e}. Check that (1) the coordinator host:port is "
+            "reachable from this host (firewall/VPC rules), (2) EVERY "
+            "process of the world starts within the timeout with the SAME "
+            "coordinator address and world size, and (3) process ids are "
+            "unique in [0, world). On Cloud TPU, omit all arguments — "
+            "discovery is automatic.") from e
+
+
+def _is_bringup_failure(e: BaseException) -> bool:
+    """Does this look like a coordinator-connect failure (worth the
+    actionable bring-up diagnosis) rather than a caller bug? Double
+    initialization or bad arguments must keep their own message — sending
+    an operator to debug firewalls for those would be worse than no
+    wrapping at all."""
+    if isinstance(e, (TimeoutError, ConnectionError, OSError)):
+        return True
+    text = str(e).lower()
+    return any(s in text for s in ("timeout", "deadline", "unavailable",
+                                   "connect", "refused", "unreachable"))
 
 
 def slice_groups(devices: Sequence) -> dict:

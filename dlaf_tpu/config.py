@@ -322,6 +322,18 @@ class Configuration:
     compilation_cache_dir: str = ""
     #: Only compiles at least this long (seconds) are persisted.
     compilation_cache_min_secs: float = 5.0
+    #: Opt-in finite guard (``DLAF_CHECK`` / ``--dlaf:check``): robustness
+    #: drivers (health.robust_cholesky; miniapp_cholesky wires the CLI
+    #: flag) validate inputs and outputs for non-finite values, raising a
+    #: structured health.CheckError instead of letting a NaN propagate
+    #: silently. Off by default — the guard host-syncs by design.
+    check: bool = False
+    #: Strict degradation mode (``DLAF_STRICT``): a registered fallback
+    #: (native secular/band-chase -> numpy, pallas -> XLA, ozaki -> plain
+    #: dot; health.registry) RAISES health.DegradationError instead of
+    #: silently taking the degraded path. The CI/bring-up stance where a
+    #: missing native library must fail the job, not slow it 100x.
+    strict: bool = False
 
     def _fields(self):
         return {f.name: f for f in dataclasses.fields(self)}

@@ -183,10 +183,15 @@ def band_to_tridiag(band: np.ndarray, b: int, impl: str | None = None) -> Tridia
 
     impl = impl or get_configuration().band_to_tridiag_impl
     if impl == "native":
-        try:
+        # unified degradation policy: dlaf_fallback_total counter +
+        # one-shot announce; DLAF_STRICT=1 raises instead of degrading
+        from ..health.registry import run_with_fallback
+
+        def _native():
             from ..native import bindings
 
             return bindings.band_to_tridiag(band, b)
-        except Exception:
-            pass  # fall back to numpy
+
+        return run_with_fallback("band_to_tridiag", _native,
+                                 lambda: band_to_tridiag_numpy(band, b))
     return band_to_tridiag_numpy(band, b)

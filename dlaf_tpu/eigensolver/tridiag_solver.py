@@ -140,12 +140,18 @@ def _secular_roots_host(ds, zs, rho):
     from ..config import get_configuration
 
     if get_configuration().secular_impl == "native":
-        try:
+        # unified degradation policy (health.registry): counted under
+        # dlaf_fallback_total{site="secular"}, announced once, raises in
+        # strict mode — the ~100x bisection slowdown is never silent
+        from ..health.registry import run_with_fallback
+
+        def _native():
             from ..native import bindings
 
             return bindings.secular_roots(ds, zs, rho)
-        except Exception:
-            pass
+
+        return run_with_fallback("secular", _native,
+                                 lambda: _secular_roots(ds, zs, rho))
     return _secular_roots(ds, zs, rho)
 
 
@@ -241,8 +247,10 @@ def _deflation_scan(ds, zs, live, tol):
             from ..native import bindings
 
             return bindings.deflate_scan(ds, zs, live, tol)
-        except Exception:
-            pass
+        except Exception as e:
+            from ..health.registry import report_fallback
+
+            report_fallback("deflate", "native_unavailable", exc=e)
     gi, gj, gc, gs = [], [], [], []
     prev = -1
     for j in range(ds.shape[0]):

@@ -1,0 +1,77 @@
+"""Structured error types of the health subsystem (docs/robustness.md).
+
+The reference surfaces factorization failure as *data* (``tile::potrfInfo``
+returns the LAPACK/cusolver info instead of asserting); these types are the
+host-side face of that contract once the in-graph detection
+(:mod:`dlaf_tpu.health.info`) decides a run cannot proceed. All of them
+carry their diagnostic payload as attributes — callers branch on fields,
+not on message text.
+"""
+
+from __future__ import annotations
+
+
+class HealthError(RuntimeError):
+    """Base of every error the health subsystem raises."""
+
+
+class FactorizationError(HealthError):
+    """A factorization stayed indefinite after every recovery attempt
+    (:func:`dlaf_tpu.health.recovery.robust_cholesky`).
+
+    Attributes:
+        failing_column: 1-based first failing global column reported by the
+            LAST attempt (backend NaN semantics bound its precision — see
+            ``tile_ops/lapack.py:potrf_info``).
+        attempts: number of factorization attempts performed.
+        shifts: the diagonal shift ``alpha`` of each attempt (first is 0.0).
+        infos: the info value of each attempt (all nonzero, or this would
+            not have been raised).
+    """
+
+    def __init__(self, failing_column: int, attempts: int,
+                 shifts: tuple, infos: tuple = ()):
+        self.failing_column = int(failing_column)
+        self.attempts = int(attempts)
+        self.shifts = tuple(float(s) for s in shifts)
+        self.infos = tuple(int(i) for i in infos)
+        super().__init__(
+            f"factorization failed at global column {self.failing_column} "
+            f"after {self.attempts} attempt(s) with diagonal shifts "
+            f"{self.shifts}")
+
+
+class DegradationError(HealthError):
+    """Strict mode (``DLAF_STRICT=1``) forbids a registered degradation
+    (:func:`dlaf_tpu.health.registry.report_fallback`): the preferred
+    implementation is unavailable and falling back silently is not allowed.
+
+    Attributes:
+        site: the degradation site (the ``site`` label of
+            ``dlaf_fallback_total``).
+        reason: why the preferred route was unavailable.
+    """
+
+    def __init__(self, site: str, reason: str, detail: str = ""):
+        self.site = site
+        self.reason = reason
+        suffix = f": {detail}" if detail else ""
+        super().__init__(
+            f"strict mode: degradation at site {site!r} ({reason}){suffix} "
+            "— unset DLAF_STRICT to allow the fallback")
+
+
+class CheckError(HealthError):
+    """The opt-in finite guard (``DLAF_CHECK=1``) found non-finite values.
+
+    Attributes:
+        what: which operand failed (e.g. ``"cholesky input"``).
+        count: number of non-finite elements.
+    """
+
+    def __init__(self, what: str, count: int):
+        self.what = what
+        self.count = int(count)
+        super().__init__(
+            f"finite guard: {self.count} non-finite element(s) in {what} "
+            "(DLAF_CHECK=1)")

@@ -1,0 +1,142 @@
+"""In-graph failure detection: info values from factor/operand diagonals.
+
+The reference's ``tile::potrfInfo`` surfaces per-tile LAPACK/cusolver info
+as data; the blocked composition of that signal is what this module owns.
+XLA backends mark a failed Cholesky by NaN-ing the factor (CPU NaNs the
+whole tile, TPU's blocked form NaNs from the failing block on —
+``tile_ops/lapack.py:potrf_info``), and NaNs propagate through every
+downstream trailing update, so the FIRST non-finite diagonal element of
+the *final* factor is the blocked-algorithm info: a 1-based first failing
+global column, exact to the backend's NaN-prefix behavior. Computing it
+from the final diagonal (instead of collecting per-step tile infos) keeps
+the factorization subgraph byte-identical with detection on or off, works
+uniformly across the unrolled/scan step forms and the look-ahead carry,
+and additionally catches corruption injected *after* the failing potrf
+(e.g. a poisoned collective payload — :mod:`dlaf_tpu.health.inject`).
+
+Everything here is pure jnp (jit-safe, no host callbacks, no host sync);
+distributed combination happens in the callers — the cholesky builders
+merge the per-rank owner-masked vectors with an all-reduce ``max`` over
+both mesh axes (disjoint owner masks make max an OR).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def bad_diag_mask(d, *, singular: bool = False):
+    """Bool mask of "bad" diagonal entries. Default (``potrf_info``
+    semantics): non-finite real part. ``singular=True`` (triangular-solve /
+    HEGST detection) additionally flags exact zeros and — for complex —
+    non-finite imaginary parts."""
+    if jnp.iscomplexobj(d):
+        bad = ~jnp.isfinite(d.real)
+        if singular:
+            bad = bad | ~jnp.isfinite(d.imag) | (d == 0)
+    else:
+        bad = ~jnp.isfinite(d)
+        if singular:
+            bad = bad | (d == 0)
+    return bad
+
+
+def first_bad_info(bad):
+    """1-based index of the first True along the last axis, 0 if none —
+    the LAPACK-shaped info value, as an int32 device scalar."""
+    if bad.shape[-1] == 0:
+        return jnp.zeros(bad.shape[:-1], jnp.int32)
+    idx = jnp.argmax(bad, axis=-1)
+    return jnp.where(jnp.any(bad, axis=-1), idx + 1, 0).astype(jnp.int32)
+
+
+def local_factor_info(a, *, singular: bool = False):
+    """Info of a square global factor (local builders): 1-based first bad
+    diagonal column, 0 on success."""
+    n = a.shape[-1]
+    if n == 0:
+        return jnp.zeros((), jnp.int32)
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return first_bad_info(bad_diag_mask(d, singular=singular))
+
+
+def dist_diag_bad(lt, rr, rc, *, Pr: int, Qc: int, nt: int, mb: int, n: int,
+                  singular: bool = False):
+    """Per-rank owner-masked bad-column vector for the distributed
+    builders (called INSIDE shard_map).
+
+    ``lt``: this rank's local tiles ``(ltr, ltc, mb, mb)``; ``rr``/``rc``:
+    this rank's (traced) cycle positions along the row/col axes. Returns a
+    length-``n`` int32 vector that is 1 exactly at the global diagonal
+    columns whose OWNED diagonal tile has a bad entry, 0 elsewhere —
+    owner masks are disjoint across ranks, so an all-reduce ``max`` over
+    both axes yields the global bad-column vector.
+    """
+    if n == 0:
+        return jnp.zeros((0,), jnp.int32)
+    ltr, ltc = lt.shape[0], lt.shape[1]
+    g_rows = jnp.arange(ltr) * Pr + rr                    # global tile rows
+    g_cols = jnp.arange(ltc) * Qc + rc
+    own = (g_rows[:, None] == g_cols[None, :]) & (g_rows[:, None] < nt)
+    d = jnp.diagonal(lt, axis1=-2, axis2=-1)              # (ltr, ltc, mb)
+    bad = bad_diag_mask(d, singular=singular)
+    contrib = (bad & own[:, :, None]).any(axis=1)         # (ltr, mb)
+    pos = g_rows[:, None] * mb + jnp.arange(mb)[None, :]  # global columns
+    vec = jnp.zeros((nt * mb,), jnp.int32)
+    # invalid slots (padded local rows past nt) scatter out of range: drop
+    vec = vec.at[pos.reshape(-1)].max(
+        contrib.reshape(-1).astype(jnp.int32), mode="drop")
+    return vec[:n]
+
+
+# ---------------------------------------------------------------------------
+# Standalone diag-info program over Matrix tile storage (triangular / HEGST)
+# ---------------------------------------------------------------------------
+
+def _diag_tile_coords(dist):
+    """Host-side (storage_row, storage_col, extent) of every global
+    diagonal tile, in global order (storage layout owned by
+    ``matrix.tiling.global_tile_to_storage_index``)."""
+    from ..matrix.tiling import global_tile_to_storage_index
+
+    mb = dist.block_size.row
+    n = dist.size.row
+    coords = []
+    for k in range(dist.nr_tiles.row):
+        si, sj = global_tile_to_storage_index(dist, k, k)
+        coords.append((si, sj, min(mb, n - k * mb)))
+    return coords
+
+
+from ..config import register_program_cache
+
+
+@register_program_cache
+@functools.lru_cache(maxsize=64)
+def _diag_info_prog(dist, singular: bool):
+    """Compiled ``tile storage -> info`` reduction for one layout. Static
+    per-tile indexing; on a sharded storage GSPMD inserts the gathers, so
+    one program serves local and distributed matrices."""
+    coords = _diag_tile_coords(dist)
+
+    def run(storage):
+        if not coords:
+            return jnp.zeros((), jnp.int32)
+        parts = [jnp.diagonal(storage[si, sj])[:ts]
+                 for (si, sj, ts) in coords]
+        d = jnp.concatenate(parts)
+        return first_bad_info(bad_diag_mask(d, singular=singular))
+
+    return jax.jit(run)
+
+
+def matrix_diag_info(mat, *, singular: bool = False):
+    """1-based first bad global diagonal column of ``mat`` (0 = clean), as
+    an int32 device scalar — jit-compiled, no host sync (the caller decides
+    when/whether to fetch). ``singular=True`` is the triangular-solve /
+    HEGST detection (zero OR non-finite diagonal); the default matches
+    ``potrf_info`` (non-finite only)."""
+    return _diag_info_prog(mat.dist, singular)(mat.storage)

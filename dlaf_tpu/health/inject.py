@@ -1,0 +1,188 @@
+"""Deterministic, seedable fault injection (tests and chaos drills).
+
+Every degradation path in the framework must be provable end-to-end
+WITHOUT breaking a real component (arXiv:2112.09017's pod-scale lesson:
+the untested fallback is the one that corrupts silently). This module is
+the injection surface the tests use:
+
+* :func:`nan_tile` — pure: returns a copy of a Matrix with one (seeded or
+  chosen) element of one tile poisoned to NaN, the stand-in for silent
+  numerical corruption.
+* :func:`corrupt_collective` — context manager: poisons the payload of
+  ONE collective (the ``nth`` traced call of a ``kind``) via a hook in
+  :mod:`dlaf_tpu.comm.collectives`. Corruption happens at TRACE time, so
+  compiled-program caches are cleared on entry and exit — a cached clean
+  program must not mask the injection, and a cached poisoned program must
+  not outlive it.
+* :func:`disable_route` (and the :func:`disable_pallas` /
+  :func:`disable_ozaki` shorthands) — context manager: makes a route gate
+  report "unavailable", driving the pallas->XLA / ozaki->plain-dot
+  degradations without touching the real gates' inputs.
+* :func:`force_native_failure` — context manager: makes
+  ``native.bindings`` fail its build/load (covering the cached-error
+  re-raise path and every native->numpy chain).
+
+All injection state is process-global and OFF by default; the production
+cost of the hooks is one module-attribute check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_LOCK = threading.Lock()
+
+#: Armed collective corruption: {"kind", "nth", "seed", "count"} or None.
+_COLLECTIVE: Optional[dict] = None
+
+#: Route names currently forced unavailable (see :func:`disable_route`).
+_DISABLED_ROUTES: set = set()
+
+
+def _clear_program_caches() -> None:
+    from ..config import _clear_program_caches as clear
+
+    clear()
+
+
+# ---------------------------------------------------------------------------
+# Data corruption
+# ---------------------------------------------------------------------------
+
+def nan_tile(mat, tile: Optional[tuple] = None,
+             element: Optional[tuple] = None, seed: int = 0):
+    """A copy of ``mat`` with one element of one tile set to NaN.
+
+    ``tile``: global tile index (i, j); ``element``: (row, col) within the
+    tile. Either may be None — a deterministic choice is drawn from
+    ``seed`` over the valid range, so repeated runs inject the same fault.
+    """
+    from ..matrix.tiling import global_tile_to_storage_index
+
+    dist = mat.dist
+    nt_r, nt_c = dist.nr_tiles.row, dist.nr_tiles.col
+    if nt_r == 0 or nt_c == 0:
+        raise ValueError("nan_tile: matrix has no tiles")
+    rng = np.random.default_rng(seed)
+    ti, tj = tile if tile is not None else (int(rng.integers(nt_r)),
+                                            int(rng.integers(nt_c)))
+    mb_r = min(dist.block_size.row, dist.size.row - ti * dist.block_size.row)
+    mb_c = min(dist.block_size.col, dist.size.col - tj * dist.block_size.col)
+    ei, ej = element if element is not None else (int(rng.integers(mb_r)),
+                                                  int(rng.integers(mb_c)))
+    si, sj = global_tile_to_storage_index(dist, ti, tj)
+    poison = jnp.asarray(np.nan, mat.dtype)
+    return mat.with_storage(mat.storage.at[si, sj, ei, ej].set(poison))
+
+
+def _corrupt_payload(x, seed: int):
+    """One NaN (max value for integer payloads) at a seeded position."""
+    if x.ndim == 0:
+        flat = x[None]
+    else:
+        flat = x.reshape(-1)
+    pos = int(np.random.default_rng(seed).integers(flat.shape[0])) \
+        if flat.shape[0] else 0
+    bad = jnp.asarray(np.nan, x.dtype) if jnp.issubdtype(x.dtype, jnp.inexact) \
+        else jnp.asarray(jnp.iinfo(x.dtype).max, x.dtype)
+    flat = flat.at[pos].set(bad)
+    return flat.reshape(x.shape) if x.ndim else flat[0]
+
+
+def _collective_hook(kind: str, axis: str, x):
+    """Installed into ``comm.collectives`` while :func:`corrupt_collective`
+    is armed; corrupts the payload of the nth matching traced call."""
+    with _LOCK:
+        spec = _COLLECTIVE
+        if spec is None or spec["kind"] != kind:
+            return x
+        hit = spec["count"] == spec["nth"]
+        spec["count"] += 1
+    return _corrupt_payload(x, spec["seed"]) if hit else x
+
+
+@contextlib.contextmanager
+def corrupt_collective(kind: str = "bcast", nth: int = 0, seed: int = 0):
+    """Poison the payload of the ``nth`` traced ``kind`` collective
+    (``"bcast"`` | ``"all_reduce"``) while the context is active."""
+    global _COLLECTIVE
+    from ..comm import collectives as cc
+
+    with _LOCK:
+        if _COLLECTIVE is not None:
+            raise RuntimeError("corrupt_collective is not reentrant")
+        _COLLECTIVE = {"kind": kind, "nth": int(nth), "seed": int(seed),
+                       "count": 0}
+    cc._INJECT_HOOK = _collective_hook
+    _clear_program_caches()
+    try:
+        yield
+    finally:
+        cc._INJECT_HOOK = None
+        with _LOCK:
+            _COLLECTIVE = None
+        _clear_program_caches()
+
+
+# ---------------------------------------------------------------------------
+# Route availability
+# ---------------------------------------------------------------------------
+
+def route_disabled(name: str) -> bool:
+    """Has injection forced route ``name`` unavailable? Consulted by the
+    route gates (``pallas`` — tile_ops.pallas_kernels; ``ozaki`` —
+    tile_ops.blas)."""
+    return name in _DISABLED_ROUTES
+
+
+@contextlib.contextmanager
+def disable_route(name: str):
+    """Force route ``name`` unavailable while active; the owning gate
+    reports the degradation through :mod:`dlaf_tpu.health.registry`.
+    Program caches are cleared on entry and exit — route choices are
+    trace-time decisions."""
+    with _LOCK:
+        _DISABLED_ROUTES.add(name)
+    _clear_program_caches()
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _DISABLED_ROUTES.discard(name)
+        _clear_program_caches()
+
+
+def disable_pallas():
+    """Force every pallas kernel route off (degrades to the XLA forms)."""
+    return disable_route("pallas")
+
+
+def disable_ozaki():
+    """Force the int8/bf16 MXU f64 gemm route off (degrades to the
+    native dot)."""
+    return disable_route("ozaki")
+
+
+# ---------------------------------------------------------------------------
+# Native library failure
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def force_native_failure():
+    """Make ``native.bindings`` build/load fail while active (drives every
+    native->numpy chain and the cached-error re-raise path). The bindings
+    cache is reset on entry and exit so neither a pre-loaded library nor
+    the injected failure leaks across the boundary."""
+    from ..native import bindings
+
+    bindings._reset_for_tests(force_failure=True)
+    try:
+        yield
+    finally:
+        bindings._reset_for_tests(force_failure=False)

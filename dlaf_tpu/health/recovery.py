@@ -1,0 +1,184 @@
+"""Shift-retry recovery driver for the Cholesky factorization.
+
+``robust_cholesky`` is the policy layer above ``cholesky(...,
+with_info=True)``: the factorization itself stays a pure in-graph program
+(info computed on device, no host sync on the hot path); ONLY when the
+caller opts into recovery does the driver fetch the info scalar (the one
+deliberate host sync, per attempt) and decide. On a nonzero info it
+retries with an exponentially growing diagonal shift ``alpha * I`` — the
+standard modified-Cholesky response to an indefinite or barely-SPD matrix
+(Nocedal & Wright §3.4 spelling; the reference leaves this policy to the
+application, surfacing only ``potrfInfo``). Every attempt is traced as a
+span carrying ``attempt``/``shift`` attributes so JSONL artifacts record
+the whole recovery history, and exhaustion raises the structured
+:class:`~dlaf_tpu.health.errors.FactorizationError`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import obs
+from ..config import register_program_cache
+from .errors import CheckError, FactorizationError
+
+#: Counter incremented once per shifted retry (labels: algo).
+RETRY_COUNTER = "dlaf_retry_total"
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryResult:
+    """Outcome of a successful :func:`robust_cholesky`.
+
+    ``matrix`` holds the factor; ``attempts`` counts factorization
+    attempts performed (1 = no recovery was needed); ``shifts``/``infos``
+    record the per-attempt diagonal shift and info value (the final info
+    is 0 by construction)."""
+
+    matrix: object
+    attempts: int
+    shifts: tuple
+    infos: tuple
+
+
+@register_program_cache
+@functools.lru_cache(maxsize=64)
+def _add_diag_prog(dist):
+    """Compiled ``(tile storage, alpha) -> storage + alpha*I`` for one
+    layout: a static scatter-add into the global diagonal tiles (edge tile
+    truncated to the matrix size). ``alpha`` is a traced scalar, so every
+    attempt of a retry loop reuses ONE program."""
+    from .info import _diag_tile_coords
+
+    coords = _diag_tile_coords(dist)
+    mb = dist.block_size.row
+
+    def run(storage, alpha):
+        eye = jnp.eye(mb, dtype=storage.dtype)
+        for si, sj, ts in coords:
+            e = eye if ts == mb else eye * (jnp.arange(mb) < ts)[:, None]
+            storage = storage.at[si, sj].add(alpha.astype(storage.dtype) * e)
+        return storage
+
+    return jax.jit(run)
+
+
+def shift_diagonal(mat, alpha):
+    """``mat + alpha * I`` as a new Matrix (same layout/sharding). With
+    ``alpha == 0`` this is the fresh-copy idiom — the retry loop's
+    attempts all consume copies so the original survives for the next
+    shift."""
+    return mat.with_storage(
+        _add_diag_prog(mat.dist)(mat.storage, jnp.asarray(alpha)))
+
+
+def check_finite(what: str, mat) -> None:
+    """Opt-in finite guard (``DLAF_CHECK``): raise :class:`CheckError`
+    naming ``what`` when the matrix carries non-finite elements. Host-
+    syncs by design — callers gate it on the config knob."""
+    s = mat.storage
+    finite = jnp.isfinite(s.real) & jnp.isfinite(s.imag) \
+        if jnp.iscomplexobj(s) else jnp.isfinite(s)
+    count = int(jnp.sum(~finite))
+    if count:
+        obs.counter("dlaf_check_failures_total", what=what).inc()
+        raise CheckError(what, count)
+
+
+def checks_enabled() -> bool:
+    """Is the opt-in finite guard on (``DLAF_CHECK``)?"""
+    from ..config import get_configuration
+
+    return bool(get_configuration().check)
+
+
+def robust_cholesky(uplo: str, mat, *, max_attempts: int = 4,
+                    shift: Optional[float] = None,
+                    shift_growth: float = 1e4) -> RecoveryResult:
+    """Factorize ``mat`` with in-graph failure detection and bounded
+    shift-retry recovery.
+
+    Attempt 0 runs unshifted. On a nonzero info (1-based first failing
+    global column), the matrix is re-shifted from the ORIGINAL as
+    ``A + alpha*I`` with ``alpha`` starting at ``shift`` (default
+    ``sqrt(eps) * max|A|``) and growing by ``shift_growth`` per retry —
+    exponential backoff bounded by ``max_attempts`` total attempts. Each
+    attempt is traced as a ``robust_cholesky.attempt`` span with
+    ``attempt``/``shift``/``info`` attrs; retries count under
+    ``dlaf_retry_total{algo="cholesky"}``. Exhaustion raises
+    :class:`FactorizationError`; success returns a
+    :class:`RecoveryResult`.
+
+    With ``DLAF_CHECK=1`` the input and the returned factor additionally
+    pass a finite guard (:func:`check_finite`) — e.g. a NaN planted by
+    :func:`dlaf_tpu.health.inject.nan_tile` fails fast here instead of
+    surfacing as an unexplained nonzero info.
+
+    The original ``mat`` must stay live across attempts (each retry
+    shifts it afresh), so unlike ``cholesky`` there is no ``donate``
+    option; every attempt's working copy IS donated internally.
+    """
+    from ..algorithms.cholesky import cholesky
+
+    if max_attempts < 1:
+        raise ValueError(f"max_attempts={max_attempts}: must be >= 1")
+    if shift is not None and not shift > 0:
+        # 0 would alias the first-attempt sentinel: every retry would
+        # repeat the identical unshifted factorization
+        raise ValueError(f"shift={shift}: must be > 0 (or None for the "
+                         "sqrt(eps)*max|A| default)")
+    if not shift_growth > 1:
+        raise ValueError(f"shift_growth={shift_growth}: must be > 1")
+    if checks_enabled():
+        check_finite("cholesky input", mat)
+    n = mat.size.row
+    alpha = 0.0
+    shifts, infos = [], []
+    log = obs.get_logger("health")
+    for attempt in range(max_attempts):
+        span = obs.span("robust_cholesky.attempt", attempt=attempt,
+                        shift=float(alpha), n=n, uplo=uplo,
+                        dtype=np.dtype(mat.dtype).name)
+        with span:
+            work = shift_diagonal(mat, alpha)
+            out, info_dev = cholesky(uplo, work, donate=True, with_info=True)
+            info = int(info_dev)       # the recovery decision point: the
+            span.set_attr("info", info)  # driver's deliberate host sync
+        shifts.append(float(alpha))
+        infos.append(info)
+        if info == 0:
+            if checks_enabled():
+                check_finite("cholesky factor", out)
+            return RecoveryResult(out, attempt + 1, tuple(shifts),
+                                  tuple(infos))
+        if attempt + 1 < max_attempts:
+            obs.counter(RETRY_COUNTER, algo="cholesky").inc()
+            if alpha == 0.0:
+                alpha = shift if shift is not None else _default_shift(mat)
+            else:
+                alpha *= shift_growth
+            log.warning(
+                f"cholesky info={info} (first failing global column) at "
+                f"attempt {attempt}; retrying with diagonal shift "
+                f"{alpha:.3e}", n=n, uplo=uplo, attempt=attempt)
+    raise FactorizationError(failing_column=infos[-1],
+                             attempts=max_attempts,
+                             shifts=tuple(shifts), infos=tuple(infos))
+
+
+def _default_shift(mat) -> float:
+    """Initial shift scale: ``sqrt(eps) * max|A|`` — large enough to
+    regularize rounding-level indefiniteness in one step, small enough to
+    stay a perturbation; subsequent retries grow it exponentially."""
+    eps = float(np.finfo(np.dtype(mat.dtype).type(0).real.dtype).eps)
+    amax = float(jnp.max(jnp.abs(mat.storage))) if mat.storage.size else 1.0
+    if not np.isfinite(amax) or amax == 0.0:
+        amax = 1.0
+    return float(np.sqrt(eps)) * amax

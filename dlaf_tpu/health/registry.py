@@ -1,0 +1,97 @@
+"""Unified graceful-degradation policy (docs/robustness.md).
+
+The codebase grew four independent ad-hoc fallback chains — native secular
+solver -> numpy bisection, native band chase -> numpy, pallas kernels ->
+XLA, ozaki MXU gemm -> plain dot — each with its own bare ``except`` and
+no accounting. This module is the single policy they now share:
+
+* every degradation is counted in ``dlaf_fallback_total{site,reason}``
+  (:mod:`dlaf_tpu.obs` — visible in JSONL artifacts and the Prometheus
+  exposition) and announced once per (site, reason) through the obs
+  logger, so a pod silently running 100x slower on an interpreter
+  fallback cannot happen;
+* strict mode (``DLAF_STRICT=1`` / ``Configuration.strict``) turns every
+  degradation into a structured
+  :class:`~dlaf_tpu.health.errors.DegradationError` — the CI/bring-up
+  stance where a missing native library must fail the job, not quietly
+  degrade it.
+
+Sites register a degradation at the moment they *decide* to fall back
+(:func:`report_fallback`), or wrap the whole try/except with
+:func:`run_with_fallback`. Route *policy* decisions (e.g. the
+``f64_gemm_min_dim`` small-gemm gate) are configuration, not degradation,
+and are not reported here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import obs
+from .errors import DegradationError
+
+#: Counter name shared by every degradation site (labels: site, reason).
+FALLBACK_COUNTER = "dlaf_fallback_total"
+
+
+def strict_mode() -> bool:
+    """Is strict mode on (``DLAF_STRICT``)? Strict forbids degradation:
+    :func:`report_fallback` raises instead of recording-and-continuing."""
+    from ..config import get_configuration
+
+    return bool(get_configuration().strict)
+
+
+def report_fallback(site: str, reason: str, *,
+                    exc: Optional[BaseException] = None,
+                    detail: str = "") -> None:
+    """Record one degradation at ``site`` (counter + one-shot warning);
+    raise :class:`DegradationError` in strict mode.
+
+    ``exc`` is the triggering exception, if any — chained onto the strict
+    error and included in the announcement. Call this exactly when the
+    fallback decision is made; callers then proceed down their degraded
+    path (unless this raises)."""
+    obs.counter(FALLBACK_COUNTER, site=site, reason=reason).inc()
+    why = detail or (repr(exc) if exc is not None else "")
+    obs.get_logger("health").warning_once(
+        (site, reason),
+        f"degraded path at {site!r} ({reason})"
+        + (f": {why}" if why else "")
+        + " — counting under dlaf_fallback_total; DLAF_STRICT=1 raises "
+          "instead",
+        site=site, reason=reason)
+    if strict_mode():
+        err = DegradationError(site, reason, why)
+        if exc is not None:
+            raise err from exc
+        raise err
+
+
+def run_with_fallback(site: str, primary: Callable, fallback: Callable, *,
+                      reason: str = "native_unavailable",
+                      expected: type = Exception):
+    """Run ``primary()``; on ``expected`` record the degradation and run
+    ``fallback()`` — the one-policy spelling of the repo's try/except
+    chains (the native band-chase/secular/deflate sites). Strict mode
+    raises from inside :func:`report_fallback`, so the fallback never
+    executes there."""
+    try:
+        return primary()
+    except expected as e:
+        report_fallback(site, reason, exc=e)
+        return fallback()
+
+
+def route_available(name: str, site: str, reason: str = "injected_off") -> bool:
+    """Injection gate shared by the route deciders (tile_ops.blas ozaki,
+    tile_ops.pallas_kernels, the dist cholesky ozaki-pallas gate): call
+    ONLY after the route's own policy gates said yes. Returns False —
+    registering the degradation at ``site`` — when
+    :func:`dlaf_tpu.health.inject.disable_route` forced ``name`` off."""
+    from .inject import route_disabled
+
+    if route_disabled(name):
+        report_fallback(site, reason)
+        return False
+    return True

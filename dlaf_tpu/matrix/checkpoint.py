@@ -17,7 +17,6 @@ from typing import Optional
 import numpy as np
 
 from ..comm.grid import Grid
-from ..common.asserts import dlaf_assert
 from ..common.index2d import GlobalElementSize, RankIndex2D, TileElementSize
 from .matrix import Matrix
 
@@ -43,29 +42,82 @@ def save(path: str, mat: Matrix) -> None:
         ckpt.save(path, tree, force=True)
 
 
+_META_FIELDS = ("size", "block_size", "grid_size", "source_rank")
+
+
+def _meta_pair(meta, name: str, path: str) -> tuple:
+    """One validated (row, col) int pair from the restored metadata —
+    a missing or malformed field must name ITSELF, not surface later as
+    an unrelated shape error."""
+    val = meta.get(name) if hasattr(meta, "get") else None
+    if val is None:
+        raise ValueError(
+            f"checkpoint {path!r}: metadata field {name!r} is missing "
+            f"(expected one of {_META_FIELDS}) — not a dlaf_tpu matrix "
+            "checkpoint, or written by an incompatible version")
+    arr = np.asarray(val)
+    if arr.shape != (2,):
+        raise ValueError(
+            f"checkpoint {path!r}: metadata field {name!r} has shape "
+            f"{arr.shape}, expected (2,)")
+    return int(arr[0]), int(arr[1])
+
+
 def load(path: str, grid: Optional[Grid] = None) -> Matrix:
     """Rebuild a Matrix from ``path``. ``grid`` must match the saved grid
-    shape (or be omitted for a matrix saved without a grid)."""
+    shape (or be omitted for a matrix saved without a grid).
+
+    Every metadata field is validated against the restored storage and the
+    caller's ``grid`` BEFORE any Matrix is built: a mismatch raises a
+    ``ValueError`` naming the offending field (size / block_size /
+    grid_size / source_rank / storage shape) instead of a downstream
+    shape assertion from the tiling layer."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckpt:
         tree = ckpt.restore(path)
-    meta = tree["meta"]
-    gr, gc = (int(x) for x in meta["grid_size"])
+    meta = tree.get("meta") if hasattr(tree, "get") else None
+    if meta is None or "storage" not in tree:
+        raise ValueError(
+            f"checkpoint {path!r}: missing 'meta'/'storage' entries — not "
+            "a dlaf_tpu matrix checkpoint")
+    gr, gc = _meta_pair(meta, "grid_size", path)
     if grid is None:
-        dlaf_assert(gr * gc == 1,
-                    f"checkpoint was saved on a {gr}x{gc} grid; pass grid=")
-    else:
-        dlaf_assert((grid.size.row, grid.size.col) == (gr, gc),
-                    f"grid {grid.size} != saved {gr}x{gc}")
-    size = GlobalElementSize(*(int(x) for x in meta["size"]))
-    block = TileElementSize(*(int(x) for x in meta["block_size"]))
-    src = RankIndex2D(*(int(x) for x in meta["source_rank"]))
+        if gr * gc != 1:
+            raise ValueError(
+                f"checkpoint {path!r}: grid_size mismatch — saved on a "
+                f"{gr}x{gc} grid; pass a grid= of that shape")
+    elif (grid.size.row, grid.size.col) != (gr, gc):
+        raise ValueError(
+            f"checkpoint {path!r}: grid_size mismatch — saved {gr}x{gc}, "
+            f"loading onto {grid.size.row}x{grid.size.col}")
+    size = GlobalElementSize(*_meta_pair(meta, "size", path))
+    block = TileElementSize(*_meta_pair(meta, "block_size", path))
+    if size.row < 0 or size.col < 0:
+        raise ValueError(f"checkpoint {path!r}: size {size} is negative")
+    if block.row < 1 or block.col < 1:
+        raise ValueError(
+            f"checkpoint {path!r}: block_size {block} must be >= 1")
+    sr, sc = _meta_pair(meta, "source_rank", path)
+    if not (0 <= sr < gr and 0 <= sc < gc):
+        raise ValueError(
+            f"checkpoint {path!r}: source_rank ({sr}, {sc}) outside the "
+            f"saved {gr}x{gc} grid")
+    src = RankIndex2D(sr, sc)
     from .matrix import _make_dist
+    from .tiling import storage_tile_grid
 
     dist = _make_dist(size, block, grid, src)
     storage = tree["storage"]
+    Sr, Sc, _, _ = storage_tile_grid(dist)
+    expect = (Sr, Sc, block.row, block.col)
+    if tuple(storage.shape) != expect:
+        raise ValueError(
+            f"checkpoint {path!r}: storage shape {tuple(storage.shape)} "
+            f"inconsistent with metadata (size={size}, block_size={block}, "
+            f"grid_size={gr}x{gc} => expected {expect}) — the checkpoint "
+            "is corrupt or its metadata was edited")
     if grid is not None and grid.num_devices > 1:
         from .memory import place
 

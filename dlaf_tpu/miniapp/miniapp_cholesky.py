@@ -78,6 +78,17 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
     n, nb = args.matrix_size, args.block_size
     flops = total_ops(opts.dtype, n**3 / 6, n**3 / 6)
     announce_donation()   # timed runs consume their input copies
+    # --dlaf:check (the DLAF_CHECK knob): drive the robustness path by
+    # hand — in-graph info detection, shift-retry recovery, finite guards
+    # on input/factor — and report info/attempts per run (docs/
+    # robustness.md). Off by default: the guard host-syncs by design, and
+    # the robust driver cannot donate the run's input (the original must
+    # survive for re-shifted retries), so peak HBM is ~one full-matrix
+    # buffer higher than the plain donated path — near the single-chip
+    # ceiling (N=16384) run WITHOUT the flag.
+    robust = config.get_configuration().check
+    if robust:
+        from ..health import robust_cholesky
     for run_i in range(-opts.nwarmups, opts.nruns):
         mat = ref.with_storage(ref.storage + 0)   # fresh copy per run (:127-128)
         hard_fence(mat.storage)                   # start fence (:134-136)
@@ -91,12 +102,17 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
                              dtype=np.dtype(opts.dtype).name,
                              grid=f"{opts.grid_rows}x{opts.grid_cols}",
                              backend=backend)
+        rec = None
         with step_span, ptimer.phase("cholesky.factor", run=run_i):
-            # donate: the reference's cholesky overwrites mat_a in place
-            # (factorization/cholesky.h:36); this run's fresh copy is dead
-            # after the call, and the freed buffer is what lets N=16384
-            # fit the single chip
-            out = cholesky(args.uplo, mat, donate=True)
+            if robust:
+                rec = robust_cholesky(args.uplo, mat)
+                out = rec.matrix
+            else:
+                # donate: the reference's cholesky overwrites mat_a in
+                # place (factorization/cholesky.h:36); this run's fresh
+                # copy is dead after the call, and the freed buffer is
+                # what lets N=16384 fit the single chip
+                out = cholesky(args.uplo, mat, donate=True)
             hard_fence(out.storage)               # end fence (:142-144)
         t = time.perf_counter() - t0
         gflops = flops / t / 1e9
@@ -105,6 +121,9 @@ def _timed_runs(args, opts, ref, ptimer, backend, threads, results):
         line = (f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s "
                 f"{type_letter(opts.dtype)}{args.uplo} ({n}, {n}) ({nb}, {nb}) "
                 f"({opts.grid_rows}, {opts.grid_cols}) {threads} {backend}")
+        if rec is not None:
+            line += (f" info={rec.infos[-1]} attempts={rec.attempts}"
+                     f" shifts={list(rec.shifts)}")
         print(line, flush=True)
         results.append({"run": run_i, "time_s": t, "gflops": gflops})
         last = run_i == opts.nruns - 1

@@ -48,6 +48,23 @@ _lock = threading.Lock()
 _lib = None
 _load_error: Exception | None = None
 
+#: Test hook (health.inject.force_native_failure): when True, get_lib()
+#: fails as if the compiler/loader had — exercising the cached-error
+#: re-raise path and every native -> numpy degradation chain without
+#: breaking a real toolchain.
+_FORCE_BUILD_FAILURE = False
+
+
+def _reset_for_tests(force_failure: bool = False) -> None:
+    """Drop the cached library/error and (un)arm the forced-failure hook,
+    so injection contexts neither see a pre-loaded library nor leak the
+    injected failure into later callers."""
+    global _lib, _load_error, _FORCE_BUILD_FAILURE
+    with _lock:
+        _lib = None
+        _load_error = None
+        _FORCE_BUILD_FAILURE = bool(force_failure)
+
 
 def _build() -> str:
     # -march=native vectorizes the diagonal-major chase streams ~1.5x over
@@ -74,6 +91,9 @@ def get_lib():
         if _load_error is not None:
             raise _load_error
         try:
+            if _FORCE_BUILD_FAILURE:
+                raise RuntimeError(
+                    "forced build failure (health.inject test hook)")
             if (not os.path.exists(_LIB)
                     or any(os.path.getmtime(_LIB) < os.path.getmtime(s)
                            for s in _SRCS)):

@@ -94,6 +94,15 @@ def _validate_span(r: dict, where: str, errors: list) -> None:
         # the tracer never derives throughput from unfenced dispatch
         # wall; hold third-party emitters to the same contract
         errors.append(f"{where}: unfenced span must not carry gflops")
+    if r.get("name") == "robust_cholesky.attempt":
+        # retry spans are the recovery audit trail (docs/robustness.md):
+        # each must say WHICH attempt with WHAT shift, or the artifact
+        # cannot reconstruct the recovery history
+        attrs = r.get("attrs") or {}
+        for key in ("attempt", "shift"):
+            if not _finite(attrs.get(key)):
+                errors.append(
+                    f"{where}: retry span missing finite attr {key!r}")
 
 
 def _validate_metrics(r: dict, where: str, errors: list) -> None:
@@ -119,13 +128,17 @@ def _validate_metrics(r: dict, where: str, errors: list) -> None:
 
 
 def validate_records(records, require_spans=False, require_gflops=False,
-                     require_collectives=False) -> list:
+                     require_collectives=False, require_retries=False,
+                     require_fallbacks=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
-    at least one span, at least one span with finite derived gflops, and
-    collective byte counters in some metrics snapshot."""
+    at least one span, at least one span with finite derived gflops,
+    collective byte counters in some metrics snapshot, at least one
+    ``robust_cholesky.attempt`` retry span (with its attempt/shift
+    attrs — the fault-injection smoke), and a positive
+    ``dlaf_fallback_total`` counter."""
     errors = []
-    n_spans = n_gflops = n_coll = 0
+    n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     for i, r in enumerate(records):
         where = f"record {i}"
         if not isinstance(r, dict):
@@ -145,13 +158,21 @@ def validate_records(records, require_spans=False, require_gflops=False,
             n_spans += 1
             if _finite(r.get("gflops")):
                 n_gflops += 1
+            if r.get("name") == "robust_cholesky.attempt" and \
+                    (r.get("attrs") or {}).get("attempt", 0) >= 1:
+                # attempt 0 is the plain factorization; only a shifted
+                # RE-attempt proves the recovery path ran
+                n_retries += 1
         elif rtype == "metrics":
             _validate_metrics(r, where, errors)
             for m in r.get("metrics") or []:
-                if isinstance(m, dict) and \
-                        m.get("name") == "dlaf_comm_collective_bytes_total" \
-                        and _finite(m.get("value")) and m["value"] > 0:
+                if not isinstance(m, dict) or not _finite(m.get("value")):
+                    continue
+                if m.get("name") == "dlaf_comm_collective_bytes_total" \
+                        and m["value"] > 0:
                     n_coll += 1
+                if m.get("name") == "dlaf_fallback_total" and m["value"] > 0:
+                    n_fallbacks += 1
         elif rtype == "log":
             if not isinstance(r.get("msg"), str):
                 errors.append(f"{where}: log without msg")
@@ -162,6 +183,12 @@ def validate_records(records, require_spans=False, require_gflops=False,
     if require_collectives and n_coll == 0:
         errors.append("artifact contains no positive "
                       "dlaf_comm_collective_bytes_total counter")
+    if require_retries and n_retries == 0:
+        errors.append("artifact contains no robust_cholesky.attempt "
+                      "retry span (attempt >= 1)")
+    if require_fallbacks and n_fallbacks == 0:
+        errors.append("artifact contains no positive dlaf_fallback_total "
+                      "counter")
     return errors
 
 

@@ -7,6 +7,11 @@ Flags:
     --require-gflops        fail unless >= 1 span has finite derived gflops
     --require-collectives   fail unless a metrics snapshot carries a
                             positive dlaf_comm_collective_bytes_total
+    --require-retries       fail unless >= 1 robust_cholesky.attempt span
+                            with attempt >= 1 (an actual shifted retry —
+                            the fault-injection smoke's audit trail)
+    --require-fallbacks     fail unless a metrics snapshot carries a
+                            positive dlaf_fallback_total
     --prom                  print the last metrics snapshot as Prometheus
                             text exposition after validating
 
@@ -28,7 +33,7 @@ def main(argv=None) -> int:
     flags = {a for a in argv if a.startswith("--")}
     paths = [a for a in argv if not a.startswith("--")]
     known = {"--require-spans", "--require-gflops", "--require-collectives",
-             "--prom"}
+             "--require-retries", "--require-fallbacks", "--prom"}
     if len(paths) != 1 or flags - known:
         print(__doc__, file=sys.stderr)
         return 2
@@ -42,7 +47,9 @@ def main(argv=None) -> int:
         records,
         require_spans="--require-spans" in flags,
         require_gflops="--require-gflops" in flags,
-        require_collectives="--require-collectives" in flags)
+        require_collectives="--require-collectives" in flags,
+        require_retries="--require-retries" in flags,
+        require_fallbacks="--require-fallbacks" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)

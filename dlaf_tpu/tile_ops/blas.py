@@ -362,10 +362,17 @@ def f64_gemm_uses_mxu(dtype, dim: int) -> bool:
 
     import numpy as _np
 
-    return (resolved_f64_gemm() == "mxu"
-            and _np.dtype(dtype) in (_np.dtype(_np.float64),
-                                     _np.dtype(_np.complex128))
-            and dim >= get_configuration().f64_gemm_min_dim)
+    routed = (resolved_f64_gemm() == "mxu"
+              and _np.dtype(dtype) in (_np.dtype(_np.float64),
+                                       _np.dtype(_np.complex128))
+              and dim >= get_configuration().f64_gemm_min_dim)
+    if routed:
+        # fault injection can force the ozaki -> plain-dot degradation;
+        # the min-dim gate above is route policy and stays uncounted
+        from ..health.registry import route_available
+
+        return route_available("ozaki", "ozaki_gemm")
+    return routed
 
 
 def resolve_chunk_width(knob: str, dtype, gate_dim: int, chunk_axis: int,

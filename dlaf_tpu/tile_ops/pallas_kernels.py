@@ -88,11 +88,21 @@ def supports_pallas_update(dtype, platform: str) -> bool:
     ``DLAF_FORCE_PALLAS_UPDATE=1`` drops the platform requirement so tests can
     exercise the Pallas integration path off-TPU (the call site then runs the
     kernel in interpret mode).
+
+    Fault injection (``health.inject.disable_pallas``) forces the gate
+    closed; when that flips a would-be-True answer the pallas -> XLA
+    degradation is registered (dlaf_fallback_total{site="pallas_update"},
+    strict mode raises) — the platform/dtype gate itself is route policy,
+    not degradation, and stays uncounted.
     """
     import os
 
     dtype_ok = jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
                                     jnp.dtype(jnp.bfloat16))
-    if os.environ.get("DLAF_FORCE_PALLAS_UPDATE") == "1":
-        return dtype_ok
-    return platform == "tpu" and dtype_ok
+    supported = dtype_ok if os.environ.get("DLAF_FORCE_PALLAS_UPDATE") == "1" \
+        else (platform == "tpu" and dtype_ok)
+    if supported:
+        from ..health.registry import route_available
+
+        return route_available("pallas", "pallas_update")
+    return supported

@@ -1,0 +1,115 @@
+"""Checkpoint metadata validation + roundtrip (matrix/checkpoint.py).
+
+The load path must reject size/block/grid/source-rank mismatches with a
+ValueError NAMING the mismatched field — not surface them later as a
+tiling-layer shape assertion. Skips cleanly when orbax is absent (the
+checkpoint hook is optional; nothing in the algorithms depends on it).
+"""
+
+import numpy as np
+import pytest
+
+ocp = pytest.importorskip("orbax.checkpoint")
+
+from dlaf_tpu.comm.grid import Grid  # noqa: E402
+from dlaf_tpu.common.index2d import RankIndex2D, TileElementSize  # noqa: E402
+from dlaf_tpu.matrix import checkpoint  # noqa: E402
+from dlaf_tpu.matrix.matrix import Matrix  # noqa: E402
+
+
+def _mat(n=12, nb=4, grid=None, seed=0, src=RankIndex2D(0, 0)):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n))
+    return Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
+                              source_rank=src)
+
+
+def _save_tree(path, storage, meta):
+    """Write a raw checkpoint tree (the tampered-metadata fixture: orbax
+    trees can't be edited in place, so mismatches are saved directly)."""
+    with ocp.PyTreeCheckpointer() as ckpt:
+        ckpt.save(str(path), {"storage": storage, "meta": meta}, force=True)
+
+
+def _meta(size, block, grid, src):
+    return {
+        "size": np.array(size, dtype=np.int64),
+        "block_size": np.array(block, dtype=np.int64),
+        "grid_size": np.array(grid, dtype=np.int64),
+        "source_rank": np.array(src, dtype=np.int64),
+    }
+
+
+def test_roundtrip_local(tmp_path):
+    mat = _mat()
+    checkpoint.save(str(tmp_path / "ckpt"), mat)
+    back = checkpoint.load(str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(back.to_numpy(), mat.to_numpy())
+    assert back.dist.size == mat.dist.size
+    assert back.dist.block_size == mat.dist.block_size
+
+
+def test_roundtrip_distributed(tmp_path, devices8):
+    grid = Grid(2, 2)
+    mat = _mat(16, 4, grid=grid, src=RankIndex2D(1, 0))
+    checkpoint.save(str(tmp_path / "ckpt"), mat)
+    back = checkpoint.load(str(tmp_path / "ckpt"), grid=Grid(2, 2))
+    np.testing.assert_array_equal(back.to_numpy(), mat.to_numpy())
+    assert back.dist.source_rank == mat.dist.source_rank
+
+
+def test_grid_size_mismatch_names_field(tmp_path, devices8):
+    mat = _mat()
+    checkpoint.save(str(tmp_path / "ckpt"), mat)
+    with pytest.raises(ValueError, match="grid_size mismatch"):
+        checkpoint.load(str(tmp_path / "ckpt"), grid=Grid(2, 2))
+    grid = Grid(2, 2)
+    dmat = _mat(16, 4, grid=grid)
+    checkpoint.save(str(tmp_path / "dckpt"), dmat)
+    with pytest.raises(ValueError, match="grid_size mismatch"):
+        checkpoint.load(str(tmp_path / "dckpt"))   # no grid passed
+
+
+def test_missing_meta_field_names_field(tmp_path):
+    mat = _mat()
+    meta = _meta((12, 12), (4, 4), (1, 1), (0, 0))
+    del meta["source_rank"]
+    _save_tree(tmp_path / "ckpt", np.asarray(mat.storage), meta)
+    with pytest.raises(ValueError, match="'source_rank' is missing"):
+        checkpoint.load(str(tmp_path / "ckpt"))
+
+
+def test_source_rank_outside_grid_names_field(tmp_path):
+    mat = _mat()
+    meta = _meta((12, 12), (4, 4), (1, 1), (1, 0))   # rank 1 on a 1x1 grid
+    _save_tree(tmp_path / "ckpt", np.asarray(mat.storage), meta)
+    with pytest.raises(ValueError, match="source_rank .* outside"):
+        checkpoint.load(str(tmp_path / "ckpt"))
+
+
+def test_block_size_mismatch_is_storage_inconsistency(tmp_path):
+    """Tampered block_size: metadata says 6 but the storage was tiled at
+    4 — the error names the inconsistency instead of raising from the
+    tiling layer's shape assert."""
+    mat = _mat(12, 4)
+    meta = _meta((12, 12), (6, 6), (1, 1), (0, 0))
+    _save_tree(tmp_path / "ckpt", np.asarray(mat.storage), meta)
+    with pytest.raises(ValueError, match="storage shape .* inconsistent"):
+        checkpoint.load(str(tmp_path / "ckpt"))
+
+
+def test_size_mismatch_is_storage_inconsistency(tmp_path):
+    mat = _mat(12, 4)
+    meta = _meta((20, 20), (4, 4), (1, 1), (0, 0))
+    _save_tree(tmp_path / "ckpt", np.asarray(mat.storage), meta)
+    with pytest.raises(ValueError, match="storage shape .* inconsistent"):
+        checkpoint.load(str(tmp_path / "ckpt"))
+
+
+def test_malformed_meta_shape_names_field(tmp_path):
+    mat = _mat()
+    meta = _meta((12, 12), (4, 4), (1, 1), (0, 0))
+    meta["size"] = np.array([12, 12, 12], dtype=np.int64)
+    _save_tree(tmp_path / "ckpt", np.asarray(mat.storage), meta)
+    with pytest.raises(ValueError, match="'size' has shape"):
+        checkpoint.load(str(tmp_path / "ckpt"))
