@@ -29,13 +29,20 @@ case "$TIER" in
     # distributed run on a 2x2 virtual-CPU grid so the artifact carries
     # real collective byte counters; the validator fails the tier on any
     # missing or non-finite field (NaN GFlop/s must not scrape as data)
+    # comm look-ahead pinned ON (the CPU auto would resolve it off): the
+    # artifact must additionally carry the dlaf_comm_overlapped_total
+    # trace-time counters and finite per-axis collective byte counts —
+    # the audit trail that the hoisted-collective programs were built
+    # (docs/comm_overlap.md)
     OBS_ART=$(mktemp -d)/miniapp_cholesky_metrics.jsonl
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
       DLAF_METRICS_PATH="$OBS_ART" \
+      DLAF_CHOLESKY_LOOKAHEAD=1 DLAF_COMM_LOOKAHEAD=1 \
       python -m dlaf_tpu.miniapp.miniapp_cholesky -m 256 -b 64 \
         --grid-rows 2 --grid-cols 2 --nruns 2
     python -m dlaf_tpu.obs.validate "$OBS_ART" \
-      --require-spans --require-gflops --require-collectives
+      --require-spans --require-gflops --require-collectives \
+      --require-comm-overlap
     echo "== smoke: fault-injection / graceful-degradation artifact =="
     # drive the robustness layer end-to-end (docs/robustness.md): a tiny
     # non-SPD robust_cholesky must recover through shift-retry (leaving

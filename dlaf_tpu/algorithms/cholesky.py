@@ -563,7 +563,7 @@ def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
 def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                          use_mxu=False, use_mixed=False, cplx=False,
                          use_oz_pallas=False, lookahead=False,
-                         with_info=False):
+                         comm_la=False, with_info=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
@@ -585,6 +585,20 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
     the column axis, all-gathered along the row axis to index the transposed
     panel by local trailing rows, and the trailing update
     ``A[i,j] -= U[k,i]^H U[k,j]`` touches the upper-triangle tile pairs.
+
+    Each step is three phases — ``panel_chain`` (fused diag ``bcast2d`` +
+    potrf + panel trsm + panel broadcast + transposed-panel all_gather),
+    ``step_pre`` (diag/panel writes + the lookahead next-column strip) and
+    ``step_bulk`` (the bulk trailing product) — so ``comm_la``
+    (``comm_lookahead=1``, docs/comm_overlap.md) can emit step k+1's
+    ENTIRE panel chain, collectives included, BEFORE step k's bulk
+    product: the chain reads only the carried post-strip column values,
+    never ``lt`` after the bulk scatter, which is exactly the dependency
+    shape that lets XLA run the ICI transfer concurrently with the bulk
+    MXU gemms (the reference hides the same transfer behind the trailing
+    update, ``broadcast_panel.h`` + ``impl.h:147-156``). Phase order of
+    ``lt`` mutations is identical in both modes, so results are bitwise
+    the same with the knob on or off.
     """
     nt = dist.nr_tiles.row
     mb = dist.block_size.row
@@ -600,25 +614,39 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
     def local_cols_global(lu, rc, count):
         return (lu + jnp.arange(count)) * Qc + rc
 
-    def step(lt, k, la):
-        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr   # my cycle position (rows)
-        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+    def _indices(k):
+        """Trace-time per-step index bundle (owners, pivot slots, uniform
+        trailing slot starts)."""
         owner_r = ud.rank_global_tile(k, Pr, sr)
         owner_c = ud.rank_global_tile(k, Qc, sc)
         kr = ud.local_tile_from_global_tile(k, Pr)
         kc = ud.local_tile_from_global_tile(k, Qc)
-        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
-        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
+        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
+        return owner_r, owner_c, kr, kc, lu_r, lu_c
 
-        # -- diag tile -> everyone (reference: col bcast impl.h:215-219) ----
-        # lookahead carry ``la = (col_tiles, lu)``: step k-1's next-column
-        # values as direct SSA inputs — correct on the owner column (the
-        # only contributor the bcast/keep masks select), so the potrf/trsm
-        # chain of this step never waits on the bulk trailing scatter.
-        # uplo='U' carries a block ROW, indexed by column slots.
+    def panel_chain(lt, k, la):
+        """Panel chain of step k: fused diag broadcast (one collective,
+        :func:`cc.bcast2d`) + potrf + panel trsm + panel broadcast +
+        transposed-panel all_gather (reference impl.h:215-231 +
+        broadcast_panel.h:101-193). With the lookahead carry
+        ``la = (tiles, lu)`` (step k-1's post-strip column/row values) the
+        chain reads NO ``lt`` value at all — it is independent of step
+        k-1's bulk trailing product, which is what allows ``comm_la`` to
+        emit it (collectives included) ahead of that product. The carried
+        tiles are trusted only under the owner masks, exactly like the
+        PR-2 carry. Returns ``(lkk, pan, vbcast, vtrans)``; ``pan`` is
+        None past the last trailing step, ``vtrans`` None when no rank
+        has trailing columns (rows for uplo='U')."""
+        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
+        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
+
+        # -- diag tile -> everyone (reference: col bcast impl.h:215-219);
+        # uplo='U' carries a block ROW, indexed by column slots
         cand = lt[kr, kc] if la is None \
             else la[0][(kr if uplo == "L" else kc) - la[1]]
-        diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
+        diag = cc.bcast2d(cand, owner_r, owner_c)
         ts = min(mb, n - k * mb)
         if ts < mb:  # pad short edge tile with identity to keep potrf defined
             pad = (jnp.arange(mb) >= ts)
@@ -635,68 +663,95 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             lkk = fac + tb.tri_mask(diag, other, k=-1)
         else:
             lkk = tl.potrf(uplo, diag)
+        if k == nt - 1:
+            return lkk, None, None, None
+
+        if uplo == "L":
+            nrows = ltr - lu_r
+            if nrows == 0:
+                return lkk, None, None, None
+            g_rows = local_rows_global(lu_r, rr, nrows)
+            row_valid = (g_rows > k) & (g_rows < nt)
+            # trsm_panel: native batched solve, or (f64_trsm="mixed")
+            # refined inverse + matmul that follows the f64_gemm routing
+            # (inverse precomputed by the fused potrf step); the panel
+            # source is the carried next-column when pipelined (non-owner
+            # ranks' carried tiles are stale pre-bulk values, but every
+            # use of `pan` is gated by the owner-column keep/bcast masks)
+            colsrc = lt[lu_r:, kc] if la is None else la[0][lu_r - la[1]:]
+            pan = tb.trsm_panel("R", "L", "C", "N", lkk, colsrc,
+                                inv_a=lkk_inv)
+            pan = jnp.where(row_valid[:, None, None], pan,
+                            jnp.zeros_like(pan))
+            # -- panel broadcast (reference broadcast_panel.h:101-193) ---
+            # row-wise: every rank gets the panel tiles for its local rows
+            vr = cc.bcast(pan, COL_AXIS, owner_c)
+            ncols = ltc - lu_c
+            if ncols == 0:
+                return lkk, pan, vr, None
+            g_cols = local_cols_global(lu_c, rc, ncols)
+            col_valid = (g_cols > k) & (g_cols < nt)
+            # transposed panel: all_gather along 'row' -> all panel tiles,
+            # then gather the tiles matching my local trailing columns
+            vc = transpose_col_to_rows(DistContext(dist), vr, lu_r, g_cols)
+            vc = jnp.where(col_valid[:, None, None], vc, jnp.zeros_like(vc))
+            return lkk, pan, vr, vc
+
+        # uplo='U': panel is the block row k (reference ``call_U``)
+        ncols = ltc - lu_c
+        if ncols == 0:
+            return lkk, None, None, None
+        g_cols = local_cols_global(lu_c, rc, ncols)
+        col_valid = (g_cols > k) & (g_cols < nt)
+        rowsrc = lt[kr, lu_c:] if la is None else la[0][lu_c - la[1]:]
+        pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowsrc,
+                            inv_a=lkk_inv)
+        pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
+        # col-wise down the mesh, then all_gather along the column axis
+        # to index the transposed panel by local rows
+        vcp = cc.bcast(pan, ROW_AXIS, owner_r)
+        nrows = ltr - lu_r
+        if nrows == 0:
+            return lkk, pan, vcp, None
+        g_rows = local_rows_global(lu_r, rr, nrows)
+        row_valid = (g_rows > k) & (g_rows < nt)
+        vrp = transpose_row_to_cols(DistContext(dist), vcp, lu_c, g_rows)
+        vrp = jnp.where(row_valid[:, None, None], vrp, jnp.zeros_like(vrp))
+        return lkk, pan, vcp, vrp
+
+    def step_pre(lt, k, ch):
+        """Write step k's factored diag + panel and apply the lookahead
+        next-column (next-row for 'U') strip; returns ``(lt, la_next)``
+        with ``la_next = (post-strip tiles, lu)`` — the SSA carry feeding
+        both step k+1's panel chain and its strip indexing."""
+        lkk, pan, vb, vt = ch
+        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
+        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
 
         # owner writes the factored diagonal back
         upd_tile = jnp.where(is_owner_r & is_owner_c, lkk, lt[kr, kc])
         lt = lt.at[kr, kc].set(upd_tile)
-        if k == nt - 1:
+        if pan is None:
             return lt, None
-        if uplo == "U":
-            return step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, lkk,
-                                   lkk_inv, la)
 
-        # -- panel trsm on owner column (reference impl.h:222-231) ----------
-        # uniform local row start: every rank's rows >= k+1 live at slots
-        # >= lu_r (off by at most one tile from the per-rank optimum)
-        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
-        nrows = ltr - lu_r
-        if nrows == 0:
-            return lt, None
-        g_rows = local_rows_global(lu_r, rr, nrows)
-        row_valid = (g_rows > k) & (g_rows < nt)
-        # trsm_panel: native batched solve, or (f64_trsm="mixed") refined
-        # inverse + matmul that follows the f64_gemm routing (inverse
-        # precomputed by the fused potrf step); the panel source is the
-        # carried next-column when pipelined (non-owner ranks' carried
-        # tiles are stale pre-bulk values, but every use of `pan` below
-        # is gated by the owner-column keep/bcast masks)
-        colsrc = lt[lu_r:, kc] if la is None else la[0][lu_r - la[1]:]
-        pan = tb.trsm_panel("R", "L", "C", "N", lkk, colsrc,
-                            inv_a=lkk_inv)
-        pan = jnp.where(row_valid[:, None, None], pan, jnp.zeros_like(pan))
-        # owner column keeps the factored panel (others keep their tiles)
-        keep = (is_owner_c & row_valid)[:, None, None]
-        lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan, lt[lu_r:, kc]))
-
-        # -- panel broadcast (reference broadcast_panel.h:101-193) ----------
-        # row-wise: every rank gets the panel tiles for its local rows
-        vr = cc.bcast(pan, COL_AXIS, owner_c)
-        # transposed panel: all_gather along 'row' -> all panel tiles,
-        # then gather the tiles matching my local trailing columns
-        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
-        ncols = ltc - lu_c
-        if ncols == 0:
-            return lt, None
-        g_cols = local_cols_global(lu_c, rc, ncols)
-        col_valid = (g_cols > k) & (g_cols < nt)
-        vc = transpose_col_to_rows(DistContext(dist), vr, lu_r, g_cols)
-        vc = jnp.where(col_valid[:, None, None], vc, jnp.zeros_like(vc))
-
-        # -- trailing update (reference impl.h:242-271) ---------------------
-        # A[i,j] -= L[i,k] L[j,k]^H for trailing lower-triangle tiles
-        pair = row_valid[:, None] & col_valid[None, :]
-        # strictly-lower tiles: full update; diagonal tiles: lower triangle
-        # only (the matrix's upper triangle passes through untouched, like
-        # the reference's herk vs gemm split)
-        below = pair & (g_rows[:, None] > g_cols[None, :])
-        ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-        la_next = None
-        if lookahead and k + 1 < nt:
+        if uplo == "L":
+            nrows = ltr - lu_r
+            g_rows = local_rows_global(lu_r, rr, nrows)
+            row_valid = (g_rows > k) & (g_rows < nt)
+            # owner column keeps the factored panel (others their tiles)
+            keep = (is_owner_c & row_valid)[:, None, None]
+            lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan, lt[lu_r:, kc]))
+            if vt is None or not (lookahead and k + 1 < nt):
+                return lt, None
             # -- next panel column first (reference's high-priority
             # first-column herk, impl.h:147-156): one tile-column einsum
             # against MY kc1-slot transposed-panel tile (exactly the tile
             # the bulk product would have used — bitwise-identical dots),
             # emitted before the bulk and carried to step k+1
+            vr, vc = vb, vt
             kc1 = ud.local_tile_from_global_tile(k + 1, Qc)
             owner_c1 = ud.rank_global_tile(k + 1, Qc, sc)
             pk1 = vc[kc1 - lu_c]
@@ -716,20 +771,80 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             new_col = lt[lu_r:, kc1] - jnp.where(m3, updc,
                                                  jnp.zeros_like(updc))
             lt = lt.at[lu_r:, kc1].set(new_col)
-            la_next = (new_col, lu_r)
-            # the bulk below excludes column k+1 (already applied)
-            notnext = g_cols != k + 1
-            below = below & notnext[None, :]
-            ondiag = ondiag & notnext[None, :]
-        if use_pallas:
-            # predicated Pallas kernel: masked-out tile pairs skip the MXU
-            # work entirely (exact flops instead of rectangle-then-mask)
-            mode = below.astype(jnp.int32) + 2 * ondiag.astype(jnp.int32)
-            new_block = masked_trailing_update(lt[lu_r:, lu_c:], vr, vc, mode,
-                                               interpret=pallas_interpret)
-            lt = lt.at[lu_r:, lu_c:].set(new_block)
-            return lt, la_next
+            return lt, (new_col, lu_r)
+
+        # uplo='U'
+        ncols = ltc - lu_c
+        g_cols = local_cols_global(lu_c, rc, ncols)
+        col_valid = (g_cols > k) & (g_cols < nt)
+        keep = (is_owner_r & col_valid)[:, None, None]
+        lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
+        if vt is None or not (lookahead and k + 1 < nt):
+            return lt, None
+        # next block row first (mirrored split): my kr1-slot
+        # transposed-panel tile, carried to step k+1
+        vc, vr = vb, vt
+        kr1 = ud.local_tile_from_global_tile(k + 1, Pr)
+        owner_r1 = ud.rank_global_tile(k + 1, Pr, sr)
+        pk1 = vr[kr1 - lu_r]
+        own_r1 = cc.this_rank(ROW_AXIS) == owner_r1
+        above1 = col_valid & (g_cols > k + 1)
+        ondiag1 = col_valid & (g_cols == k + 1)
+        if use_mxu:
+            mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+            updr = mmfn(jnp.swapaxes(jnp.conj(pk1), -1, -2),
+                        jnp.swapaxes(vc, -1, -2).reshape(
+                            ncols * mb, mb).T,
+                        slices=tb._oz_slices()).reshape(
+                            mb, ncols, mb).transpose(1, 0, 2)
         else:
+            updr = jnp.einsum("ba,cbd->cad", jnp.conj(pk1), vc,
+                              preferred_element_type=vc.dtype)
+        triu1 = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+        m3 = (above1[:, None, None] | (ondiag1[:, None, None] & triu1)) \
+            & own_r1
+        new_row = lt[kr1, lu_c:] - jnp.where(m3, updr,
+                                             jnp.zeros_like(updr))
+        lt = lt.at[kr1, lu_c:].set(new_row)
+        return lt, (new_row, lu_c)
+
+    def step_bulk(lt, k, ch, stripped):
+        """Bulk trailing product of step k (reference impl.h:242-271);
+        ``stripped`` excludes the eagerly-updated next column/row."""
+        lkk, pan, vb, vt = ch
+        if pan is None or vt is None:
+            return lt
+        rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
+        rc = (cc.this_rank(COL_AXIS) - sc) % Qc
+        _, _, _, _, lu_r, lu_c = _indices(k)
+        nrows, ncols = ltr - lu_r, ltc - lu_c
+        g_rows = local_rows_global(lu_r, rr, nrows)
+        g_cols = local_cols_global(lu_c, rc, ncols)
+        row_valid = (g_rows > k) & (g_rows < nt)
+        col_valid = (g_cols > k) & (g_cols < nt)
+        pair = row_valid[:, None] & col_valid[None, :]
+
+        if uplo == "L":
+            # A[i,j] -= L[i,k] L[j,k]^H for trailing lower-triangle tiles:
+            # strictly-lower tiles full update, diagonal tiles lower
+            # triangle only (the matrix's upper triangle passes through
+            # untouched, like the reference's herk vs gemm split)
+            vr, vc = vb, vt
+            below = pair & (g_rows[:, None] > g_cols[None, :])
+            ondiag = pair & (g_rows[:, None] == g_cols[None, :])
+            if stripped:
+                # the bulk excludes column k+1 (already applied)
+                notnext = g_cols != k + 1
+                below = below & notnext[None, :]
+                ondiag = ondiag & notnext[None, :]
+            if use_pallas:
+                # predicated Pallas kernel: masked-out tile pairs skip the
+                # MXU work entirely (exact flops, not rectangle-then-mask)
+                mode = below.astype(jnp.int32) + 2 * ondiag.astype(jnp.int32)
+                new_block = masked_trailing_update(lt[lu_r:, lu_c:], vr, vc,
+                                                   mode,
+                                                   interpret=pallas_interpret)
+                return lt.at[lu_r:, lu_c:].set(new_block)
             if use_mxu and use_oz_pallas:
                 # predicated fused kernel: dead tile pairs skip the MXU work
                 upd = _masked_oz_update(
@@ -748,74 +863,16 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 upd = jnp.einsum("rab,cdb->rcad", vr, jnp.conj(vc),
                                  preferred_element_type=vr.dtype)
             tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
-            mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
+            mask4 = below[:, :, None, None] \
+                | (ondiag[:, :, None, None] & tril_m)
             upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
-            lt = lt.at[lu_r:, lu_c:].add(-upd)
-        return lt, la_next
+            return lt.at[lu_r:, lu_c:].add(-upd)
 
-    def step_trailing_U(lt, k, rr, rc, owner_r, kr, kc, ukk, ukk_inv=None,
-                        la=None):
-        """Mirrored sweep for uplo='U' (reference ``call_U``): panel is the
-        block row k, trailing update hits upper-triangle tile pairs."""
-        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
-
-        # -- panel trsm on owner row: A[k, j] <- Ukk^-H A[k, j] -------------
-        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
-        ncols = ltc - lu_c
-        if ncols == 0:
-            return lt, None
-        g_cols = local_cols_global(lu_c, rc, ncols)
-        col_valid = (g_cols > k) & (g_cols < nt)
-        rowsrc = lt[kr, lu_c:] if la is None else la[0][lu_c - la[1]:]
-        pan = tb.trsm_panel("L", "U", "C", "N", ukk, rowsrc,
-                            inv_a=ukk_inv)
-        pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
-        keep = (is_owner_r & col_valid)[:, None, None]
-        lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
-
-        # -- panel broadcast: col-wise down the mesh, then all_gather along
-        # the column axis to index the transposed panel by local rows -------
-        vc = cc.bcast(pan, ROW_AXIS, owner_r)
-        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
-        nrows = ltr - lu_r
-        if nrows == 0:
-            return lt, None
-        g_rows = local_rows_global(lu_r, rr, nrows)
-        row_valid = (g_rows > k) & (g_rows < nt)
-        vr = transpose_row_to_cols(DistContext(dist), vc, lu_c, g_rows)
-        vr = jnp.where(row_valid[:, None, None], vr, jnp.zeros_like(vr))
-
-        # -- trailing update: A[i,j] -= U[k,i]^H U[k,j], upper triangle -----
-        pair = row_valid[:, None] & col_valid[None, :]
+        # uplo='U': A[i,j] -= U[k,i]^H U[k,j], upper triangle
+        vc, vr = vb, vt
         above = pair & (g_rows[:, None] < g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
-        la_next = None
-        if lookahead and k + 1 < nt:
-            # next block row first (mirrored split): my kr1-slot
-            # transposed-panel tile, carried to step k+1
-            kr1 = ud.local_tile_from_global_tile(k + 1, Pr)
-            owner_r1 = ud.rank_global_tile(k + 1, Pr, sr)
-            pk1 = vr[kr1 - lu_r]
-            own_r1 = cc.this_rank(ROW_AXIS) == owner_r1
-            above1 = col_valid & (g_cols > k + 1)
-            ondiag1 = col_valid & (g_cols == k + 1)
-            if use_mxu:
-                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
-                updr = mmfn(jnp.swapaxes(jnp.conj(pk1), -1, -2),
-                            jnp.swapaxes(vc, -1, -2).reshape(
-                                ncols * mb, mb).T,
-                            slices=tb._oz_slices()).reshape(
-                                mb, ncols, mb).transpose(1, 0, 2)
-            else:
-                updr = jnp.einsum("ba,cbd->cad", jnp.conj(pk1), vc,
-                                  preferred_element_type=vc.dtype)
-            triu1 = jnp.triu(jnp.ones((mb, mb), dtype=bool))
-            m3 = (above1[:, None, None] | (ondiag1[:, None, None] & triu1)) \
-                & own_r1
-            new_row = lt[kr1, lu_c:] - jnp.where(m3, updr,
-                                                 jnp.zeros_like(updr))
-            lt = lt.at[kr1, lu_c:].set(new_row)
-            la_next = (new_row, lu_c)
+        if stripped:
             notnext = g_rows != k + 1
             above = above & notnext[:, None]
             ondiag = ondiag & notnext[:, None]
@@ -826,31 +883,49 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             new_block = masked_trailing_update(
                 lt[lu_r:, lu_c:], jnp.swapaxes(vr, -1, -2),
                 jnp.swapaxes(vc, -1, -2), mode, interpret=pallas_interpret)
-            lt = lt.at[lu_r:, lu_c:].set(new_block)
-            return lt, la_next
+            return lt.at[lu_r:, lu_c:].set(new_block)
+        if use_mxu and use_oz_pallas:
+            ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
+            bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
+            upd = _masked_oz_update(ar, bc, above | ondiag,
+                                    nrows, ncols, mb, pallas_interpret)
+        elif use_mxu:
+            mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
+            ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
+            bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
+            full = mmfn(ar, bc.T, slices=tb._oz_slices())
+            upd = full.reshape(nrows, mb, ncols, mb).transpose(0, 2, 1, 3)
         else:
-            if use_mxu and use_oz_pallas:
-                ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
-                bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
-                upd = _masked_oz_update(ar, bc, above | ondiag,
-                                        nrows, ncols, mb, pallas_interpret)
-            elif use_mxu:
-                mmfn = oz.matmul_c128 if cplx else oz.matmul_f64
-                ar = jnp.swapaxes(jnp.conj(vr), -1, -2).reshape(nrows * mb, mb)
-                bc = jnp.swapaxes(vc, -1, -2).reshape(ncols * mb, mb)
-                full = mmfn(ar, bc.T, slices=tb._oz_slices())
-                upd = full.reshape(nrows, mb, ncols, mb).transpose(0, 2, 1, 3)
-            else:
-                upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vr), vc,
-                                 preferred_element_type=vr.dtype)
-            triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
-            mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
-            upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
-            lt = lt.at[lu_r:, lu_c:].add(-upd)
-        return lt, la_next
+            upd = jnp.einsum("rba,cbd->rcad", jnp.conj(vr), vc,
+                             preferred_element_type=vr.dtype)
+        triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+        mask4 = above[:, :, None, None] | (ondiag[:, :, None, None] & triu_m)
+        upd = jnp.where(mask4, upd, jnp.zeros_like(upd))
+        return lt.at[lu_r:, lu_c:].add(-upd)
+
+    def chain_comm_counts(k):
+        """Collectives ``panel_chain(k)`` emits per mesh axis (trace-time
+        statics mirroring the chain's early-exit structure): the fused
+        diag bcast2d counts once on each axis; a full chain adds the
+        panel broadcast on one axis and the transposed-panel all_gather
+        on the other."""
+        _, _, _, _, lu_r, lu_c = _indices(k)
+        nrows, ncols = ltr - lu_r, ltc - lu_c
+        row = col = 1
+        if k < nt - 1:
+            if uplo == "L" and nrows > 0:
+                col += 1                      # panel bcast along 'col'
+                if ncols > 0:
+                    row += 1                  # transpose all_gather
+            elif uplo == "U" and ncols > 0:
+                row += 1
+                if nrows > 0:
+                    col += 1
+        return row, col
 
     def factorize(lt):
         la = None
+        ch_next = None
         for k in range(nt):
             # phase name on the compiled program's op metadata (device
             # timeline) + per-step tile-slot accounting; all trace-time
@@ -865,7 +940,27 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                     _count_step_modes(
                         "cholesky_dist",
                         *((1, 0) if lookahead and k + 1 < nt else (0, 1)))
-                lt, la = step(lt, k, la)
+                if comm_la:
+                    # comm look-ahead (docs/comm_overlap.md): step k+1's
+                    # panel chain — its bcast2d/bcast/all_gather included
+                    # — is emitted between step k's strip and step k's
+                    # bulk product, reading only the carried strip values
+                    ch = ch_next if ch_next is not None \
+                        else panel_chain(lt, k, la)
+                    lt, la = step_pre(lt, k, ch)
+                    ch_next = None
+                    if k + 1 < nt and la is not None:
+                        ch_next = panel_chain(None, k + 1, la)
+                        n_row, n_col = chain_comm_counts(k + 1)
+                        cc.record_overlapped("cholesky_dist", ROW_AXIS,
+                                             n_row)
+                        cc.record_overlapped("cholesky_dist", COL_AXIS,
+                                             n_col)
+                    lt = step_bulk(lt, k, ch, la is not None)
+                else:
+                    ch = panel_chain(lt, k, la)
+                    lt, la = step_pre(lt, k, ch)
+                    lt = step_bulk(lt, k, ch, la is not None)
         if with_info:
             return lt, _dist_factor_info(lt, dist)
         return lt
@@ -941,11 +1036,10 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             is_owner_r = ctx.rank_r == owner_r
             is_owner_c = ctx.rank_c == owner_c
 
-            # -- diag tile -> everyone ----------------------------------
+            # -- diag tile -> everyone (one fused 2D collective) --------
             cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0),
                                          (1, 1, mb, mb))[0, 0]
-            diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r),
-                            COL_AXIS, owner_c)
+            diag = cc.bcast2d(cand, owner_r, owner_c)
             ts = jnp.minimum(mb, n - k * mb)
             pad = jnp.arange(mb) >= ts   # short-edge mask
             diag = pad_diag_identity_dyn(diag, ts)
@@ -1084,12 +1178,15 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             is_owner_r = ctx.rank_r == owner_r
             is_owner_c = ctx.rank_c == owner_c
 
-            # -- diag tile -> everyone (pivot column is current: it took
-            # the k-1 strip eagerly and the k-2 bulk in body k-1) --------
+            # -- diag tile -> everyone (one fused 2D collective; pivot
+            # column is current: it took the k-1 strip eagerly and the
+            # k-2 bulk in body k-1). Emitted — like this body's panel
+            # bcast/all_gather below — BEFORE the deferred bulk of step
+            # k-1, so the scan form's collectives overlap the bulk MXU
+            # product by construction (docs/comm_overlap.md).
             cand = jax.lax.dynamic_slice(lt, (kr, kc, 0, 0),
                                          (1, 1, mb, mb))[0, 0]
-            diag = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r),
-                            COL_AXIS, owner_c)
+            diag = cc.bcast2d(cand, owner_r, owner_c)
             ts = jnp.minimum(mb, n - k * mb)
             pad = jnp.arange(mb) >= ts
             diag = pad_diag_identity_dyn(diag, ts)
@@ -1262,6 +1359,14 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             sub = lt[lu_r0:, lu_c0:]
             if lookahead:
                 _count_step_modes("cholesky_dist_scan", seg_len, 0)
+                # the pipelined body emits its diag bcast2d + panel bcast
+                # + transposed-panel all_gather ahead of the deferred
+                # bulk product of step k-1 — per step: 2 collectives per
+                # mesh axis run while the bulk MXU product is in flight
+                cc.record_overlapped("cholesky_dist_scan", ROW_AXIS,
+                                     2 * seg_len)
+                cc.record_overlapped("cholesky_dist_scan", COL_AXIS,
+                                     2 * seg_len)
                 if pvr is None:
                     pvr = jnp.zeros((ltr_s, mb, mb), lt.dtype)
                     pvc = jnp.zeros((ltc_s, mb, mb), lt.dtype)
@@ -1290,11 +1395,14 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
                           use_oz_pallas=False, scan=False, donate=False,
-                          lookahead=False, with_info=False):
+                          lookahead=False, comm_la=False, with_info=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
     donate_kw = donate_argnums_kw(donate, 0)
     if scan:
+        # comm_la is not a scan cache key: the pipelined scan body already
+        # emits its collectives ahead of the deferred bulk (callers
+        # normalize it to False — see cholesky())
         return jax.jit(_build_dist_cholesky_scan(
             dist, mesh, uplo, use_mxu=use_mxu, use_mixed=use_mixed,
             cplx=dtype.startswith("complex"),
@@ -1307,6 +1415,7 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                                         cplx=dtype.startswith("complex"),
                                         use_oz_pallas=use_oz_pallas,
                                         lookahead=lookahead,
+                                        comm_la=comm_la,
                                         with_info=with_info),
                    **donate_kw)
 
@@ -1366,10 +1475,14 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
     grid_shape = (mat.dist.grid_size.row, mat.dist.grid_size.col)
     # look-ahead step order (docs/lookahead.md): pipelined when the knob
     # resolves 1; the whole-matrix "xla" delegation has no step structure
-    # to pipeline
-    from ..config import resolved_cholesky_lookahead
+    # to pipeline. comm_lookahead (docs/comm_overlap.md) extends the
+    # carry across the collectives of the unrolled distributed builder —
+    # it rides the SSA carry, so it requires lookahead too.
+    from ..config import (resolved_cholesky_lookahead,
+                          resolved_comm_lookahead)
 
     lookahead = resolved_cholesky_lookahead() and trailing != "xla"
+    comm_la = lookahead and resolved_comm_lookahead()
     # entry span: host wall around trace+dispatch, unfenced (device
     # completion is the caller's fence — the miniapp span carries the
     # honest GFlop/s); attrs and the reference flop model build lazily
@@ -1377,6 +1490,7 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
         flops=total_ops(dt, n**3 / 6, n**3 / 6),
         n=n, nb=mat.block_size.row, uplo=uplo, dtype=dt.name,
         trailing=trailing, lookahead=int(lookahead),
+        comm_lookahead=int(comm_la),
         grid=f"{grid_shape[0]}x{grid_shape[1]}"))
     # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
     # resolution local and distributed, single owner in tile_ops.blas);
@@ -1440,7 +1554,11 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                                use_mxu, use_mixed,
                                use_oz_pallas,
                                scan=scan_mode, donate=donate,
-                               lookahead=lookahead, with_info=with_info)
+                               lookahead=lookahead,
+                               # scan bodies overlap by construction; the
+                               # hoist (and cache key) is unrolled-only
+                               comm_la=comm_la and not scan_mode,
+                               with_info=with_info)
     with entry_span, quiet_donation():
         if with_info:
             storage, info = fn(mat.storage)

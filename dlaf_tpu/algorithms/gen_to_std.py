@@ -279,7 +279,7 @@ def _row_strip_product(x_tile, y_tiles, cplx: bool, use_mxu: bool):
 
 
 def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
-                      lookahead=False):
+                      lookahead=False, comm_la=False):
     """shard_map'd blocked HEGST over the 2D mesh, k-loop unrolled.
 
     Per step k (uplo='L'): broadcast the L diag + col-panel (row-wise and
@@ -293,6 +293,19 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
     half-hemm. uplo='U' mirrors with row panels / the upper triangle.
     All index bounds are static per k; validity masks are the only traced
     rank-dependent values.
+
+    Phased like the distributed Cholesky (``panel_chain`` / ``step_pre``
+    / ``step_bulk``) so ``comm_la`` (``comm_lookahead=1``,
+    docs/comm_overlap.md) can emit step k+1's panel chain — the L-panel
+    broadcasts (constant operand!), the fused diag ``bcast2d``s, the
+    A-panel broadcast and both transposed-panel all_gathers — BEFORE
+    step k's bulk her2k product: the chain reads only ``ll`` and the
+    carried post-strip values, never ``lt`` after the bulk scatter. The
+    deferred-solve broadcast (``akj``/``ajk``) reads ``lt`` rows/cols
+    behind the pivot and stays in its serial position — the documented
+    exception (docs/comm_overlap.md). Phase order of ``lt`` mutations is
+    identical in both modes, so results are bitwise the same with the
+    knob on or off.
     """
     nt = dist.nr_tiles.row
     mb = dist.block_size.row
@@ -309,23 +322,31 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
                 + jnp.diag(pad.astype(lkk.dtype))
         return lkk
 
-    def step_L(lt, ll, k, rr, rc, la=None):
+    def _indices(k):
         owner_r = ud.rank_global_tile(k, Pr, sr)
         owner_c = ud.rank_global_tile(k, Qc, sc)
         kr = ud.local_tile_from_global_tile(k, Pr)
         kc = ud.local_tile_from_global_tile(k, Qc)
-        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
+        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
+        return owner_r, owner_c, kr, kc, lu_r, lu_c
+
+    # chain tuples: (lkk, lkk_inv, vpan_l, akk, w, pan, vb_a, vt_a, vt_l)
+    # with vpan_l the broadcast L panel, vb_a the broadcast A panel and
+    # vt_* the transposed panels; trailing entries None past the static
+    # early-exit points (mirroring the serial step's early returns).
+
+    def chain_L(lt, ll, k, la, rr, rc):
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
         is_owner_c = cc.this_rank(COL_AXIS) == owner_c
 
-        # -- L diag -> everyone --------------------------------------------
-        lkk = pad_lkk(cc.bcast(cc.bcast(ll[kr, kc], ROW_AXIS, owner_r),
-                               COL_AXIS, owner_c), k)
+        # -- L diag -> everyone (one fused 2D collective; constant ll) ----
+        lkk = pad_lkk(cc.bcast2d(ll[kr, kc], owner_r, owner_c), k)
         # lkk is already triangular: refined inverse computed ONCE per
         # step, shared by the prev-panel solve, diag hegst and panel trsm
         lkk_inv = _step_inv("L", lkk)
 
-        # -- L col-panel (rows > k) row-broadcast --------------------------
-        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
+        # -- L col-panel (rows > k) row-broadcast (constant ll) -----------
         nrows = ltr - lu_r
         g_rows = (lu_r + jnp.arange(max(nrows, 1))) * Pr + rr
         row_valid = (g_rows > k) & (g_rows < nt)
@@ -335,9 +356,53 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
                                       ll[lu_r:, kc], 0), COL_AXIS, owner_c)
             vr_l = jnp.where(row_valid[:, None, None], vr_l, 0)
 
-        # -- deferred trailing-solve updates of previous panels ------------
-        # (reference impl.h:327-372: only tasks involving the k-th panel of
-        # L run at iteration k, so every previous panel updates here)
+        # -- diag hegst (redundant on every rank) -------------------------
+        # lookahead carry (next-column strip of step k-1,
+        # docs/lookahead.md): the hegst-diag chain consumes it directly —
+        # correct on the owner (the only contributor bcast/keep select)
+        cand = lt[kr, kc] if la is None else la[0][kr - la[1]]
+        akk = cc.bcast2d(cand, owner_r, owner_c)
+        w = _hegst_diag("L", akk, lkk, inv=lkk_inv)
+        if k == nt - 1 or nrows == 0:
+            return lkk, lkk_inv, vr_l, akk, w, None, None, None, None
+
+        # -- panel: trsm right with Lkk + first half-hemm -----------------
+        pan = tb.trsm_panel("R", "L", "C", "N", lkk,
+                            lt[lu_r:, kc] if la is None
+                            else la[0][lu_r - la[1]:],
+                            inv_a=lkk_inv)
+        pan = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
+        pan = jnp.where(row_valid[:, None, None], pan, 0)
+        ncols = ltc - lu_c
+        if ncols == 0:
+            return lkk, lkk_inv, vr_l, akk, w, pan, None, None, None
+
+        # -- A panel broadcast + transposed panels ------------------------
+        g_cols = (lu_c + jnp.arange(ncols)) * Qc + rc
+        col_valid = (g_cols > k) & (g_cols < nt)
+        ctx = DistContext(dist)
+        keep = (is_owner_c & row_valid)[:, None, None]
+        vr_a = cc.bcast(jnp.where(keep, pan, 0), COL_AXIS, owner_c)
+        vc_a = transpose_col_to_rows(ctx, vr_a, lu_r, g_cols)
+        vc_l = transpose_col_to_rows(ctx, vr_l, lu_r, g_cols)
+        vc_a = jnp.where(col_valid[:, None, None], vc_a, 0)
+        vc_l = jnp.where(col_valid[:, None, None], vc_l, 0)
+        return lkk, lkk_inv, vr_l, akk, w, pan, vr_a, vc_a, vc_l
+
+    def step_pre_L(lt, k, ch, rr, rc):
+        lkk, lkk_inv, vr_l, akk, w, pan, vr_a, vc_a, vc_l = ch
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+        nrows = ltr - lu_r
+        g_rows = (lu_r + jnp.arange(max(nrows, 1))) * Pr + rr
+        row_valid = (g_rows > k) & (g_rows < nt)
+
+        # -- deferred trailing-solve updates of previous panels -----------
+        # (reference impl.h:327-372: only tasks involving the k-th panel
+        # of L run at iteration k, so every previous panel updates here).
+        # The akj broadcast reads lt rows behind the pivot — the one
+        # collective comm_la does NOT hoist (docs/comm_overlap.md).
         lc_ub = ceil_div(k, Qc)   # max local cols with global col < k
         if lc_ub > 0:
             g_pcols = jnp.arange(lc_ub) * Qc + rc
@@ -345,9 +410,9 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
             rowk = lt[kr, :lc_ub]
             rowk_new = tb.trsm_panel("L", "L", "N", "N", lkk, rowk,
                                      inv_a=lkk_inv)
-            keep = (is_owner_r & pcol_valid)[:, None, None]
-            lt = lt.at[kr, :lc_ub].set(jnp.where(keep, rowk_new, rowk))
-            akj = cc.bcast(jnp.where(keep, rowk_new, 0), ROW_AXIS, owner_r)
+            keepp = (is_owner_r & pcol_valid)[:, None, None]
+            lt = lt.at[kr, :lc_ub].set(jnp.where(keepp, rowk_new, rowk))
+            akj = cc.bcast(jnp.where(keepp, rowk_new, 0), ROW_AXIS, owner_r)
             if nrows > 0:
                 upd = _pair_product(vr_l, jnp.conj(jnp.swapaxes(
                     akj, -1, -2)), cplx, use_mxu)
@@ -355,71 +420,62 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
                          )[:, :, None, None]
                 lt = lt.at[lu_r:, :lc_ub].add(-jnp.where(mask4, upd, 0))
 
-        # -- diag hegst (redundant on every rank) --------------------------
-        # lookahead carry (next-column strip of step k-1, docs/lookahead.md):
-        # the hegst-diag chain consumes it directly — correct on the owner
-        # (the only contributor bcast/keep select)
-        cand = lt[kr, kc] if la is None else la[0][kr - la[1]]
-        akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
-        w = _hegst_diag("L", akk, lkk, inv=lkk_inv)
+        # -- diag write ---------------------------------------------------
         lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
                                          tb.tri_mask(w, "L")
                                          + tb.tri_mask(akk, "U", k=-1),
                                          lt[kr, kc]))
-        if k == nt - 1 or nrows == 0:
+        if pan is None:
             return lt, None
 
-        # -- panel: trsm right with Lkk + first half-hemm ------------------
-        pan = tb.trsm_panel("R", "L", "C", "N", lkk,
-                            lt[lu_r:, kc] if la is None
-                            else la[0][lu_r - la[1]:],
-                            inv_a=lkk_inv)
-        pan = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
-        pan = jnp.where(row_valid[:, None, None], pan, 0)
         keep = (is_owner_c & row_valid)[:, None, None]
         lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan, lt[lu_r:, kc]))
-
-        # -- A panel broadcast + transposed panels -------------------------
-        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
-        ncols = ltc - lu_c
-        if ncols == 0:
+        if vc_l is None:
             # no trailing columns on any rank; finish the second half-hemm
             pan2 = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
             lt = lt.at[lu_r:, kc].set(
                 jnp.where(keep, pan2, lt[lu_r:, kc]))
             return lt, None
-        g_cols = (lu_c + jnp.arange(ncols)) * Qc + rc
-        col_valid = (g_cols > k) & (g_cols < nt)
-        ctx = DistContext(dist)
-        vr_a = cc.bcast(jnp.where(keep, pan, 0), COL_AXIS, owner_c)
-        vc_a = transpose_col_to_rows(ctx, vr_a, lu_r, g_cols)
-        vc_l = transpose_col_to_rows(ctx, vr_l, lu_r, g_cols)
-        vc_a = jnp.where(col_valid[:, None, None], vc_a, 0)
-        vc_l = jnp.where(col_valid[:, None, None], vc_l, 0)
+        if not (lookahead and k + 1 < nt):
+            return lt, None
 
-        # -- her2k trailing: A_ij -= P_i L_jk^H + L_ik P_j^H ---------------
+        # next panel column of the her2k first (my kc1-slot transposed
+        # tiles — the exact tiles the bulk pair product would use),
+        # carried to step k+1's hegst-diag/panel chain
+        tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
+        kc1 = ud.local_tile_from_global_tile(k + 1, Qc)
+        owner_c1 = ud.rank_global_tile(k + 1, Qc, sc)
+        own_c1 = cc.this_rank(COL_AXIS) == owner_c1
+        updc = _col_strip_product(vr_a, vc_l[kc1 - lu_c], cplx, use_mxu) \
+            + _col_strip_product(vr_l, vc_a[kc1 - lu_c], cplx, use_mxu)
+        below1 = row_valid & (g_rows > k + 1)
+        ondiag1 = row_valid & (g_rows == k + 1)
+        m3 = (below1[:, None, None] | (ondiag1[:, None, None] & tril_m)) \
+            & own_c1
+        new_col = lt[lu_r:, kc1] - jnp.where(m3, updc,
+                                             jnp.zeros_like(updc))
+        lt = lt.at[lu_r:, kc1].set(new_col)
+        return lt, (new_col, lu_r)
+
+    def step_bulk_L(lt, k, ch, stripped, rr, rc):
+        lkk, lkk_inv, vr_l, akk, w, pan, vr_a, vc_a, vc_l = ch
+        if pan is None or vc_l is None:
+            return lt
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+        nrows, ncols = ltr - lu_r, ltc - lu_c
+        g_rows = (lu_r + jnp.arange(nrows)) * Pr + rr
+        g_cols = (lu_c + jnp.arange(ncols)) * Qc + rc
+        row_valid = (g_rows > k) & (g_rows < nt)
+        col_valid = (g_cols > k) & (g_cols < nt)
+        keep = (is_owner_c & row_valid)[:, None, None]
+
+        # -- her2k trailing: A_ij -= P_i L_jk^H + L_ik P_j^H --------------
         pair = row_valid[:, None] & col_valid[None, :]
         below = pair & (g_rows[:, None] > g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
         tril_m = jnp.tril(jnp.ones((mb, mb), dtype=bool))
-        la_next = None
-        if lookahead and k + 1 < nt:
-            # next panel column of the her2k first (my kc1-slot transposed
-            # tiles — the exact tiles the bulk pair product would use),
-            # carried to step k+1's hegst-diag/panel chain
-            kc1 = ud.local_tile_from_global_tile(k + 1, Qc)
-            owner_c1 = ud.rank_global_tile(k + 1, Qc, sc)
-            own_c1 = cc.this_rank(COL_AXIS) == owner_c1
-            updc = _col_strip_product(vr_a, vc_l[kc1 - lu_c], cplx, use_mxu) \
-                + _col_strip_product(vr_l, vc_a[kc1 - lu_c], cplx, use_mxu)
-            below1 = row_valid & (g_rows > k + 1)
-            ondiag1 = row_valid & (g_rows == k + 1)
-            m3 = (below1[:, None, None] | (ondiag1[:, None, None] & tril_m)) \
-                & own_c1
-            new_col = lt[lu_r:, kc1] - jnp.where(m3, updc,
-                                                 jnp.zeros_like(updc))
-            lt = lt.at[lu_r:, kc1].set(new_col)
-            la_next = (new_col, lu_r)
+        if stripped:
             notnext = g_cols != k + 1
             below = below & notnext[None, :]
             ondiag = ondiag & notnext[None, :]
@@ -428,25 +484,19 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
         mask4 = below[:, :, None, None] | (ondiag[:, :, None, None] & tril_m)
         lt = lt.at[lu_r:, lu_c:].add(-jnp.where(mask4, upd, 0))
 
-        # -- second half-hemm on the panel ---------------------------------
+        # -- second half-hemm on the panel --------------------------------
         pan2 = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
         lt = lt.at[lu_r:, kc].set(jnp.where(keep, pan2, lt[lu_r:, kc]))
-        return lt, la_next
+        return lt
 
-    def step_U(lt, ll, k, rr, rc, la=None):
-        owner_r = ud.rank_global_tile(k, Pr, sr)
-        owner_c = ud.rank_global_tile(k, Qc, sc)
-        kr = ud.local_tile_from_global_tile(k, Pr)
-        kc = ud.local_tile_from_global_tile(k, Qc)
+    def chain_U(lt, ll, k, la, rr, rc):
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
         is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
-        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
 
-        ukk = pad_lkk(cc.bcast(cc.bcast(ll[kr, kc], ROW_AXIS, owner_r),
-                               COL_AXIS, owner_c), k)
+        ukk = pad_lkk(cc.bcast2d(ll[kr, kc], owner_r, owner_c), k)
         ukk_inv = _step_inv("U", ukk)
 
-        # -- U row-panel (cols > k) col-broadcast --------------------------
-        lu_c = max(0, -(-(k + 2 - Qc) // Qc))
+        # -- U row-panel (cols > k) col-broadcast (constant ll) -----------
         ncols = ltc - lu_c
         g_cols = (lu_c + jnp.arange(max(ncols, 1))) * Qc + rc
         col_valid = (g_cols > k) & (g_cols < nt)
@@ -456,7 +506,46 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
                                       ll[kr, lu_c:], 0), ROW_AXIS, owner_r)
             vc_u = jnp.where(col_valid[:, None, None], vc_u, 0)
 
-        # -- deferred right-solve updates of previous panel rows -----------
+        cand = lt[kr, kc] if la is None else la[0][kc - la[1]]
+        akk = cc.bcast2d(cand, owner_r, owner_c)
+        w = _hegst_diag("U", akk, ukk, inv=ukk_inv)
+        if k == nt - 1 or ncols == 0:
+            return ukk, ukk_inv, vc_u, akk, w, None, None, None, None
+
+        # -- panel: trsm left with Ukk^H + first half-hemm ----------------
+        pan = tb.trsm_panel("L", "U", "C", "N", ukk,
+                            lt[kr, lu_c:] if la is None
+                            else la[0][lu_c - la[1]:],
+                            inv_a=ukk_inv)
+        pan = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
+        pan = jnp.where(col_valid[:, None, None], pan, 0)
+        nrows = ltr - lu_r
+        if nrows == 0:
+            return ukk, ukk_inv, vc_u, akk, w, pan, None, None, None
+
+        g_rows = (lu_r + jnp.arange(nrows)) * Pr + rr
+        row_valid = (g_rows > k) & (g_rows < nt)
+        ctx = DistContext(dist)
+        keep = (is_owner_r & col_valid)[:, None, None]
+        vc_a = cc.bcast(jnp.where(keep, pan, 0), ROW_AXIS, owner_r)
+        vr_a = transpose_row_to_cols(ctx, vc_a, lu_c, g_rows)
+        vr_u = transpose_row_to_cols(ctx, vc_u, lu_c, g_rows)
+        vr_a = jnp.where(row_valid[:, None, None], vr_a, 0)
+        vr_u = jnp.where(row_valid[:, None, None], vr_u, 0)
+        return ukk, ukk_inv, vc_u, akk, w, pan, vc_a, vr_a, vr_u
+
+    def step_pre_U(lt, k, ch, rr, rc):
+        ukk, ukk_inv, vc_u, akk, w, pan, vc_a, vr_a, vr_u = ch
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        is_owner_c = cc.this_rank(COL_AXIS) == owner_c
+        ncols = ltc - lu_c
+        g_cols = (lu_c + jnp.arange(max(ncols, 1))) * Qc + rc
+        col_valid = (g_cols > k) & (g_cols < nt)
+
+        # -- deferred right-solve updates of previous panel rows ----------
+        # (the ajk broadcast reads lt cols behind the pivot — the one
+        # collective comm_la does NOT hoist, docs/comm_overlap.md)
         lr_ub = ceil_div(k, Pr)   # max local rows with global row < k
         if lr_ub > 0:
             g_prows = jnp.arange(lr_ub) * Pr + rr
@@ -464,9 +553,9 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
             colk = lt[:lr_ub, kc]
             colk_new = tb.trsm_panel("R", "U", "N", "N", ukk, colk,
                                      inv_a=ukk_inv)
-            keep = (is_owner_c & prow_valid)[:, None, None]
-            lt = lt.at[:lr_ub, kc].set(jnp.where(keep, colk_new, colk))
-            ajk = cc.bcast(jnp.where(keep, colk_new, 0), COL_AXIS, owner_c)
+            keepp = (is_owner_c & prow_valid)[:, None, None]
+            lt = lt.at[:lr_ub, kc].set(jnp.where(keepp, colk_new, colk))
+            ajk = cc.bcast(jnp.where(keepp, colk_new, 0), COL_AXIS, owner_c)
             if ncols > 0:
                 # A_ji -= A_jk U_ki: pair product with x = A_jk tiles,
                 # y[c] = conj(U_ki)^T so conj(y)^T = U_ki
@@ -476,67 +565,62 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
                          )[:, :, None, None]
                 lt = lt.at[:lr_ub, lu_c:].add(-jnp.where(mask4, upd, 0))
 
-        cand = lt[kr, kc] if la is None else la[0][kc - la[1]]
-        akk = cc.bcast(cc.bcast(cand, ROW_AXIS, owner_r), COL_AXIS, owner_c)
-        w = _hegst_diag("U", akk, ukk, inv=ukk_inv)
         lt = lt.at[kr, kc].set(jnp.where(is_owner_r & is_owner_c,
                                          tb.tri_mask(w, "U")
                                          + tb.tri_mask(akk, "L", k=-1),
                                          lt[kr, kc]))
-        if k == nt - 1 or ncols == 0:
+        if pan is None:
             return lt, None
 
-        # -- panel: trsm left with Ukk^H + first half-hemm -----------------
-        pan = tb.trsm_panel("L", "U", "C", "N", ukk,
-                            lt[kr, lu_c:] if la is None
-                            else la[0][lu_c - la[1]:],
-                            inv_a=ukk_inv)
-        pan = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
-        pan = jnp.where(col_valid[:, None, None], pan, 0)
         keep = (is_owner_r & col_valid)[:, None, None]
         lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan, lt[kr, lu_c:]))
-
-        lu_r = max(0, -(-(k + 2 - Pr) // Pr))
-        nrows = ltr - lu_r
-        if nrows == 0:
+        if vr_u is None:
             pan2 = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
             lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan2, lt[kr, lu_c:]))
             return lt, None
-        g_rows = (lu_r + jnp.arange(nrows)) * Pr + rr
-        row_valid = (g_rows > k) & (g_rows < nt)
-        ctx = DistContext(dist)
-        vc_a = cc.bcast(jnp.where(keep, pan, 0), ROW_AXIS, owner_r)
-        vr_a = transpose_row_to_cols(ctx, vc_a, lu_c, g_rows)
-        vr_u = transpose_row_to_cols(ctx, vc_u, lu_c, g_rows)
-        vr_a = jnp.where(row_valid[:, None, None], vr_a, 0)
-        vr_u = jnp.where(row_valid[:, None, None], vr_u, 0)
+        if not (lookahead and k + 1 < nt):
+            return lt, None
 
-        # -- her2k trailing (upper): A_ij -= P_i^H U_kj + U_ki^H P_j -------
+        # mirrored split: next block row of the her2k first (carried)
+        triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
+        kr1 = ud.local_tile_from_global_tile(k + 1, Pr)
+        owner_r1 = ud.rank_global_tile(k + 1, Pr, sr)
+        own_r1 = cc.this_rank(ROW_AXIS) == owner_r1
+        xa = jnp.conj(jnp.swapaxes(vr_a[kr1 - lu_r], -1, -2))
+        xu = jnp.conj(jnp.swapaxes(vr_u[kr1 - lu_r], -1, -2))
+        updr = _row_strip_product(
+            xa, jnp.conj(jnp.swapaxes(vc_u, -1, -2)), cplx, use_mxu) \
+            + _row_strip_product(
+                xu, jnp.conj(jnp.swapaxes(vc_a, -1, -2)), cplx, use_mxu)
+        above1 = col_valid & (g_cols > k + 1)
+        ondiag1 = col_valid & (g_cols == k + 1)
+        m3 = (above1[:, None, None] | (ondiag1[:, None, None] & triu_m)) \
+            & own_r1
+        new_row = lt[kr1, lu_c:] - jnp.where(m3, updr,
+                                             jnp.zeros_like(updr))
+        lt = lt.at[kr1, lu_c:].set(new_row)
+        return lt, (new_row, lu_c)
+
+    def step_bulk_U(lt, k, ch, stripped, rr, rc):
+        ukk, ukk_inv, vc_u, akk, w, pan, vc_a, vr_a, vr_u = ch
+        if pan is None or vr_u is None:
+            return lt
+        owner_r, owner_c, kr, kc, lu_r, lu_c = _indices(k)
+        is_owner_r = cc.this_rank(ROW_AXIS) == owner_r
+        nrows, ncols = ltr - lu_r, ltc - lu_c
+        g_rows = (lu_r + jnp.arange(nrows)) * Pr + rr
+        g_cols = (lu_c + jnp.arange(ncols)) * Qc + rc
+        row_valid = (g_rows > k) & (g_rows < nt)
+        col_valid = (g_cols > k) & (g_cols < nt)
+        keep = (is_owner_r & col_valid)[:, None, None]
+
+        # -- her2k trailing (upper): A_ij -= P_i^H U_kj + U_ki^H P_j ------
         # tile (i, j), i < j: A_ij -= conj(P_ki)^T U_kj + conj(U_ki)^T P_kj
         pair = row_valid[:, None] & col_valid[None, :]
         above = pair & (g_rows[:, None] < g_cols[None, :])
         ondiag = pair & (g_rows[:, None] == g_cols[None, :])
         triu_m = jnp.triu(jnp.ones((mb, mb), dtype=bool))
-        la_next = None
-        if lookahead and k + 1 < nt:
-            # mirrored split: next block row of the her2k first (carried)
-            kr1 = ud.local_tile_from_global_tile(k + 1, Pr)
-            owner_r1 = ud.rank_global_tile(k + 1, Pr, sr)
-            own_r1 = cc.this_rank(ROW_AXIS) == owner_r1
-            xa = jnp.conj(jnp.swapaxes(vr_a[kr1 - lu_r], -1, -2))
-            xu = jnp.conj(jnp.swapaxes(vr_u[kr1 - lu_r], -1, -2))
-            updr = _row_strip_product(
-                xa, jnp.conj(jnp.swapaxes(vc_u, -1, -2)), cplx, use_mxu) \
-                + _row_strip_product(
-                    xu, jnp.conj(jnp.swapaxes(vc_a, -1, -2)), cplx, use_mxu)
-            above1 = col_valid & (g_cols > k + 1)
-            ondiag1 = col_valid & (g_cols == k + 1)
-            m3 = (above1[:, None, None] | (ondiag1[:, None, None] & triu_m)) \
-                & own_r1
-            new_row = lt[kr1, lu_c:] - jnp.where(m3, updr,
-                                                 jnp.zeros_like(updr))
-            lt = lt.at[kr1, lu_c:].set(new_row)
-            la_next = (new_row, lu_c)
+        if stripped:
             notnext = g_rows != k + 1
             above = above & notnext[:, None]
             ondiag = ondiag & notnext[:, None]
@@ -551,16 +635,54 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
 
         pan2 = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
         lt = lt.at[kr, lu_c:].set(jnp.where(keep, pan2, lt[kr, lu_c:]))
-        return lt, la_next
+        return lt
 
-    step = step_L if uplo == "L" else step_U
+    chain, step_pre, step_bulk = (
+        (chain_L, step_pre_L, step_bulk_L) if uplo == "L"
+        else (chain_U, step_pre_U, step_bulk_U))
+
+    def chain_comm_counts(k):
+        """Collectives ``chain(k)`` emits per mesh axis (trace-time
+        statics mirroring the chain's early-exit structure): two fused
+        bcast2d (L diag + A diag) on each axis, the factor-panel
+        broadcast whenever trailing slots exist, and — on a full chain —
+        the A-panel broadcast plus the two transposed-panel
+        all_gathers."""
+        _, _, _, _, lu_r, lu_c = _indices(k)
+        nrows, ncols = ltr - lu_r, ltc - lu_c
+        if uplo == "L":
+            full = k < nt - 1 and nrows > 0 and ncols > 0
+            row = 2 + (2 if full else 0)
+            col = 2 + (1 if nrows > 0 else 0) + (1 if full else 0)
+        else:
+            full = k < nt - 1 and ncols > 0 and nrows > 0
+            row = 2 + (1 if ncols > 0 else 0) + (1 if full else 0)
+            col = 2 + (2 if full else 0)
+        return row, col
 
     def transform(lt, ll):
         rr = (cc.this_rank(ROW_AXIS) - sr) % Pr
         rc = (cc.this_rank(COL_AXIS) - sc) % Qc
         la = None
+        ch_next = None
         for k in range(nt):
-            lt, la = step(lt, ll, k, rr, rc, la)
+            if comm_la:
+                # step k+1's panel chain (collectives included) emitted
+                # between step k's strip and step k's bulk her2k
+                ch = ch_next if ch_next is not None \
+                    else chain(lt, ll, k, la, rr, rc)
+                lt, la = step_pre(lt, k, ch, rr, rc)
+                ch_next = None
+                if k + 1 < nt and la is not None:
+                    ch_next = chain(None, ll, k + 1, la, rr, rc)
+                    n_row, n_col = chain_comm_counts(k + 1)
+                    cc.record_overlapped("hegst_dist", ROW_AXIS, n_row)
+                    cc.record_overlapped("hegst_dist", COL_AXIS, n_col)
+                lt = step_bulk(lt, k, ch, la is not None, rr, rc)
+            else:
+                ch = chain(lt, ll, k, la, rr, rc)
+                lt, la = step_pre(lt, k, ch, rr, rc)
+                lt = step_bulk(lt, k, ch, la is not None, rr, rc)
         return lt
 
     return shard_map(transform, mesh=mesh,
@@ -571,10 +693,10 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
 @register_program_cache
 @functools.lru_cache(maxsize=64)
 def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu, donate=False,
-                       lookahead=False):
+                       lookahead=False, comm_la=False):
     return jax.jit(_build_dist_hegst(dist, mesh, uplo, use_mxu=use_mxu,
                                      cplx=dtype.startswith("complex"),
-                                     lookahead=lookahead),
+                                     lookahead=lookahead, comm_la=comm_la),
                    **donate_argnums_kw(donate, 0))
 
 
@@ -640,10 +762,15 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
             return (res, info) if with_info else res
     # blocked forms take the same look-ahead split as the pipelined
     # Cholesky (docs/lookahead.md); twosolve inherits it through the
-    # triangular solver's own scan-mode gate above
-    from ..config import resolved_cholesky_lookahead
+    # triangular solver's own scan-mode gate above. comm_lookahead
+    # (docs/comm_overlap.md) hoists the distributed builder's panel
+    # collectives ahead of the bulk her2k — it rides the carry, so it
+    # requires lookahead too.
+    from ..config import (resolved_cholesky_lookahead,
+                          resolved_comm_lookahead)
 
     lookahead = resolved_cholesky_lookahead()
+    comm_la = lookahead and resolved_comm_lookahead()
     if not distributed:
         with entry_span, quiet_donation():
             g = tiles_to_global(a.storage, a.dist)
@@ -661,7 +788,8 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     dt = np.dtype(a.dtype)
     use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
     fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu,
-                            donate=donate, lookahead=lookahead)
+                            donate=donate, lookahead=lookahead,
+                            comm_la=comm_la)
     with entry_span, quiet_donation():
         res = a.with_storage(fn(a.storage, b_factor.storage))
         return (res, info) if with_info else res

@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from .. import obs
 from ..config import register_program_cache
 from ..common.asserts import dlaf_assert
+from ..comm import collectives as cc
 from ..comm.grid import COL_AXIS, ROW_AXIS
 from ..matrix.distribution import assert_slot_aligned
 from ..matrix.matrix import Matrix
@@ -202,7 +203,7 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
 
 
 def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
-                           lookahead=False):
+                           lookahead=False, comm_la=False):
     """``lax.scan`` form of the distributed solve (config
     ``dist_step_mode="scan"``): one compiled step body per telescoped
     segment, looped over the segment's steps — the same O(1)-compile /
@@ -296,7 +297,18 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
             following body's solve reads current data. Per-slot
             application order matches the serial body (bulk k-1 before
             strip k), so results are bitwise identical on the native
-            route."""
+            route.
+
+            ``comm_la`` (``comm_lookahead=1``, docs/comm_overlap.md)
+            additionally hoists this step's A-panel read — the
+            ``col_panel``/``row_panel`` broadcast, and for op != 'N' the
+            transpose-exchange all_gather — AHEAD of the deferred bulk
+            product: the panel reads only the constant ``lta``, so the
+            collective can run on the ICI while the bulk contraction is
+            in flight. The pivot solve's own panel broadcast and the
+            fused diag ``bcast2d`` already precede the bulk either way.
+            Pure emission reorder of identical values — bitwise-equal
+            results with the knob on or off."""
 
             def step(carry, i):
                 sub, pe, pxk = carry
@@ -313,19 +325,29 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                         sub, (row, 0, 0, 0), (1,) + sub.shape[1:])[0]
                     sub = jax.lax.dynamic_update_slice(
                         sub, jnp.where(own, xk, cur)[None], (row, 0, 0, 0))
-                    # deferred bulk of step k-1 (its next-pivot strip was
-                    # applied eagerly there; pe is pre-masked)
-                    sub = sub - tb.contract("rab,cbd->rcad", pe, pxk)
                     g = ctx_b.g_rows(lu0, cnt)
                     rem = ((g > k) if forward else (g < k)) & (g < nt)
-                    if op == "N":
-                        e = col_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+
+                    def epanel():
+                        if op == "N":
+                            e = col_panel_dyn(ctx_a, lta, k, lu=lu0,
+                                              count=cnt)
+                        else:
+                            rk = row_panel_dyn(ctx_a, lta, k, lu=lq0,
+                                               count=cnt_q)
+                            e = _tile_op(
+                                transpose_row_to_cols(ctx_a, rk, lq0, g), op)
+                        return jnp.where(rem[:, None, None], e,
+                                         jnp.zeros_like(e))
+
+                    if comm_la:
+                        # A-panel collectives emitted BEFORE the deferred
+                        # bulk of step k-1 (pe is pre-masked)
+                        e = epanel()
+                        sub = sub - tb.contract("rab,cbd->rcad", pe, pxk)
                     else:
-                        rk = row_panel_dyn(ctx_a, lta, k, lu=lq0,
-                                           count=cnt_q)
-                        e = _tile_op(
-                            transpose_row_to_cols(ctx_a, rk, lq0, g), op)
-                    e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                        sub = sub - tb.contract("rab,cbd->rcad", pe, pxk)
+                        e = epanel()
                     # eager next-pivot-row strip (slot holds global row
                     # knext only on its owner; gval-gating keeps every
                     # other rank's slot in the pending set instead)
@@ -352,16 +374,26 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                     (sub.shape[0], 1) + sub.shape[2:])[:, 0]
                 sub = jax.lax.dynamic_update_slice(
                     sub, jnp.where(own, xk, cur)[:, None], (0, col, 0, 0))
-                sub = sub - tb.contract("rab,cbd->rcad", pxk, pe)
                 g = ctx_b.g_cols(lu0, cnt)
                 rem = ((g > k) if forward else (g < k)) & (g < nt)
-                if op == "N":
-                    e = row_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+
+                def epanel():
+                    if op == "N":
+                        e = row_panel_dyn(ctx_a, lta, k, lu=lu0, count=cnt)
+                    else:
+                        ck = col_panel_dyn(ctx_a, lta, k, lu=lq0,
+                                           count=cnt_q)
+                        e = _tile_op(
+                            transpose_col_to_rows(ctx_a, ck, lq0, g), op)
+                    return jnp.where(rem[:, None, None], e,
+                                     jnp.zeros_like(e))
+
+                if comm_la:
+                    e = epanel()
+                    sub = sub - tb.contract("rab,cbd->rcad", pxk, pe)
                 else:
-                    ck = col_panel_dyn(ctx_a, lta, k, lu=lq0, count=cnt_q)
-                    e = _tile_op(
-                        transpose_col_to_rows(ctx_a, ck, lq0, g), op)
-                e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                    sub = sub - tb.contract("rab,cbd->rcad", pxk, pe)
+                    e = epanel()
                 cnext = ctx_b.kc(knext) - lu0
                 gval = jax.lax.dynamic_slice(g, (cnext,), (1,))[0]
                 hit = (gval == knext) & (knext >= 0) & (knext < nt)
@@ -410,6 +442,27 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
             sub = jax.lax.slice_in_dim(ltb, lu0, lu0 + cnt,
                                        axis=0 if side == "L" else 1)
             if lookahead:
+                # collectives emitted ahead of the deferred bulk
+                # (docs/comm_overlap.md): the diag bcast2d (one per
+                # axis) and the pivot panel broadcast (swept axis)
+                # precede it in the pipelined body regardless of the
+                # comm knob; comm_la additionally hoists the A-panel
+                # read — one broadcast on the opposite axis for
+                # op='N', else the source-panel broadcast plus the
+                # transpose-exchange all_gather
+                n_row = 1 + (side == "L")   # bcast2d + pivot panel bcast
+                n_col = 1 + (side == "R")
+                if comm_la:
+                    if op == "N":
+                        n_col += side == "L"   # opposite-axis panel bcast
+                        n_row += side == "R"
+                    else:                      # source bcast + all_gather
+                        n_row += 1
+                        n_col += 1
+                cc.record_overlapped("triangular_solve_scan",
+                                     ROW_AXIS, n_row * seg_len)
+                cc.record_overlapped("triangular_solve_scan",
+                                     COL_AXIS, n_col * seg_len)
                 if pe is None:
                     pe = jnp.zeros((cnt, mb, mb), ltb.dtype)
                     orth = ltb.shape[1] if side == "L" else ltb.shape[0]
@@ -634,10 +687,12 @@ def _unit_diag(t, diag):
 @register_program_cache
 @functools.lru_cache(maxsize=128)
 def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
-                       scan=False, donate_b=False, lookahead=False):
+                       scan=False, donate_b=False, lookahead=False,
+                       comm_la=False):
     if scan:
         built = _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op,
-                                       diag, dtype, lookahead=lookahead)
+                                       diag, dtype, lookahead=lookahead,
+                                       comm_la=comm_la)
     else:
         built = _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op,
                                   diag, dtype)
@@ -708,16 +763,19 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
     # on the swept axis — misalignment corrupts silently, so contract it
     assert_slot_aligned(a.dist, b.dist, rows=side == "L", cols=side == "R",
                         what="triangular_solve(A, B)")
-    from ..config import resolve_step_mode, resolved_cholesky_lookahead
+    from ..config import (resolve_step_mode, resolved_cholesky_lookahead,
+                          resolved_comm_lookahead)
 
     scan_mode = resolve_step_mode(a.dist.nr_tiles.row) == "scan"
+    # the pipelined scan body (same knob as the Cholesky look-ahead;
+    # docs/lookahead.md); comm_lookahead additionally hoists the A-panel
+    # collectives ahead of the deferred bulk (docs/comm_overlap.md)
+    la = scan_mode and resolved_cholesky_lookahead()
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
                             np.dtype(a.dtype).name,
                             scan=scan_mode, donate_b=donate_b,
-                            # the pipelined scan body (same knob as the
-                            # Cholesky look-ahead; docs/lookahead.md)
-                            lookahead=scan_mode
-                            and resolved_cholesky_lookahead())
+                            lookahead=la,
+                            comm_la=la and resolved_comm_lookahead())
     with entry_span, quiet_donation():
         res = b.with_storage(fn(a.storage, b.storage,
                                 jnp.asarray(alpha, b.dtype)))

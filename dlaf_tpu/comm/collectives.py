@@ -120,6 +120,54 @@ def _bcast_tree(x, axis: str, src: int):
     return val
 
 
+def bcast2d(x, owner_r: int, owner_c: int):
+    """Broadcast ``x`` from the single rank ``(owner_r, owner_c)`` to the
+    whole 2D mesh in ONE collective (the diagonal-tile broadcast of every
+    blocked factorization step — reference ``cholesky/impl.h:215-219``).
+
+    Replaces the two-hop ``bcast(bcast(x, 'row', r), 'col', c)``: under the
+    default mask+psum realization the two hops are two serialized
+    all-reduces on the step critical path; here the payload is masked to
+    the owning rank and ONE ``psum`` over BOTH mesh axes delivers it —
+    XLA lowers this to a single all-reduce over the combined replica
+    groups. Bitwise-identical to the two-hop form: either way the result
+    is the owner's value plus exact zeros (the same masked-add discipline,
+    including the ``-0.0 + 0.0 -> +0.0`` flattening any psum with more
+    than one participant performs).
+
+    ``bcast_impl="tree"`` has no 2-axis fusion (ppermute pairs live on one
+    axis), so it keeps the two-hop binomial trees.
+
+    Accounting: recorded once per axis under kind ``"bcast2d"`` so the
+    per-axis byte counters see the same per-axis payload the two-hop form
+    charged; the injection hook fires once (kind ``"bcast2d"``), and
+    ``health.inject.corrupt_collective("bcast", ...)`` matches it too.
+    """
+    from ..config import get_configuration
+
+    _record("bcast2d", ROW_AXIS, x)
+    _record("bcast2d", COL_AXIS, x)
+    x = _maybe_inject("bcast2d", ROW_AXIS, x)
+    if get_configuration().bcast_impl == "tree":
+        return _bcast_tree(_bcast_tree(x, ROW_AXIS, owner_r),
+                           COL_AXIS, owner_c)
+    mask = ((this_rank(ROW_AXIS) == owner_r)
+            & (this_rank(COL_AXIS) == owner_c)).astype(x.dtype)
+    return lax.psum(x * mask, (ROW_AXIS, COL_AXIS))
+
+
+def record_overlapped(algo: str, axis: str, n: int = 1) -> None:
+    """Trace-time accounting of HOISTED collectives (``comm_lookahead``,
+    docs/comm_overlap.md): each collective a distributed builder emits
+    BEFORE the preceding step's bulk trailing product — i.e. a transfer
+    XLA can run on the ICI while the MXU grinds the bulk gemms — bumps
+    ``dlaf_comm_overlapped_total{algo,axis}`` once per compiled program.
+    Same trace-time semantics as the byte counters above."""
+    if obs.metrics_active() and n:
+        obs.counter("dlaf_comm_overlapped_total", algo=algo,
+                    axis=axis).inc(n)
+
+
 def all_reduce(x, axis: str, op: str = "sum"):
     """All-reduce along ``axis`` (reference ``scheduleAllReduce``,
     ``kernels/all_reduce.h:67-138``). The rooted :func:`reduce` lowers

@@ -87,6 +87,28 @@ class Configuration:
     #: routes (same tile ops, same per-cell application order; enforced
     #: by tests/test_cholesky.py lookahead A/Bs). See docs/lookahead.md.
     cholesky_lookahead: str = "auto"
+    #: Communication look-ahead for the distributed builders
+    #: (docs/comm_overlap.md): "1" extends the ``cholesky_lookahead``
+    #: pipeline across the COLLECTIVES — step k+1's panel broadcast /
+    #: all-gather (and the fused diag ``bcast2d``) are emitted BEFORE
+    #: step k's bulk trailing product, so XLA's async collective
+    #: start/done pairs can run the ICI transfer while the MXU grinds
+    #: the bulk gemms (the reference hides the same transfer behind the
+    #: trailing update via sender pipelines, ``broadcast_panel.h`` +
+    #: ``impl.h:147-156``; arXiv:2112.09017 measures this overlap as the
+    #: difference between latency-bound and MXU-bound distributed
+    #: factorizations on TPU pods). "0" keeps the plain per-step
+    #: emission order. "auto" (default): 1 on TPU, 0 elsewhere. In the
+    #: unrolled builders the hoist rides the PR-2 SSA carry, so it only
+    #: takes effect when ``cholesky_lookahead`` also resolves 1 (the
+    #: scan builders' deferred-bulk bodies already emit their
+    #: collectives ahead of the deferred product — there the knob labels
+    #: the structure rather than changing it); the distributed
+    #: reduction_to_band builder pipelines its panel all-gather under
+    #: this knob alone. Results are bitwise-identical either way on the
+    #: native routes (same collectives, same payloads, same per-cell
+    #: application order; pinned by the comm A/Bs in tests/).
+    comm_lookahead: str = "auto"
     #: bt_band_to_tridiag reflector application: "blocked" (compact-WY
     #: staircase groups -> larft + two gemms per step level, the MXU form of
     #: the reference's b x b HH re-tiling) or "sweeps" (one batched rank-1
@@ -385,6 +407,7 @@ _VALID_CHOICES = {
     "secular_impl": ("native", "numpy"),
     "bt_b2t_impl": ("blocked", "sweeps"),
     "cholesky_lookahead": ("0", "1", "auto"),
+    "comm_lookahead": ("0", "1", "auto"),
     "f64_gemm": ("native", "mxu", "auto"),
     "f64_trsm": ("native", "mixed", "auto"),
     "ozaki_impl": ("jnp", "pallas"),
@@ -562,6 +585,20 @@ def resolved_cholesky_lookahead() -> bool:
                "TPU (config #1: 133 GF/s at N=4096 vs 514 at N=16384); "
                "the pipelined step order exposes panel k+1 to XLA while "
                "the bulk trailing update of step k is in flight") == "1"
+
+
+def resolved_comm_lookahead() -> bool:
+    """``comm_lookahead`` with "auto" resolved (True = collectives
+    hoisted): 1 on TPU, 0 elsewhere (see the knob docstring and
+    docs/comm_overlap.md)."""
+    return resolve_platform_auto(
+        get_configuration().comm_lookahead, knob="comm_lookahead",
+        tpu_choice="1", other_choice="0",
+        detail="ICI transfer time adds serially to the step chain unless "
+               "the next panel's collectives are emitted before the bulk "
+               "trailing product (arXiv:2112.09017's overlapped SUMMA "
+               "updates); off-TPU the thunk executor runs collectives "
+               "serially anyway") == "1"
 
 
 #: Step counts at which ``dist_step_mode="auto"`` switches to the scan

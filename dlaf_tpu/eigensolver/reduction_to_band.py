@@ -263,7 +263,7 @@ def _red2band_local_scan(a, *, nb: int):
 # Distributed
 # ---------------------------------------------------------------------------
 
-def _build_dist_red2band(dist, mesh, dtype, band):
+def _build_dist_red2band(dist, mesh, dtype, band, comm_la=False):
     """Distributed reduction with bandwidth ``band`` <= block size (``band``
     must divide it, so every sub-panel boundary offset is trace-time static).
 
@@ -274,6 +274,19 @@ def _build_dist_red2band(dist, mesh, dtype, band):
     a static in-tile offset, so tile-level validity masks simply become
     element-level masks; everything else (redundant panel factorization,
     W/M psums, X all_gather) is unchanged from the band == nb scheme.
+
+    ``comm_la`` (``comm_lookahead=1``, docs/comm_overlap.md) pipelines the
+    PANEL GATHER across the bulk rank-2 product: once X is formed, the
+    next panel's element columns take their rank-2 strip eagerly (the
+    exact dots the bulk product would compute for that tile-column slot),
+    panel p+1 is gathered (column broadcast + tile-row all_gather),
+    QR-factored and written back — all emitted BEFORE panel p's bulk
+    ``X V^H + V X^H`` contraction, which then excludes the already-
+    applied strip columns. W/M/X themselves stay on the critical path:
+    W reads the whole trailing matrix, so no deferral is possible there
+    (the same boundary the reference's hemmComputeX chain has). Results
+    are bitwise-identical with the knob on or off (same dots, same
+    per-cell application order).
     """
     nt = dist.nr_tiles.row
     nb = dist.block_size.row
@@ -281,19 +294,19 @@ def _build_dist_red2band(dist, mesh, dtype, band):
     b = band
     npan = ceil_div(n, b) - 1 if n else 0
 
-    def step(lt, taus_out, p):
+    def factor_panel(lt, taus_out, p):
+        """Gather + redundant QR + T factor + write-back of panel ``p``;
+        returns ``(lt, taus_out, (v, t))`` or ``(lt, taus_out, None)``
+        when no rank has sub-panel rows."""
         ctx = DistContext(dist)
         bdy = (p + 1) * b              # first eliminated element row
         tc = (p * b) // nb             # tile column holding the panel
         co = (p * b) % nb              # its in-tile column offset
 
-        # -- gather the full sub-panel, factor redundantly ------------------
         got = gather_sub_panel(ctx, lt, pb=p * b, b=b, n=n)
         if got is None:
-            return lt, taus_out
+            return lt, taus_out, None
         pan, lu, tr0, ro, row_val_e, g_rows = got
-        nrows = ctx.ltr - lu
-        arange_nb = jnp.arange(nb)
         m_p = (nt - tr0) * nb - ro
         vfull, taus = panel_qr(pan)
         ntau = taus.shape[0]
@@ -307,11 +320,8 @@ def _build_dist_red2band(dist, mesh, dtype, band):
         v = jnp.tril(vfull, -1) + jnp.eye(m_p, b, dtype=pan.dtype)
         t = larft(v, taus)
 
-        def tiles_of(mat):
-            return pad_sub_panel_to_tiles(ctx, mat, tr0=tr0, ro=ro)
-
-        # -- write the factored panel back (owner column, my rows) ----------
-        vtiles = tiles_of(vfull)
+        # -- write the factored panel back (owner column, my rows) --------
+        vtiles = pad_sub_panel_to_tiles(ctx, vfull, tr0=tr0, ro=ro)
         sel = jnp.clip(g_rows - tr0, 0, nt - tr0 - 1)
         my_new = vtiles[sel]
         keep = (ctx.rank_c == ctx.owner_c(tc)) & row_val_e
@@ -319,52 +329,132 @@ def _build_dist_red2band(dist, mesh, dtype, band):
         col_block = col_block.at[:, :, co:co + b].set(
             jnp.where(keep[:, :, None], my_new, col_block[:, :, co:co + b]))
         lt = lt.at[lu:, ctx.kc(tc)].set(col_block)
+        return lt, taus_out, (v, t)
 
-        # -- trailing update ------------------------------------------------
+    def trailing_ops(lt, p, v, t, strip_next):
+        """Panel p's two-sided update UP TO the bulk rank-2 product:
+        W/M/X (their psums + the X all_gather are panel p's own latency
+        chain) and — when ``strip_next`` — the eager rank-2 strip of the
+        NEXT panel's element columns, so the next panel's gather reads
+        final values before the bulk is emitted. Returns ``(lt, ops)``;
+        ops is None on the no-trailing early-outs."""
+        ctx = DistContext(dist)
+        from ..common.index2d import GlobalElementIndex
+        from ..matrix.views import SubMatrixView
+
+        bdy = (p + 1) * b
+        body = SubMatrixView(ctx.dist, GlobalElementIndex(bdy, p * b))
+        tr0, ro = body.begin_tile.row, body.origin_in_tile.row
+        lu = ctx.row_start(tr0)
+        nrows = ctx.ltr - lu
         luc = ctx.col_start(tr0)
         ncols = ctx.ltc - luc
         if ncols == 0 or nrows == 0:
-            return lt, taus_out
+            return lt, None
+        arange_nb = jnp.arange(nb)
+        g_rows = ctx.g_rows(lu, nrows)
+        g_erows = g_rows[:, None] * nb + arange_nb[None, :]
+        row_val_e = (g_erows >= bdy) & (g_erows < n)
+        sel = jnp.clip(g_rows - tr0, 0, nt - tr0 - 1)
         g_cols = ctx.g_cols(luc, ncols)
         g_ecols = g_cols[:, None] * nb + arange_nb[None, :]
         col_val_e = (g_ecols >= bdy) & (g_ecols < n)       # (ncols, nb)
         selc = jnp.clip(g_cols - tr0, 0, nt - tr0 - 1)
+
+        def tiles_of(mat):
+            return pad_sub_panel_to_tiles(ctx, mat, tr0=tr0, ro=ro)
+
         v_tiles = tiles_of(v)
         vt_tiles = tiles_of(v @ t)
         vtl = jnp.where(col_val_e[:, :, None], vt_tiles[selc],
-                        jnp.zeros((ncols, nb, b), dtype=pan.dtype))
+                        jnp.zeros((ncols, nb, b), dtype=v.dtype))
         atr = lt[lu:, luc:]
         atr = jnp.where((row_val_e[:, None, :, None]
                          & col_val_e[None, :, None, :]), atr,
                         jnp.zeros_like(atr))
-        # W partial over my local cols -> psum along 'col' (replicates W rows
-        # across each grid row)
+        # W partial over my local cols -> psum along 'col' (replicates W
+        # rows across each grid row)
         w_loc = tb.contract("rcab,cbd->rad", atr, vtl)
         w_loc = cc.all_reduce(w_loc, COL_AXIS)           # (nrows, nb, b)
         # M = V^H W partial over my rows -> psum along 'row'
         vr = jnp.where(row_val_e[:, :, None], v_tiles[sel],
-                       jnp.zeros((nrows, nb, b), dtype=pan.dtype))
+                       jnp.zeros((nrows, nb, b), dtype=v.dtype))
         m_mat = tb.contract("rab,rad->bd", jnp.conj(vr), w_loc)
-        m_mat = cc.all_reduce(m_mat, ROW_AXIS)           # replicated everywhere
+        m_mat = cc.all_reduce(m_mat, ROW_AXIS)           # replicated
         x_loc = w_loc - 0.5 * jnp.einsum("rab,bd->rad", vr,
                                          t.conj().T @ m_mat,
-                                         preferred_element_type=atr.dtype)
+                                         preferred_element_type=lt.dtype)
         # full X (ordered) for column-side updates
-        xfull = gather_col_panel_ordered(ctx, x_loc, tr0, lu)  # (nt-tr0, nb, b)
+        xfull = gather_col_panel_ordered(ctx, x_loc, tr0, lu)  # (nt-tr0,..)
         xc = jnp.where(col_val_e[:, :, None], xfull[selc],
-                       jnp.zeros((ncols, nb, b), dtype=pan.dtype))
+                       jnp.zeros((ncols, nb, b), dtype=v.dtype))
         vc = jnp.where(col_val_e[:, :, None], v_tiles[selc],
-                       jnp.zeros((ncols, nb, b), dtype=pan.dtype))
+                       jnp.zeros((ncols, nb, b), dtype=v.dtype))
         xr = jnp.where(row_val_e[:, :, None], x_loc, jnp.zeros_like(x_loc))
+        stripped = False
+        if strip_next:
+            # -- eager strip of the next panel's element columns
+            # [bdy, bdy+b): the SAME dots the bulk computes for that
+            # tile-column slot (one narrow contraction — bitwise-equal
+            # cells), applied before the gather so panel p+1 reads final
+            # values; the bulk below masks these columns out
+            tc1 = bdy // nb
+            co1 = bdy % nb
+            idx1 = ctx.kc(tc1) - luc
+            own1 = ctx.rank_c == ctx.owner_c(tc1)
+            strip_upd = tb.contract("rad,bd->rab", xr, jnp.conj(vc[idx1])) \
+                + tb.contract("rad,bd->rab", vr, jnp.conj(xc[idx1]))
+            smask = (arange_nb >= co1) & (arange_nb < co1 + b)
+            cur = lt[lu:, luc + idx1]
+            lt = lt.at[lu:, luc + idx1].set(
+                cur - jnp.where(smask[None, None, :] & own1, strip_upd, 0))
+            stripped = True
+        return lt, (lu, luc, xr, vr, xc, vc, g_ecols, bdy, stripped)
+
+    def apply_bulk(lt, ops):
+        """The bulk rank-2 product ``A -= X V^H + V X^H`` over the
+        trailing tile grid — emitted AFTER the next panel's collectives
+        under ``comm_la``; excludes the eagerly-stripped columns."""
+        lu, luc, xr, vr, xc, vc, g_ecols, bdy, stripped = ops
         upd = (tb.contract("rad,cbd->rcab", xr, jnp.conj(vc))
                + tb.contract("rad,cbd->rcab", vr, jnp.conj(xc)))
-        lt = lt.at[lu:, luc:].add(-upd)
-        return lt, taus_out
+        if not stripped:
+            return lt.at[lu:, luc:].add(-upd)
+        notstrip = ~((g_ecols >= bdy) & (g_ecols < bdy + b))   # (ncols, nb)
+        return lt.at[lu:, luc:].add(
+            -jnp.where(notstrip[None, :, None, :], upd, 0))
 
     def prog(lt):
         taus_out = jnp.zeros((max(npan, 0), b), dtype=lt.dtype)
+        if not comm_la:
+            for p in range(npan):
+                lt, taus_out, pq = factor_panel(lt, taus_out, p)
+                if pq is None:
+                    continue
+                lt, ops = trailing_ops(lt, p, *pq, strip_next=False)
+                if ops is not None:
+                    lt = apply_bulk(lt, ops)
+            return lt, taus_out
+        pq = None
         for p in range(npan):
-            lt, taus_out = step(lt, taus_out, p)
+            if pq is None:
+                lt, taus_out, pq = factor_panel(lt, taus_out, p)
+            if pq is None:
+                continue
+            strip_next = p + 1 < npan
+            lt, ops = trailing_ops(lt, p, *pq, strip_next=strip_next)
+            pq = None
+            if ops is None:
+                continue
+            if strip_next:
+                # panel p+1's gather (column broadcast + tile-row
+                # all_gather), QR and write-back — emitted BEFORE panel
+                # p's bulk rank-2 product
+                lt, taus_out, pq = factor_panel(lt, taus_out, p + 1)
+                if pq is not None:
+                    cc.record_overlapped("red2band_dist", ROW_AXIS, 1)
+                    cc.record_overlapped("red2band_dist", COL_AXIS, 1)
+            lt = apply_bulk(lt, ops)
         return lt, taus_out
 
     def run(lt):
@@ -501,10 +591,17 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
 
 @register_program_cache
 @functools.lru_cache(maxsize=32)
-def _dist_red2band_cached(dist, mesh, dtype, band, scan=False, donate=False):
-    build = _build_dist_red2band_scan if scan else _build_dist_red2band
-    return jax.jit(build(dist, mesh, dtype, band),
-                   **donate_argnums_kw(donate, 0))
+def _dist_red2band_cached(dist, mesh, dtype, band, scan=False, donate=False,
+                          comm_la=False):
+    if scan:
+        # the scan body's W reads the whole trailing matrix every
+        # iteration, so the panel gather cannot be hoisted across the
+        # previous bulk there (documented exception, docs/comm_overlap.md)
+        built = _build_dist_red2band_scan(dist, mesh, dtype, band)
+    else:
+        built = _build_dist_red2band(dist, mesh, dtype, band,
+                                     comm_la=comm_la)
+    return jax.jit(built, **donate_argnums_kw(donate, 0))
 
 
 # ---------------------------------------------------------------------------
@@ -559,10 +656,19 @@ def reduction_to_band(a: Matrix, band_size: int | None = None, *,
             return BandReduction(
                 a.with_storage(global_to_tiles_donated(out, a.dist)),
                 taus, band)
+    from ..config import resolved_comm_lookahead
+
+    scan_mode = resolve_step_mode(steps) == "scan"
     fn = _dist_red2band_cached(a.dist, a.grid.mesh, np.dtype(a.dtype).name,
                                band,
-                               scan=resolve_step_mode(steps) == "scan",
-                               donate=donate)
+                               scan=scan_mode,
+                               donate=donate,
+                               # the unrolled builder pipelines the panel
+                               # gather across the bulk rank-2 product
+                               # (docs/comm_overlap.md); no compute-carry
+                               # prerequisite here — the knob acts alone
+                               comm_la=not scan_mode
+                               and resolved_comm_lookahead())
     with entry_span, quiet_donation():
         storage, taus = fn(a.storage)
     return BandReduction(a.with_storage(storage), taus, band)

@@ -95,12 +95,20 @@ def _corrupt_payload(x, seed: int):
     return flat.reshape(x.shape) if x.ndim else flat[0]
 
 
+def _kind_matches(armed: str, kind: str) -> bool:
+    """Armed ``"bcast"`` also matches the fused 2D diagonal broadcast
+    (``"bcast2d"``, comm.collectives) — the drill targets "a broadcast on
+    the step critical path", and the bcast2d fusion must not silently
+    move that payload out of the corruption's reach."""
+    return armed == kind or (armed == "bcast" and kind == "bcast2d")
+
+
 def _collective_hook(kind: str, axis: str, x):
     """Installed into ``comm.collectives`` while :func:`corrupt_collective`
     is armed; corrupts the payload of the nth matching traced call."""
     with _LOCK:
         spec = _COLLECTIVE
-        if spec is None or spec["kind"] != kind:
+        if spec is None or not _kind_matches(spec["kind"], kind):
             return x
         hit = spec["count"] == spec["nth"]
         spec["count"] += 1
@@ -110,7 +118,9 @@ def _collective_hook(kind: str, axis: str, x):
 @contextlib.contextmanager
 def corrupt_collective(kind: str = "bcast", nth: int = 0, seed: int = 0):
     """Poison the payload of the ``nth`` traced ``kind`` collective
-    (``"bcast"`` | ``"all_reduce"``) while the context is active."""
+    (``"bcast"`` — which also matches the fused ``"bcast2d"`` diagonal
+    broadcast — | ``"all_reduce"`` | ``"bcast2d"``) while the context is
+    active."""
     global _COLLECTIVE
     from ..comm import collectives as cc
 

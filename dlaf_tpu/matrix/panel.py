@@ -93,10 +93,12 @@ class DistContext:
 
 
 def bcast_diag(ctx: DistContext, lt, k: int):
-    """The (k,k) tile to every rank: two mask+psum hops (reference: diag-tile
-    column broadcast, ``cholesky/impl.h:215-219``)."""
+    """The (k,k) tile to every rank: one fused 2D mask+psum
+    (:func:`dlaf_tpu.comm.collectives.bcast2d` — one collective on the
+    step critical path instead of the previous two hops; reference:
+    diag-tile column broadcast, ``cholesky/impl.h:215-219``)."""
     cand = lt[ctx.kr(k), ctx.kc(k)]
-    return cc.bcast(cc.bcast(cand, ROW_AXIS, ctx.owner_r(k)), COL_AXIS, ctx.owner_c(k))
+    return cc.bcast2d(cand, ctx.owner_r(k), ctx.owner_c(k))
 
 
 def pad_diag_identity(tile, real_size: int):
@@ -117,8 +119,7 @@ def bcast_diag_dyn(ctx: DistContext, lt, k):
     mb, nb = lt.shape[-2], lt.shape[-1]
     cand = jax.lax.dynamic_slice(
         lt, (ctx.kr(k), ctx.kc(k), 0, 0), (1, 1, mb, nb))[0, 0]
-    return cc.bcast(cc.bcast(cand, ROW_AXIS, ctx.owner_r(k)),
-                    COL_AXIS, ctx.owner_c(k))
+    return cc.bcast2d(cand, ctx.owner_r(k), ctx.owner_c(k))
 
 
 def gather_sub_panel_dyn(ctx: DistContext, lt, *, p, b: int, n: int,

@@ -129,16 +129,20 @@ def _validate_metrics(r: dict, where: str, errors: list) -> None:
 
 def validate_records(records, require_spans=False, require_gflops=False,
                      require_collectives=False, require_retries=False,
-                     require_fallbacks=False) -> list:
+                     require_fallbacks=False, require_comm_overlap=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
     collective byte counters in some metrics snapshot, at least one
     ``robust_cholesky.attempt`` retry span (with its attempt/shift
-    attrs — the fault-injection smoke), and a positive
-    ``dlaf_fallback_total`` counter."""
+    attrs — the fault-injection smoke), a positive
+    ``dlaf_fallback_total`` counter, and (``require_comm_overlap``)
+    positive finite ``dlaf_comm_overlapped_total{algo,axis}`` counters
+    plus finite per-axis ``dlaf_comm_collective_bytes_total`` for BOTH
+    mesh axes — the comm look-ahead audit trail (docs/comm_overlap.md)."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
+    overlap_axes, byte_axes = set(), set()
     for i, r in enumerate(records):
         where = f"record {i}"
         if not isinstance(r, dict):
@@ -171,6 +175,14 @@ def validate_records(records, require_spans=False, require_gflops=False,
                 if m.get("name") == "dlaf_comm_collective_bytes_total" \
                         and m["value"] > 0:
                     n_coll += 1
+                    axis = (m.get("labels") or {}).get("axis")
+                    if axis:
+                        byte_axes.add(axis)
+                if m.get("name") == "dlaf_comm_overlapped_total" \
+                        and m["value"] > 0:
+                    labels = m.get("labels") or {}
+                    if labels.get("algo") and labels.get("axis"):
+                        overlap_axes.add(labels["axis"])
                 if m.get("name") == "dlaf_fallback_total" and m["value"] > 0:
                     n_fallbacks += 1
         elif rtype == "log":
@@ -189,6 +201,15 @@ def validate_records(records, require_spans=False, require_gflops=False,
     if require_fallbacks and n_fallbacks == 0:
         errors.append("artifact contains no positive dlaf_fallback_total "
                       "counter")
+    if require_comm_overlap:
+        if not {"row", "col"} <= overlap_axes:
+            errors.append("artifact lacks positive finite "
+                          "dlaf_comm_overlapped_total{algo,axis} counters "
+                          f"for both mesh axes (got {sorted(overlap_axes)})")
+        if not {"row", "col"} <= byte_axes:
+            errors.append("artifact lacks finite per-axis "
+                          "dlaf_comm_collective_bytes_total for both mesh "
+                          f"axes (got {sorted(byte_axes)})")
     return errors
 
 

@@ -12,6 +12,12 @@ Flags:
                             the fault-injection smoke's audit trail)
     --require-fallbacks     fail unless a metrics snapshot carries a
                             positive dlaf_fallback_total
+    --require-comm-overlap  fail unless a metrics snapshot carries positive
+                            finite dlaf_comm_overlapped_total{algo,axis}
+                            counters AND finite per-axis
+                            dlaf_comm_collective_bytes_total for BOTH mesh
+                            axes (the comm look-ahead audit trail,
+                            docs/comm_overlap.md)
     --prom                  print the last metrics snapshot as Prometheus
                             text exposition after validating
 
@@ -33,7 +39,8 @@ def main(argv=None) -> int:
     flags = {a for a in argv if a.startswith("--")}
     paths = [a for a in argv if not a.startswith("--")]
     known = {"--require-spans", "--require-gflops", "--require-collectives",
-             "--require-retries", "--require-fallbacks", "--prom"}
+             "--require-retries", "--require-fallbacks",
+             "--require-comm-overlap", "--prom"}
     if len(paths) != 1 or flags - known:
         print(__doc__, file=sys.stderr)
         return 2
@@ -49,7 +56,8 @@ def main(argv=None) -> int:
         require_gflops="--require-gflops" in flags,
         require_collectives="--require-collectives" in flags,
         require_retries="--require-retries" in flags,
-        require_fallbacks="--require-fallbacks" in flags)
+        require_fallbacks="--require-fallbacks" in flags,
+        require_comm_overlap="--require-comm-overlap" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)

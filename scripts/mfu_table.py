@@ -32,16 +32,36 @@ numerically corrupted; see bench.py ``PEEL_FIX_TS``).  Multi-chip
 BASELINE configs whose grids this environment has never exposed report
 their single-chip rehearsal number with a note, or "pending".
 
+* **ICI roofline** (multi-chip configs) — comm-bound ceiling derived from
+  the per-axis ``dlaf_comm_collective_bytes_total`` counters: the
+  distributed program is TRACED (no compile, no execution) on a virtual
+  CPU mesh of the config's grid in a subprocess — the UNROLLED builders,
+  whose per-``k`` emission makes the trace-time counters exact per-run
+  traffic (a scan body's counters fire once per traced body, not per
+  executed iteration, and would undercount by the trip count) — the
+  trace-time byte counters give the per-rank ICI payload per axis, and
+  the ceiling is
+  ``flops_model / sum_axis(2(p-1)/p * bytes_axis / link_bw)`` — the ring
+  all-reduce traffic factor applied per mesh axis (conservative for the
+  all_gathers, whose factor is (p-1)/p).  This is the bound the
+  ``comm_lookahead`` overlap (docs/comm_overlap.md) must stay under even
+  with perfect compute/comm overlap, so the "pending" multi-chip rows
+  carry a number before live silicon does.  Link bandwidth is the public
+  per-chip ICI aggregate / 4 links.
+
 Usage:
     python scripts/mfu_table.py            # print the markdown table
     python scripts/mfu_table.py --write    # splice into BASELINE.md
                                            # between the mfu-table markers
+    python scripts/mfu_table.py --no-ici   # skip the traced ICI column
+                                           # (fast; prints em-dashes)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -65,6 +85,22 @@ CHIPS = {
 #: f64_gemm_slices=0 -> s=7 (config.py): s*(s+1)/2.
 OZ_SLICES = 7
 OZ_PAIRS = OZ_SLICES * (OZ_SLICES + 1) // 2
+
+#: Per-link, per-direction ICI bytes/s: public per-chip aggregate (v5e
+#: 1600 Gbps, v5p 4800 Gbps) spread over the 4 torus links.
+ICI_LINK_BW = {"v5e": 50e9, "v5p": 150e9}
+
+#: Reference real-flop models per family (the entry spans' total_ops
+#: basis at real dtypes — add + mul summed — so the ICI ceiling divides
+#: like the measured numbers do; config #3's complex weighting is noted
+#: in its row, not folded in here).
+_FLOPS_MODEL = {
+    "cholesky": lambda n: n ** 3 / 3,
+    "trsm": lambda n: n ** 3,            # square B (free axis = n)
+    "hegst": lambda n: n ** 3,
+    "red2band": lambda n: 4 * n ** 3 / 3,
+    "eigensolver": lambda n: 4 * n ** 3 / 3,   # red2band-stage proxy
+}
 
 
 def oz_compute_ceiling(chip: str, dot: str = "bf16") -> float:
@@ -91,6 +127,107 @@ def chol_hbm_ceiling(chip: str, n: int, nb: int) -> float:
 def trsm_hbm_ceiling(chip: str, n: int, nb: int) -> float:
     """Same traffic shape for the blocked substitution (free axis = n)."""
     return chol_hbm_ceiling(chip, n, nb)
+
+
+def _trace_ici_child(spec: dict) -> None:
+    """Child-process body (``--trace-ici``): trace the family's
+    distributed builder on a virtual CPU mesh of the config's grid —
+    abstract eval only, no compile/exec — and print the per-axis
+    ``dlaf_comm_collective_bytes_total`` totals as JSON. Runs under
+    ``tpu_info.cpu_subprocess_env`` so the device count can be forced."""
+    sys.path.insert(0, REPO)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from dlaf_tpu import obs
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index2d import (GlobalElementSize, GridSize2D,
+                                         TileElementSize)
+    from dlaf_tpu.matrix.distribution import Distribution
+    from dlaf_tpu.matrix.tiling import storage_tile_grid
+
+    family = spec["family"]
+    n, nb = spec["n"], spec["nb"]
+    rows, cols = spec["rows"], spec["cols"]
+    dtype = jnp.dtype(spec["dtype"])
+    grid = Grid(rows, cols)
+    dist = Distribution(GlobalElementSize(n, n), TileElementSize(nb, nb),
+                        grid_size=GridSize2D(rows, cols))
+    str_, stc, _, _ = storage_tile_grid(dist)
+    sds = jax.ShapeDtypeStruct((str_, stc, nb, nb), dtype)
+
+    # UNROLLED builders only: their per-k emission makes the trace-time
+    # byte counters exact per-run traffic; a scan body traces once per
+    # telescope segment and would undercount by the trip count
+    if family in ("cholesky",):
+        from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky
+
+        fn = _build_dist_cholesky(dist, grid.mesh, "L", False, True)
+        jax.eval_shape(fn, sds)
+    elif family in ("trsm", "hegst"):
+        from dlaf_tpu.algorithms.triangular import _build_dist_solve
+
+        alpha = jax.ShapeDtypeStruct((), dtype)
+        combos = ([("L", "L", "N")] if family == "trsm"
+                  # twosolve HEGST = two whole-matrix solves
+                  else [("L", "L", "N"), ("R", "L", "C")])
+        for side, uplo, op in combos:
+            fn = _build_dist_solve(dist, dist, grid.mesh, side, uplo,
+                                   op, "N", dtype.name)
+            jax.eval_shape(fn, sds, sds, alpha)
+    else:   # red2band (and the eigensolver row's red2band-stage proxy)
+        from dlaf_tpu.eigensolver.reduction_to_band import \
+            _build_dist_red2band
+
+        fn = _build_dist_red2band(dist, grid.mesh, dtype.name,
+                                  spec.get("band", nb))
+        jax.eval_shape(fn, sds)
+
+    per_axis = {"row": 0.0, "col": 0.0}
+    for m in obs.registry().snapshot():
+        if m["name"] == "dlaf_comm_collective_bytes_total":
+            axis = m["labels"].get("axis")
+            if axis in per_axis:
+                per_axis[axis] += m["value"]
+    print(json.dumps(per_axis))
+
+
+def ici_ceiling(family: str, n: int, nb: int, grid: str, chip: str):
+    """Traced comm-bound ceiling in GF/s for a multi-chip config, or None
+    (1x1 grids, or the trace child failed)."""
+    rows, cols = (int(x) for x in grid.split("x"))
+    if rows * cols <= 1:
+        return None
+    sys.path.insert(0, REPO)
+    from dlaf_tpu.tpu_info import cpu_subprocess_env
+
+    env = cpu_subprocess_env(n_virtual_devices=rows * cols)
+    env["DLAF_METRICS_PATH"] = os.devnull   # arm the trace-time counters
+    env.pop("DLAF_LOG", None)
+    spec = dict(family=family, n=n, nb=nb, rows=rows, cols=cols,
+                dtype="complex128" if family == "hegst" else "float64")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--trace-ici",
+             json.dumps(spec)],
+            env=env, capture_output=True, text=True, timeout=2400,
+            cwd=REPO, check=True)
+        per_axis = json.loads(out.stdout.strip().splitlines()[-1])
+    except (subprocess.SubprocessError, ValueError, OSError) as e:
+        print(f"ici trace failed for {family} {n}/{nb} {grid}: {e}",
+              file=sys.stderr)
+        return None
+    bw = ICI_LINK_BW[chip]
+    t = 0.0
+    for axis, p in (("row", rows), ("col", cols)):
+        if p > 1 and per_axis.get(axis):
+            t += 2.0 * (p - 1) / p * per_axis[axis] / bw
+    if t == 0.0:
+        return None
+    return _FLOPS_MODEL[family](n) / t / 1e9
 
 
 #: measured-entry classifier: history `variant` labels per workload family
@@ -156,46 +293,62 @@ CONFIGS = [
 _MEAS_AT = {"#4 red2band d 16384/512 4x4": (8192, 512)}
 
 
-def build_rows():
+def build_rows(with_ici=True):
     rows = []
     for label, family, n, nb, grid, chip, note in CONFIGS:
         comp = oz_compute_ceiling(chip)
         hbm = (chol_hbm_ceiling(chip, n, nb)
                if family in ("cholesky", "trsm", "hegst") else None)
-        ceil = min(comp, hbm) if hbm is not None else comp
-        bound = "hbm" if (hbm is not None and hbm < comp) else "mxu"
+        ici = ici_ceiling(family, n, nb, grid, chip) if with_ici else None
+        candidates = [comp] + [x for x in (hbm, ici) if x is not None]
+        ceil = min(candidates)
+        bound = ("ici" if ici is not None and ceil == ici
+                 else "hbm" if hbm is not None and ceil == hbm else "mxu")
         n_m, nb_m = _MEAS_AT.get(label, (n, nb))
         got = measured(family, n_m, nb_m)
         mfu = f"{100.0 * got / ceil:.1f}%" if got else "—"
         rows.append((label, f"ozaki s={OZ_SLICES} (bf16 dots)",
-                     f"{comp:.0f}", f"{hbm:.0f}" if hbm else "—", bound,
+                     f"{comp:.0f}", f"{hbm:.0f}" if hbm else "—",
+                     f"{ici:.0f}" if ici else "—", bound,
                      f"{got:.1f}" if got else "pending", mfu, note))
     return rows
 
 
-def render() -> str:
+def render(with_ici=True) -> str:
     head = (f"{BEGIN}\n"
             "## MFU / roofline table (scripts/mfu_table.py — regenerate "
             "with `--write`)\n\n"
             "Route ceilings per chip (f64-equivalent): ozaki compute = "
             f"dot-route peak / {OZ_PAIRS} slice pairs (s={OZ_SLICES}); "
             "HBM roofline from the slice-traffic model in the script "
-            "docstring. `MFU` = measured / min(compute, HBM). Measured "
-            "values: best post-peel-fix TPU f64 entries in "
-            "`.bench_history.jsonl` (v5e, one chip). Single-digit MFU "
-            "with neither roofline binding = the step chain is "
+            "docstring; ICI roofline (multi-chip rows) from the TRACED "
+            "per-axis `dlaf_comm_collective_bytes_total` counters over "
+            "per-link ICI bandwidth (ring traffic factor; script "
+            "docstring) — the ceiling the `comm_lookahead` overlap "
+            "(docs/comm_overlap.md) cannot exceed even with perfect "
+            "compute/comm overlap. `MFU` = measured / min(compute, HBM, "
+            "ICI). Measured values: best post-peel-fix TPU f64 entries "
+            "in `.bench_history.jsonl` (v5e, one chip). Single-digit MFU "
+            "with no roofline binding = the step chain is "
             "latency/serialization-bound — the gap `cholesky_lookahead` "
-            "(docs/lookahead.md) exists to close; the N-ladder's rising "
-            "MFU is that serial fraction amortizing.\n\n"
-            "| config | route | compute ceil GF/s | HBM ceil GF/s | bound "
-            "| measured GF/s | MFU | note |\n"
-            "|---|---|---|---|---|---|---|---|\n")
-    body = "".join("| " + " | ".join(r) + " |\n" for r in build_rows())
+            "(docs/lookahead.md) + `comm_lookahead` exist to close; the "
+            "N-ladder's rising MFU is that serial fraction amortizing. "
+            "The #5 ICI bound covers the red2band stage (the pipeline's "
+            "comm-dominant sweep), not the mixed host stages.\n\n"
+            "| config | route | compute ceil GF/s | HBM ceil GF/s "
+            "| ICI ceil GF/s | bound | measured GF/s | MFU | note |\n"
+            "|---|---|---|---|---|---|---|---|---|\n")
+    body = "".join("| " + " | ".join(r) + " |\n"
+                   for r in build_rows(with_ici))
     return head + body + END
 
 
 def main() -> None:
-    text = render()
+    if "--trace-ici" in sys.argv:
+        _trace_ici_child(json.loads(sys.argv[sys.argv.index("--trace-ici")
+                                             + 1]))
+        return
+    text = render(with_ici="--no-ici" not in sys.argv)
     if "--write" not in sys.argv:
         print(text)
         return

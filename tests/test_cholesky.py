@@ -306,10 +306,11 @@ def test_cholesky_distributed_scan_multisegment(dtype, mode, devices8,
 # ---------------------------------------------------------------------------
 
 def _cholesky_la(uplo, a, nb, la, monkeypatch, trailing=None, grid=None,
-                 src=RankIndex2D(0, 0)):
+                 src=RankIndex2D(0, 0), comm="0"):
     import dlaf_tpu.config as config
 
     monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", la)
+    monkeypatch.setenv("DLAF_COMM_LOOKAHEAD", comm)
     if trailing:
         monkeypatch.setenv("DLAF_CHOLESKY_TRAILING", trailing)
     config.initialize()
@@ -318,6 +319,7 @@ def _cholesky_la(uplo, a, nb, la, monkeypatch, trailing=None, grid=None,
                                           src=src)).to_numpy()
     finally:
         monkeypatch.delenv("DLAF_CHOLESKY_LOOKAHEAD")
+        monkeypatch.delenv("DLAF_COMM_LOOKAHEAD")
         monkeypatch.delenv("DLAF_CHOLESKY_TRAILING", raising=False)
         config.initialize()
 
@@ -354,6 +356,11 @@ def test_cholesky_lookahead_bitwise_distributed(uplo, rows, cols, sr, sc,
     r0 = _cholesky_la(uplo, a, nb, "0", monkeypatch, trailing, grid, src)
     r1 = _cholesky_la(uplo, a, nb, "1", monkeypatch, trailing, grid, src)
     np.testing.assert_array_equal(r1, r0)
+    # comm_lookahead=1 (panel collectives hoisted ahead of the bulk,
+    # docs/comm_overlap.md) must also be bitwise-identical
+    r2 = _cholesky_la(uplo, a, nb, "1", monkeypatch, trailing, grid, src,
+                      comm="1")
+    np.testing.assert_array_equal(r2, r0)
     check_factor(uplo, a, r1, np.float64)
 
 

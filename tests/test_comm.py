@@ -275,6 +275,104 @@ def test_bcast_tree_full_algorithm(devices8, monkeypatch):
     np.testing.assert_allclose(np.tril(out), np.tril(ref), rtol=0, atol=0)
 
 
+@pytest.mark.parametrize("rows,cols", [(2, 4), (4, 2), (2, 2), (1, 8)])
+@pytest.mark.parametrize("owner_r,owner_c", [(0, 0), (1, 1)])
+def test_bcast2d_matches_two_hop(rows, cols, owner_r, owner_c, devices8):
+    """The fused 2D diagonal broadcast (one psum over BOTH mesh axes,
+    docs/comm_overlap.md) is BITWISE identical to the two-hop
+    bcast(bcast(...)) it replaces — including the signed-zero flattening
+    any multi-participant psum performs."""
+    g = Grid(rows, cols)
+    orr, occ = owner_r % rows, owner_c % cols
+    vals = np.arange(rows * cols, dtype=np.float64).reshape(rows, cols) + 1.0
+    vals[0, 0] = -0.0   # the masked-add edge the contract documents
+    x = jnp.asarray(vals)
+
+    def fused(x):
+        return cc.bcast2d(x.reshape(()), orr, occ).reshape(1, 1)
+
+    def two_hop(x):
+        blk = x.reshape(())
+        return cc.bcast(cc.bcast(blk, "row", orr), "col", occ).reshape(1, 1)
+
+    out_f = np.asarray(_shmap(g, fused, P("row", "col"), P("row", "col"))(x))
+    out_2 = np.asarray(_shmap(g, two_hop, P("row", "col"),
+                              P("row", "col"))(x))
+    np.testing.assert_array_equal(out_f, out_2)
+    np.testing.assert_array_equal(out_f, np.full((rows, cols),
+                                                 vals[orr, occ]))
+
+
+def test_bcast2d_tree_impl(devices8, monkeypatch):
+    """bcast_impl="tree" has no 2-axis fusion: bcast2d falls back to the
+    two-hop binomial trees with identical values."""
+    import dlaf_tpu.config as config
+
+    g = Grid(2, 4)
+    x = jnp.arange(8, dtype=jnp.float64).reshape(2, 4) + 1.0
+
+    def f(x):
+        return cc.bcast2d(x.reshape(()), 1, 2).reshape(1, 1)
+
+    ref = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    monkeypatch.setenv("DLAF_BCAST_IMPL", "tree")
+    config.initialize()
+    try:
+        out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    finally:
+        monkeypatch.delenv("DLAF_BCAST_IMPL")
+        config.initialize()
+    np.testing.assert_array_equal(out, ref)
+    np.testing.assert_array_equal(out, np.full((2, 4), np.asarray(x)[1, 2]))
+
+
+def test_bcast2d_records_per_axis_bytes(devices8, monkeypatch, tmp_path):
+    """Accounting parity with the two-hop form: one bcast2d charges the
+    payload once per mesh axis under kind="bcast2d" (the per-axis byte
+    counters the ICI roofline reads — scripts/mfu_table.py)."""
+    import dlaf_tpu.config as config
+    from dlaf_tpu import obs
+
+    monkeypatch.setenv("DLAF_METRICS_PATH", str(tmp_path / "m.jsonl"))
+    config.initialize()
+    try:
+        g = Grid(2, 4)
+        x = jnp.arange(8, dtype=jnp.float64).reshape(2, 4) + 1.0
+
+        def f(x):
+            return cc.bcast2d(x.reshape(()), 0, 0).reshape(1, 1)
+
+        _shmap(g, f, P("row", "col"), P("row", "col"))(x)
+        snap = obs.registry().snapshot()
+        got = {m["labels"]["axis"]: m["value"] for m in snap
+               if m["name"] == "dlaf_comm_collective_bytes_total"
+               and m["labels"].get("kind") == "bcast2d"}
+        assert got.get("row", 0) == 8 and got.get("col", 0) == 8, snap
+    finally:
+        monkeypatch.delenv("DLAF_METRICS_PATH")
+        config.initialize()
+        obs._reset_for_tests()
+
+
+def test_bcast2d_injection_parity(devices8):
+    """corrupt_collective("bcast") must still reach the diagonal-tile
+    broadcast now that it is the fused bcast2d — the drill targets "a
+    broadcast on the step critical path", not a specific lowering."""
+    from dlaf_tpu.health import inject
+
+    g = Grid(2, 2)
+    x = jnp.ones((2, 2), dtype=jnp.float64)
+
+    def f(x):
+        return cc.bcast2d(x.reshape(()), 0, 0).reshape(1, 1)
+
+    with inject.corrupt_collective("bcast", nth=0, seed=1):
+        out = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    assert np.isnan(out).all(), out
+    clean = np.asarray(_shmap(g, f, P("row", "col"), P("row", "col"))(x))
+    np.testing.assert_array_equal(clean, np.ones((2, 2)))
+
+
 def test_reduce_root_semantics(devices8):
     """reduce() defines the result ONLY on root (zeros elsewhere) — the
     reference's contract (kernels/reduce.h: only the root's output tile is

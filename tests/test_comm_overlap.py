@@ -1,0 +1,364 @@
+"""Communication look-ahead (``comm_lookahead``, docs/comm_overlap.md).
+
+Structural jaxpr pins: for every distributed builder with the knob on,
+the NEXT step's panel collective must (a) have no transitive dependency
+on the current step's bulk trailing product and (b) be emitted ahead of
+it in program order — exactly the dependency/order shape that lets XLA's
+async collective start/done pairs run the ICI transfer concurrently with
+the bulk MXU gemms. The serialized forms are pinned too, so a stale test
+cannot pass vacuously. Bitwise on/off A/Bs for the families whose pins
+don't live in their own test files (cholesky/trsm knob pins are in
+test_cholesky.py / test_triangular.py) ride along here.
+
+All checks run on traced jaxprs over the 8-device CPU mesh — no
+compilation, no execution.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dlaf_tpu.config as config
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.matrix.matrix import Matrix
+
+
+def _mat(a, nb, grid):
+    return Matrix.from_global(np.asarray(a), TileElementSize(nb, nb),
+                              grid=grid)
+
+
+def _inner_eqns(fn, *args):
+    """Equations inside the builder's shard_map body."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    [eq] = [e for e in jaxpr.jaxpr.eqns
+            if "shard_map" in e.primitive.name]
+    inner = eq.params["jaxpr"]
+    return getattr(inner, "eqns", None) or inner.jaxpr.eqns
+
+
+def _scan_body_eqns(eqns):
+    """Body equations of the FIRST lax.scan among ``eqns``."""
+    scans = [e for e in eqns if e.primitive.name == "scan"]
+    assert scans, "no scan in traced program"
+    return scans[0].params["jaxpr"].jaxpr.eqns
+
+
+def _closure(eqns, seed_invars):
+    """Transitive producer closure of ``seed_invars`` within ``eqns``."""
+    producers = {}
+    for e in eqns:
+        for v in e.outvars:
+            producers[v] = e
+    seen, todo, out = set(), list(seed_invars), []
+    while todo:
+        v = todo.pop()
+        if isinstance(v, jax.core.Literal):
+            continue
+        e = producers.get(v)
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        out.append(e)
+        todo.extend(e.invars)
+    return out
+
+
+def _is_bulk_dot(e):
+    """The bulk trailing product of every dist builder under test is the
+    only dot_general with a 4D (tile-pair grid) output; panel solves,
+    strips and W/M products are <= 3D."""
+    return (e.primitive.name == "dot_general"
+            and len(e.outvars[0].aval.shape) == 4)
+
+
+def _ag_positions(eqns):
+    return [i for i, e in enumerate(eqns)
+            if e.primitive.name == "all_gather"]
+
+
+def _bulk_positions(eqns):
+    return [i for i, e in enumerate(eqns) if _is_bulk_dot(e)]
+
+
+def _depends_on_bulk(eqns, idx):
+    return any(_is_bulk_dot(e) for e in _closure(eqns, eqns[idx].invars))
+
+
+# ---------------------------------------------------------------------------
+# Unrolled distributed Cholesky
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_dist_cholesky_overlap(uplo, devices8):
+    """comm_lookahead=1: step k+1's transposed-panel all_gather is
+    independent of step k's bulk product AND emitted before it; the
+    serialized program keeps the dependency (stale-test guard)."""
+    from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky
+
+    config.initialize()
+    grid = Grid(2, 2)
+    mat = _mat(np.eye(24), 4, grid)   # nt=6
+
+    def trace(lookahead, comm_la):
+        fn = _build_dist_cholesky(mat.dist, grid.mesh, uplo, False, True,
+                                  lookahead=lookahead, comm_la=comm_la)
+        return _inner_eqns(fn, mat.storage)
+
+    eqns = trace(lookahead=True, comm_la=True)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    assert len(ag) >= 2 and bulk
+    # step 1's panel all_gather: hoisted ahead of step 0's bulk product
+    assert ag[1] < bulk[0], (ag, bulk)
+    assert not _depends_on_bulk(eqns, ag[1])
+
+    eqns = trace(lookahead=False, comm_la=False)
+    ag = _ag_positions(eqns)
+    assert _depends_on_bulk(eqns, ag[1]), \
+        "serialized form lost its bulk dependency — test is stale"
+
+
+# ---------------------------------------------------------------------------
+# Scan distributed Cholesky (overlap by construction in the la body)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_dist_cholesky_scan_overlap(uplo, devices8):
+    """The pipelined scan body applies step k-1's DEFERRED bulk product
+    from the carry: the bulk dot must not consume this body's panel
+    collectives (they feed step k, overlapping the bulk), while the
+    serial body's bulk consumes its own panel broadcast directly."""
+    from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky_scan
+
+    config.initialize()
+    grid = Grid(2, 2)
+    mat = _mat(np.eye(24), 4, grid)   # nt=6, multi-segment telescope
+
+    def body(lookahead):
+        fn = _build_dist_cholesky_scan(mat.dist, grid.mesh, uplo,
+                                       lookahead=lookahead)
+        return _scan_body_eqns(_inner_eqns(fn, mat.storage))
+
+    eqns = body(lookahead=True)
+    bulk = _bulk_positions(eqns)
+    assert bulk
+    bulk_deps = _closure(eqns, eqns[bulk[0]].invars)
+    assert not any(e.primitive.name == "all_gather" for e in bulk_deps), \
+        "pipelined scan bulk consumes this body's collectives"
+    # and the collectives are emitted ahead of the deferred bulk
+    assert _ag_positions(eqns)[0] < bulk[0]
+
+    eqns = body(lookahead=False)
+    bulk = _bulk_positions(eqns)
+    bulk_deps = _closure(eqns, eqns[bulk[0]].invars)
+    assert any(e.primitive.name == "all_gather" for e in bulk_deps), \
+        "serial scan body lost its panel->bulk chain — test is stale"
+
+
+# ---------------------------------------------------------------------------
+# Scan distributed triangular solve
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("side,uplo,op", [("L", "L", "C"), ("R", "U", "C")])
+def test_dist_solve_scan_overlap(side, uplo, op, devices8):
+    """comm_lookahead=1 hoists the A-panel transpose-exchange all_gather
+    ahead of the deferred bulk inside the pipelined solve body; off, it
+    trails the bulk. Either way it must not depend on the bulk (it reads
+    only the constant A storage)."""
+    from dlaf_tpu.algorithms.triangular import _build_dist_solve_scan
+
+    config.initialize()
+    grid = Grid(2, 2)
+    n, nb = 24, 4
+    amat = _mat(np.eye(n), nb, grid)
+    bmat = _mat(np.zeros((n, 2 * nb) if side == "L" else (2 * nb, n)),
+                nb, grid)
+
+    def body(comm_la):
+        fn = _build_dist_solve_scan(amat.dist, bmat.dist, grid.mesh, side,
+                                    uplo, op, "N", "float64",
+                                    lookahead=True, comm_la=comm_la)
+        return _scan_body_eqns(_inner_eqns(
+            fn, amat.storage, bmat.storage, jnp.ones((), jnp.float64)))
+
+    eqns = body(comm_la=True)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    assert ag and bulk
+    assert ag[0] < bulk[0], (ag, bulk)
+    assert not _depends_on_bulk(eqns, ag[0])
+
+    eqns = body(comm_la=False)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    assert ag[0] > bulk[0], "comm_la=0 no longer serial — test is stale"
+    assert not _depends_on_bulk(eqns, ag[0])
+
+
+# ---------------------------------------------------------------------------
+# Unrolled distributed HEGST
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_dist_hegst_overlap(uplo, devices8):
+    """comm_lookahead=1: step k+1's transposed-panel all_gathers are
+    emitted ahead of step k's bulk her2k pair products and independent
+    of them; the serialized sweep keeps the dependency."""
+    from dlaf_tpu.algorithms.gen_to_std import _build_dist_hegst
+
+    config.initialize()
+    grid = Grid(2, 2)
+    n, nb = 24, 4
+    amat = _mat(np.eye(n), nb, grid)
+    lmat = _mat(np.eye(n), nb, grid)
+
+    def trace(lookahead, comm_la):
+        fn = _build_dist_hegst(amat.dist, grid.mesh, uplo,
+                               lookahead=lookahead, comm_la=comm_la)
+        return _inner_eqns(fn, amat.storage, lmat.storage)
+
+    eqns = trace(lookahead=True, comm_la=True)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    # 2 transposes per chain: ag[2] is the first all_gather of step 1's
+    # chain; it must precede step 0's bulk her2k products
+    assert len(ag) >= 4 and bulk
+    assert ag[2] < bulk[0], (ag, bulk)
+    assert not _depends_on_bulk(eqns, ag[2])
+
+    eqns = trace(lookahead=False, comm_la=False)
+    ag = _ag_positions(eqns)
+    assert _depends_on_bulk(eqns, ag[2]), \
+        "serialized hegst lost its bulk dependency — test is stale"
+
+
+# ---------------------------------------------------------------------------
+# Unrolled distributed reduction_to_band
+# ---------------------------------------------------------------------------
+
+def test_dist_red2band_overlap(devices8):
+    """comm_lookahead=1: panel p+1's gather all_gather is emitted ahead of
+    panel p's bulk rank-2 product and independent of it; serialized, the
+    gather reads the post-bulk matrix and so depends on it."""
+    from dlaf_tpu.eigensolver.reduction_to_band import _build_dist_red2band
+
+    config.initialize()
+    grid = Grid(2, 2)
+    n, nb = 32, 8
+    mat = _mat(np.eye(n), nb, grid)
+
+    def trace(comm_la):
+        fn = _build_dist_red2band(mat.dist, grid.mesh, "float64", nb,
+                                  comm_la=comm_la)
+        return _inner_eqns(fn, mat.storage)
+
+    eqns = trace(comm_la=True)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    # per step: gather all_gather + X all_gather; ag[2] = panel 1's gather
+    assert len(ag) >= 3 and bulk
+    assert ag[2] < bulk[0], (ag, bulk)
+    assert not _depends_on_bulk(eqns, ag[2])
+
+    eqns = trace(comm_la=False)
+    ag = _ag_positions(eqns)
+    assert _depends_on_bulk(eqns, ag[2]), \
+        "serialized red2band lost its bulk dependency — test is stale"
+
+
+# ---------------------------------------------------------------------------
+# Bitwise on/off A/Bs (hegst + red2band; cholesky/trsm pins live in their
+# own test files) and the overlap counters
+# ---------------------------------------------------------------------------
+
+def _with_knobs(monkeypatch, fn, **knobs):
+    for key, val in knobs.items():
+        monkeypatch.setenv(key, val)
+    config.initialize()
+    try:
+        return fn()
+    finally:
+        for key in knobs:
+            monkeypatch.delenv(key, raising=False)
+        config.initialize()
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_hegst_comm_bitwise(uplo, devices8, monkeypatch):
+    """Distributed blocked HEGST: comm_lookahead=1 must be bitwise equal
+    to =0 (same collectives, same payloads, same per-cell order)."""
+    from dlaf_tpu.algorithms.gen_to_std import gen_to_std
+
+    rng = np.random.default_rng(3)
+    n, nb = 29, 4
+    az = rng.standard_normal((n, n))
+    az = az + az.T
+    bz = rng.standard_normal((n, n))
+    bz = bz @ bz.T + n * np.eye(n)
+    lchol = np.linalg.cholesky(bz)
+    lz = lchol if uplo == "L" else lchol.T.copy()
+    grid = Grid(2, 4)
+
+    def run(comm):
+        return _with_knobs(
+            monkeypatch,
+            lambda: gen_to_std(uplo, _mat(az, nb, grid),
+                               _mat(lz, nb, grid)).to_numpy(),
+            DLAF_CHOLESKY_LOOKAHEAD="1", DLAF_COMM_LOOKAHEAD=comm,
+            DLAF_HEGST_IMPL="blocked")
+
+    np.testing.assert_array_equal(run("1"), run("0"))
+
+
+@pytest.mark.parametrize("band_div", [1, 2])
+def test_red2band_comm_bitwise(band_div, devices8, monkeypatch):
+    """Distributed reduction_to_band: the pipelined panel gather (strip
+    first, gather before the bulk rank-2 product) must reproduce the
+    serial sweep bitwise — matrix AND taus."""
+    from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+
+    rng = np.random.default_rng(5)
+    n, nb = 37, 8
+    x = rng.standard_normal((n, n))
+    a = x @ x.T + n * np.eye(n)
+    grid = Grid(2, 2)
+
+    def run(comm):
+        def body():
+            red = reduction_to_band(_mat(a, nb, grid),
+                                    band_size=nb // band_div)
+            return red.matrix.to_numpy(), np.asarray(red.taus)
+        return _with_knobs(monkeypatch, body,
+                           DLAF_COMM_LOOKAHEAD=comm,
+                           DLAF_DIST_STEP_MODE="unrolled")
+
+    m0, t0 = run("0")
+    m1, t1 = run("1")
+    np.testing.assert_array_equal(m1, m0)
+    np.testing.assert_array_equal(t1, t0)
+
+
+def test_comm_overlap_counters(devices8, monkeypatch, tmp_path):
+    """The hoisted collectives are accounted:
+    dlaf_comm_overlapped_total{algo,axis} appears for both mesh axes
+    when a distributed factorization runs with the knob on."""
+    from dlaf_tpu import obs
+    from dlaf_tpu.algorithms.cholesky import cholesky
+
+    a = np.eye(16) * 16
+    monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", "1")
+    monkeypatch.setenv("DLAF_COMM_LOOKAHEAD", "1")
+    monkeypatch.setenv("DLAF_METRICS_PATH", str(tmp_path / "m.jsonl"))
+    config.initialize()
+    try:
+        cholesky("L", _mat(a, 4, Grid(2, 2)))
+        snap = obs.registry().snapshot()
+        axes = {m["labels"]["axis"]: m["value"] for m in snap
+                if m["name"] == "dlaf_comm_overlapped_total"
+                and m["labels"].get("algo") == "cholesky_dist"}
+        assert axes.get("row", 0) > 0 and axes.get("col", 0) > 0, snap
+    finally:
+        for key in ("DLAF_CHOLESKY_LOOKAHEAD", "DLAF_COMM_LOOKAHEAD",
+                    "DLAF_METRICS_PATH"):
+            monkeypatch.delenv(key)
+        config.initialize()
+        obs._reset_for_tests()

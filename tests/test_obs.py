@@ -258,6 +258,55 @@ def test_validator_requires_content(tmp_path):
     assert len(errs) == 3
 
 
+def test_validator_requires_comm_overlap(tmp_path):
+    """--require-comm-overlap: positive finite overlap counters AND finite
+    per-axis byte counters for BOTH mesh axes (docs/comm_overlap.md);
+    non-finite or single-axis artifacts fail."""
+    def write(path, metrics):
+        sink = obs.JsonlSink(str(path))
+        sink.write({"type": "metrics", "metrics": metrics})
+        sink.close()
+        return str(path)
+
+    def counter(name, value, **labels):
+        return {"name": name, "kind": "counter", "value": value,
+                "labels": labels}
+
+    good = write(tmp_path / "good.jsonl", [
+        counter("dlaf_comm_overlapped_total", 4, algo="cholesky_dist",
+                axis="row"),
+        counter("dlaf_comm_overlapped_total", 4, algo="cholesky_dist",
+                axis="col"),
+        counter("dlaf_comm_collective_bytes_total", 128, kind="bcast2d",
+                axis="row"),
+        counter("dlaf_comm_collective_bytes_total", 128, kind="bcast2d",
+                axis="col"),
+    ])
+    assert obs.validate_file(good, require_comm_overlap=True) == []
+    # one axis missing -> both obligations can fail independently
+    partial = write(tmp_path / "partial.jsonl", [
+        counter("dlaf_comm_overlapped_total", 4, algo="cholesky_dist",
+                axis="row"),
+        counter("dlaf_comm_collective_bytes_total", 128, kind="bcast",
+                axis="row"),
+    ])
+    errs = obs.validate_file(partial, require_comm_overlap=True)
+    assert any("dlaf_comm_overlapped_total" in e for e in errs)
+    assert any("dlaf_comm_collective_bytes_total" in e for e in errs)
+    # non-finite counter values (NaN AND +inf) must not satisfy the
+    # requirement — the shared _finite gate filters both before the
+    # axis sets are populated
+    for bad in (float("nan"), float("inf")):
+        art = write(tmp_path / f"bad_{bad}.jsonl", [
+            counter("dlaf_comm_overlapped_total", bad,
+                    algo="cholesky_dist", axis="row"),
+            counter("dlaf_comm_overlapped_total", 4, algo="cholesky_dist",
+                    axis="col"),
+        ])
+        errs = obs.validate_file(art, require_comm_overlap=True)
+        assert any("dlaf_comm_overlapped_total" in e for e in errs), bad
+
+
 def test_validate_cli(tmp_path, capsys):
     from dlaf_tpu.obs.validate import main
 

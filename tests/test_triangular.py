@@ -295,7 +295,9 @@ def test_solve_scan_lookahead_bitwise(side, uplo, op, devices8, monkeypatch):
     """The pipelined scan-solve body (cholesky_lookahead=1 — deferred bulk
     + eager next-pivot strip, docs/lookahead.md) must match the serial
     scan body BITWISE, at nt=11 (multi-segment windows, both transpose-
-    exchange paths) on an offset grid."""
+    exchange paths) on an offset grid — and so must comm_lookahead=1
+    (the A-panel collectives hoisted ahead of the deferred bulk,
+    docs/comm_overlap.md: emission reorder of identical values)."""
     import dlaf_tpu.config as config
     from dlaf_tpu.matrix.matrix import Matrix
 
@@ -306,21 +308,24 @@ def test_solve_scan_lookahead_bitwise(side, uplo, op, devices8, monkeypatch):
     grid, src = Grid(2, 4), RankIndex2D(1, 2)
     res = {}
     try:
-        for la in ("0", "1"):
+        for la, comm in (("0", "0"), ("1", "0"), ("1", "1")):
             monkeypatch.setenv("DLAF_CHOLESKY_LOOKAHEAD", la)
+            monkeypatch.setenv("DLAF_COMM_LOOKAHEAD", comm)
             config.initialize()
             am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid,
                                     source_rank=src)
             bm = Matrix.from_global(b, TileElementSize(nb, nb), grid=grid,
                                     source_rank=src)
-            res[la] = triangular_solve(side, uplo, op, "N", 1.0, am,
-                                       bm).to_numpy()
+            res[la, comm] = triangular_solve(side, uplo, op, "N", 1.0, am,
+                                             bm).to_numpy()
     finally:
         monkeypatch.delenv("DLAF_DIST_STEP_MODE", raising=False)
         monkeypatch.delenv("DLAF_CHOLESKY_LOOKAHEAD", raising=False)
+        monkeypatch.delenv("DLAF_COMM_LOOKAHEAD", raising=False)
         config.initialize()
-    np.testing.assert_array_equal(res["1"], res["0"])
+    np.testing.assert_array_equal(res["1", "0"], res["0", "0"])
+    np.testing.assert_array_equal(res["1", "1"], res["0", "0"])
     t = np_op(np_tri(a, uplo, "N"), op)
     want = np.linalg.solve(t, b) if side == "L" else \
         np.linalg.solve(t.T, b.T).T
-    np.testing.assert_allclose(res["1"], want, **_tol(np.float64))
+    np.testing.assert_allclose(res["1", "1"], want, **_tol(np.float64))
