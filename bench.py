@@ -95,6 +95,104 @@ def _cache_dir() -> str:
                         ".jax_cache")
 
 
+#: eigensolver-pipeline stage arms (ISSUE 6): A/B the level-batched D&C
+#: ("tridiag" vs "tridiag+dcb1") and the pipelined reflector-block
+#: back-transform ("btr2b" vs "btr2b+btla1"), plus the chase
+#: back-transform ("btb2t", its blocked/sweeps A/B rides the existing
+#: bt_b2t_impl knob). Plain arms pin their knob to 0 via env so TPU
+#: "auto" cannot blur the A/B; results carry a "workload" field so they
+#: never take the cholesky headline. The mfu table's stage rows read
+#: these labels (scripts/mfu_table.py _FAMILIES).
+STAGE_BASES = ("tridiag", "btr2b", "btb2t")
+
+
+def _run_stage_variant(variant: str, base: str, mods: set) -> None:
+    """Measure one eigensolver-stage arm; same artifact/stdout protocol as
+    the cholesky arms (bench_result record + one JSON line)."""
+    import jax
+
+    import dlaf_tpu.config as config
+    from dlaf_tpu.common.sync import hard_fence
+    from dlaf_tpu.types import total_ops
+
+    os.environ.setdefault("DLAF_DC_LEVEL_BATCH",
+                          "1" if "dcb1" in mods else "0")
+    os.environ.setdefault("DLAF_BT_LOOKAHEAD",
+                          "1" if "btla1" in mods else "0")
+    config.initialize()
+    platform = jax.devices()[0].platform
+    # stage arms default to a smaller N off-TPU: the local red2band that
+    # feeds the bt arm compiles per-panel, and the CPU fallback sweep's
+    # budget belongs to the headline arms
+    n = int(os.environ.get("DLAF_BENCH_STAGE_N") or
+            (os.environ.get("DLAF_BENCH_N", "4096")
+             if platform == "tpu" else "1024"))
+    nb = int(os.environ.get("DLAF_BENCH_NB", "256"))
+    log(f"[{variant}] stage arm on {platform}: n={n} nb={nb}")
+    rng = np.random.default_rng(n)
+    if base == "tridiag":
+        from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+
+        d = rng.standard_normal(n)
+        e = rng.standard_normal(n - 1)
+        flops = total_ops(np.dtype(np.float64), 2 * n**3 / 3, 2 * n**3 / 3)
+
+        def measure():
+            return tridiag_solver(d, e, nb, use_device=True)[1]
+    elif base == "btb2t":
+        from dlaf_tpu.eigensolver.back_transform import bt_band_to_tridiag
+        from dlaf_tpu.eigensolver.band_to_tridiag import band_to_tridiag
+
+        b = min(nb, max(n // 8, 1))
+        band = np.zeros((b + 1, n))
+        band[0] = rng.standard_normal(n)
+        for r in range(1, b + 1):
+            band[r, : n - r] = rng.standard_normal(n - r)
+        tri = band_to_tridiag(band, b)
+        c = rng.standard_normal((n, n))
+        flops = total_ops(np.dtype(np.float64), n**3, n**3)
+
+        def measure():
+            return bt_band_to_tridiag(tri, c)
+    else:   # btr2b
+        import jax.numpy as jnp
+
+        from dlaf_tpu.common.index2d import TileElementSize
+        from dlaf_tpu.eigensolver.back_transform import bt_reduction_to_band
+        from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+        from dlaf_tpu.matrix.matrix import Matrix
+
+        x = rng.standard_normal((n, n))
+        a = x @ x.T + n * np.eye(n)
+        red = reduction_to_band(
+            Matrix.from_global(a, TileElementSize(nb, nb)))
+        hard_fence(red.matrix.storage)
+        c = jnp.asarray(rng.standard_normal((n, n)))
+        flops = total_ops(np.dtype(np.float64), n**3, n**3)
+
+        def measure():
+            return bt_reduction_to_band(red, c)
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    # the single timing-policy owner (1 warmup + fenced best-of-reps):
+    # the stage arms must never drift from the other history entries
+    from measure_common import append_history, best_time
+
+    best_t = best_time(measure, reps=3)
+    best_g = flops / best_t / 1e9
+    log(f"[{variant}] best of 3: {best_t:.4f}s {best_g:.1f} GFlop/s")
+
+    line = append_history(platform, n, nb, best_g, best_t,
+                          source="bench.py", variant=variant,
+                          dtype="float64", workload=base)
+    from dlaf_tpu import obs
+
+    obs.emit_event("bench_result", payload=line)
+    obs.flush()
+    print(json.dumps(line), flush=True)
+
+
 def run_variant() -> None:
     """Child: measure ONE trailing variant (env DLAF_BENCH_VARIANT), print
     one JSON line {variant, platform, dtype, n, nb, gflops, t, ts, source,
@@ -116,6 +214,10 @@ def run_variant() -> None:
     la = None
     if variant.endswith("+la1"):
         base, la = variant[: -len("+la1")], "1"
+    if base.split("+")[0] in STAGE_BASES:
+        parts = base.split("+")
+        _run_stage_variant(variant, parts[0], set(parts[1:]))
+        return
     os.environ.setdefault("DLAF_CHOLESKY_LOOKAHEAD", la or "0")
     # "ozaki_concat"/"ozaki_dots" = the ozaki trailing with the group form
     # pinned (config ozaki_group) — labeled separately so the sweep A/Bs
@@ -244,7 +346,9 @@ def best_recorded(platform: str, n: int, nb: int, path: str | None = None):
                 g = r.get("gflops")
                 if not (isinstance(g, (int, float))
                         and r.get("platform") == platform and r.get("n") == n
-                        and r.get("nb") == nb and r.get("dtype") == "float64"):
+                        and r.get("nb") == nb and r.get("dtype") == "float64"
+                        # stage-arm entries carry different flop models
+                        and r.get("workload") in (None, "cholesky")):
                     continue
                 if str(r.get("ts", "")) >= PEEL_FIX_TS:
                     if best is None or g > best["gflops"]:
@@ -269,7 +373,30 @@ def assemble_headline(results, n, nb, hist_lookup=None) -> dict:
     """
     if hist_lookup is None:
         hist_lookup = best_recorded
-    best = max(results, key=lambda r: r["gflops"])
+
+    def replay_headline(hist):
+        """The one shape of a history-replayed headline record."""
+        return {
+            "metric": (f"miniapp_cholesky {hist['dtype']} N={n} nb={nb} "
+                       f"local GFlop/s [tpu] "
+                       f"trailing={hist.get('variant', '?')}"),
+            "value": hist["gflops"],
+            "unit": "GFlop/s",
+            "vs_baseline": 1.0,
+            "replayed": True,
+            "replayed_ts": hist.get("ts"),
+            "replayed_source": hist.get("source", ".bench_history.jsonl"),
+        }
+
+    # the headline is BASELINE config #1 (cholesky); the eigensolver stage
+    # arms measure different flop models and only ride in the artifact —
+    # a sweep where every cholesky arm died must NOT publish a stage
+    # number under the cholesky label: replay history or report nothing
+    chol = [r for r in results if r.get("workload") in (None, "cholesky")]
+    if not chol:
+        hist = hist_lookup(platform="tpu", n=n, nb=nb)
+        return replay_headline(hist) if hist else None
+    best = max(chol, key=lambda r: r["gflops"])
     result = {
         "metric": (f"miniapp_cholesky {best['dtype']} N={n} nb={nb} "
                    f"local GFlop/s [{best['platform']}] "
@@ -281,22 +408,11 @@ def assemble_headline(results, n, nb, hist_lookup=None) -> dict:
     if best["platform"] != "tpu":
         hist = hist_lookup(platform="tpu", n=n, nb=nb)
         if hist:
-            result = {
-                "metric": (f"miniapp_cholesky {hist['dtype']} N={n} nb={nb} "
-                           f"local GFlop/s [tpu] "
-                           f"trailing={hist.get('variant', '?')}"),
-                "value": hist["gflops"],
-                "unit": "GFlop/s",
-                "vs_baseline": 1.0,
-                "replayed": True,
-                "replayed_ts": hist.get("ts"),
-                "replayed_source": hist.get("source",
-                                            ".bench_history.jsonl"),
-                "live_fallback": {
-                    k: best[k] for k in
-                    ("variant", "platform", "dtype", "gflops", "ts")
-                    if k in best},
-            }
+            result = replay_headline(hist)
+            result["live_fallback"] = {
+                k: best[k] for k in
+                ("variant", "platform", "dtype", "gflops", "ts")
+                if k in best}
     return result
 
 
@@ -339,13 +455,18 @@ def sweep(platform: str) -> None:
     # (trailing="xla" delegates the whole factorization to one fused XLA
     # cholesky — no step chain to pipeline, so it has no "+la1" arm; the
     # unrolled-order A/B rides the stepped forms instead)
+    # the eigensolver stage A/B arms (tridiag dc_level_batch, btr2b
+    # bt_lookahead — ISSUE 6) run LAST: the headline cholesky sweep owns
+    # the budget, and the stage pairs are informational artifact rows
     ab_arm = "ozaki_dots" if platform == "tpu" else "ozaki_concat"
     order = ["ozaki", "ozaki+la1", ab_arm, "xla", "scan", "scan+la1",
-             "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm"]
+             "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm",
+             "tridiag", "tridiag+dcb1", "btr2b", "btr2b+btla1", "btb2t"]
 
     def _known(v):
         b = v[: -len("+la1")] if v.endswith("+la1") else v
-        return b in VALID_TRAILING or v == ab_arm
+        return b in VALID_TRAILING or v == ab_arm \
+            or v.split("+")[0] in STAGE_BASES
 
     variants = [pinned] if pinned else \
         [v for v in order if _known(v)] + \
@@ -412,13 +533,20 @@ def sweep(platform: str) -> None:
         sys.exit(1)
     n = int(os.environ.get("DLAF_BENCH_N", "4096"))
     nb = int(os.environ.get("DLAF_BENCH_NB", "256"))
-    best = max(results, key=lambda r: r["gflops"])  # best LIVE result
     result = assemble_headline(results, n, nb)
+    if result is None:
+        # stage arms alone cannot stand in for the cholesky headline
+        log("no cholesky variant produced a measurement (and no recorded "
+            "TPU history to replay)")
+        sys.exit(1)
     print(json.dumps(result), flush=True)
 
+    chol = [r for r in results if r.get("workload") in (None, "cholesky")]
+    best = max(chol, key=lambda r: r["gflops"]) if chol else None
     # informational MXU-tier number (stderr only — the headline metric
     # stays f64 per BASELINE config #1)
-    if best["dtype"] == "float64" and time.perf_counter() - sweep_t0 < budget_s:
+    if best is not None and best["dtype"] == "float64" \
+            and time.perf_counter() - sweep_t0 < budget_s:
         env = dict(os.environ)
         env["DLAF_BENCH_VARIANT"] = best["variant"]
         env["DLAF_BENCH_DTYPE"] = "float32"

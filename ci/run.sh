@@ -79,7 +79,43 @@ print("native-load injection degraded to numpy:", c)
 obs.flush()
 EOF
     python -m dlaf_tpu.obs.validate "$HEALTH_ART" \
-      --require-spans --require-retries --require-fallbacks ;;
+      --require-spans --require-retries --require-fallbacks
+    echo "== smoke: eigensolver pipeline (batched D&C + pipelined bt) =="
+    # distributed eigensolver on a 2x2 virtual-CPU grid with the two
+    # ISSUE-6 knobs pinned ON (the CPU auto would resolve both off): the
+    # artifact must carry the level-batched merge counters
+    # (dlaf_dc_merges_total{mode=batched}) AND the hoisted bt-collective
+    # counters (dlaf_comm_overlapped_total{algo=bt_*}) — the audit trail
+    # that the batched/pipelined programs were actually built
+    # (docs/eigensolver_perf.md)
+    EIG_ART=$(mktemp -d)/eigensolver_metrics.jsonl
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+      DLAF_METRICS_PATH="$EIG_ART" \
+      DLAF_DC_LEVEL_BATCH=1 DLAF_BT_LOOKAHEAD=1 DLAF_DIST_STEP_MODE=unrolled \
+      python - <<'EOF'
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.eigensolver.eigensolver import eigensolver
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+n, nb = 64, 8
+x = rng.standard_normal((n, n))
+a = (x + x.T) / 2
+res = eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb),
+                                          grid=Grid(2, 2)))
+q = res.eigenvectors.to_numpy()
+resid = np.linalg.norm(a @ q - q * res.eigenvalues[None, :])
+assert resid < 1e-10 * n, resid
+print(f"eigensolver smoke ok: n={n} residual={resid:.2e}")
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$EIG_ART" \
+      --require-spans --require-dc-batch --require-bt-overlap ;;
   main)
     python -m pytest tests/ -q -m "not slow" ;;
   full)

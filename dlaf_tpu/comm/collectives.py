@@ -225,7 +225,22 @@ def all_gather(x, axis: str, *, tiled: bool = False, concat_axis: int = 0):
     the full panel (reference ``broadcast_panel.h`` achieves the same with
     per-tile bcasts)."""
     _record("all_gather", axis, x)
+    x = _maybe_inject("all_gather", axis, x)
     return lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
+    """Tiled all-to-all along ``axis`` (the layout-transpose verb of the
+    distributed chase back-transform, eigensolver/back_transform.py: each
+    rank scatters ``split_axis`` slices and concatenates the received
+    ones along ``concat_axis``). The reference pipelines per-tile sends
+    instead (``bt_band_to_tridiag/impl.h``); on ICI one all_to_all moves
+    V(p-1)/p per link in a single collective. Accounted and injectable
+    like every other verb."""
+    _record("all_to_all", axis, x)
+    x = _maybe_inject("all_to_all", axis, x)
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
 
 
 def barrier_value(x, axis: str):

@@ -109,6 +109,39 @@ class Configuration:
     #: native routes (same collectives, same payloads, same per-cell
     #: application order; pinned by the comm A/Bs in tests/).
     comm_lookahead: str = "auto"
+    #: Level-batched divide-and-conquer merge execution in the tridiagonal
+    #: eigensolver (eigensolver/tridiag_solver.py, docs/eigensolver_perf.md):
+    #: every merge within one D&C tree level is independent, and "1" runs
+    #: all same-shape merges of a level as ONE vmapped device dispatch
+    #: (secular solve, qc assembly, Q·C apply) with small merges padded to
+    #: the group's max deflated-size bucket — the batch-many-small-problems
+    #: idiom arXiv:2112.09017 credits TPU MXU utilization to — while the
+    #: host control scan of the next group overlaps the dispatched device
+    #: work. "0" walks the tree one merge at a time (the recursive
+    #: reference order, ``merge.h:790-887``). "auto" (default): 1 on TPU
+    #: (the serialized walk is dispatch-bound there: every small merge
+    #: pays a full host->device round trip), 0 elsewhere. Results match
+    #: the serialized walk bitwise on the host-secular route; the
+    #: device-secular route re-buckets to the group's max k, whose padded
+    #: zero terms may reassociate at <= 1 ulp (docs/eigensolver_perf.md
+    #: exception table). Counted per level in
+    #: ``dlaf_dc_merges_total{mode=batched|serialized}``.
+    dc_level_batch: str = "auto"
+    #: Look-ahead for the reflector-block back-transform
+    #: (bt_reduction_to_band, local + distributed): "1" emits reflector
+    #: block k+1's larft/T-factor chain — and, distributed, its panel
+    #: gather collectives — BEFORE block k's bulk trmm+gemm application,
+    #: so the latency-bound T factor and the ICI transfer hide under the
+    #: MXU bulk exactly like ``cholesky_lookahead``/``comm_lookahead`` do
+    #: for the factorizations (docs/lookahead.md, docs/comm_overlap.md).
+    #: "0" keeps the plain per-block emission order. "auto" (default): 1
+    #: on TPU, 0 elsewhere. Bitwise identical either way (the T chain
+    #: reads only the constant reflector storage — a pure emission
+    #: reorder); hoisted collectives count under
+    #: ``dlaf_comm_overlapped_total{algo="bt_r2b_dist"}``. The scan-form
+    #: distributed builder already emits its panel gather ahead of the
+    #: bulk by construction; there the knob only labels the structure.
+    bt_lookahead: str = "auto"
     #: bt_band_to_tridiag reflector application: "blocked" (compact-WY
     #: staircase groups -> larft + two gemms per step level, the MXU form of
     #: the reference's b x b HH re-tiling) or "sweeps" (one batched rank-1
@@ -408,6 +441,8 @@ _VALID_CHOICES = {
     "bt_b2t_impl": ("blocked", "sweeps"),
     "cholesky_lookahead": ("0", "1", "auto"),
     "comm_lookahead": ("0", "1", "auto"),
+    "dc_level_batch": ("0", "1", "auto"),
+    "bt_lookahead": ("0", "1", "auto"),
     "f64_gemm": ("native", "mxu", "auto"),
     "f64_trsm": ("native", "mixed", "auto"),
     "ozaki_impl": ("jnp", "pallas"),
@@ -599,6 +634,33 @@ def resolved_comm_lookahead() -> bool:
                "trailing product (arXiv:2112.09017's overlapped SUMMA "
                "updates); off-TPU the thunk executor runs collectives "
                "serially anyway") == "1"
+
+
+def resolved_dc_level_batch() -> bool:
+    """``dc_level_batch`` with "auto" resolved (True = level-batched D&C
+    merges): 1 on TPU, 0 elsewhere (see the knob docstring and
+    docs/eigensolver_perf.md)."""
+    return resolve_platform_auto(
+        get_configuration().dc_level_batch, knob="dc_level_batch",
+        tpu_choice="1", other_choice="0",
+        detail="the serialized merge walk pays one host->device dispatch "
+               "round trip per small merge; batching a level's merges "
+               "into one vmapped program is the arXiv:2112.09017 idiom "
+               "that earns MXU utilization on many small problems") == "1"
+
+
+def resolved_bt_lookahead() -> bool:
+    """``bt_lookahead`` with "auto" resolved (True = pipelined reflector
+    blocks): 1 on TPU, 0 elsewhere (see the knob docstring and
+    docs/eigensolver_perf.md)."""
+    return resolve_platform_auto(
+        get_configuration().bt_lookahead, knob="bt_lookahead",
+        tpu_choice="1", other_choice="0",
+        detail="the reflector-block T-factor chain (and its panel gather "
+               "collectives, distributed) is latency-bound and reads only "
+               "constant reflector storage; emitting block k+1's chain "
+               "before block k's bulk application lets it hide under the "
+               "MXU bulk") == "1"
 
 
 #: Step counts at which ``dist_step_mode="auto"`` switches to the scan

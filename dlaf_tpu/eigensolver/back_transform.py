@@ -214,7 +214,7 @@ def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int,
     def run(v_all, tau_all, phase, lt):
         x = jnp.pad(lt, ((0, 0), (0, ltc_pad - ltc), (0, 0), (0, 0)))
         # block-cyclic rows -> full rows x 1/P of my column group's columns
-        x = lax.all_to_all(x, ROW_AXIS, split_axis=1, concat_axis=0, tiled=True)
+        x = cc.all_to_all(x, ROW_AXIS, split_axis=1, concat_axis=0)
         x = x[row_order]                              # global row-tile order
         e = x.transpose(0, 2, 1, 3).reshape(Sr * nb, chunk * nb)[:n]
         if cplx:
@@ -225,7 +225,7 @@ def _build_dist_bt_b2t(dist, mesh, *, b: int, cplx: bool, n_sweeps: int,
         e = jnp.pad(e, ((0, Sr * nb - n), (0, 0)))
         x = e.reshape(Sr, nb, chunk, nb).transpose(0, 2, 1, 3)
         x = x[inv_order]
-        x = lax.all_to_all(x, ROW_AXIS, split_axis=0, concat_axis=1, tiled=True)
+        x = cc.all_to_all(x, ROW_AXIS, split_axis=0, concat_axis=1)
         return x[:, :ltc]
 
     return shard_map(run, mesh=mesh,
@@ -255,6 +255,21 @@ def _bt_b2t_local_array(tri: TridiagResult, e) -> jax.Array:
                                    e, b=tri.band, n=n, impl=impl, group=group)
 
 
+def _bt_b2t_entry_span(tri: TridiagResult, m: int, impl: str, group: int,
+                       grid: str):
+    """Entry span: chase back-transform flop model n^2*m muls + n^2*m
+    adds (one rank-1 segment update per reflector;
+    docs/eigensolver_perf.md)."""
+    from .. import obs
+    from ..types import total_ops
+
+    n = tri.d.shape[0]
+    dt = np.dtype(tri.v.dtype)
+    return obs.entry_span("bt_band_to_tridiag", lambda: dict(
+        flops=total_ops(dt, n**2 * m, n**2 * m), n=n, m=m, band=tri.band,
+        dtype=dt.name, impl=impl, group=group, grid=grid))
+
+
 def bt_band_to_tridiag(tri: TridiagResult, evecs):
     """Eigenvectors of the BAND matrix from eigenvectors of the tridiagonal:
     apply the complex phases (see band_to_tridiag), then the chase reflectors
@@ -264,10 +279,20 @@ def bt_band_to_tridiag(tri: TridiagResult, evecs):
     :class:`~dlaf_tpu.matrix.matrix.Matrix` (local or distributed; returns a
     Matrix — reference distributed overload ``bt_band_to_tridiag/api.h:21-22``).
     """
+    impl_l, group_l = _bt_b2t_params()
+    # span attr carries the RESOLVED group (same meaning as the
+    # distributed span below, where it keys the compiled-program cache)
+    group_l = _effective_group(tri.band, int(tri.v.shape[0]), group_l) \
+        if impl_l == "blocked" else 0
     if not isinstance(evecs, Matrix):
-        return _bt_b2t_local_array(tri, evecs)
+        m = evecs.shape[1] if getattr(evecs, "ndim", 2) > 1 else 1
+        with _bt_b2t_entry_span(tri, m, impl_l, group_l, "1x1"):
+            return _bt_b2t_local_array(tri, evecs)
     if evecs.grid is None or evecs.grid.num_devices == 1:
-        out = _bt_b2t_local_array(tri, tiles_to_global(evecs.storage, evecs.dist))
+        with _bt_b2t_entry_span(tri, evecs.size.col, impl_l, group_l, "1x1"):
+            out = _bt_b2t_local_array(tri,
+                                      tiles_to_global(evecs.storage,
+                                                      evecs.dist))
         return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
     dlaf_assert(evecs.size.row == tri.d.shape[0],
                 "bt_band_to_tridiag: eigenvector rows != n")
@@ -277,37 +302,59 @@ def bt_band_to_tridiag(tri: TridiagResult, evecs):
     storage = evecs.storage
     if cplx and not np.issubdtype(storage.dtype, np.complexfloating):
         storage = storage.astype(tri.v.dtype)
-    impl, group = _bt_b2t_params()
-    # normalized cache key: group is pre-clamped and irrelevant for "sweeps",
-    # so equivalent configurations share one compiled program
-    n_sweeps = int(tri.v.shape[0])
-    group = _effective_group(tri.band, n_sweeps, group) if impl == "blocked" else 0
+    # normalized cache key = the resolved (impl_l, group_l) from entry:
+    # group is pre-clamped and irrelevant for "sweeps", so equivalent
+    # configurations share one compiled program — and the span attrs
+    # above carry exactly the values that key the cache
     fn = _dist_bt_b2t_cached(evecs.dist, evecs.grid.mesh, tri.band, cplx,
-                             n_sweeps, impl, group)
-    out = fn(memory.as_device(tri.v), memory.as_device(tri.tau),
-             memory.as_device(tri.phase), storage)
+                             int(tri.v.shape[0]), impl_l, group_l)
+    with _bt_b2t_entry_span(
+            tri, evecs.size.col, impl_l, group_l,
+            f"{evecs.dist.grid_size.row}x{evecs.dist.grid_size.col}"):
+        out = fn(memory.as_device(tri.v), memory.as_device(tri.tau),
+                 memory.as_device(tri.phase), storage)
     return Matrix(evecs.dist, out, evecs.grid)
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("nb",))
-def _bt_r2b_local(a_v, taus, e, *, nb: int):
+@functools.partial(jax.jit, static_argnames=("nb", "la"))
+def _bt_r2b_local(a_v, taus, e, *, nb: int, la: bool = False):
+    """C <- (I - V T V^H) C per reflector block, reverse order.
+
+    ``la`` (``bt_lookahead=1``, docs/eigensolver_perf.md): the next
+    block's tril/larft T-factor chain reads only the CONSTANT (a_v, taus)
+    storage — never the updated ``e`` — so it is emitted BEFORE the
+    current block's bulk trmm+gemm application, freeing XLA's scheduler
+    to hide the latency-bound chain under the MXU bulk (the PR-2
+    look-ahead treatment; same ops either way, bitwise identical)."""
     n = a_v.shape[0]
     nt = ceil_div(n, nb) if n else 0
-    for k in range(nt - 2, -1, -1):
+    ks = [k for k in range(nt - 2, -1, -1) if n - (k + 1) * nb > 0]
+
+    def chain(k):
         k1 = (k + 1) * nb
         m_p = n - k1
-        if m_p <= 0:
-            continue
         vf = a_v[k1:, k * nb: k * nb + nb]
         v = jnp.tril(vf, -1) + jnp.eye(m_p, nb, dtype=a_v.dtype)
-        t = larft(v, taus[k])
+        return k1, v, larft(v, taus[k])
+
+    if la:
+        pend = chain(ks[0]) if ks else None
+        for i in range(len(ks)):
+            k1, v, t = pend
+            # emit block i+1's T chain ahead of block i's bulk application
+            pend = chain(ks[i + 1]) if i + 1 < len(ks) else None
+            w = t @ tb.mm(jnp.conj(v).T, e[k1:])
+            e = e.at[k1:].add(-tb.mm(v, w))
+        return e
+    for k in ks:
+        k1, v, t = chain(k)
         w = t @ tb.mm(jnp.conj(v).T, e[k1:])
         e = e.at[k1:].add(-tb.mm(v, w))
     return e
 
 
-def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
+def _build_dist_bt_r2b(dist_a, dist_c, mesh, band, la: bool = False):
     """Distributed reflector-block back-transform C <- (I - V T V^H) C,
     panels in reverse order (reference ``bt_reduction_to_band/impl.h:82-373``:
     trmmPanel W=VT, gemmUpdateW2 W2=W^H C, gemmTrailingMatrix C-=V W2).
@@ -316,7 +363,17 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
     of V at element columns [p*band, (p+1)*band), acting on C rows >=
     (p+1)*band — static sub-tile offsets, element-level masks, same scheme
     as the generalized forward reduction (beyond-reference: the reference's
-    distributed back-transform exists only for band == block size)."""
+    distributed back-transform exists only for band == block size).
+
+    ``la`` (``bt_lookahead=1``): panel p+1's whole chain — the V
+    sub-panel gather (one COL bcast + one ROW all_gather), larft, and the
+    C-side masks — reads only the CONSTANT (lt_a, taus), so it is emitted
+    BEFORE panel p's bulk W2/update contractions; XLA's async collective
+    start/done pairs can then run the ICI transfer and the latency-bound
+    T factor while the MXU grinds the bulk (the PR-4 comm look-ahead
+    treatment, docs/comm_overlap.md). Hoisted chains count under
+    ``dlaf_comm_overlapped_total{algo="bt_r2b_dist"}``. Bitwise identical
+    either way — a pure emission reorder."""
     nt = dist_a.nr_tiles.row
     nb = dist_a.block_size.row
     n = dist_a.size.row
@@ -327,38 +384,66 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
         ctx_a = DistContext(dist_a)
         ctx_c = DistContext(dist_c)
         arange_nb = jnp.arange(nb)
-        for p in range(npan - 1, -1, -1):
+
+        def chain(p):
+            """Panel p's hoistable prefix (constant-storage reads only);
+            None when this step is a no-op on every rank (trace-time)."""
             bdy = (p + 1) * b
-            # -- gather the full V sub-panel (element rows >= bdy) -----------
+            # -- gather the full V sub-panel (element rows >= bdy) -------
             got = gather_sub_panel(ctx_a, lt_a, pb=p * b, b=b, n=n)
             if got is None:
-                continue
-            vfull, _, tr0, ro, _, _ = got  # A-side masks unused: the C-side
-            # loop below recomputes its own element masks from ctx_c
+                return None
+            vfull, _, tr0, ro, _, _ = got  # A-side masks unused: the
+            # C-side masks below are recomputed from ctx_c
             m_p = (nt - tr0) * nb - ro
             v = jnp.tril(vfull, -1) + jnp.eye(m_p, b, dtype=vfull.dtype)
             t = larft(v, taus[p])
             vt = pad_sub_panel_to_tiles(ctx_a, v, tr0=tr0, ro=ro)
-
-            # -- W2 = T (V^H C): partial V^H C over my C rows, psum 'row' ----
             luc = ctx_c.row_start(tr0)
             nrows_c = ctx_c.ltr - luc
             if nrows_c <= 0:
-                continue
+                return None
             g_rows_c = ctx_c.g_rows(luc, nrows_c)
             g_erows_c = g_rows_c[:, None] * nb + arange_nb[None, :]
             rv_c_e = (g_erows_c >= bdy) & (g_erows_c < n)
             sel = jnp.clip(g_rows_c - tr0, 0, nt - tr0 - 1)
             v_my = jnp.where(rv_c_e[:, :, None], vt[sel],
-                             jnp.zeros((nrows_c, nb, b), dtype=vfull.dtype))
+                             jnp.zeros((nrows_c, nb, b),
+                                       dtype=vfull.dtype))
+            return luc, t, v_my
+
+        def update(ch, lt_c):
+            """Panel p's bulk: W2 = T (V^H C) psum'd over 'row', then
+            C -= V W2 — the only reads of the updated C."""
+            luc, t, v_my = ch
             cpart = lt_c[luc:]
             w2 = tb.contract("rab,rcad->cbd", jnp.conj(v_my), cpart)
-            w2 = cc.all_reduce(w2, ROW_AXIS)         # (ltc_c, b, nb_c) = V^H C
+            w2 = cc.all_reduce(w2, ROW_AXIS)     # (ltc_c, b, nb_c) = V^H C
             w2 = tb.contract("xb,cbd->cxd", t, w2)
-
-            # -- C -= V W2 (local rows x local cols) -------------------------
             upd = tb.contract("rab,cbd->rcad", v_my, w2)
-            lt_c = lt_c.at[luc:].add(-upd)
+            return lt_c.at[luc:].add(-upd)
+
+        ps = range(npan - 1, -1, -1)
+        if la:
+            pend = None
+            for p in ps:
+                ch = chain(p)      # emitted ahead of pend's bulk update
+                if ch is None:
+                    continue
+                if pend is not None:
+                    # this chain's collectives overlap the pending bulk
+                    cc.record_overlapped("bt_r2b_dist", ROW_AXIS, 1)
+                    cc.record_overlapped("bt_r2b_dist", COL_AXIS, 1)
+                    lt_c = update(pend, lt_c)
+                pend = ch
+            if pend is not None:
+                lt_c = update(pend, lt_c)
+            return lt_c
+        for p in ps:
+            ch = chain(p)
+            if ch is None:
+                continue
+            lt_c = update(ch, lt_c)
         return lt_c
 
     return shard_map(run, mesh=mesh,
@@ -366,7 +451,7 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band):
                      out_specs=P(ROW_AXIS, COL_AXIS), check_vma=False)
 
 
-def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
+def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band, la: bool = False):
     """``lax.scan`` form of the distributed back-transform
     (``dist_step_mode="scan"``): one compiled reflector-block step looped
     ``ceil(n/b) - 1`` times in reverse — config #5's back-transform has
@@ -376,7 +461,14 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
     reverse sweep: panel ``p`` only touches C rows at element >= (p+1)*b,
     so early segments (large ``p``) work on a small bottom window of the
     row-slot axis that grows as the sweep ascends; the W2 psum and the C
-    update run over the window's slots under traced element masks."""
+    update run over the window's slots under traced element masks.
+
+    The body already emits its panel gather (COL bcast + ROW all_gather)
+    and larft AHEAD of the bulk contractions, reading only the constant
+    (sub_a, taus) — overlap by construction, like the PR-4 scan bodies;
+    ``la`` (``bt_lookahead=1``) labels the structure and books the
+    per-body overlap counters (trace-time: once per telescope segment,
+    not per executed step — the PR-4 scan caveat)."""
     nt = dist_a.nr_tiles.row
     nb = dist_a.block_size.row
     n = dist_a.size.row
@@ -395,6 +487,11 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
 
             def step(sub_c, i):
                 p = npan - 1 - i
+                if la:
+                    # the gather below reads only constant storage and is
+                    # emitted ahead of this body's bulk contractions
+                    cc.record_overlapped("bt_r2b_dist_scan", ROW_AXIS, 1)
+                    cc.record_overlapped("bt_r2b_dist_scan", COL_AXIS, 1)
                 pan, bdy, _, _, _, _, _ = gather_sub_panel_dyn(
                     ctx_a, sub_a, p=p, b=b, n=n,
                     row_off=lu_off, col_off=lc_off)
@@ -443,9 +540,22 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band):
 
 @register_program_cache
 @functools.lru_cache(maxsize=32)
-def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band, scan=False):
+def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band, scan=False, la=False):
     build = _build_dist_bt_r2b_scan if scan else _build_dist_bt_r2b
-    return jax.jit(build(dist_a, dist_c, mesh, band))
+    return jax.jit(build(dist_a, dist_c, mesh, band, la=la))
+
+
+def _bt_r2b_entry_span(red: BandReduction, n: int, m: int, la: bool,
+                       grid: str):
+    """Entry span (docs/observability.md): block-reflector application
+    flop model n^2*m muls + n^2*m adds (docs/eigensolver_perf.md)."""
+    from .. import obs
+    from ..types import total_ops
+
+    dt = np.dtype(red.matrix.dtype)
+    return obs.entry_span("bt_reduction_to_band", lambda: dict(
+        flops=total_ops(dt, n**2 * m, n**2 * m), n=n, m=m,
+        band=red.band, dtype=dt.name, bt_lookahead=int(la), grid=grid))
 
 
 def bt_reduction_to_band(red: BandReduction, evecs):
@@ -455,7 +565,15 @@ def bt_reduction_to_band(red: BandReduction, evecs):
     Local when ``red.matrix`` is local (``evecs`` array -> array); distributed
     when both ``red.matrix`` and ``evecs`` live on a grid (Matrix -> Matrix,
     reference ``bt_reduction_to_band/api.h:18-23`` distributed overload).
+
+    Under ``bt_lookahead=1`` (auto: TPU) reflector block k+1's T-factor
+    chain — and, distributed, its panel gather collectives — is emitted
+    ahead of block k's bulk application (docs/eigensolver_perf.md);
+    results are bitwise identical either way.
     """
+    from ..config import resolved_bt_lookahead
+
+    la = resolved_bt_lookahead()
     a = red.matrix
     if isinstance(evecs, Matrix) and a.grid is not None and a.grid.num_devices > 1:
         dlaf_assert(evecs.grid is not None
@@ -476,8 +594,11 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band,
                                  scan=resolve_step_mode(max(
                                      -(-a.size.row // red.band) - 1, 1))
-                                 == "scan")
-        out = fn(a.storage, memory.as_device(red.taus), storage)
+                                 == "scan", la=la)
+        with _bt_r2b_entry_span(
+                red, a.size.row, evecs.size.col, la,
+                f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"):
+            out = fn(a.storage, memory.as_device(red.taus), storage)
         return Matrix(evecs.dist, out, evecs.grid)
     a_v = tiles_to_global(a.storage, a.dist)
     arr = evecs
@@ -485,7 +606,10 @@ def bt_reduction_to_band(red: BandReduction, evecs):
     if ret_matrix:
         arr = tiles_to_global(evecs.storage, evecs.dist)
     e = memory.as_device(arr).astype(a_v.dtype)
-    out = _bt_r2b_local(a_v, memory.as_device(red.taus), e, nb=red.band)
+    with _bt_r2b_entry_span(red, a.size.row,
+                            e.shape[1] if e.ndim > 1 else 1, la, "1x1"):
+        out = _bt_r2b_local(a_v, memory.as_device(red.taus), e, nb=red.band,
+                            la=la)
     if ret_matrix:
         return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
     return out

@@ -103,21 +103,23 @@ def band_to_tridiag_numpy(band: np.ndarray, b: int) -> TridiagResult:
             dlen = m - r
             wb[r, j0: j0 + dlen] = w[np.arange(r, m), np.arange(dlen)]
 
+    def _block_rows(i0, j0, mr, mc):
+        """Banded-storage row indices of the dense block A[i0:i0+mr,
+        j0:j0+mc]: column j0+c starts at storage row i0-(j0+c), so the
+        block is an anti-diagonal window — one fancy-index gather/scatter
+        instead of a per-column Python loop (it is the reference twin
+        every bitwise test runs against; the loops were O(n*b) interpreter
+        iterations on the pipeline's host critical path)."""
+        return (i0 - j0 - np.arange(mc))[None, :] + np.arange(mr)[:, None]
+
     def get_block(i0, j0, mr, mc):
         """Dense A[i0:i0+mr, j0:j0+mc] (strictly below-diag block)."""
-        w = np.zeros((mr, mc), dtype=dtype)
-        for c in range(mc):
-            col = j0 + c
-            r0 = i0 - col
-            w[:, c] = wb[r0: r0 + mr, col]
-        return w
+        return wb[_block_rows(i0, j0, mr, mc),
+                  j0 + np.arange(mc)[None, :]]
 
     def put_block(i0, j0, w):
         mr, mc = w.shape
-        for c in range(mc):
-            col = j0 + c
-            r0 = i0 - col
-            wb[r0: r0 + mr, col] = w[:, c]
+        wb[_block_rows(i0, j0, mr, mc), j0 + np.arange(mc)[None, :]] = w
 
     n_sweeps = max(n - 2, 0)
     n_steps = ceil_div(max(n - 1, 1), b) if n > 1 else 0

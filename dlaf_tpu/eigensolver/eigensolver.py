@@ -78,11 +78,17 @@ def eigensolver(uplo: str, a: Matrix,
     from .. import obs
     from ..types import total_ops
 
+    from ..config import resolved_bt_lookahead, resolved_dc_level_batch
+
     # canonical full-EVP flop model (miniapp_eigensolver): 5n^3/3
-    # muls+adds; the five stage spans below nest under this one
+    # muls+adds; the five stage spans below nest under this one. The
+    # pipeline-throughput knobs (docs/eigensolver_perf.md) ride along so
+    # one span record says which trailing-stage formulation ran.
     pipeline_span = obs.entry_span("eigensolver", lambda: dict(
         flops=total_ops(np.dtype(a.dtype), 5 * n**3 / 3, 5 * n**3 / 3),
         n=n, nb=nb, uplo=uplo, dtype=np.dtype(a.dtype).name,
+        dc_level_batch=int(resolved_dc_level_batch()),
+        bt_lookahead=int(resolved_bt_lookahead()),
         grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
     with pipeline_span:
         return _eigensolver_pipeline(uplo, a, pt, fence, distributed,

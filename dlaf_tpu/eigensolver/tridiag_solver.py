@@ -19,6 +19,7 @@ suffer cancellation.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import numpy as np
@@ -378,30 +379,115 @@ def _apply_qc_jit(mesh):
     algorithm's trailing products (raw jnp.matmul kept them on the
     ~342 GF/s emulated-f64 tier regardless of the knob)."""
     def fn(q1, q2, qc):
-        n1 = q1.shape[0]
-        top = tb.mm(q1, qc[:n1, :])
-        bot = tb.mm(q2, qc[n1:, :])
-        return jnp.concatenate([top, bot], axis=0)
+        # FRESH closure per builder call: jax.jit keyed on a module-level
+        # function would survive this lru cache's config-change clearing
+        # (jit's trace cache keys on the underlying callable), resurrecting
+        # a program traced under the previous f64_gemm route
+        return _apply_qc_fn(q1, q2, qc)
 
     if mesh is None:
         return jax.jit(fn)
     return jax.jit(fn, out_shardings=_q_2d_sharding(mesh))
 
 
-def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool, mesh=None):
-    """One Cuppen merge (reference ``merge.h:790-887``).
+def _apply_qc_fn(q1, q2, qc):
+    """The one merge-apply kernel (shared by the per-merge and the
+    vmapped level-batched programs, so the two walks can never drift
+    apart and break the bitwise contract)."""
+    n1 = q1.shape[0]
+    top = tb.mm(q1, qc[:n1, :])
+    bot = tb.mm(q2, qc[n1:, :])
+    return jnp.concatenate([top, bot], axis=0)
 
-    Division of labor (device path): O(n) control work (sort, deflation
-    scan, liveness) on host; the secular solve on host (small k) or device
-    (large k, bucketed); and ALL O(n^2) workspace assembly on device
-    (:func:`_assemble_qc_impl`) — host memory stays O(n + k^2_small) per
-    merge, against the round-1 review's O(n^2) host ``u_sorted``/``qc``.
-    With ``mesh``, the merge gemms and their Q outputs are 2D-sharded."""
+
+@register_program_cache
+@functools.lru_cache(maxsize=None)
+def _secular_vcols_batched_jit():
+    """vmapped device secular solve for one level batch of same-bucket
+    merges (``dc_level_batch=1``): every lane is an independent merge's
+    deflated problem, padded to the group's max bucket, so a whole tree
+    level's secular work lands in ONE device dispatch instead of one per
+    merge. Sharded merges never batch (they keep the per-merge
+    :func:`_secular_vcols_jit` with its mesh shardings)."""
+    return jax.jit(jax.vmap(_secular_vcols_device))
+
+
+@register_program_cache
+@functools.lru_cache(maxsize=None)
+def _assemble_qc_batched_jit(n: int):
+    """vmapped qc assembly over a level group of same-(n, kb, gb) merges."""
+    return jax.jit(jax.vmap(functools.partial(_assemble_qc_impl, n=n)))
+
+
+@register_program_cache
+@functools.lru_cache(maxsize=None)
+def _apply_qc_batched_jit():
+    """vmapped merge gemms over a level group: the batched dot_general is
+    the MXU-earning form of many small Q·C products (arXiv:2112.09017).
+    Same kernel as the per-merge program (:func:`_apply_qc_fn`; the vmap
+    wrapper is a fresh callable per builder call, so jit retraces after a
+    config-change cache clear)."""
+    return jax.jit(jax.vmap(_apply_qc_fn))
+
+
+def _count_merges(mode: str, n: int = 1) -> None:
+    """Per-level merge-dispatch accounting (docs/eigensolver_perf.md):
+    ``dlaf_dc_merges_total{mode=batched|serialized}`` counts how many
+    merges ran through the level-batched vmapped dispatch vs one-at-a-time
+    programs."""
+    from .. import obs
+
+    if n and obs.metrics_active():
+        obs.counter("dlaf_dc_merges_total", mode=mode).inc(n)
+
+
+@dataclasses.dataclass
+class _MergeCtl:
+    """Host control state of one Cuppen merge, split in two phases so the
+    level-batched driver can interleave the host control scans with the
+    device dispatches: :func:`_merge_ctl_pre` (sort + deflation + host
+    secular solve / device-secular prep), then — once ``lam_live`` exists
+    — :func:`_merge_ctl_fin` (final eigenvalue order + the pole-sort
+    undo). All fields are O(n) host arrays or scalars; the O(n^2)
+    workspaces stay on device."""
+
+    n1: int
+    n2: int
+    neg: bool
+    decoupled: bool = False
+    rho_n: float = 0.0
+    order: np.ndarray = None
+    ds: np.ndarray = None           # sorted (negated) poles, full n
+    k: int = 0
+    kb: int = 0                     # secular bucket (>= k, power of two)
+    idx_live: np.ndarray = None
+    idx_defl: np.ndarray = None
+    gi: np.ndarray = None           # deflation Givens rotations
+    gj: np.ndarray = None
+    gc: np.ndarray = None
+    gs: np.ndarray = None
+    dsk: np.ndarray = None          # live poles/weights (secular inputs)
+    zsk: np.ndarray = None
+    dev_secular: bool = False       # secular solve deferred to the device
+    vcols: np.ndarray = None        # host secular output (k, k)
+    lam_live: np.ndarray = None     # host-mode roots (ready after pre)
+    lam: np.ndarray = None          # final ascending eigenvalues
+    fin: np.ndarray = None
+    inv_order: np.ndarray = None
+
+    @property
+    def n(self) -> int:
+        return self.n1 + self.n2
+
+
+def _merge_ctl_pre(lam1, lam2, z, rho_signed, use_device: bool,
+                   dev_min_k: int) -> _MergeCtl:
+    """Phase 1 of a merge's host control work (reference
+    ``merge.h:443-629``): rank-one tear normalization, pole sort,
+    deflation scan, and either the host secular solve + Gu-Eisenstat
+    refinement (small k) or the device-secular prep (large k — the solve
+    itself is dispatched by the caller, per merge or level-batched)."""
     n1, n2 = lam1.shape[0], lam2.shape[0]
-    n = n1 + n2
-    dtype = q1.dtype
-    # rank-one coupling: z from the edge rows of the subproblem eigenvectors
-    z = np.concatenate([np.asarray(q1[-1, :]), np.asarray(q2[0, :])])
     d = np.concatenate([lam1, lam2])
     # rho < 0: rho*z z^T is negative semidefinite, so solve the negated
     # problem -T = diag(-d) + |rho| z z^T (same eigenvectors, negated
@@ -410,32 +496,21 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool, mesh=None):
     rho = abs(rho_signed)
     if neg:
         d = -d
-
-    def apply_qc(lam, qc_dev=None, qc_host=None):
-        """blkdiag(q1, q2) @ qc — device gemms keep Q device-resident
-        across the whole merge tree; only O(n) vectors cross to the host.
-        Under a mesh the gemms run sharded (SUMMA via GSPMD)."""
-        if use_device:
-            return lam, _apply_qc_jit(mesh)(
-                jnp.asarray(q1), jnp.asarray(q2), qc_dev)
-        return lam, np.vstack([q1 @ qc_host[:n1, :], q2 @ qc_host[n1:, :]])
-
+    ctl = _MergeCtl(n1=n1, n2=n2, neg=neg)
     znorm2 = float(z @ z)
     if rho * znorm2 <= 1e-300:  # fully decoupled
         lam = -d if neg else d
         fin = np.argsort(lam, kind="stable")
-        lam = lam[fin]
-        if use_device:
-            qc = _eye_perm_jit(n, np.dtype(dtype).name, mesh)(
-                jnp.asarray(fin))
-            return apply_qc(lam, qc_dev=qc)
-        return apply_qc(lam, qc_host=np.eye(n, dtype=dtype)[:, fin])
-
+        ctl.decoupled = True
+        ctl.lam = lam[fin]
+        ctl.fin = fin
+        return ctl
     zn = z / np.sqrt(znorm2)
-    rho_n = rho * znorm2
+    ctl.rho_n = rho_n = rho * znorm2
     # sort poles
     order = np.argsort(d, kind="stable")
     ds, zs = d[order].copy(), zn[order].copy()
+    ctl.order, ctl.ds = order, ds
 
     # -- deflation (reference merge.h:443-508) ------------------------------
     dmax = np.abs(ds).max(initial=0.0)
@@ -443,122 +518,384 @@ def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool, mesh=None):
     # dropping z_j perturbs the matrix by ~rho_n*|z_j|; deflate when that
     # is below eps * ||T|| (LAPACK dlaed2 criterion)
     live = rho_n * np.abs(zs) > 8 * _EPS * max(dmax, rho_n)
-    gi, gj, gc, gs = _deflation_scan(ds, zs, live, tol)
-    idx_live = np.nonzero(live)[0]
-    idx_defl = np.nonzero(~live)[0]
-    k = idx_live.shape[0]
-
-    lam = np.empty(n)
-    vcols_dev = None          # (kb, kb) device secular output (large k)
-    vcols = None              # (k, k) host secular output (small k)
-    kb = 1 << max(0, (k - 1).bit_length())
+    ctl.gi, ctl.gj, ctl.gc, ctl.gs = _deflation_scan(ds, zs, live, tol)
+    ctl.idx_live = np.nonzero(live)[0]
+    ctl.idx_defl = np.nonzero(~live)[0]
+    k = ctl.k = ctl.idx_live.shape[0]
+    ctl.kb = 1 << max(0, (k - 1).bit_length())
     if k == 0:
-        lam[:] = ds
+        return ctl
+    ctl.dsk = dsk = ds[ctl.idx_live]
+    ctl.zsk = zsk = zs[ctl.idx_live]
+    if use_device and k >= dev_min_k and jax.config.jax_enable_x64:
+        ctl.dev_secular = True
+        return ctl
+    anchor, mu = _secular_roots_host(dsk, zsk, rho_n)
+    ctl.lam_live = dsk[anchor] + mu
+    # accurate pole-root differences: m[i, j] = d_j - lambda_i
+    m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]
+    # Gu-Eisenstat z refinement (reference laed4/dlaed3 step)
+    logm = np.log(np.abs(m))
+    dd = dsk[None, :] - dsk[:, None]
+    np.fill_diagonal(dd, 1.0)
+    logdd = np.log(np.abs(dd))
+    np.fill_diagonal(logdd, 0.0)
+    log_zhat2 = logm.sum(0) - logdd.sum(0)
+    zhat = np.sign(zsk) * np.exp(0.5 * log_zhat2)
+    # eigenvector coefficients: v_i[j] = zhat_j / (d_j - lambda_i)
+    vcols = (zhat[None, :] / m)
+    vcols /= np.linalg.norm(vcols, axis=1, keepdims=True)
+    ctl.vcols = vcols
+    return ctl
+
+
+def _secular_bucket(ctl: _MergeCtl, kb: int):
+    """Padded ``(ds_b, zs_b, live_kb)`` device-secular inputs at bucket
+    ``kb >= ctl.k``: padded poles sit strictly above the root bound with
+    z = 0, so they contribute nothing to the secular function (the
+    level-batched driver re-buckets to the group's max kb; the padding
+    policy is the same one the per-merge path has always used)."""
+    dsk, zsk, k = ctl.dsk, ctl.zsk, ctl.k
+    if kb > k:
+        span = ctl.rho_n * float((zsk * zsk).sum()) + 1.0
+        # scale-aware step: at |d| ~ 1e17 an absolute +1.0 would
+        # round away, colliding a padded pole with a live one
+        step = max(1.0, 16 * np.spacing(abs(dsk[-1]) + span))
+        ds_b = np.concatenate(
+            [dsk, dsk[-1] + span + step * np.arange(1.0, kb - k + 1)])
+        zs_b = np.concatenate([zsk, np.zeros(kb - k)])
     else:
-        dsk = ds[idx_live]
-        zsk = zs[idx_live]
-        if (use_device and k >= _device_secular_min_k()
-                and jax.config.jax_enable_x64):
-            # bucket to the next power of two so the jit cache is keyed
-            # by bucket, not by the data-dependent deflated size k:
-            # padded poles sit strictly above the root bound with z = 0
-            if kb > k:
-                span = rho_n * float((zsk * zsk).sum()) + 1.0
-                # scale-aware step: at |d| ~ 1e17 an absolute +1.0 would
-                # round away, colliding a padded pole with a live one
-                step = max(1.0, 16 * np.spacing(abs(dsk[-1]) + span))
-                ds_b = np.concatenate(
-                    [dsk, dsk[-1] + span
-                     + step * np.arange(1.0, kb - k + 1)])
-                zs_b = np.concatenate([zsk, np.zeros(kb - k)])
-            else:
-                ds_b, zs_b = dsk, zsk
-            live_kb = np.zeros(kb, dtype=bool)
-            live_kb[:k] = True
-            lam_j, vcols_dev = _secular_vcols_jit(mesh)(
-                jnp.asarray(ds_b), jnp.asarray(zs_b), jnp.float64(rho_n),
-                jnp.asarray(live_kb))
-            # only the O(kb) eigenvalues cross to the host; the (kb, kb)
-            # coefficient matrix stays device-resident (row-sharded over
-            # the mesh when one is given)
-            lam_live = np.asarray(lam_j)[:k]
-        else:
-            anchor, mu = _secular_roots_host(dsk, zsk, rho_n)
-            lam_live = dsk[anchor] + mu
-            # accurate pole-root differences: m[i, j] = d_j - lambda_i
-            m = (dsk[None, :] - dsk[anchor][:, None]) - mu[:, None]
-            # Gu-Eisenstat z refinement (reference laed4/dlaed3 step)
-            logm = np.log(np.abs(m))
-            dd = dsk[None, :] - dsk[:, None]
-            np.fill_diagonal(dd, 1.0)
-            logdd = np.log(np.abs(dd))
-            np.fill_diagonal(logdd, 0.0)
-            log_zhat2 = logm.sum(0) - logdd.sum(0)
-            zhat = np.sign(zsk) * np.exp(0.5 * log_zhat2)
-            # eigenvector coefficients: v_i[j] = zhat_j / (d_j - lambda_i)
-            vcols = (zhat[None, :] / m)
-            vcols /= np.linalg.norm(vcols, axis=1, keepdims=True)
+        ds_b, zs_b = dsk, zsk
+    live_kb = np.zeros(kb, dtype=bool)
+    live_kb[:k] = True
+    return ds_b, zs_b, live_kb
+
+
+def _merge_ctl_fin(ctl: _MergeCtl, lam_live) -> _MergeCtl:
+    """Phase 2 of the host control work: final ascending eigenvalue order
+    and the pole-sort undo, from the (host- or device-) solved roots."""
+    n, k = ctl.n, ctl.k
+    lam = np.empty(n)
+    if k == 0:
+        lam[:] = ctl.ds
+    else:
         lam[:k] = lam_live
-        lam[k:] = ds[idx_defl]
-    if neg:
+        lam[k:] = ctl.ds[ctl.idx_defl]
+    if ctl.neg:
         lam = -lam
-    # final ascending eigenvalue order
     fin = np.argsort(lam, kind="stable")
-    lam = lam[fin]
-    # undo of the pole sort, as a row gather
+    ctl.lam = lam[fin]
+    ctl.fin = fin
     inv_order = np.empty(n, dtype=np.int64)
-    inv_order[order] = np.arange(n)
+    inv_order[ctl.order] = np.arange(n)
+    ctl.inv_order = inv_order
+    return ctl
+
+
+def _givens_padded(ctl: _MergeCtl, gb: int) -> np.ndarray:
+    """(gb, 4) Givens-undo array in application (reverse) order, padded
+    with identity rotations (exact no-ops) to the bucket ``gb``."""
+    g = ctl.gi.shape[0]
+    giv = np.zeros((gb, 4))
+    giv[:, 2] = 1.0                     # identity-rotation padding
+    # reverse order: the undo applies rotations last-to-first
+    giv[:g, 0] = ctl.gi[::-1]
+    giv[:g, 1] = ctl.gj[::-1]
+    giv[:g, 2] = ctl.gc[::-1]
+    giv[:g, 3] = ctl.gs[::-1]
+    return giv
+
+
+def _givens_bucket(ctl: _MergeCtl) -> int:
+    """Power-of-two bucket of this merge's deflation-rotation count."""
+    g = ctl.gi.shape[0]
+    return (1 << max(0, (g - 1).bit_length())) if g else 0
+
+
+def _assembly_arrays(ctl: _MergeCtl, kb: int):
+    """O(n)-sized qc-assembly control arrays at secular bucket ``kb``
+    (shapes bucketed so the jit cache is keyed by (n, kb, givens bucket),
+    not by data-dependent counts). The Givens-undo array is NOT built
+    here — callers pad it once at their target bucket
+    (:func:`_givens_padded`; the level-batched driver pads to the group
+    max, the per-merge path to :func:`_givens_bucket`)."""
+    n, k = ctl.n, ctl.k
+    live_b = np.zeros(kb, dtype=bool)
+    live_b[:k] = True
+    rows_live = np.full(kb, n, dtype=np.int64)
+    rows_live[:k] = ctl.idx_live
+    nd = n - k
+    rows_d = np.full(n, n, dtype=np.int64)
+    rows_d[:nd] = ctl.idx_defl
+    cols_d = np.full(n, n, dtype=np.int64)
+    cols_d[:nd] = k + np.arange(nd)
+    return live_b, rows_live, rows_d, cols_d
+
+
+def _vcols_padded(ctl: _MergeCtl, kb: int) -> np.ndarray:
+    """Host secular output zero-padded to bucket ``kb``."""
+    vpad = np.zeros((kb, kb), dtype=np.float64)
+    if ctl.k:
+        vpad[:ctl.k, :ctl.k] = ctl.vcols
+    return vpad
+
+
+def _merge_apply(ctl: _MergeCtl, q1, q2, vcols_dev, use_device: bool,
+                 mesh=None):
+    """Device (or numpy-twin) tail of one merge: qc assembly + the
+    blkdiag(q1, q2) @ qc gemms. Device gemms keep Q device-resident
+    across the whole merge tree; only O(n) vectors cross to the host.
+    Under a mesh the gemms run sharded (SUMMA via GSPMD)."""
+    n1, n = ctl.n1, ctl.n
+    dtype = q1.dtype
+
+    def apply_qc(lam, qc_dev=None, qc_host=None):
+        if use_device:
+            return lam, _apply_qc_jit(mesh)(
+                jnp.asarray(q1), jnp.asarray(q2), qc_dev)
+        return lam, np.vstack([q1 @ qc_host[:n1, :], q2 @ qc_host[n1:, :]])
+
+    if ctl.decoupled:
+        if use_device:
+            qc = _eye_perm_jit(n, np.dtype(dtype).name, mesh)(
+                jnp.asarray(ctl.fin))
+            return apply_qc(ctl.lam, qc_dev=qc)
+        return apply_qc(ctl.lam, qc_host=np.eye(n, dtype=dtype)[:, ctl.fin])
 
     if use_device:
-        # O(n)-sized control arrays; shapes bucketed so the jit cache is
-        # keyed by (n, kb, givens bucket), not by data-dependent counts
         if vcols_dev is None:
-            vpad = np.zeros((kb, kb), dtype=np.float64)
-            if k:
-                vpad[:k, :k] = vcols
-            vcols_dev = jnp.asarray(vpad)
-        live_b = np.zeros(kb, dtype=bool)
-        live_b[:k] = True
-        rows_live = np.full(kb, n, dtype=np.int64)
-        rows_live[:k] = idx_live
-        nd = n - k
-        rows_d = np.full(n, n, dtype=np.int64)
-        rows_d[:nd] = idx_defl
-        cols_d = np.full(n, n, dtype=np.int64)
-        cols_d[:nd] = k + np.arange(nd)
-        g = gi.shape[0]
-        gb = (1 << max(0, (g - 1).bit_length())) if g else 0
-        giv = np.zeros((gb, 4))
-        giv[:, 2] = 1.0                     # identity-rotation padding
-        # reverse order: the undo applies rotations last-to-first
-        giv[:g, 0] = gi[::-1]
-        giv[:g, 1] = gj[::-1]
-        giv[:g, 2] = gc[::-1]
-        giv[:g, 3] = gs[::-1]
+            vcols_dev = jnp.asarray(_vcols_padded(ctl, ctl.kb))
+        live_b, rows_live, rows_d, cols_d = _assembly_arrays(ctl, ctl.kb)
+        giv = _givens_padded(ctl, _givens_bucket(ctl))
         qc = _assemble_qc_jit(n, mesh)(
             vcols_dev, jnp.asarray(live_b), jnp.asarray(rows_live),
             jnp.asarray(rows_d), jnp.asarray(cols_d), jnp.asarray(giv),
-            jnp.asarray(inv_order), jnp.asarray(fin))
-        return apply_qc(lam, qc_dev=qc)
+            jnp.asarray(ctl.inv_order), jnp.asarray(ctl.fin))
+        return apply_qc(ctl.lam, qc_dev=qc)
 
     # host assembly (use_device=False twin, kept as the numpy reference)
+    k = ctl.k
     u_sorted = np.zeros((n, n), dtype=dtype)
     if k == 0:
         u_sorted[:] = np.eye(n, dtype=dtype)
     else:
         u_live = np.zeros((n, k), dtype=dtype)
-        u_live[idx_live, :] = vcols.T.astype(dtype)
+        u_live[ctl.idx_live, :] = ctl.vcols.T.astype(dtype)
         u_sorted[:, :k] = u_live
-        for t, j in enumerate(idx_defl):
+        for t, j in enumerate(ctl.idx_defl):
             u_sorted[j, k + t] = 1.0
     # undo the Givens rotations (rows, reverse order)
-    for i, j, c, s in zip(gi[::-1], gj[::-1], gc[::-1], gs[::-1]):
+    for i, j, c, s in zip(ctl.gi[::-1], ctl.gj[::-1], ctl.gc[::-1],
+                          ctl.gs[::-1]):
         ri = u_sorted[i].copy()
         rj = u_sorted[j].copy()
         u_sorted[i] = c * ri - s * rj
         u_sorted[j] = s * ri + c * rj
-    qc = u_sorted[inv_order][:, fin]
-    return apply_qc(lam, qc_host=qc)
+    qc = u_sorted[ctl.inv_order][:, ctl.fin]
+    return apply_qc(ctl.lam, qc_host=qc)
+
+
+def _merge(lam1, q1, lam2, q2, rho_signed, use_device: bool, mesh=None):
+    """One Cuppen merge (reference ``merge.h:790-887``), serialized.
+
+    Division of labor (device path): O(n) control work (sort, deflation
+    scan, liveness) on host; the secular solve on host (small k) or device
+    (large k, bucketed); and ALL O(n^2) workspace assembly on device
+    (:func:`_assemble_qc_impl`) — host memory stays O(n + k^2_small) per
+    merge, against the round-1 review's O(n^2) host ``u_sorted``/``qc``.
+    With ``mesh``, the merge gemms and their Q outputs are 2D-sharded."""
+    # rank-one coupling: z from the edge rows of the subproblem eigenvectors
+    z = np.concatenate([np.asarray(q1[-1, :]), np.asarray(q2[0, :])])
+    ctl = _merge_ctl_pre(lam1, lam2, z, rho_signed, use_device,
+                         _device_secular_min_k())
+    _count_merges("serialized")
+    vcols_dev = None
+    if ctl.decoupled:
+        return _merge_apply(ctl, q1, q2, None, use_device, mesh)
+    if ctl.dev_secular:
+        ds_b, zs_b, live_kb = _secular_bucket(ctl, ctl.kb)
+        lam_j, vcols_dev = _secular_vcols_jit(mesh)(
+            jnp.asarray(ds_b), jnp.asarray(zs_b), jnp.float64(ctl.rho_n),
+            jnp.asarray(live_kb))
+        # only the O(kb) eigenvalues cross to the host; the (kb, kb)
+        # coefficient matrix stays device-resident (row-sharded over
+        # the mesh when one is given)
+        lam_live = np.asarray(lam_j)[:ctl.k]
+    else:
+        lam_live = ctl.lam_live
+    _merge_ctl_fin(ctl, lam_live)
+    return _merge_apply(ctl, q1, q2, vcols_dev, use_device, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Level-batched merge tree (dc_level_batch=1, docs/eigensolver_perf.md)
+# ---------------------------------------------------------------------------
+
+class _TreeNode:
+    """One node of the D&C split tree (host bookkeeping only)."""
+
+    __slots__ = ("off", "n", "rho", "left", "right", "height")
+
+    def __init__(self, off, n, rho=None, left=None, right=None, height=0):
+        self.off, self.n, self.rho = off, n, rho
+        self.left, self.right, self.height = left, right, height
+
+
+def _merge_schedule(d, e, nb: int):
+    """Host twin of the recursive splitting (same split rule, same
+    pre-order d adjustments — leaf subproblems are bitwise the
+    recursion's): returns ``(d_adj, leaves, levels, root)`` with
+    ``levels[h]`` = all merge nodes at height ``h`` above the leaves.
+    Merges within one level have disjoint index ranges and both children
+    at strictly lower heights, so a whole level can run as one batch."""
+    d_adj = d.copy()
+    leaves: list = []
+    levels: dict = {}
+
+    def build(off, n):
+        if n <= max(nb, 2):
+            node = _TreeNode(off, n)
+            leaves.append(node)
+            return node
+        # split at a tile boundary near the middle (reference impl.h:66-80
+        # splits at every tile boundary; binary recursion reaches the same
+        # leaves)
+        m = (n // 2 // nb) * nb
+        if m == 0 or m == n:
+            m = n // 2
+        rho = e[off + m - 1]
+        d_adj[off + m - 1] -= rho
+        d_adj[off + m] -= rho
+        left = build(off, m)
+        right = build(off + m, n - m)
+        node = _TreeNode(off, n, rho, left, right,
+                         1 + max(left.height, right.height))
+        levels.setdefault(node.height, []).append(node)
+        return node
+
+    root = build(0, d.shape[0])
+    return d_adj, leaves, levels, root
+
+
+def _run_group(group, res, zmap, dev_min_k: int):
+    """One same-(n1, n2) level group: host control scan for every merge
+    (the scan overlaps the previously dispatched device programs — jax
+    dispatch is async, so the device grinds group g's assembly gemms
+    while the host runs group g+1's deflation/secular work), ONE vmapped
+    secular dispatch for the device-secular members (padded to the
+    group's max bucket), then ONE vmapped assembly + apply dispatch."""
+    ctls = [
+        _merge_ctl_pre(res[node.left][0], res[node.right][0], zmap[node],
+                       node.rho, True, dev_min_k)
+        for node in group
+    ]
+    # batched device secular at the group's shared max bucket
+    dev = [(i, c) for i, c in enumerate(ctls)
+           if not c.decoupled and c.dev_secular]
+    vdev = {}
+    if dev:
+        kb_g = max(c.kb for _, c in dev)
+        buckets = [_secular_bucket(c, kb_g) for _, c in dev]
+        lam_j, vcols_j = _secular_vcols_batched_jit()(
+            jnp.asarray(np.stack([b[0] for b in buckets])),
+            jnp.asarray(np.stack([b[1] for b in buckets])),
+            jnp.asarray(np.array([c.rho_n for _, c in dev])),
+            jnp.asarray(np.stack([b[2] for b in buckets])))
+        lam_h = np.asarray(lam_j)           # one sync for the whole group
+        for lane, (i, c) in enumerate(dev):
+            c.kb = kb_g                     # re-bucketed to the group max
+            vdev[i] = vcols_j[lane]
+            _merge_ctl_fin(c, lam_h[lane][:c.k])
+    for c in ctls:
+        if not c.decoupled and not c.dev_secular:
+            _merge_ctl_fin(c, c.lam_live)
+    # decoupled merges have no assembly to batch: per-merge dispatch
+    asm = [(i, c) for i, c in enumerate(ctls) if not c.decoupled]
+    for i, c in enumerate(ctls):
+        if c.decoupled:
+            node = group[i]
+            res[node] = _merge_apply(c, res[node.left][1],
+                                     res[node.right][1], None, True, None)
+    _count_merges("batched", len(asm))
+    _count_merges("serialized", len(ctls) - len(asm))
+    if not asm:
+        return
+    n = group[0].n
+    kb_g = max(c.kb for _, c in asm)
+    arrs = [_assembly_arrays(c, kb_g) for _, c in asm]
+    gb_g = max(_givens_bucket(c) for _, c in asm)
+    vcols_stack = jnp.stack(
+        [vdev[i] if i in vdev else jnp.asarray(_vcols_padded(c, kb_g))
+         for i, c in asm])
+    qc = _assemble_qc_batched_jit(n)(
+        vcols_stack,
+        jnp.asarray(np.stack([a[0] for a in arrs])),
+        jnp.asarray(np.stack([a[1] for a in arrs])),
+        jnp.asarray(np.stack([a[2] for a in arrs])),
+        jnp.asarray(np.stack([a[3] for a in arrs])),
+        jnp.asarray(np.stack([_givens_padded(c, gb_g) for _, c in asm])),
+        jnp.asarray(np.stack([c.inv_order for _, c in asm])),
+        jnp.asarray(np.stack([c.fin for _, c in asm])))
+    qout = _apply_qc_batched_jit()(
+        jnp.stack([res[group[i].left][1] for i, _ in asm]),
+        jnp.stack([res[group[i].right][1] for i, _ in asm]),
+        qc)
+    for lane, (i, c) in enumerate(asm):
+        res[group[i]] = (c.lam, qout[lane])
+
+
+def _run_level(merges, res, use_device: bool, mesh, level_batch: bool):
+    """Execute one tree level. Sharded merges (mesh given, n >=
+    _SHARD_MERGE_MIN_N) and sub-2-member groups stay on the serialized
+    per-merge path; everything else batches by (n1, n2) shape."""
+    serial, groups = [], {}
+    for node in merges:
+        eff_mesh = mesh if (mesh is not None
+                            and node.n >= _SHARD_MERGE_MIN_N) else None
+        if not level_batch or not use_device or eff_mesh is not None:
+            serial.append((node, eff_mesh))
+        else:
+            groups.setdefault((node.left.n, node.right.n), []).append(node)
+    # singleton groups run serialized: a one-lane vmapped program would
+    # only duplicate the per-merge jit cache entries
+    for key in [key for key, g in groups.items() if len(g) < 2]:
+        serial.extend((node, None) for node in groups.pop(key))
+    for node, eff_mesh in serial:
+        res[node] = _merge(res[node.left][0], res[node.left][1],
+                           res[node.right][0], res[node.right][1],
+                           node.rho, use_device, mesh=eff_mesh)
+    if groups:
+        batch_nodes = [node for g in groups.values() for node in g]
+        # ONE host sync pulls every batched merge's rank-one coupling rows
+        # (vs two device round trips per merge on the serialized walk)
+        edges = jax.device_get(
+            [(res[node.left][1][-1, :], res[node.right][1][0, :])
+             for node in batch_nodes])
+        zmap = {node: np.concatenate([e1, e2])
+                for node, (e1, e2) in zip(batch_nodes, edges)}
+        dev_min_k = _device_secular_min_k()
+        for group in groups.values():
+            _run_group(group, res, zmap, dev_min_k)
+    # children are dead once the level completes: free their Q storage
+    for node in merges:
+        del res[node.left], res[node.right]
+
+
+def _tridiag_dc(d, e, nb: int, use_device: bool, mesh, level_batch: bool):
+    """Iterative bottom-up merge-tree driver (level order). With
+    ``level_batch`` (and ``use_device``) same-shape merges of one level
+    run as single vmapped dispatches; otherwise each merge runs the
+    serialized :func:`_merge` — same per-merge math in either walk (the
+    merges of a level are independent, so order cannot change results)."""
+    d_adj, leaves, levels, root = _merge_schedule(d, e, nb)
+    res = {}
+    for leaf in leaves:
+        lam, q = stedc(d_adj[leaf.off: leaf.off + leaf.n],
+                       e[leaf.off: leaf.off + leaf.n - 1])
+        res[leaf] = (lam, jnp.asarray(q) if use_device else q)
+    for h in sorted(levels):
+        _run_level(levels[h], res, use_device, mesh, level_batch)
+    return res[root]
 
 
 def tridiag_solver(d: np.ndarray, e: np.ndarray, nb: int,
@@ -577,7 +914,15 @@ def tridiag_solver(d: np.ndarray, e: np.ndarray, nb: int,
     and the eigenvector matrix over the mesh — beyond the local-only
     reference, and the scaling path for eigenvector matrices past one
     device's HBM (the returned Q is 2D-sharded; the single-device
-    remainder is the deflated secular workspace, bounded by deflation)."""
+    remainder is the deflated secular workspace, bounded by deflation).
+
+    Under ``dc_level_batch=1`` (auto: TPU) all same-shape merges of one
+    tree level run as single vmapped device dispatches — the secular
+    solves, qc assemblies, and Q·C gemms of a level become one batched
+    program each instead of one dispatch per merge, and the host control
+    scans overlap the in-flight device work (docs/eigensolver_perf.md).
+    Sharded merges (past ``_SHARD_MERGE_MIN_N`` under a mesh) always run
+    per merge."""
     if mesh is not None:
         from ..comm.grid import COL_AXIS, ROW_AXIS
         from ..common.asserts import dlaf_assert
@@ -593,22 +938,16 @@ def tridiag_solver(d: np.ndarray, e: np.ndarray, nb: int,
     n = d.shape[0]
     if n == 0:
         return d, (jnp.zeros((0, 0)) if use_device else np.zeros((0, 0)))
-    if n <= max(nb, 2):
-        lam, q = stedc(d, e)
-        return lam, (jnp.asarray(q) if use_device else q)
-    # split at a tile boundary near the middle (reference impl.h:66-80 splits
-    # at every tile boundary; binary recursion reaches the same leaves)
-    m = (n // 2 // nb) * nb
-    if m == 0 or m == n:
-        m = n // 2
-    rho = e[m - 1]
-    d1 = d[:m].copy()
-    d2 = d[m:].copy()
-    d1[-1] -= rho
-    d2[0] -= rho
-    # the mesh flows down the tree, but small merges stay unsharded —
-    # sharding tiny gemms is all collective overhead (threshold below)
-    lam1, q1 = tridiag_solver(d1, e[: m - 1], nb, use_device, mesh=mesh)
-    lam2, q2 = tridiag_solver(d2, e[m:], nb, use_device, mesh=mesh)
-    eff_mesh = mesh if (mesh is not None and n >= _SHARD_MERGE_MIN_N) else None
-    return _merge(lam1, q1, lam2, q2, rho, use_device, mesh=eff_mesh)
+    from .. import obs
+    from ..config import resolved_dc_level_batch
+    from ..types import total_ops
+
+    level_batch = resolved_dc_level_batch()
+    # merge-gemm flop model: sum over levels of 2^l * (n/2^l)^3 muls+adds
+    # -> (4/3) n^3 (deflation only reduces it; docs/eigensolver_perf.md)
+    span = obs.entry_span("tridiag_solver", lambda: dict(
+        flops=total_ops(np.dtype(np.float64), 2 * n**3 / 3, 2 * n**3 / 3),
+        n=n, nb=nb, dc_level_batch=int(level_batch),
+        use_device=int(use_device), sharded=int(mesh is not None)))
+    with span:
+        return _tridiag_dc(d, e, nb, use_device, mesh, level_batch)

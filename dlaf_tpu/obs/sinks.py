@@ -129,19 +129,27 @@ def _validate_metrics(r: dict, where: str, errors: list) -> None:
 
 def validate_records(records, require_spans=False, require_gflops=False,
                      require_collectives=False, require_retries=False,
-                     require_fallbacks=False, require_comm_overlap=False) -> list:
+                     require_fallbacks=False, require_comm_overlap=False,
+                     require_dc_batch=False, require_bt_overlap=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
     collective byte counters in some metrics snapshot, at least one
     ``robust_cholesky.attempt`` retry span (with its attempt/shift
     attrs — the fault-injection smoke), a positive
-    ``dlaf_fallback_total`` counter, and (``require_comm_overlap``)
+    ``dlaf_fallback_total`` counter, (``require_comm_overlap``)
     positive finite ``dlaf_comm_overlapped_total{algo,axis}`` counters
     plus finite per-axis ``dlaf_comm_collective_bytes_total`` for BOTH
-    mesh axes — the comm look-ahead audit trail (docs/comm_overlap.md)."""
+    mesh axes — the comm look-ahead audit trail (docs/comm_overlap.md) —,
+    (``require_dc_batch``) a positive finite
+    ``dlaf_dc_merges_total{mode="batched"}`` counter (the level-batched
+    D&C audit trail, docs/eigensolver_perf.md), and
+    (``require_bt_overlap``) a positive finite
+    ``dlaf_comm_overlapped_total`` counter whose algo label starts with
+    ``bt_`` (the pipelined back-transform's hoisted collectives)."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
+    n_dc_batched = n_bt_overlap = 0
     overlap_axes, byte_axes = set(), set()
     for i, r in enumerate(records):
         where = f"record {i}"
@@ -183,6 +191,12 @@ def validate_records(records, require_spans=False, require_gflops=False,
                     labels = m.get("labels") or {}
                     if labels.get("algo") and labels.get("axis"):
                         overlap_axes.add(labels["axis"])
+                        if str(labels["algo"]).startswith("bt_"):
+                            n_bt_overlap += 1
+                if m.get("name") == "dlaf_dc_merges_total" \
+                        and m["value"] > 0 \
+                        and (m.get("labels") or {}).get("mode") == "batched":
+                    n_dc_batched += 1
                 if m.get("name") == "dlaf_fallback_total" and m["value"] > 0:
                     n_fallbacks += 1
         elif rtype == "log":
@@ -201,6 +215,12 @@ def validate_records(records, require_spans=False, require_gflops=False,
     if require_fallbacks and n_fallbacks == 0:
         errors.append("artifact contains no positive dlaf_fallback_total "
                       "counter")
+    if require_dc_batch and n_dc_batched == 0:
+        errors.append("artifact contains no positive "
+                      "dlaf_dc_merges_total{mode=batched} counter")
+    if require_bt_overlap and n_bt_overlap == 0:
+        errors.append("artifact contains no positive "
+                      "dlaf_comm_overlapped_total counter with a bt_* algo")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "

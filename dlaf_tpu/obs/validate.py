@@ -18,6 +18,14 @@ Flags:
                             dlaf_comm_collective_bytes_total for BOTH mesh
                             axes (the comm look-ahead audit trail,
                             docs/comm_overlap.md)
+    --require-dc-batch      fail unless a metrics snapshot carries a
+                            positive dlaf_dc_merges_total{mode=batched}
+                            counter (the level-batched D&C audit trail,
+                            docs/eigensolver_perf.md)
+    --require-bt-overlap    fail unless a metrics snapshot carries a
+                            positive dlaf_comm_overlapped_total counter
+                            with a bt_* algo label (the pipelined
+                            back-transform's hoisted collectives)
     --prom                  print the last metrics snapshot as Prometheus
                             text exposition after validating
 
@@ -40,7 +48,8 @@ def main(argv=None) -> int:
     paths = [a for a in argv if not a.startswith("--")]
     known = {"--require-spans", "--require-gflops", "--require-collectives",
              "--require-retries", "--require-fallbacks",
-             "--require-comm-overlap", "--prom"}
+             "--require-comm-overlap", "--require-dc-batch",
+             "--require-bt-overlap", "--prom"}
     if len(paths) != 1 or flags - known:
         print(__doc__, file=sys.stderr)
         return 2
@@ -57,7 +66,9 @@ def main(argv=None) -> int:
         require_collectives="--require-collectives" in flags,
         require_retries="--require-retries" in flags,
         require_fallbacks="--require-fallbacks" in flags,
-        require_comm_overlap="--require-comm-overlap" in flags)
+        require_comm_overlap="--require-comm-overlap" in flags,
+        require_dc_batch="--require-dc-batch" in flags,
+        require_bt_overlap="--require-bt-overlap" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)

@@ -63,7 +63,8 @@ def best_time(fn, *args, reps: int = None, return_last: bool = False):
 
 def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
                    source: str, variant: str = "ozaki",
-                   dtype: str = "float64", donate: bool = None):
+                   dtype: str = "float64", donate: bool = None,
+                   workload: str = None):
     """Append one measurement to the git-tracked append-only history log
     and return the line dict (single schema owner — bench.py prints the
     returned dict rather than rebuilding it): a later tunnel wedge or
@@ -84,6 +85,11 @@ def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
         # from the pre-donation entries in this log — round-4 advisory):
         # record the flag so cross-round comparisons can tell them apart
         line["donate"] = bool(donate)
+    if workload is not None:
+        # non-cholesky workloads (bench.py's eigensolver stage arms carry
+        # different flop models): labeled so the cholesky headline and
+        # its replayed-history lookup never pick them up
+        line["workload"] = str(workload)
     try:
         with open(os.path.join(repo_root(), ".bench_history.jsonl"),
                   "a") as f:

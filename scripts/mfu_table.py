@@ -94,12 +94,30 @@ ICI_LINK_BW = {"v5e": 50e9, "v5p": 150e9}
 #: basis at real dtypes — add + mul summed — so the ICI ceiling divides
 #: like the measured numbers do; config #3's complex weighting is noted
 #: in its row, not folded in here).
+#:
+#: The three eigensolver-pipeline stage models (new in PR 6 — config #5
+#: stops being a red2band proxy; docs/eigensolver_perf.md):
+#:
+#: * tridiag — D&C merge gemms: level l runs 2^l merges of size n/2^l,
+#:   each blkdiag(q1, q2) @ qc ~ (n/2^l)^3 muls+adds -> sum = (4/3) n^3
+#:   (deflation only reduces it, so this is the model ceiling).
+#: * bt_b2t — chase back-transform: ~n^2/b reflectors of length b, each
+#:   a rank-1 segment update of 2*b*m muls+adds over m = n columns
+#:   -> 2 n^3.
+#: * bt_r2b — reflector-block application C <- (I - V T V^H) C:
+#:   W2 = V^H C and C -= V W2 at 2*b*m_p*n muls+adds each, summed over
+#:   panels (sum m_p ~ n^2 / 2b) -> 2 n^3.
 _FLOPS_MODEL = {
     "cholesky": lambda n: n ** 3 / 3,
     "trsm": lambda n: n ** 3,            # square B (free axis = n)
     "hegst": lambda n: n ** 3,
     "red2band": lambda n: 4 * n ** 3 / 3,
-    "eigensolver": lambda n: 4 * n ** 3 / 3,   # red2band-stage proxy
+    "tridiag": lambda n: 4 * n ** 3 / 3,
+    "bt_b2t": lambda n: 2 * n ** 3,
+    "bt_r2b": lambda n: 2 * n ** 3,
+    # full standard-EVP pipeline (the eigensolver entry span's canonical
+    # 5n^3/3 muls + 5n^3/3 adds; #5's extra gen stages noted in its row)
+    "eigensolver": lambda n: 10 * n ** 3 / 3,
 }
 
 
@@ -159,9 +177,42 @@ def _trace_ici_child(spec: dict) -> None:
     str_, stc, _, _ = storage_tile_grid(dist)
     sds = jax.ShapeDtypeStruct((str_, stc, nb, nb), dtype)
 
+    def trace_red2band():
+        from dlaf_tpu.eigensolver.reduction_to_band import \
+            _build_dist_red2band
+
+        fn = _build_dist_red2band(dist, grid.mesh, dtype.name,
+                                  spec.get("band", nb))
+        jax.eval_shape(fn, sds)
+
+    def trace_bt_r2b():
+        from dlaf_tpu.eigensolver.back_transform import _build_dist_bt_r2b
+
+        band = spec.get("band", nb)
+        npan = max(-(-n // band) - 1, 0)
+        taus = jax.ShapeDtypeStruct((npan, band), dtype)
+        fn = _build_dist_bt_r2b(dist, dist, grid.mesh, band, la=True)
+        jax.eval_shape(fn, sds, taus, sds)
+
+    def trace_bt_b2t():
+        from dlaf_tpu.eigensolver.back_transform import _build_dist_bt_b2t
+
+        band = spec.get("band", nb)
+        n_sweeps = max(n - 2, 0)
+        n_steps = -(-max(n - 1, 1) // band)
+        fn = jax.jit(_build_dist_bt_b2t(dist, grid.mesh, b=band,
+                                        cplx=False, n_sweeps=n_sweeps))
+        jax.eval_shape(fn,
+                       jax.ShapeDtypeStruct((n_sweeps, n_steps, band),
+                                            dtype),
+                       jax.ShapeDtypeStruct((n_sweeps, n_steps), dtype),
+                       jax.ShapeDtypeStruct((n,), dtype), sds)
+
     # UNROLLED builders only: their per-k emission makes the trace-time
     # byte counters exact per-run traffic; a scan body traces once per
-    # telescope segment and would undercount by the trip count
+    # telescope segment and would undercount by the trip count.
+    # (Exception: bt_b2t's layout all_to_alls sit OUTSIDE its sweep scan
+    # — exactly two collectives per run — so its trace is exact too.)
     if family in ("cholesky",):
         from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky
 
@@ -178,13 +229,21 @@ def _trace_ici_child(spec: dict) -> None:
             fn = _build_dist_solve(dist, dist, grid.mesh, side, uplo,
                                    op, "N", dtype.name)
             jax.eval_shape(fn, sds, sds, alpha)
-    else:   # red2band (and the eigensolver row's red2band-stage proxy)
-        from dlaf_tpu.eigensolver.reduction_to_band import \
-            _build_dist_red2band
-
-        fn = _build_dist_red2band(dist, grid.mesh, dtype.name,
-                                  spec.get("band", nb))
-        jax.eval_shape(fn, sds)
+    elif family == "bt_r2b":
+        trace_bt_r2b()
+    elif family == "bt_b2t":
+        trace_bt_b2t()
+    elif family == "eigensolver":
+        # the full pipeline's traced ICI traffic = red2band + both
+        # back-transform stages (the counters accumulate across the three
+        # traces); the host tridiag control stages move no ICI payload
+        # and the sharded merge gemms communicate through GSPMD, which
+        # the cc-layer counters do not see — noted in the #5 row
+        trace_red2band()
+        trace_bt_r2b()
+        trace_bt_b2t()
+    else:   # red2band
+        trace_red2band()
 
     per_axis = {"row": 0.0, "col": 0.0}
     for m in obs.registry().snapshot():
@@ -197,9 +256,11 @@ def _trace_ici_child(spec: dict) -> None:
 
 def ici_ceiling(family: str, n: int, nb: int, grid: str, chip: str):
     """Traced comm-bound ceiling in GF/s for a multi-chip config, or None
-    (1x1 grids, or the trace child failed)."""
+    (1x1 grids, the tridiag stage — its sharded merge gemms communicate
+    through GSPMD collectives the cc-layer trace counters do not see —
+    or the trace child failed)."""
     rows, cols = (int(x) for x in grid.split("x"))
-    if rows * cols <= 1:
+    if rows * cols <= 1 or family == "tridiag":
         return None
     sys.path.insert(0, REPO)
     from dlaf_tpu.tpu_info import cpu_subprocess_env
@@ -237,6 +298,9 @@ _FAMILIES = {
     "trsm": ("trsm_",),
     "hegst": ("hegst_",),
     "red2band": ("red2band_",),
+    "tridiag": ("tridiag",),       # bench.py dc arms: tridiag, tridiag+dcb1
+    "bt_r2b": ("btr2b",),          # bench.py bt arms: btr2b, btr2b+btla1
+    "bt_b2t": ("btb2t",),
     "eigensolver": ("eig_", "eigensolver"),
 }
 
@@ -285,8 +349,22 @@ CONFIGS = [
     ("#4 red2band d 16384/512 4x4", "red2band", 16384, 512, "4x4", "v5e",
      "measured at 8192/512 single-chip; 16384 is multi-chip-only"),
     ("#5 gen_eigensolver d 32768/512 8x8", "eigensolver", 32768, 512,
-     "8x8", "v5e", "pipeline rehearsal at 8192 passed; flops span mixed "
-     "stages, MFU not meaningful as one number"),
+     "8x8", "v5e", "standard-EVP 10n^3/3 model; ICI = traced red2band + "
+     "both bt stages (tridiag GSPMD merge collectives + gen stages "
+     "excluded); per-stage rows below"),
+    # -- eigensolver-pipeline stage rows (configs #4-#5's trailing
+    # stages; real flop/roofline models, not red2band proxies) ------------
+    ("#5 stage tridiag d 32768/512", "tridiag", 32768, 512, "8x8", "v5e",
+     "D&C merge gemms (4n^3/3 model ceiling — deflation reduces it); "
+     "dc_level_batch batches each level's merges into one dispatch; "
+     "sharded merges ride GSPMD, so no cc-traced ICI row"),
+    ("#5 stage bt_band_to_tridiag d 32768/512", "bt_b2t", 32768, 512,
+     "8x8", "v5e", "chase back-transform (2n^3): two layout all_to_alls "
+     "around a local sweep scan — traced exactly"),
+    ("#5 stage bt_reduction_to_band d 32768/512", "bt_r2b", 32768, 512,
+     "8x8", "v5e", "reflector-block application (2n^3); bt_lookahead "
+     "hoists each panel's gather ahead of the previous bulk "
+     "(docs/eigensolver_perf.md)"),
 ]
 
 #: where the recorded datum ran a different (n, nb) than the config asks
@@ -333,8 +411,11 @@ def render(with_ici=True) -> str:
             "latency/serialization-bound — the gap `cholesky_lookahead` "
             "(docs/lookahead.md) + `comm_lookahead` exist to close; the "
             "N-ladder's rising MFU is that serial fraction amortizing. "
-            "The #5 ICI bound covers the red2band stage (the pipeline's "
-            "comm-dominant sweep), not the mixed host stages.\n\n"
+            "The #5 ICI bound sums the traced red2band + back-transform "
+            "stage traffic; the `#5 stage` rows carry each trailing "
+            "stage's own flop model and roofline (`dc_level_batch` / "
+            "`bt_lookahead`, docs/eigensolver_perf.md), so config #5 "
+            "reads per stage instead of through a red2band proxy.\n\n"
             "| config | route | compute ceil GF/s | HBM ceil GF/s "
             "| ICI ceil GF/s | bound | measured GF/s | MFU | note |\n"
             "|---|---|---|---|---|---|---|---|---|\n")

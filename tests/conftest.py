@@ -111,11 +111,18 @@ _QUICK_TESTS = {
 #: never demotes a ``quick``-marked item.
 _TIER1_STRIDE = {
     "test_cholesky.py": 8,
-    "test_eigensolver.py": 6,
+    # PR-6 rebalance: the quick tier had crept to 761 s of the 870 s
+    # budget; the eigensolver files carry the compile-heaviest
+    # parametrizations (full-pipeline + distributed grids), so their
+    # strides widen and the new batched-vs-serial D&C pins are strided
+    # from day one (every parametrization still runs in ci/run.sh full).
+    # Post-rebalance tier-1: 742 passed in ~545-615 s warm-cache.
+    "test_eigensolver.py": 8,
     "test_reduction_to_band.py": 6,
     "test_gen_to_std.py": 4,
     "test_triangular.py": 4,
     "test_ozaki.py": 2,
+    "test_tridiag_solver.py": 2,
 }
 
 

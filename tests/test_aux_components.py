@@ -260,6 +260,65 @@ def test_bench_headline_fallback_replays_history():
     assert out["live_fallback"]["gflops"] == 13.6
 
 
+def test_bench_headline_ignores_stage_arms():
+    # the eigensolver stage arms (tridiag/btr2b — ISSUE 6) measure
+    # different flop models; even a faster stage number must never take
+    # the cholesky headline
+    bench = _load_bench_module()
+    results = [
+        {"variant": "xla", "platform": "tpu", "dtype": "float64",
+         "gflops": 41.0, "ts": "t1"},
+        {"variant": "tridiag+dcb1", "platform": "tpu", "dtype": "float64",
+         "gflops": 500.0, "workload": "tridiag", "ts": "t2"},
+        {"variant": "btr2b+btla1", "platform": "tpu", "dtype": "float64",
+         "gflops": 900.0, "workload": "btr2b", "ts": "t3"},
+    ]
+    out = bench.assemble_headline(results, 4096, 256,
+                                  hist_lookup=lambda **kw: None)
+    assert out["value"] == 41.0 and "xla" in out["metric"]
+
+
+def test_bench_headline_stage_arms_only():
+    # every cholesky arm died, only stage arms landed: the headline is
+    # the replayed TPU history entry when one exists, and None (sweep
+    # exits nonzero) when it does not — never a mislabeled stage number
+    bench = _load_bench_module()
+    results = [
+        {"variant": "tridiag+dcb1", "platform": "cpu", "dtype": "float64",
+         "gflops": 500.0, "workload": "tridiag", "ts": "t"},
+    ]
+    hist = {"variant": "ozaki", "platform": "tpu", "dtype": "float64",
+            "n": 4096, "nb": 256, "gflops": 103.89, "ts": "h"}
+    out = bench.assemble_headline(results, 4096, 256,
+                                  hist_lookup=lambda **kw: hist)
+    assert out["value"] == 103.89 and out["replayed"] is True
+    assert "trailing=ozaki" in out["metric"]
+    out = bench.assemble_headline(results, 4096, 256,
+                                  hist_lookup=lambda **kw: None)
+    assert out is None
+
+
+def test_bench_best_recorded_skips_stage_workloads(tmp_path):
+    # history entries with a non-cholesky workload never feed the
+    # replayed headline lookup
+    import json
+
+    bench = _load_bench_module()
+    path = tmp_path / "hist.jsonl"
+    lines = [
+        {"variant": "tridiag", "platform": "tpu", "dtype": "float64",
+         "n": 2048, "nb": 256, "gflops": 777.0, "workload": "tridiag",
+         "ts": "2026-08-03T00:00:00"},
+        {"variant": "ozaki", "platform": "tpu", "dtype": "float64",
+         "n": 2048, "nb": 256, "gflops": 99.0,
+         "ts": "2026-08-03T00:00:00"},
+    ]
+    path.write_text("".join(json.dumps(x) + "\n" for x in lines))
+    got = bench.best_recorded(platform="tpu", n=2048, nb=256,
+                              path=str(path))
+    assert got["gflops"] == 99.0 and got["variant"] == "ozaki"
+
+
 def test_bench_headline_fallback_without_history():
     # no recorded TPU entry (fresh checkout): the live result stands,
     # honestly labeled with its platform

@@ -266,6 +266,139 @@ def test_dist_red2band_overlap(devices8):
 
 
 # ---------------------------------------------------------------------------
+# Distributed bt_reduction_to_band (bt_lookahead, docs/eigensolver_perf.md)
+# ---------------------------------------------------------------------------
+
+def _bt_builders(devices8, band=4):
+    from dlaf_tpu.eigensolver import back_transform as bt
+
+    config.initialize()
+    grid = Grid(2, 2)
+    n, nb = 24, 4
+    amat = _mat(np.eye(n), nb, grid)
+    cmat = _mat(np.zeros((n, n)), nb, grid)
+    npan = -(-n // band) - 1
+    taus = jnp.zeros((npan, band), jnp.float64)
+    return bt, grid, amat, cmat, taus, band
+
+
+def test_dist_bt_r2b_overlap(devices8):
+    """bt_lookahead=1: panel p+1's V sub-panel all_gather is emitted ahead
+    of panel p's bulk C update and independent of it. The chain reads only
+    the constant (V, taus) storage, so it is bulk-independent under EITHER
+    knob — the serialized pin is therefore the emission ORDER (gather p+1
+    after bulk p), the same shape test_dist_solve_scan_overlap uses for
+    the hoisted solve read."""
+    bt, grid, amat, cmat, taus, band = _bt_builders(devices8)
+
+    def trace(la):
+        fn = bt._build_dist_bt_r2b(amat.dist, cmat.dist, grid.mesh, band,
+                                   la=la)
+        return _inner_eqns(fn, amat.storage, taus, cmat.storage)
+
+    eqns = trace(la=True)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    assert len(ag) >= 2 and bulk
+    # panel p+1's gather all_gather: hoisted ahead of panel p's bulk update
+    assert ag[1] < bulk[0], (ag, bulk)
+    assert not _depends_on_bulk(eqns, ag[1])
+
+    eqns = trace(la=False)
+    ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+    assert ag[1] > bulk[0], "bt_lookahead=0 no longer serial — test is stale"
+    assert not _depends_on_bulk(eqns, ag[1])
+
+
+def test_dist_bt_r2b_scan_overlap(devices8):
+    """The scan body emits its panel gather (COL bcast + ROW all_gather)
+    ahead of the bulk C-update dot by construction, reading only constant
+    storage — pinned for both knob values (the knob labels the structure
+    there; docs/eigensolver_perf.md)."""
+    bt, grid, amat, cmat, taus, band = _bt_builders(devices8)
+
+    for la in (False, True):
+        fn = bt._build_dist_bt_r2b_scan(amat.dist, cmat.dist, grid.mesh,
+                                        band, la=la)
+        eqns = _scan_body_eqns(_inner_eqns(fn, amat.storage, taus,
+                                           cmat.storage))
+        ag, bulk = _ag_positions(eqns), _bulk_positions(eqns)
+        assert ag and bulk
+        assert ag[0] < bulk[0], (la, ag, bulk)
+        assert not _depends_on_bulk(eqns, ag[0])
+
+
+@pytest.mark.parametrize("band_div", [1, 2])
+def test_bt_r2b_lookahead_bitwise(band_div, devices8, monkeypatch):
+    """bt_lookahead=1 must reproduce =0 bitwise — local array path AND the
+    distributed builder (same collectives, same payloads, same per-cell
+    application order; the hoist is a pure emission reorder)."""
+    from dlaf_tpu.eigensolver.back_transform import bt_reduction_to_band
+    from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+
+    rng = np.random.default_rng(11)
+    n, nb = 24, 4
+    x = rng.standard_normal((n, n))
+    a = x @ x.T + n * np.eye(n)
+    c = rng.standard_normal((n, n))
+    grid = Grid(2, 2)
+
+    def run(la, dist):
+        def body():
+            g = grid if dist else None
+            red = reduction_to_band(_mat(a, nb, grid=g) if dist
+                                    else _local_mat(a, nb),
+                                    band_size=nb // band_div)
+            ev = _mat(c, nb, grid=grid) if dist else c
+            out = bt_reduction_to_band(red, ev)
+            return out.to_numpy() if dist else np.asarray(out)
+        return _with_knobs(monkeypatch, body, DLAF_BT_LOOKAHEAD=la,
+                           DLAF_DIST_STEP_MODE="unrolled")
+
+    np.testing.assert_array_equal(run("1", False), run("0", False))
+    np.testing.assert_array_equal(run("1", True), run("0", True))
+
+
+def _local_mat(a, nb):
+    from dlaf_tpu.common.index2d import TileElementSize
+
+    return Matrix.from_global(np.asarray(a), TileElementSize(nb, nb))
+
+
+def test_bt_overlap_counters(devices8, monkeypatch, tmp_path):
+    """The hoisted bt chains are accounted:
+    dlaf_comm_overlapped_total{algo="bt_r2b_dist"} appears for both mesh
+    axes when the distributed back-transform runs with the knob on."""
+    from dlaf_tpu import obs
+    from dlaf_tpu.eigensolver.back_transform import bt_reduction_to_band
+    from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+
+    rng = np.random.default_rng(13)
+    n, nb = 24, 4
+    x = rng.standard_normal((n, n))
+    a = x @ x.T + n * np.eye(n)
+    monkeypatch.setenv("DLAF_BT_LOOKAHEAD", "1")
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "unrolled")
+    monkeypatch.setenv("DLAF_METRICS_PATH", str(tmp_path / "bt.jsonl"))
+    config.initialize()
+    try:
+        grid = Grid(2, 2)
+        red = reduction_to_band(_mat(a, nb, grid))
+        bt_reduction_to_band(red, _mat(rng.standard_normal((n, n)), nb,
+                                       grid))
+        snap = obs.registry().snapshot()
+        axes = {m["labels"]["axis"]: m["value"] for m in snap
+                if m["name"] == "dlaf_comm_overlapped_total"
+                and m["labels"].get("algo") == "bt_r2b_dist"}
+        assert axes.get("row", 0) > 0 and axes.get("col", 0) > 0, snap
+    finally:
+        for key in ("DLAF_BT_LOOKAHEAD", "DLAF_DIST_STEP_MODE",
+                    "DLAF_METRICS_PATH"):
+            monkeypatch.delenv(key)
+        config.initialize()
+        obs._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
 # Bitwise on/off A/Bs (hegst + red2band; cholesky/trsm pins live in their
 # own test files) and the overlap counters
 # ---------------------------------------------------------------------------

@@ -457,3 +457,89 @@ def test_multihost_timeout_actionable_error(monkeypatch):
     assert seen["timeout"] == 5
     # single-process worlds stay a no-op (no coordinator required)
     multihost.initialize_multihost(None, num_processes=1)
+
+
+# ---------------------------------------------------------------------------
+# fault injection parity: the PR-6 eigensolver pipeline paths
+# (hoisted bt collectives + the level-batched secular route)
+# ---------------------------------------------------------------------------
+
+def test_corrupt_all_gather_reaches_bt_chain(devices8, monkeypatch):
+    """corrupt_collective("all_gather") must reach the bt_reduction_to_band
+    panel gather even when bt_lookahead hoists it ahead of the bulk
+    (the drill targets "a collective on the back-transform chain"; the
+    hoist must not move the payload out of the corruption's reach) — and
+    the poison must NOT leak into later runs."""
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.eigensolver.back_transform import bt_reduction_to_band
+    from dlaf_tpu.eigensolver.reduction_to_band import reduction_to_band
+
+    monkeypatch.setenv("DLAF_BT_LOOKAHEAD", "1")
+    monkeypatch.setenv("DLAF_DIST_STEP_MODE", "unrolled")
+    C.initialize()
+    try:
+        rng = np.random.default_rng(7)
+        n, nb = 24, 4
+        x = rng.standard_normal((n, n))
+        a = x @ x.T + n * np.eye(n)
+        c = rng.standard_normal((n, n))
+        grid = Grid(2, 2)
+
+        def run():
+            red = reduction_to_band(Matrix.from_global(
+                a, TileElementSize(nb, nb), grid=grid))
+            return bt_reduction_to_band(red, Matrix.from_global(
+                c, TileElementSize(nb, nb), grid=grid)).to_numpy()
+
+        clean = run()
+        assert np.isfinite(clean).all()
+        with inject.corrupt_collective("all_gather", nth=0, seed=5):
+            poisoned = run()
+        assert np.isnan(poisoned).any(), \
+            "all_gather corruption never reached the hoisted bt gather"
+        again = run()
+        np.testing.assert_array_equal(again, clean)
+    finally:
+        monkeypatch.delenv("DLAF_BT_LOOKAHEAD", raising=False)
+        monkeypatch.delenv("DLAF_DIST_STEP_MODE", raising=False)
+        C.initialize()
+
+
+def test_level_batched_secular_native_failure(tmp_path, monkeypatch):
+    """Batched D&C + injected native failure: every merge's host secular
+    solve must degrade to the numpy bisection THROUGH the registry
+    (dlaf_fallback_total{site="secular"} counted), and the batched
+    decomposition must stay correct."""
+    import scipy.linalg as sla
+
+    from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+
+    _metrics_on(tmp_path, dc_level_batch="1")
+    rng = np.random.default_rng(9)
+    n = 64
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    with inject.force_native_failure():
+        lam, q = tridiag_solver(d, e, 8, use_device=True)
+    assert fallback_count("secular", "native_unavailable") >= 1
+    np.testing.assert_allclose(lam, sla.eigvalsh_tridiagonal(d, e),
+                               atol=1e-11)
+    q = np.asarray(q)
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    assert np.linalg.norm(t @ q - q * lam[None, :]) < 1e-10 * n
+
+
+def test_level_batched_strict_mode_raises(tmp_path):
+    """DLAF_STRICT under the batched route: the first secular degradation
+    raises DegradationError instead of silently taking the ~100x numpy
+    path (same contract as the serialized walk)."""
+    from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+    from dlaf_tpu.health.errors import DegradationError
+
+    _metrics_on(tmp_path, dc_level_batch="1", strict=True)
+    rng = np.random.default_rng(2)
+    d = rng.standard_normal(48)
+    e = rng.standard_normal(47)
+    with inject.force_native_failure():
+        with pytest.raises(DegradationError):
+            tridiag_solver(d, e, 8, use_device=True)

@@ -119,6 +119,145 @@ def test_mesh_sharded_merge_tree(monkeypatch, devices8):
     check(d, e, lam2, np.asarray(q2))
 
 
+def _run_with_batch(monkeypatch, dcb, fn):
+    import dlaf_tpu.config as config
+
+    monkeypatch.setenv("DLAF_DC_LEVEL_BATCH", dcb)
+    config.initialize()
+    try:
+        return fn()
+    finally:
+        monkeypatch.delenv("DLAF_DC_LEVEL_BATCH", raising=False)
+        config.initialize()
+
+
+@pytest.mark.parametrize("use_device", [True, False])
+@pytest.mark.parametrize("n,nb", [(96, 16), (100, 16), (64, 8), (33, 8)])
+def test_level_batched_matches_serialized(n, nb, use_device, monkeypatch):
+    """dc_level_batch=1 (vmapped same-shape merge groups per tree level)
+    must reproduce the serialized walk BITWISE on the host-secular route:
+    the per-merge host control work is identical, the vmapped assembly
+    scatters/rotations/gathers are lane-exact, and the batched Q·C
+    dot_general contracts the same K extent per lane
+    (docs/eigensolver_perf.md bitwise contract)."""
+    rng = np.random.default_rng(n + nb)
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    l0, q0 = _run_with_batch(monkeypatch, "0",
+                             lambda: tridiag_solver(d, e, nb, use_device))
+    l1, q1 = _run_with_batch(monkeypatch, "1",
+                             lambda: tridiag_solver(d, e, nb, use_device))
+    np.testing.assert_array_equal(l1, l0)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q0))
+    check(d, e, l1, np.asarray(q1))
+
+
+def test_level_batched_device_secular(monkeypatch):
+    """Batched vs serialized with the DEVICE secular branch forced: the
+    batch re-buckets each lane to the group's max k, whose padded zero
+    terms may reassociate at <= 1 ulp (the one documented exception,
+    docs/eigensolver_perf.md) — results must stay eigensolver-grade and
+    match the serialized walk to ulp-level."""
+    import dlaf_tpu.config as config
+
+    rng = np.random.default_rng(10)
+    n = 96
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    monkeypatch.setenv("DLAF_SECULAR_DEVICE_MIN_K", "1")
+    l0, q0 = _run_with_batch(monkeypatch, "0",
+                             lambda: tridiag_solver(d, e, 16, True))
+    l1, q1 = _run_with_batch(monkeypatch, "1",
+                             lambda: tridiag_solver(d, e, 16, True))
+    monkeypatch.delenv("DLAF_SECULAR_DEVICE_MIN_K")
+    config.initialize()
+    np.testing.assert_allclose(l1, l0, rtol=1e-14, atol=1e-14)
+    check(d, e, l1, np.asarray(q1))
+
+
+def test_level_batched_mesh_sharded(monkeypatch, devices8):
+    """Level batching under a mesh: merges past _SHARD_MERGE_MIN_N keep
+    the per-merge sharded path (batch groups never shard), and the full
+    decomposition still matches the host reference."""
+    import importlib
+
+    ts_mod = importlib.import_module("dlaf_tpu.eigensolver.tridiag_solver")
+    from dlaf_tpu.comm.grid import Grid
+
+    rng = np.random.default_rng(21)
+    n = 96
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    monkeypatch.setattr(ts_mod, "_SHARD_MERGE_MIN_N", 48)
+    mesh = Grid(2, 4).mesh
+    lam, q = _run_with_batch(
+        monkeypatch, "1",
+        lambda: ts_mod.tridiag_solver(d, e, 16, True, mesh=mesh))
+    l_ref, _ = _run_with_batch(
+        monkeypatch, "0", lambda: ts_mod.tridiag_solver(d, e, 16, False))
+    np.testing.assert_allclose(lam, l_ref, atol=1e-11)
+    check(d, e, lam, np.asarray(q))
+
+
+def test_level_batched_mxu_route(monkeypatch):
+    """Batched apply under f64_gemm="mxu" — the combination every TPU run
+    gets by default (both knobs auto-resolve on there): the ozaki int8
+    reroute must vmap cleanly and stay bitwise vs the serialized mxu
+    walk."""
+    import dlaf_tpu.config as config
+
+    rng = np.random.default_rng(3)
+    n = 64
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    monkeypatch.setenv("DLAF_F64_GEMM", "mxu")
+    monkeypatch.setenv("DLAF_F64_GEMM_MIN_DIM", "4")
+    try:
+        l0, q0 = _run_with_batch(monkeypatch, "0",
+                                 lambda: tridiag_solver(d, e, 8, True))
+        l1, q1 = _run_with_batch(monkeypatch, "1",
+                                 lambda: tridiag_solver(d, e, 8, True))
+    finally:
+        monkeypatch.delenv("DLAF_F64_GEMM")
+        monkeypatch.delenv("DLAF_F64_GEMM_MIN_DIM")
+        config.initialize()
+    np.testing.assert_array_equal(l1, l0)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q0))
+    check(d, e, l1, np.asarray(q1))
+
+
+def test_level_batched_counters(monkeypatch, tmp_path):
+    """dlaf_dc_merges_total accounting: a batched run books both modes
+    (vmapped groups + the serialized top merges), a serialized run books
+    only mode=serialized."""
+    import dlaf_tpu.config as config
+    from dlaf_tpu import obs
+
+    rng = np.random.default_rng(3)
+    d = rng.standard_normal(96)
+    e = rng.standard_normal(95)
+    monkeypatch.setenv("DLAF_METRICS_PATH", str(tmp_path / "dc.jsonl"))
+
+    def modes(dcb):
+        obs._reset_for_tests()
+        out = _run_with_batch(monkeypatch, dcb,
+                              lambda: tridiag_solver(d, e, 16, True))
+        assert out is not None
+        snap = obs.registry().snapshot()
+        return {m["labels"]["mode"]: m["value"] for m in snap
+                if m["name"] == "dlaf_dc_merges_total"}
+
+    try:
+        m1 = modes("1")
+        assert m1.get("batched", 0) > 0 and m1.get("serialized", 0) > 0, m1
+        m0 = modes("0")
+        assert m0.get("batched", 0) == 0 and m0.get("serialized", 0) > 0, m0
+    finally:
+        monkeypatch.delenv("DLAF_METRICS_PATH")
+        config.initialize()
+        obs._reset_for_tests()
+
+
 def test_native_secular_matches_numpy():
     """C++ safeguarded-Newton secular solver vs the numpy bisection: same
     anchors, same roots, and the roots actually satisfy the secular eq."""
