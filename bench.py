@@ -331,32 +331,36 @@ def best_recorded(platform: str, n: int, nb: int, path: str | None = None):
     metric is BASELINE config #1's double precision. Post-peel-fix entries
     (ts >= PEEL_FIX_TS) are preferred; pre-fix entries are a fallback for
     configs never re-measured after the fix. ``path`` overrides the log
-    location (tests)."""
+    location (tests).
+
+    The log is read through the schema-validating history reader
+    (``dlaf_tpu.obs.read_history_records``): a malformed or non-finite
+    line raises ValueError — loudly failing the bench — instead of being
+    silently skipped while it skews the replayed headline (ISSUE 7
+    satellite; ``python -m dlaf_tpu.obs.validate --history`` is the
+    standalone check)."""
     if path is None:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             ".bench_history.jsonl")
+    from dlaf_tpu.obs import read_history_records
+
     best = best_prefix = None
     try:
-        with open(path) as f:
-            for raw in f:
-                try:
-                    r = json.loads(raw)
-                except ValueError:
-                    continue
-                g = r.get("gflops")
-                if not (isinstance(g, (int, float))
-                        and r.get("platform") == platform and r.get("n") == n
-                        and r.get("nb") == nb and r.get("dtype") == "float64"
-                        # stage-arm entries carry different flop models
-                        and r.get("workload") in (None, "cholesky")):
-                    continue
-                if str(r.get("ts", "")) >= PEEL_FIX_TS:
-                    if best is None or g > best["gflops"]:
-                        best = r
-                elif best_prefix is None or g > best_prefix["gflops"]:
-                    best_prefix = r
+        records = read_history_records(path)
     except OSError:
-        return None
+        return None     # no history yet — a legitimate first round
+    for r in records:
+        g = r.get("gflops")
+        if not (r.get("platform") == platform and r.get("n") == n
+                and r.get("nb") == nb and r.get("dtype") == "float64"
+                # stage-arm entries carry different flop models
+                and r.get("workload") in (None, "cholesky")):
+            continue
+        if str(r.get("ts", "")) >= PEEL_FIX_TS:
+            if best is None or g > best["gflops"]:
+                best = r
+        elif best_prefix is None or g > best_prefix["gflops"]:
+            best_prefix = r
     return best if best is not None else best_prefix
 
 

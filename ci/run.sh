@@ -34,15 +34,51 @@ case "$TIER" in
     # trace-time counters and finite per-axis collective byte counts —
     # the audit trail that the hoisted-collective programs were built
     # (docs/comm_overlap.md)
-    OBS_ART=$(mktemp -d)/miniapp_cholesky_metrics.jsonl
+    # per-rank artifact convention (%r -> jax.process_index()) + program
+    # telemetry (ISSUE 7): compile walls, retrace counters, and HBM
+    # gauges must land in the artifact; obs.aggregate merges the
+    # per-rank files into one timeline and exports a Chrome trace
+    OBS_DIR=$(mktemp -d)
+    OBS_ART="$OBS_DIR/miniapp_cholesky.r%r.jsonl"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
-      DLAF_METRICS_PATH="$OBS_ART" \
+      DLAF_METRICS_PATH="$OBS_ART" DLAF_PROGRAM_TELEMETRY=1 \
       DLAF_CHOLESKY_LOOKAHEAD=1 DLAF_COMM_LOOKAHEAD=1 \
       python -m dlaf_tpu.miniapp.miniapp_cholesky -m 256 -b 64 \
         --grid-rows 2 --grid-cols 2 --nruns 2
-    python -m dlaf_tpu.obs.validate "$OBS_ART" \
+    python -m dlaf_tpu.obs.aggregate "$OBS_DIR"/miniapp_cholesky.r*.jsonl \
+      -o "$OBS_DIR/merged.jsonl" --chrome "$OBS_DIR/trace.json"
+    python -m dlaf_tpu.obs.validate "$OBS_DIR/merged.jsonl" \
       --require-spans --require-gflops --require-collectives \
-      --require-comm-overlap
+      --require-comm-overlap --require-telemetry
+    # the Chrome export must be valid trace-event JSON with spans from
+    # EVERY rank that produced an artifact
+    python - "$OBS_DIR" <<'EOF'
+import glob, json, sys
+d = sys.argv[1]
+doc = json.load(open(f"{d}/trace.json"))
+evs = doc["traceEvents"]
+span_pids = {e["pid"] for e in evs if e.get("ph") == "X" and e.get("tid") == 0}
+# the rank-from-filename convention has ONE owner (obs.aggregate);
+# unresolved-rank placeholder files map >= UNRESOLVED_RANK_BASE
+from dlaf_tpu.obs.aggregate import UNRESOLVED_RANK_BASE, infer_rank
+ranks = set()
+for i, p in enumerate(sorted(glob.glob(f"{d}/miniapp_cholesky.r*.jsonl"))):
+    rk = infer_rank(p, i)
+    if rk < UNRESOLVED_RANK_BASE:
+        ranks.add(rk)
+assert ranks and span_pids >= ranks, (ranks, span_pids)
+print(f"chrome trace ok: {len(evs)} events, span ranks {sorted(span_pids)}")
+EOF
+    echo "== smoke: bench-regression gate (replay + injection drill) =="
+    # clean replay of the committed history must pass; a 20% synthetic
+    # slowdown must trip the gate (exit nonzero) — proving the gate
+    # would catch a real regression of that size
+    python scripts/bench_gate.py --replay
+    if python scripts/bench_gate.py --replay --inject-slowdown 0.2 \
+        > /dev/null 2>&1; then
+      echo "bench_gate FAILED to flag a 20% injected slowdown" >&2; exit 1
+    fi
+    echo "bench_gate correctly flagged the injected slowdown"
     echo "== smoke: fault-injection / graceful-degradation artifact =="
     # drive the robustness layer end-to-end (docs/robustness.md): a tiny
     # non-SPD robust_cholesky must recover through shift-retry (leaving

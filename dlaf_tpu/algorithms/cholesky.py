@@ -1500,18 +1500,20 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
     if mat.grid is None or mat.grid.num_devices == 1:
         with entry_span, quiet_donation():
             a = to_global(mat.storage, mat.dist, donate)
+            # program telemetry (DLAF_PROGRAM_TELEMETRY): compile wall /
+            # retraces / HBM footprint per site; off = the same jitted
+            # callables, bitwise no-op (docs/observability.md)
             if trailing == "scan":
-                out = _cholesky_local_scan(a, uplo=uplo,
-                                           nb=mat.block_size.row,
-                                           use_mxu=use_mxu,
-                                           use_mixed=use_mixed,
-                                           lookahead=lookahead,
-                                           with_info=with_info)
+                out = obs.telemetry.call(
+                    "cholesky.local_scan", _cholesky_local_scan, a,
+                    uplo=uplo, nb=mat.block_size.row, use_mxu=use_mxu,
+                    use_mixed=use_mixed, lookahead=lookahead,
+                    with_info=with_info)
             else:
-                out = _cholesky_local(a, uplo=uplo, nb=mat.block_size.row,
-                                      trailing=trailing,
-                                      lookahead=lookahead,
-                                      with_info=with_info)
+                out = obs.telemetry.call(
+                    "cholesky.local", _cholesky_local, a, uplo=uplo,
+                    nb=mat.block_size.row, trailing=trailing,
+                    lookahead=lookahead, with_info=with_info)
             info = None
             if with_info:
                 out, info = out
@@ -1561,6 +1563,8 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                                with_info=with_info)
     with entry_span, quiet_donation():
         if with_info:
-            storage, info = fn(mat.storage)
+            storage, info = obs.telemetry.call("cholesky.dist", fn,
+                                               mat.storage)
             return mat.with_storage(storage), info
-        return mat.with_storage(fn(mat.storage))
+        return mat.with_storage(
+            obs.telemetry.call("cholesky.dist", fn, mat.storage))

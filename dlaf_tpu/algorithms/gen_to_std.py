@@ -775,9 +775,10 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
         with entry_span, quiet_donation():
             g = tiles_to_global(a.storage, a.dist)
             lg = tiles_to_global(b_factor.storage, b_factor.dist)
-            out = _hegst_local_blocked(g, lg, uplo=uplo,
-                                       nb=a.block_size.row,
-                                       lookahead=lookahead)
+            # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
+            out = obs.telemetry.call(
+                "gen_to_std.local", _hegst_local_blocked, g, lg, uplo=uplo,
+                nb=a.block_size.row, lookahead=lookahead)
             out_m = a.with_storage(global_to_tiles_donated(out, a.dist))
         res = mops.merge_triangle(out_m, a, uplo, donate_orig=donate)
         return (res, info) if with_info else res
@@ -791,5 +792,6 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
                             donate=donate, lookahead=lookahead,
                             comm_la=comm_la)
     with entry_span, quiet_donation():
-        res = a.with_storage(fn(a.storage, b_factor.storage))
+        res = a.with_storage(obs.telemetry.call(
+            "gen_to_std.dist", fn, a.storage, b_factor.storage))
         return (res, info) if with_info else res

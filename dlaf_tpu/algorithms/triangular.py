@@ -777,8 +777,10 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
                             lookahead=la,
                             comm_la=la and resolved_comm_lookahead())
     with entry_span, quiet_donation():
-        res = b.with_storage(fn(a.storage, b.storage,
-                                jnp.asarray(alpha, b.dtype)))
+        # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
+        res = b.with_storage(obs.telemetry.call(
+            "triangular_solve.dist", fn, a.storage, b.storage,
+            jnp.asarray(alpha, b.dtype)))
         return (res, info) if with_info else res
 
 
@@ -812,5 +814,6 @@ def triangular_multiply(side: str, uplo: str, op: str, diag: str, alpha,
                            scan=resolve_step_mode(a.dist.nr_tiles.row)
                            == "scan")
     with entry_span:
-        return b.with_storage(fn(a.storage, b.storage,
-                                 jnp.asarray(alpha, b.dtype)))
+        return b.with_storage(obs.telemetry.call(
+            "triangular_multiply.dist", fn, a.storage, b.storage,
+            jnp.asarray(alpha, b.dtype)))

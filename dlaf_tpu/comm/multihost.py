@@ -93,6 +93,20 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
             "coordinator address and world size, and (3) process ids are "
             "unique in [0, world). On Cloud TPU, omit all arguments — "
             "discovery is automatic.") from e
+    # pin the now-authoritative rank onto the observability layer and
+    # re-resolve the metrics path: a DLAF_METRICS_PATH ``%r`` template
+    # expanded before the distributed runtime came up would have labeled
+    # every host rank 0 — and every host would append to the same file,
+    # the interleaving the per-rank convention exists to prevent
+    from .. import obs
+    from ..config import get_configuration
+
+    obs.set_rank(jax.process_index())
+    cfg = get_configuration()
+    if "%r" in (cfg.metrics_path or ""):
+        obs.configure(log_level=cfg.log, metrics_path=cfg.metrics_path,
+                      trace_dir=cfg.trace_dir or cfg.profile_dir,
+                      program_telemetry=cfg.program_telemetry)
 
 
 def _is_bringup_failure(e: BaseException) -> bool:

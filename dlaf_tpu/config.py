@@ -389,6 +389,17 @@ class Configuration:
     #: silently taking the degraded path. The CI/bring-up stance where a
     #: missing native library must fail the job, not slow it 100x.
     strict: bool = False
+    #: Program telemetry (``DLAF_PROGRAM_TELEMETRY``): the algorithm entry
+    #: points and the library's cached-program sites record per-site
+    #: compile walls (``dlaf_compile_seconds{site}``), trace counts
+    #: (``dlaf_retrace_total{site}`` — first trace = 1, more = retraces),
+    #: and ``compiled.memory_analysis()`` HBM gauges
+    #: (``dlaf_hbm_bytes{what=args|output|temp|peak,site}``), each compile
+    #: also landing as a ``program`` record in the ``metrics_path``
+    #: artifact (dlaf_tpu.obs.telemetry; docs/observability.md). Off
+    #: (default): every instrumented site is a passthrough to the same
+    #: jitted callable — bitwise no-op, one attribute read of cost.
+    program_telemetry: bool = False
 
     def _fields(self):
         return {f.name: f for f in dataclasses.fields(self)}
@@ -545,7 +556,8 @@ def initialize(user: Optional[Configuration] = None,
     from . import obs
 
     obs.configure(log_level=cfg.log, metrics_path=cfg.metrics_path,
-                  trace_dir=cfg.trace_dir or cfg.profile_dir)
+                  trace_dir=cfg.trace_dir or cfg.profile_dir,
+                  program_telemetry=cfg.program_telemetry)
     if cfg.print_config:
         print(cfg)
     _active = cfg

@@ -598,7 +598,12 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         with _bt_r2b_entry_span(
                 red, a.size.row, evecs.size.col, la,
                 f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"):
-            out = fn(a.storage, memory.as_device(red.taus), storage)
+            from .. import obs
+
+            # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
+            out = obs.telemetry.call("bt_reduction_to_band.dist", fn,
+                                     a.storage, memory.as_device(red.taus),
+                                     storage)
         return Matrix(evecs.dist, out, evecs.grid)
     a_v = tiles_to_global(a.storage, a.dist)
     arr = evecs
@@ -608,8 +613,12 @@ def bt_reduction_to_band(red: BandReduction, evecs):
     e = memory.as_device(arr).astype(a_v.dtype)
     with _bt_r2b_entry_span(red, a.size.row,
                             e.shape[1] if e.ndim > 1 else 1, la, "1x1"):
-        out = _bt_r2b_local(a_v, memory.as_device(red.taus), e, nb=red.band,
-                            la=la)
+        from .. import obs
+
+        out = obs.telemetry.call("bt_reduction_to_band.local",
+                                 _bt_r2b_local, a_v,
+                                 memory.as_device(red.taus), e, nb=red.band,
+                                 la=la)
     if ret_matrix:
         return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
     return out

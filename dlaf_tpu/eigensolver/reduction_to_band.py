@@ -649,10 +649,14 @@ def reduction_to_band(a: Matrix, band_size: int | None = None, *,
     if a.grid is None or a.grid.num_devices == 1:
         with entry_span, quiet_donation():
             g = to_global(a.storage, a.dist, donate)
+            # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
             if resolve_step_mode(steps) == "scan":
-                out, taus = _red2band_local_scan(g, nb=band)
+                out, taus = obs.telemetry.call(
+                    "reduction_to_band.local_scan", _red2band_local_scan,
+                    g, nb=band)
             else:
-                out, taus = _red2band_local(g, nb=band)
+                out, taus = obs.telemetry.call(
+                    "reduction_to_band.local", _red2band_local, g, nb=band)
             return BandReduction(
                 a.with_storage(global_to_tiles_donated(out, a.dist)),
                 taus, band)
@@ -670,7 +674,8 @@ def reduction_to_band(a: Matrix, band_size: int | None = None, *,
                                comm_la=not scan_mode
                                and resolved_comm_lookahead())
     with entry_span, quiet_donation():
-        storage, taus = fn(a.storage)
+        storage, taus = obs.telemetry.call("reduction_to_band.dist", fn,
+                                           a.storage)
     return BandReduction(a.with_storage(storage), taus, band)
 
 

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs
 from ..config import register_program_cache
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import stedc
@@ -409,7 +410,16 @@ def _secular_vcols_batched_jit():
     level's secular work lands in ONE device dispatch instead of one per
     merge. Sharded merges never batch (they keep the per-merge
     :func:`_secular_vcols_jit` with its mesh shardings)."""
-    return jax.jit(jax.vmap(_secular_vcols_device))
+    vm = jax.vmap(_secular_vcols_device)
+
+    def fn(*args):
+        # trace-time retrace counter (DLAF_PROGRAM_TELEMETRY): each
+        # re-bucketing of the level batch retraces this program — the
+        # documented compile-cost tail of dc_level_batch, now measurable
+        obs.telemetry.count_retrace("tridiag.secular_batched")
+        return vm(*args)
+
+    return jax.jit(fn)
 
 
 @register_program_cache
@@ -427,7 +437,13 @@ def _apply_qc_batched_jit():
     Same kernel as the per-merge program (:func:`_apply_qc_fn`; the vmap
     wrapper is a fresh callable per builder call, so jit retraces after a
     config-change cache clear)."""
-    return jax.jit(jax.vmap(_apply_qc_fn))
+    vm = jax.vmap(_apply_qc_fn)
+
+    def fn(q1, q2, qc):
+        obs.telemetry.count_retrace("tridiag.apply_qc_batched")
+        return vm(q1, q2, qc)
+
+    return jax.jit(fn)
 
 
 def _count_merges(mode: str, n: int = 1) -> None:

@@ -139,4 +139,8 @@ def matrix_diag_info(mat, *, singular: bool = False):
     when/whether to fetch). ``singular=True`` is the triangular-solve /
     HEGST detection (zero OR non-finite diagonal); the default matches
     ``potrf_info`` (non-finite only)."""
-    return _diag_info_prog(mat.dist, singular)(mat.storage)
+    from .. import obs
+
+    # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
+    return obs.telemetry.call("diag_info", _diag_info_prog(mat.dist, singular),
+                              mat.storage)

@@ -65,23 +65,38 @@ def run(argv=None):
         "potrf": (lambda a, spd: tl.potrf("L", spd), batch * m**3 / 6),
     }
     fn, half_flops = kernels[args.kernel]
+    from .. import obs
+
     jfn = jax.jit(fn)
     for a, spd in work:  # compile + device-place every work set before timing
-        hard_fence(jfn(a, spd))
+        # telemetry-aware warmup: with DLAF_PROGRAM_TELEMETRY on, the
+        # artifact carries this kernel's compile wall + memory analysis
+        hard_fence(obs.telemetry.call(f"miniapp_kernel.{args.kernel}",
+                                      jfn, a, spd))
     results = []
+    flops = total_ops(dtype, half_flops, half_flops)
     for run_i in range(-opts.nwarmups, opts.nruns):
         a, spd = work.next_resource()
-        t0 = time.perf_counter()
-        out = jfn(a, spd)
-        hard_fence(out)
-        t = time.perf_counter() - t0
-        gflops = total_ops(dtype, half_flops, half_flops) / t / 1e9
+        # fenced per-run span, same contract as the other miniapps: the
+        # JSONL record derives the honest GFlop/s
+        step_span = obs.span("miniapp_kernel.run", flops=flops, run=run_i,
+                             warmup=run_i < 0, kernel=args.kernel, m=m,
+                             batch=batch, dtype=np.dtype(dtype).name)
+        with step_span:
+            t0 = time.perf_counter()
+            out = obs.telemetry.call(f"miniapp_kernel.{args.kernel}",
+                                     jfn, a, spd)
+            hard_fence(out)
+            t = time.perf_counter() - t0
+        gflops = flops / t / 1e9
         if run_i < 0:
             continue
         print(f"[{run_i}] {t:.6f}s {gflops:.2f}GFlop/s {args.kernel} "
               f"{type_letter(dtype)} ({m}, {m}) x{batch} {os.cpu_count()} "
               f"{jax.devices()[0].platform}", flush=True)
         results.append({"run": run_i, "time_s": t, "gflops": gflops})
+    # counters land in the artifact when run() returns, not at exit
+    obs.flush()
     return results
 
 

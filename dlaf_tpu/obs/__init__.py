@@ -34,13 +34,15 @@ from typing import Optional
 from . import logging as _logging
 from . import metrics as _metrics
 from . import sinks as _sinks
+from . import telemetry as telemetry
 from . import trace as _trace
-from ._state import LOG_LEVELS, STATE
+from ._state import LOG_LEVELS, STATE, current_rank
 from .logging import Logger, get_logger
 from .metrics import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
                       Gauge, Histogram, Registry, prometheus_text)
-from .sinks import (SCHEMA_VERSION, JsonlSink, read_records, validate_file,
-                    validate_records)
+from .sinks import (SCHEMA_VERSION, JsonlSink, append_history_line,
+                    expand_rank_template, read_history_records, read_records,
+                    validate_file, validate_history_records, validate_records)
 from .trace import (NOOP_CTX, NOOP_SPAN, Span, current_span, entry_span,
                     named_span, span, start_profiler, stop_profiler)
 
@@ -53,12 +55,14 @@ __all__ = [
     "validate_records", "read_records", "Span", "Counter", "Gauge",
     "Histogram", "Registry", "Logger", "JsonlSink", "SCHEMA_VERSION",
     "NOOP_SPAN", "NOOP_CTX", "NOOP_COUNTER", "NOOP_GAUGE", "NOOP_HISTOGRAM",
-    "LOG_LEVELS", "start_profiler", "stop_profiler",
+    "LOG_LEVELS", "start_profiler", "stop_profiler", "telemetry",
+    "set_rank", "current_rank", "expand_rank_template",
+    "append_history_line", "read_history_records", "validate_history_records",
 ]
 
 
 def configure(log_level: str = "info", metrics_path: str = "",
-              trace_dir: str = "") -> None:
+              trace_dir: str = "", program_telemetry: bool = False) -> None:
     """(Re)configure the layer — called by ``config.initialize()`` with the
     resolved knobs, or lazily from the env by the first logging call in a
     process that never initializes the runtime.
@@ -67,6 +71,17 @@ def configure(log_level: str = "info", metrics_path: str = "",
     (its file stays, a complete artifact); counters persist across
     reconfiguration within a process — they are process-lifetime
     accumulators, like the reference's performance counters.
+
+    ``metrics_path`` may carry a ``%r`` placeholder, replaced by the
+    process rank (``jax.process_index()``) so each host of a multi-host
+    run appends to its own artifact instead of interleaving one file;
+    merge them with ``python -m dlaf_tpu.obs.aggregate``.
+
+    ``program_telemetry`` (the ``DLAF_PROGRAM_TELEMETRY`` knob) arms the
+    AOT/jit instrumentation in :mod:`dlaf_tpu.obs.telemetry` — compile
+    walls, retrace counters, and HBM gauges from the library's cached
+    program sites. Off (default), every telemetry call site is a
+    zero-cost passthrough.
     """
     level = str(log_level or "info").strip().lower()
     if level not in LOG_LEVELS:
@@ -74,6 +89,7 @@ def configure(log_level: str = "info", metrics_path: str = "",
                          f"{tuple(LOG_LEVELS)}")
     STATE.log_level = level
     STATE.log_level_num = LOG_LEVELS[level]
+    metrics_path = _sinks.expand_rank_template(metrics_path or "")
     if STATE.sink is not None and STATE.sink.path != metrics_path:
         emit_metrics_snapshot()
         STATE.sink.close()
@@ -83,12 +99,24 @@ def configure(log_level: str = "info", metrics_path: str = "",
     STATE.trace_dir = trace_dir or ""
     STATE.metrics_on = STATE.sink is not None
     STATE.annotate = bool(trace_dir)
-    if STATE.registry is None and (STATE.metrics_on or STATE.annotate):
+    STATE.telemetry_on = bool(program_telemetry)
+    if STATE.registry is None and (STATE.metrics_on or STATE.annotate
+                                   or STATE.telemetry_on):
         STATE.registry = _metrics.Registry()
-    if (STATE.metrics_on or STATE.annotate) and not STATE.atexit_registered:
+    if (STATE.metrics_on or STATE.annotate or STATE.telemetry_on) \
+            and not STATE.atexit_registered:
         STATE.atexit_registered = True
         atexit.register(_shutdown)
     STATE.configured = True
+
+
+def set_rank(rank: int) -> None:
+    """Pin the rank stamped onto JSONL records (and ``%r`` expansions).
+    :func:`dlaf_tpu.comm.multihost.initialize_multihost` calls this right
+    after ``jax.distributed.initialize`` — a ``%r`` metrics path resolved
+    before the distributed runtime came up would have labeled every host
+    rank 0."""
+    STATE.rank = int(rank)
 
 
 def _shutdown() -> None:
@@ -188,4 +216,7 @@ def _reset_for_tests() -> None:
     STATE.configured = False
     STATE.log_level = "info"
     STATE.log_level_num = LOG_LEVELS["info"]
+    STATE.telemetry_on = False
+    STATE.rank = None
+    telemetry._reset_for_tests()
     _logging.reset_once()

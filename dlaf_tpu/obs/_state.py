@@ -20,7 +20,8 @@ LOG_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "off": 99}
 class _ObsState:
     __slots__ = ("configured", "log_level", "log_level_num", "metrics_on",
                  "annotate", "trace_dir", "sink", "registry",
-                 "profiler_started", "atexit_registered")
+                 "profiler_started", "atexit_registered", "telemetry_on",
+                 "rank")
 
     def __init__(self):
         self.configured = False
@@ -33,6 +34,8 @@ class _ObsState:
         self.registry = None             # type: Optional[object]  # Registry
         self.profiler_started = False
         self.atexit_registered = False
+        self.telemetry_on = False        # DLAF_PROGRAM_TELEMETRY knob
+        self.rank = None                 # type: Optional[int]  # process rank
 
 
 STATE = _ObsState()
@@ -61,4 +64,36 @@ def ensure_env_defaults() -> None:
         level = "info"
     configure(log_level=level,
               metrics_path=os.environ.get("DLAF_METRICS_PATH", ""),
-              trace_dir=os.environ.get("DLAF_TRACE_DIR", ""))
+              trace_dir=os.environ.get("DLAF_TRACE_DIR", ""),
+              program_telemetry=os.environ.get(
+                  "DLAF_PROGRAM_TELEMETRY", "").strip().lower()
+              in ("1", "true", "yes", "on"))
+
+
+def current_rank():
+    """The process rank for record stamping: the rank an owner pinned via
+    :func:`dlaf_tpu.obs.set_rank` (``initialize_multihost`` does), else
+    ``jax.process_index()`` — but only once jax is imported AND a backend
+    already exists. A bare log write must neither import jax nor trigger
+    backend initialization (this repo never probes a possibly-wedged
+    accelerator tunnel implicitly); records written before the backend
+    comes up simply carry no ``rank`` field (optional by schema)."""
+    if STATE.rank is not None:
+        return STATE.rank
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+
+        if not getattr(xla_bridge, "_backends", None):
+            return None     # no live backend: process_index would init one
+    except ImportError:
+        pass                # unknown jax layout: accept the init cost
+    try:
+        STATE.rank = int(jax.process_index())
+    except Exception:
+        return None
+    return STATE.rank

@@ -197,8 +197,11 @@ class Registry:
 def _prom_labels(labels: dict) -> str:
     if not labels:
         return ""
+    # text exposition 0.0.4 label escaping: backslash, double-quote, and
+    # line feed (an unescaped newline would split the sample line)
     inner = ",".join(
-        '{}="{}"'.format(k, str(v).replace("\\", "\\\\").replace('"', '\\"'))
+        '{}="{}"'.format(k, str(v).replace("\\", "\\\\")
+                         .replace('"', '\\"').replace("\n", "\\n"))
         for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
@@ -220,6 +223,11 @@ def prometheus_text(snapshot: list) -> str:
     lines = []
     for (name, kind), entries in sorted(by_name.items()):
         lines.append(f"# TYPE {name} {kind}")
+        # deterministic series order within a family: sorted by labels,
+        # not by registry insertion order (two runs of the same program
+        # must scrape identically — diffs in CI artifacts stay readable)
+        entries = sorted(entries,
+                         key=lambda m: sorted(m.get("labels", {}).items()))
         for m in entries:
             labels = m.get("labels", {})
             if kind == "histogram":

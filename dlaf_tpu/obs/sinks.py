@@ -28,11 +28,28 @@ Schema (version 1). Every record carries ``v`` (int schema version),
     ``level`` str, ``logger`` str, ``msg`` str, ``fields`` object.
 ``bench_result``
     ``payload`` object (free-form; bench.py's measurement line).
+``program``
+    Program-telemetry record (:mod:`dlaf_tpu.obs.telemetry`, the
+    ``DLAF_PROGRAM_TELEMETRY`` knob): ``site`` str, ``event``
+    "compile" | "retrace", finite ``compile_s`` >= 0 (compile events;
+    optional ``trace_s``), optional ``hbm`` object of finite byte gauges
+    (``args``/``output``/``temp``/``peak`` from
+    ``compiled.memory_analysis()``), ``attrs`` object.
+
+Every record additionally carries an optional ``rank`` (int >= 0,
+``jax.process_index()``) — stamped by the sink once the rank is known, so
+multi-host artifacts merge per rank (``python -m dlaf_tpu.obs.aggregate``;
+``DLAF_METRICS_PATH`` accepts a ``%r`` per-rank template so ranks never
+interleave one file).
 
 :func:`validate_file` is the single schema owner consumed by tests and the
 CI gate (``python -m dlaf_tpu.obs.validate``): it rejects unparsable lines,
 missing fields, and non-finite numerics (a NaN GFlop/s must fail the tier,
-not scrape as a number).
+not scrape as a number). The append-only bench history
+(``.bench_history.jsonl``) has its own line schema, also owned here
+(:func:`validate_history_records`, :func:`append_history_line` — the
+validator CLI's ``--history`` mode): a malformed or non-finite history
+line must fail loudly, not silently skew the replayed-history headline.
 """
 
 from __future__ import annotations
@@ -45,7 +62,26 @@ from typing import Optional
 
 SCHEMA_VERSION = 1
 
-KNOWN_TYPES = ("span", "metrics", "log", "bench_result")
+KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program")
+
+
+def expand_rank_template(path: str) -> str:
+    """Resolve a ``%r`` per-rank placeholder in a metrics path — but ONLY
+    when the rank is already known (:func:`dlaf_tpu.obs._state.
+    current_rank`'s non-forcing resolution). Before any backend exists the
+    template is returned unexpanded: forcing ``jax.process_index()`` here
+    would initialize the local backend, and on a multi-host worker that
+    happens exactly where it must not — before ``initialize_multihost``'s
+    ``jax.distributed.initialize`` (which both breaks bring-up and pins
+    rank 0 on every host). The sink expands the deferred template at
+    first write instead, and ``initialize_multihost`` re-configures with
+    the authoritative rank."""
+    if "%r" not in path:
+        return path
+    from ._state import current_rank
+
+    rank = current_rank()
+    return path if rank is None else path.replace("%r", str(rank))
 
 
 class JsonlSink:
@@ -60,9 +96,36 @@ class JsonlSink:
     def write(self, record: dict) -> None:
         record.setdefault("v", SCHEMA_VERSION)
         record.setdefault("ts", time.time())
+        if "rank" not in record:
+            # stamp the process rank once known (lazy: resolving it must
+            # not force a jax import from a bare log call)
+            from ._state import current_rank
+
+            rank = current_rank()
+            if rank is not None:
+                record["rank"] = rank
         line = json.dumps(record, default=str)
         with self._lock:
             if self._f is None:
+                if "%r" in self.path:
+                    # deferred %r template (configure() could not resolve
+                    # the rank without forcing backend init): expand now —
+                    # by first write a backend exists for any real run —
+                    # and record the resolved path so a later configure()
+                    # with the authoritative rank reopens cleanly. If the
+                    # rank is STILL unknown (pre-distributed-init log
+                    # writes), use a per-process placeholder: claiming
+                    # rank 0 would make every late-initializing host of a
+                    # shared filesystem append to rank 0's file — the
+                    # misattributed interleaving %r exists to prevent.
+                    from ._state import current_rank
+
+                    rank = current_rank()
+                    import os as _os
+
+                    self.path = self.path.replace(
+                        "%r", str(rank) if rank is not None
+                        else f"u{_os.getpid()}")
                 self._f = open(self.path, "a", buffering=1)
             self._f.write(line + "\n")
 
@@ -105,6 +168,39 @@ def _validate_span(r: dict, where: str, errors: list) -> None:
                     f"{where}: retry span missing finite attr {key!r}")
 
 
+def _validate_program(r: dict, where: str, errors: list) -> None:
+    if not isinstance(r.get("site"), str) or not r.get("site"):
+        errors.append(f"{where}: program record without a site")
+    event = r.get("event")
+    if event not in ("compile", "retrace"):
+        errors.append(f"{where}: program event must be compile|retrace, "
+                      f"got {event!r}")
+    if event == "compile":
+        # a compile event without a finite compile wall is exactly the
+        # kind of silent telemetry hole the knob exists to close
+        if not _finite(r.get("compile_s")) or r.get("compile_s", -1) < 0:
+            errors.append(f"{where}: program compile_s "
+                          "missing/non-finite/negative")
+    elif "compile_s" in r and (not _finite(r["compile_s"])
+                               or r["compile_s"] < 0):
+        # optional on other events, but non-finite numerics are schema
+        # errors everywhere (same treatment as trace_s below)
+        errors.append(f"{where}: program compile_s non-finite/negative")
+    if "trace_s" in r and (not _finite(r["trace_s"]) or r["trace_s"] < 0):
+        errors.append(f"{where}: program trace_s non-finite/negative")
+    hbm = r.get("hbm")
+    if hbm is not None:
+        if not isinstance(hbm, dict):
+            errors.append(f"{where}: program hbm must be an object")
+        else:
+            for key, v in hbm.items():
+                if not _finite(v):
+                    errors.append(f"{where}: program hbm[{key!r}] "
+                                  "non-finite")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: program attrs must be an object")
+
+
 def _validate_metrics(r: dict, where: str, errors: list) -> None:
     entries = r.get("metrics")
     if not isinstance(entries, list):
@@ -130,7 +226,8 @@ def _validate_metrics(r: dict, where: str, errors: list) -> None:
 def validate_records(records, require_spans=False, require_gflops=False,
                      require_collectives=False, require_retries=False,
                      require_fallbacks=False, require_comm_overlap=False,
-                     require_dc_batch=False, require_bt_overlap=False) -> list:
+                     require_dc_batch=False, require_bt_overlap=False,
+                     require_telemetry=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -146,10 +243,18 @@ def validate_records(records, require_spans=False, require_gflops=False,
     D&C audit trail, docs/eigensolver_perf.md), and
     (``require_bt_overlap``) a positive finite
     ``dlaf_comm_overlapped_total`` counter whose algo label starts with
-    ``bt_`` (the pipelined back-transform's hoisted collectives)."""
+    ``bt_`` (the pipelined back-transform's hoisted collectives), and
+    (``require_telemetry``) the program-telemetry audit trail
+    (docs/observability.md): >= 1 finite compile-seconds observation,
+    finite HBM accounting, and retrace evidence — each leg satisfiable
+    by EITHER a metrics snapshot (``dlaf_compile_seconds`` histogram /
+    ``dlaf_hbm_bytes`` gauge / ``dlaf_retrace_total`` counter) or the
+    per-event ``program`` records, so a run killed before the final
+    snapshot landed still validates on its record trail."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = 0
+    n_compile_obs = n_hbm = n_retrace = 0
     overlap_axes, byte_axes = set(), set()
     for i, r in enumerate(records):
         where = f"record {i}"
@@ -165,7 +270,25 @@ def validate_records(records, require_spans=False, require_gflops=False,
         if r.get("v") != SCHEMA_VERSION:
             errors.append(f"{where}: schema version {r.get('v')!r} != "
                           f"{SCHEMA_VERSION}")
-        if rtype == "span":
+        if "rank" in r and (not isinstance(r["rank"], int)
+                            or isinstance(r["rank"], bool)
+                            or r["rank"] < 0):
+            errors.append(f"{where}: rank must be a non-negative int, "
+                          f"got {r['rank']!r}")
+        if rtype == "program":
+            _validate_program(r, where, errors)
+            if r.get("event") == "compile" and _finite(r.get("compile_s")):
+                n_compile_obs += 1
+            # program records are first-class telemetry evidence for ALL
+            # three --require-telemetry legs: a run killed before the
+            # final metrics snapshot landed still wrote its audit trail
+            if r.get("event") == "retrace":
+                n_retrace += 1
+            hbm = r.get("hbm")
+            if isinstance(hbm, dict) and hbm \
+                    and all(_finite(v) for v in hbm.values()):
+                n_hbm += 1
+        elif rtype == "span":
             _validate_span(r, where, errors)
             n_spans += 1
             if _finite(r.get("gflops")):
@@ -178,7 +301,16 @@ def validate_records(records, require_spans=False, require_gflops=False,
         elif rtype == "metrics":
             _validate_metrics(r, where, errors)
             for m in r.get("metrics") or []:
-                if not isinstance(m, dict) or not _finite(m.get("value")):
+                if not isinstance(m, dict):
+                    continue
+                # histogram checks come BEFORE the finite-value guard:
+                # histograms carry count/sum, never a 'value'
+                if m.get("name") == "dlaf_compile_seconds" \
+                        and m.get("kind") == "histogram" \
+                        and isinstance(m.get("count"), int) \
+                        and m["count"] >= 1 and _finite(m.get("sum")):
+                    n_compile_obs += 1
+                if not _finite(m.get("value")):
                     continue
                 if m.get("name") == "dlaf_comm_collective_bytes_total" \
                         and m["value"] > 0:
@@ -199,6 +331,10 @@ def validate_records(records, require_spans=False, require_gflops=False,
                     n_dc_batched += 1
                 if m.get("name") == "dlaf_fallback_total" and m["value"] > 0:
                     n_fallbacks += 1
+                if m.get("name") == "dlaf_hbm_bytes":
+                    n_hbm += 1
+                if m.get("name") == "dlaf_retrace_total" and m["value"] >= 1:
+                    n_retrace += 1
         elif rtype == "log":
             if not isinstance(r.get("msg"), str):
                 errors.append(f"{where}: log without msg")
@@ -221,6 +357,18 @@ def validate_records(records, require_spans=False, require_gflops=False,
     if require_bt_overlap and n_bt_overlap == 0:
         errors.append("artifact contains no positive "
                       "dlaf_comm_overlapped_total counter with a bt_* algo")
+    if require_telemetry:
+        if n_compile_obs == 0:
+            errors.append("artifact contains no finite compile-seconds "
+                          "observation (program record or "
+                          "dlaf_compile_seconds histogram)")
+        if n_hbm == 0:
+            errors.append("artifact contains no finite HBM accounting "
+                          "(dlaf_hbm_bytes gauge or program-record hbm)")
+        if n_retrace == 0:
+            errors.append("artifact contains no retrace evidence "
+                          "(dlaf_retrace_total counter >= 1 or program "
+                          "retrace record)")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "
@@ -255,3 +403,69 @@ def validate_file(path: str, **require) -> list:
     except (OSError, ValueError) as e:
         return [str(e)]
     return validate_records(records, **require)
+
+
+# ---------------------------------------------------------------------------
+# Bench-history line schema (.bench_history.jsonl)
+# ---------------------------------------------------------------------------
+# Bare measurement lines (no v/type/ts envelope — the file predates the
+# obs schema and BASELINE.md cites it verbatim), but schema-owned HERE so
+# bench.py's replayed-history headline lookup and scripts/bench_gate.py's
+# baselines never silently ingest a malformed or non-finite entry.
+
+#: (field, required, finiteness) — numeric fields must be finite; string
+#: fields must be non-empty strings.
+HISTORY_NUMERIC_FIELDS = ("gflops", "t", "n", "nb")
+HISTORY_STRING_FIELDS = ("variant", "platform", "dtype", "ts", "source")
+
+
+def validate_history_line(line: dict) -> list:
+    """Error strings for ONE history measurement line (empty = valid)."""
+    errors = []
+    if not isinstance(line, dict):
+        return ["history line is not an object"]
+    for key in HISTORY_NUMERIC_FIELDS:
+        if not _finite(line.get(key)):
+            errors.append(f"history field {key!r} missing/non-finite "
+                          f"(got {line.get(key)!r})")
+    for key in HISTORY_STRING_FIELDS:
+        if not isinstance(line.get(key), str) or not line.get(key):
+            errors.append(f"history field {key!r} missing/empty")
+    return errors
+
+
+def validate_history_records(records) -> list:
+    errors = []
+    for i, line in enumerate(records):
+        for e in validate_history_line(line):
+            errors.append(f"entry {i}: {e}")
+    return errors
+
+
+def read_history_records(path: str) -> list:
+    """Parse + validate the append-only bench history; raises ValueError
+    on an unparsable or schema-invalid line (loud by contract: a bad line
+    would otherwise skew every replayed-history headline and every
+    bench-gate baseline derived from the file)."""
+    records = read_records(path)
+    errors = validate_history_records(records)
+    if errors:
+        raise ValueError(f"{path}: invalid bench history: "
+                         + "; ".join(errors[:5])
+                         + (f" (+{len(errors) - 5} more)"
+                            if len(errors) > 5 else ""))
+    return records
+
+
+def append_history_line(path: str, line: dict) -> dict:
+    """Validate + append one measurement line to the history log (the
+    single write path — scripts/measure_common.append_history routes
+    through here). Raises ValueError instead of writing a line the
+    readers would have to reject."""
+    errors = validate_history_line(line)
+    if errors:
+        raise ValueError("refusing to append invalid bench history line: "
+                         + "; ".join(errors))
+    with open(path, "a") as f:
+        f.write(json.dumps(line) + "\n")
+    return line

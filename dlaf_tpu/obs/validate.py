@@ -26,12 +26,26 @@ Flags:
                             positive dlaf_comm_overlapped_total counter
                             with a bt_* algo label (the pipelined
                             back-transform's hoisted collectives)
+    --require-telemetry     fail unless the artifact carries the program
+                            telemetry audit trail (DLAF_PROGRAM_TELEMETRY,
+                            docs/observability.md): >= 1 finite
+                            compile-seconds observation, finite HBM
+                            accounting, and retrace evidence — each leg
+                            satisfiable by a metrics snapshot OR by the
+                            per-event program records
+    --history               validate the file as an append-only bench
+                            history log (.bench_history.jsonl: bare
+                            measurement lines — finite gflops/t/n/nb,
+                            non-empty variant/platform/dtype/ts/source)
+                            instead of an obs artifact; incompatible with
+                            the --require-* flags
     --prom                  print the last metrics snapshot as Prometheus
                             text exposition after validating
 
 Exit status 0 = schema-valid (and all required content present); 1 =
-errors (printed one per line). ``ci/run.sh smoke`` runs this over the
-miniapp_cholesky artifact — missing or NaN fields fail the tier.
+errors (printed one per line); 2 = usage error (unknown flag, or not
+exactly one path). ``ci/run.sh smoke`` runs this over the miniapp
+artifacts — missing or NaN fields fail the tier.
 """
 
 from __future__ import annotations
@@ -39,7 +53,7 @@ from __future__ import annotations
 import sys
 
 from .metrics import prometheus_text
-from .sinks import read_records, validate_records
+from .sinks import read_records, validate_history_records, validate_records
 
 
 def main(argv=None) -> int:
@@ -49,8 +63,11 @@ def main(argv=None) -> int:
     known = {"--require-spans", "--require-gflops", "--require-collectives",
              "--require-retries", "--require-fallbacks",
              "--require-comm-overlap", "--require-dc-batch",
-             "--require-bt-overlap", "--prom"}
-    if len(paths) != 1 or flags - known:
+             "--require-bt-overlap", "--require-telemetry", "--history",
+             "--prom"}
+    requires = {f for f in flags if f.startswith("--require-")}
+    if len(paths) != 1 or flags - known \
+            or ("--history" in flags and requires):
         print(__doc__, file=sys.stderr)
         return 2
     path = paths[0]
@@ -59,6 +76,14 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"INVALID {path}: {e}", file=sys.stderr)
         return 1
+    if "--history" in flags:
+        errors = validate_history_records(records)
+        if errors:
+            for e in errors:
+                print(f"INVALID {path}: {e}", file=sys.stderr)
+            return 1
+        print(f"VALID {path}: {len(records)} history entries")
+        return 0
     errors = validate_records(
         records,
         require_spans="--require-spans" in flags,
@@ -68,16 +93,21 @@ def main(argv=None) -> int:
         require_fallbacks="--require-fallbacks" in flags,
         require_comm_overlap="--require-comm-overlap" in flags,
         require_dc_batch="--require-dc-batch" in flags,
-        require_bt_overlap="--require-bt-overlap" in flags)
+        require_bt_overlap="--require-bt-overlap" in flags,
+        require_telemetry="--require-telemetry" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
         return 1
     n_spans = sum(r.get("type") == "span" for r in records)
     n_logs = sum(r.get("type") == "log" for r in records)
+    n_progs = sum(r.get("type") == "program" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
+    ranks = sorted({r["rank"] for r in records if "rank" in r})
+    extra = f", {n_progs} program events" if n_progs else ""
+    extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
-          f"{len(snaps)} metrics snapshots, {n_logs} logs)")
+          f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")
     if "--prom" in flags and snaps:
         sys.stdout.write(prometheus_text(snaps[-1]["metrics"]))
     return 0

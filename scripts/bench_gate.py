@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""CI bench-regression gate over the append-only measurement history.
+
+    python scripts/bench_gate.py --replay                  # CI smoke mode
+    python scripts/bench_gate.py --fresh obs_artifact.jsonl [...]
+
+Compares fresh measurements against a noise-aware baseline derived from
+the git-tracked ``.bench_history.jsonl`` (121+ entries; the trajectory
+BASELINE.md cites). Per key ``(variant, platform, n, nb, workload,
+dtype)``:
+
+* **baseline** = median of the ``--best-k`` (default 3) best historical
+  GFlop/s — median-of-best, so one lucky outlier cannot ratchet the bar
+  and one slow wedge-window entry cannot lower it;
+* **fresh**    = the best GFlop/s among the new measurements for that
+  key (matching bench.py's own best-of-reps protocol);
+* **regression** iff ``fresh < (1 - tolerance) * baseline`` (default
+  tolerance 0.10 — an injected 20 % slowdown must trip the gate, run-
+  to-run noise must not);
+* keys with fewer than ``--min-history`` (default 3) historical entries
+  are **report-only**: a new benchmark arm needs a few rounds of history
+  before it can gate anyone.
+
+Fresh measurements come from ``--fresh`` files — obs JSONL artifacts
+whose ``bench_result`` records carry the measurement payload (bench.py's
+per-variant artifacts), or bare history-style line files. ``--replay``
+instead replays the history's own best entry per key as the fresh
+measurement — the hermetic CI mode: clean history must exit 0, and
+``--inject-slowdown 0.2`` (the synthetic-regression drill ci/run.sh
+smoke runs) must exit 1, proving the gate would catch a real 20 % loss.
+
+The history is schema-validated first (``dlaf_tpu.obs.sinks`` history
+schema — the ``--history`` mode of the validator CLI): a malformed or
+non-finite line fails the gate loudly instead of skewing a baseline.
+
+Exit status: 0 = no regression; 1 = regression (or invalid history /
+no usable fresh measurements); 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlaf_tpu.obs.sinks import (read_history_records, read_records,
+                                validate_history_line)
+
+
+def measurement_key(line: dict) -> tuple:
+    """The baseline key: (variant, platform, n, nb, workload, dtype).
+    The ISSUE-7 5-tuple plus dtype — a float32 arm must never gate a
+    float64 baseline (different flop weights, same label otherwise)."""
+    return (line.get("variant"), line.get("platform"), line.get("n"),
+            line.get("nb"), line.get("workload") or "cholesky",
+            line.get("dtype"))
+
+
+def fmt_key(key: tuple) -> str:
+    variant, platform, n, nb, workload, dtype = key
+    wl = "" if workload == "cholesky" else f" workload={workload}"
+    return f"{variant} [{platform}] n={n} nb={nb} {dtype}{wl}"
+
+
+def load_fresh(paths) -> list:
+    """Measurement lines from ``--fresh`` files: ``bench_result`` records
+    of obs artifacts (payload = the measurement line), or bare
+    history-style lines. Invalid lines are rejected loudly."""
+    fresh = []
+    for path in paths:
+        for r in read_records(path):
+            if not isinstance(r, dict):
+                raise ValueError(f"{path}: non-object record")
+            line = r.get("payload") if r.get("type") == "bench_result" else \
+                (r if "gflops" in r and "type" not in r else None)
+            if line is None:
+                continue        # spans/metrics/logs ride along in artifacts
+            errors = validate_history_line(line)
+            if errors:
+                raise ValueError(f"{path}: invalid fresh measurement: "
+                                 + "; ".join(errors))
+            fresh.append(line)
+    return fresh
+
+
+def baselines(history, best_k: int) -> dict:
+    """{key: (baseline gflops, n_history)} — median of the best_k best."""
+    per_key: dict = {}
+    for line in history:
+        per_key.setdefault(measurement_key(line), []).append(line["gflops"])
+    return {key: (statistics.median(sorted(vals, reverse=True)[:best_k]),
+                  len(vals))
+            for key, vals in per_key.items()}
+
+
+def run_gate(history, fresh, *, tolerance: float, min_history: int,
+             best_k: int, log=print) -> int:
+    """Compare fresh bests against history baselines; returns the number
+    of regressed keys. Keys without fresh measurements are skipped (the
+    gate judges what this run measured, not what it skipped — bench.py's
+    budget/wedge handling legitimately drops arms); keys with thin
+    history are report-only."""
+    base = baselines(history, best_k)
+    fresh_best: dict = {}
+    for line in fresh:
+        key = measurement_key(line)
+        if key not in fresh_best or line["gflops"] > fresh_best[key]:
+            fresh_best[key] = line["gflops"]
+    regressions = 0
+    for key in sorted(fresh_best, key=fmt_key):
+        new = fresh_best[key]
+        if key not in base:
+            log(f"NEW        {fmt_key(key)}: {new:.2f} GF/s "
+                "(no history; report-only)")
+            continue
+        bl, n_hist = base[key]
+        floor = (1.0 - tolerance) * bl
+        if n_hist < min_history:
+            log(f"THIN       {fmt_key(key)}: {new:.2f} vs baseline "
+                f"{bl:.2f} GF/s ({n_hist} < {min_history} entries; "
+                "report-only)")
+            continue
+        if new < floor:
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: {new:.2f} < {floor:.2f} GF/s "
+                f"(baseline {bl:.2f} = median of best {best_k} over "
+                f"{n_hist} entries, tolerance {tolerance:.0%})")
+        else:
+            log(f"OK         {fmt_key(key)}: {new:.2f} >= {floor:.2f} GF/s "
+                f"(baseline {bl:.2f}, {n_hist} entries)")
+    return regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bench-regression gate (see module docstring)")
+    ap.add_argument("--history", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".bench_history.jsonl"))
+    ap.add_argument("--fresh", nargs="*", default=[],
+                    help="obs artifacts (bench_result records) or bare "
+                         "measurement-line files with the fresh numbers")
+    ap.add_argument("--replay", action="store_true",
+                    help="replay the history's own best entry per key as "
+                         "the fresh measurement (hermetic CI mode)")
+    ap.add_argument("--tolerance", type=float, default=0.10)
+    ap.add_argument("--min-history", type=int, default=3)
+    ap.add_argument("--best-k", type=int, default=3)
+    ap.add_argument("--inject-slowdown", type=float, default=0.0,
+                    metavar="F",
+                    help="scale every fresh measurement by (1 - F): the "
+                         "synthetic-regression drill (CI runs F=0.2 and "
+                         "requires a nonzero exit)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    if not args.replay and not args.fresh:
+        print("bench_gate: need --fresh artifacts or --replay",
+              file=sys.stderr)
+        return 2
+    if not 0.0 <= args.tolerance < 1.0 or not 0.0 <= args.inject_slowdown < 1.0:
+        print("bench_gate: tolerance/inject-slowdown must be in [0, 1)",
+              file=sys.stderr)
+        return 2
+
+    try:
+        history = read_history_records(args.history)
+    except (OSError, ValueError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 1
+    if args.replay:
+        best_per_key: dict = {}
+        for line in history:
+            key = measurement_key(line)
+            if key not in best_per_key \
+                    or line["gflops"] > best_per_key[key]["gflops"]:
+                best_per_key[key] = line
+        fresh = list(best_per_key.values())
+        mode = "replay"
+    else:
+        try:
+            fresh = load_fresh(args.fresh)
+        except (OSError, ValueError) as e:
+            print(f"bench_gate: {e}", file=sys.stderr)
+            return 1
+        mode = f"fresh x{len(args.fresh)}"
+    if not fresh:
+        print("bench_gate: no fresh measurements found", file=sys.stderr)
+        return 1
+    if args.inject_slowdown:
+        fresh = [dict(line, gflops=line["gflops"]
+                      * (1.0 - args.inject_slowdown)) for line in fresh]
+        mode += f" +{args.inject_slowdown:.0%} injected slowdown"
+
+    print(f"bench_gate: {mode}, {len(history)} history entries, "
+          f"{len(fresh)} fresh measurements "
+          f"(tolerance {args.tolerance:.0%}, min-history "
+          f"{args.min_history}, best-k {args.best_k})")
+    regressions = run_gate(history, fresh, tolerance=args.tolerance,
+                           min_history=args.min_history,
+                           best_k=args.best_k)
+    if regressions:
+        print(f"bench_gate: {regressions} regressed key(s)",
+              file=sys.stderr)
+        return 1
+    print("bench_gate: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,7 +18,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -86,20 +85,21 @@ def main():
             fn = _build_dist_cholesky(dist, grid.mesh, "L", use_pallas=False,
                                       pallas_interpret=True)
         x = jax.ShapeDtypeStruct((sr, sc, nb, nb), np.float64)
-        t0 = time.perf_counter()
-        lowered = jax.jit(fn).lower(x)
-        t_trace = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        compiled = lowered.compile()
-        t_compile = time.perf_counter() - t0
-        try:
-            size = compiled.memory_analysis().generated_code_size_in_bytes
-        except Exception:
-            size = -1
-        row = {"nt": nt, "mode": args.mode, "trace_s": round(t_trace, 2),
-               "compile_s": round(t_compile, 2), "code_bytes": size}
+        # the timed lower/compile + memory_analysis plumbing is the
+        # library's now (dlaf_tpu.obs.telemetry, ISSUE 7 satellite);
+        # with DLAF_PROGRAM_TELEMETRY=1 each point also lands as a
+        # program record in the DLAF_METRICS_PATH artifact
+        from dlaf_tpu.obs import telemetry
+
+        prog = telemetry.aot_compile(
+            f"compile_scaling.{args.mode}", jax.jit(fn), x)
+        size = int((prog.memory or {}).get("code", -1))
+        row = {"nt": nt, "mode": args.mode,
+               "trace_s": round(prog.trace_s, 2),
+               "compile_s": round(prog.compile_s, 2), "code_bytes": size}
         results.append(row)
-        log(f"nt={nt}: trace {t_trace:.1f}s, compile {t_compile:.1f}s, "
+        log(f"nt={nt}: trace {prog.trace_s:.1f}s, compile "
+            f"{prog.compile_s:.1f}s, "
             f"code {size / 1e6 if size > 0 else -1:.1f} MB")
     print(json.dumps({"platform": "cpu-mesh8", "nb": args.nb,
                       "cache": bool(args.cache), "rows": results}),

@@ -66,12 +66,18 @@ def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
                    dtype: str = "float64", donate: bool = None,
                    workload: str = None):
     """Append one measurement to the git-tracked append-only history log
-    and return the line dict (single schema owner — bench.py prints the
-    returned dict rather than rebuilding it): a later tunnel wedge or
-    container reset must never cost an already-landed hardware number —
-    bench.py's CPU-fallback path surfaces the best recorded TPU entry
-    from this file."""
-    import json
+    and return the line dict (line schema owned by ``dlaf_tpu.obs.sinks``
+    — bench.py prints the returned dict rather than rebuilding it): a
+    later tunnel wedge or container reset must never cost an
+    already-landed hardware number — bench.py's CPU-fallback path
+    surfaces the best recorded TPU entry from this file.
+
+    The line is schema-validated BEFORE it is written
+    (``obs.append_history_line``): a non-finite measurement raises
+    ValueError here, loudly, instead of landing in the log and silently
+    skewing every later replayed-history headline and bench-gate
+    baseline. Disk errors stay non-fatal (the measurement survives on
+    stdout/artifact)."""
     import time as _time
 
     line = {"variant": variant, "platform": platform, "dtype": dtype,
@@ -90,10 +96,11 @@ def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
         # different flop models): labeled so the cholesky headline and
         # its replayed-history lookup never pick them up
         line["workload"] = str(workload)
+    from dlaf_tpu.obs import append_history_line
+
     try:
-        with open(os.path.join(repo_root(), ".bench_history.jsonl"),
-                  "a") as f:
-            f.write(json.dumps(line) + "\n")
+        append_history_line(os.path.join(repo_root(),
+                                         ".bench_history.jsonl"), line)
     except OSError as e:
         log(f"history append failed: {e!r}")
     return line

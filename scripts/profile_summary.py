@@ -51,6 +51,7 @@ def summarize_jsonl(path: str, top_n: int) -> None:
     spans = [r for r in records if r.get("type") == "span"]
     snaps = [r for r in records if r.get("type") == "metrics"]
     logs = [r for r in records if r.get("type") == "log"]
+    progs = [r for r in records if r.get("type") == "program"]
 
     agg = collections.defaultdict(lambda: {"count": 0, "total": 0.0,
                                            "best_gflops": None})
@@ -69,6 +70,41 @@ def summarize_jsonl(path: str, top_n: int) -> None:
               if a["best_gflops"] is not None else "")
         print(f"  {a['total'] * 1e3:10.2f} ms  x{a['count']:<4d} "
               f"mean {a['total'] / a['count'] * 1e3:8.2f} ms  {name}{gf}")
+
+    # per-rank view when the artifact carries rank-stamped records (the
+    # %r per-rank convention, docs/observability.md): the table code is
+    # obs.aggregate's — single owner, not a fork
+    if any("rank" in r for r in spans):
+        from dlaf_tpu.obs.aggregate import format_skew_table, rank_skew_rows
+
+        print("\n== per-rank span skew ==")
+        for line in format_skew_table(rank_skew_rows(records), top_n):
+            print(f"  {line}")
+
+    if progs:
+        print(f"\n== program telemetry ({len(progs)} events) ==")
+        # every site with ANY program event gets a row: the in-body
+        # retrace counters (tridiag.secular_batched etc.) emit retrace
+        # events with no compile record, and hiding them would hide the
+        # very compile-cost tail they exist to surface
+        by_site = collections.defaultdict(lambda: {"n": 0, "compile": 0.0,
+                                                   "peak": None})
+        retraces = collections.Counter(p.get("site", "?") for p in progs
+                                       if p.get("event") == "retrace")
+        for p in progs:
+            a = by_site[p.get("site", "?")]
+            if p.get("event") != "compile":
+                continue
+            a["n"] += 1
+            a["compile"] += p.get("compile_s", 0.0) or 0.0
+            peak = (p.get("hbm") or {}).get("peak")
+            if peak is not None:
+                a["peak"] = max(a["peak"] or 0.0, peak)
+        for site, a in sorted(by_site.items(), key=lambda kv: -kv[1]["compile"]):
+            peak = (f"  peak {a['peak'] / 1024**3:.2f}G"
+                    if a["peak"] is not None else "")
+            print(f"  {a['compile']:8.2f} s compile  x{a['n']:<3d} "
+                  f"traces {retraces.get(site, a['n']):<3d} {site}{peak}")
 
     if snaps:
         print("\n== counters (last snapshot) ==")

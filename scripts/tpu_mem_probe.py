@@ -21,17 +21,17 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def fmt(analysis) -> str:
+#: printed label -> dlaf_tpu.obs.telemetry memory_analysis_dict key (the
+#: stable CLI output shape predates the telemetry API)
+_FIELDS = (("argument", "args"), ("output", "output"), ("temp", "temp"),
+           ("alias", "alias"), ("generated_code", "code"))
+
+
+def fmt(memory: dict) -> str:
     gb = 1024 ** 3
-    fields = ("argument_size_in_bytes", "output_size_in_bytes",
-              "temp_size_in_bytes", "alias_size_in_bytes",
-              "generated_code_size_in_bytes")
-    parts = []
-    for f in fields:
-        v = getattr(analysis, f, None)
-        if v is not None:
-            parts.append(f"{f.replace('_size_in_bytes', '')}={v / gb:.2f}G")
-    return " ".join(parts) or repr(analysis)
+    parts = [f"{label}={memory[key] / gb:.2f}G"
+             for label, key in _FIELDS if key in memory]
+    return " ".join(parts) or repr(memory)
 
 
 def main() -> None:
@@ -61,20 +61,23 @@ def main() -> None:
     spec = jax.ShapeDtypeStruct((n, n), jnp.float64)
     hbm = 15.75  # v5e per-chip budget, GB
 
+    # the library now owns the AOT lower/compile + memory_analysis
+    # plumbing this script used to hand-roll (ISSUE 7 satellite); the
+    # probe rides it — and with DLAF_PROGRAM_TELEMETRY=1 the numbers
+    # also land in the DLAF_METRICS_PATH artifact as program records
+    from dlaf_tpu.obs import telemetry
+
     def probe(name, jitted, *a, **kw):
+        site = "tpu_mem_probe." + name.split()[0]
         try:
-            comp = jitted.lower(*a, **kw).compile()
+            prog = telemetry.aot_compile(site, jitted, *a, **kw)
         except Exception as e:  # report, keep probing the other arms
             print(f"{name}: COMPILE FAILED: {type(e).__name__}: "
                   f"{str(e)[:300]}")
             return
-        m = comp.memory_analysis()
+        mem = prog.memory or {}
         gb = 1024 ** 3
-        tot = sum(getattr(m, f, 0) or 0
-                  for f in ("argument_size_in_bytes", "output_size_in_bytes",
-                            "temp_size_in_bytes"))
-        alias = getattr(m, "alias_size_in_bytes", 0) or 0
-        print(f"{name}: {fmt(m)}  est_live={(tot - alias) / gb:.2f}G "
+        print(f"{name}: {fmt(mem)}  est_live={mem.get('peak', 0) / gb:.2f}G "
               f"(budget {hbm}G)", flush=True)
 
     # the donated jit IS _cholesky_local_scan since the donation lever;

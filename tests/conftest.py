@@ -95,6 +95,8 @@ _QUICK_TESTS = {
     ("test_obs.py", "test_noop_fast_path_when_disabled"),
     ("test_obs.py", "test_jsonl_schema_roundtrip"),
     ("test_obs.py", "test_miniapp_cholesky_metrics_integration"),
+    ("test_telemetry.py", "test_telemetry_call_records_compile_and_retrace"),
+    ("test_telemetry.py", "test_bench_gate_committed_history_replays_clean"),
 }
 
 

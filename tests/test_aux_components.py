@@ -305,13 +305,16 @@ def test_bench_best_recorded_skips_stage_workloads(tmp_path):
 
     bench = _load_bench_module()
     path = tmp_path / "hist.jsonl"
+    # schema-complete lines (the validating history reader — obs.sinks —
+    # rejects anything append_history could not have written)
     lines = [
         {"variant": "tridiag", "platform": "tpu", "dtype": "float64",
-         "n": 2048, "nb": 256, "gflops": 777.0, "workload": "tridiag",
-         "ts": "2026-08-03T00:00:00"},
+         "n": 2048, "nb": 256, "gflops": 777.0, "t": 0.01,
+         "workload": "tridiag", "ts": "2026-08-03T00:00:00",
+         "source": "test"},
         {"variant": "ozaki", "platform": "tpu", "dtype": "float64",
-         "n": 2048, "nb": 256, "gflops": 99.0,
-         "ts": "2026-08-03T00:00:00"},
+         "n": 2048, "nb": 256, "gflops": 99.0, "t": 0.01,
+         "ts": "2026-08-03T00:00:00", "source": "test"},
     ]
     path.write_text("".join(json.dumps(x) + "\n" for x in lines))
     got = bench.best_recorded(platform="tpu", n=2048, nb=256,
@@ -349,11 +352,15 @@ def test_bench_best_recorded_prefix_fallback(tmp_path):
     # own ts), rather than silently falling back to the CPU sidecar
     import json as _json
     bench = _load_bench_module()
+    # schema-complete lines (the validating history reader — obs.sinks —
+    # rejects anything append_history could not have written)
     rows = [
         {"platform": "tpu", "n": 2048, "nb": 256, "dtype": "float64",
-         "gflops": 50.0, "ts": "2026-07-31T03:30:00"},
+         "gflops": 50.0, "t": 0.01, "variant": "ozaki",
+         "ts": "2026-07-31T03:30:00", "source": "test"},
         {"platform": "tpu", "n": 2048, "nb": 256, "dtype": "float64",
-         "gflops": 40.0, "ts": "2026-08-01T09:00:00"},
+         "gflops": 40.0, "t": 0.01, "variant": "ozaki",
+         "ts": "2026-08-01T09:00:00", "source": "test"},
     ]
     hist_file = tmp_path / ".bench_history.jsonl"
     hist_file.write_text("\n".join(_json.dumps(r) for r in rows) + "\n")
@@ -364,7 +371,8 @@ def test_bench_best_recorded_prefix_fallback(tmp_path):
     with hist_file.open("a") as f:
         f.write(_json.dumps(
             {"platform": "tpu", "n": 2048, "nb": 256, "dtype": "float64",
-             "gflops": 45.0, "ts": "2026-08-02T05:00:00"}) + "\n")
+             "gflops": 45.0, "t": 0.01, "variant": "ozaki",
+             "ts": "2026-08-02T05:00:00", "source": "test"}) + "\n")
     got = bench.best_recorded(platform="tpu", n=2048, nb=256,
                               path=str(hist_file))
     assert got is not None and got["gflops"] == 45.0

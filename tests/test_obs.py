@@ -61,6 +61,14 @@ def test_noop_fast_path_when_disabled():
     obs.histogram("z").observe(0.1)
     # the comm instrumentation's gate
     assert not obs.metrics_active()
+    # program telemetry off (the default): instrumented sites pass the
+    # call straight to the SAME jitted callable — bitwise no-op (ISSUE 7
+    # acceptance, pinned alongside the span/counter no-ops above)
+    assert not obs.telemetry.active()
+    sentinel = object()
+    assert obs.telemetry.call("site", lambda x: x, sentinel) is sentinel
+    obs.telemetry.count_retrace("site")       # silent no-op
+    assert obs.telemetry._PROGRAMS == {}
 
 
 def test_collectives_record_is_noop_when_disabled(devices8):
@@ -201,6 +209,54 @@ def test_prometheus_exposition():
     assert 'dlaf_span_seconds_count{span="x"} 1' in text
 
 
+def test_prometheus_histogram_inf_bucket_roundtrip():
+    """The +Inf bucket renders as the literal ``le="+Inf"`` with the
+    cumulative TOTAL count — including out-of-range observations that
+    land in no finite bucket (the Prometheus invariant
+    bucket{le="+Inf"} == count)."""
+    reg = obs.Registry()
+    h = reg.histogram("lat", bounds=(0.1, 1.0))
+    for v in (0.05, 0.5, 100.0, 1e9):       # two past the last bound
+        h.observe(v)
+    text = obs.prometheus_text(reg.snapshot())
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    # min/max survive the JSONL snapshot too
+    s = h.snapshot()
+    assert s["min"] == 0.05 and s["max"] == 1e9
+
+
+def test_prometheus_label_escaping():
+    """Backslash, double-quote, and newline in label values must escape
+    per text exposition 0.0.4 — an unescaped newline would split the
+    sample line and corrupt the whole scrape."""
+    reg = obs.Registry()
+    reg.counter("c", path='a\\b"c', msg="two\nlines").inc()
+    text = obs.prometheus_text(reg.snapshot())
+    assert '\\\\b' in text and '\\"c' in text
+    assert "two\\nlines" in text
+    assert "\ntwo" not in text            # no raw newline inside a value
+    # exactly the TYPE line + one sample line
+    assert len(text.strip().splitlines()) == 2
+
+
+def test_prometheus_deterministic_ordering():
+    """Exposition order is deterministic regardless of registration
+    order: families sorted by (name, kind), series by sorted labels."""
+    reg1, reg2 = obs.Registry(), obs.Registry()
+    for reg, order in ((reg1, ("b", "a")), (reg2, ("a", "b"))):
+        for axis in order:
+            reg.counter("zz_total", axis=axis).inc()
+        reg.gauge("aa_gauge").set(1)
+    t1, t2 = obs.prometheus_text(reg1.snapshot()), \
+        obs.prometheus_text(reg2.snapshot())
+    assert t1 == t2
+    assert t1.index("aa_gauge") < t1.index("zz_total")
+    assert t1.index('axis="a"') < t1.index('axis="b"')
+
+
 # ---------------------------------------------------------------------------
 # JSONL schema round-trip + validation
 # ---------------------------------------------------------------------------
@@ -318,6 +374,127 @@ def test_validate_cli(tmp_path, capsys):
     assert main([path, "--require-collectives"]) == 1
     assert main(["--nonsense", path]) == 2
     capsys.readouterr()
+
+
+def test_validate_cli_exit_codes(tmp_path, capsys):
+    """The pinned CLI contract (ISSUE 7 satellite): 2 on unknown flag or
+    no/multiple paths; 1 on an empty artifact under ANY --require-*."""
+    from dlaf_tpu.obs.validate import main
+
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert main([]) == 2                               # no path
+    assert main([empty, empty]) == 2                   # two paths
+    assert main([empty, "--require-thing"]) == 2       # unknown flag
+    assert main([empty, "--history", "--require-spans"]) == 2  # exclusive
+    assert main([empty]) == 0                          # empty, no require
+    for flag in ("--require-spans", "--require-gflops",
+                 "--require-collectives", "--require-retries",
+                 "--require-fallbacks", "--require-comm-overlap",
+                 "--require-dc-batch", "--require-bt-overlap",
+                 "--require-telemetry"):
+        assert main([empty, flag]) == 1, flag
+    capsys.readouterr()
+
+
+def test_validator_rank_field(tmp_path):
+    """Optional ``rank`` must be a non-negative int when present."""
+    path = str(tmp_path / "rank.jsonl")
+    sink = obs.JsonlSink(path)
+    sink.write({"type": "span", "name": "x", "dur_s": 0.1, "depth": 0,
+                "parent": None, "attrs": {}, "rank": 3})
+    sink.write({"type": "span", "name": "y", "dur_s": 0.1, "depth": 0,
+                "parent": None, "attrs": {}, "rank": -1})
+    sink.write({"type": "span", "name": "z", "dur_s": 0.1, "depth": 0,
+                "parent": None, "attrs": {}, "rank": "r0"})
+    sink.close()
+    errs = obs.validate_file(path)
+    assert len(errs) == 2 and all("rank" in e for e in errs)
+
+
+def test_validator_program_records(tmp_path):
+    """The telemetry record type: compile events need a finite
+    compile_s; hbm values must all be finite."""
+    path = str(tmp_path / "prog.jsonl")
+    sink = obs.JsonlSink(path)
+    sink.write({"type": "program", "site": "cholesky.dist",
+                "event": "compile", "compile_s": 0.5, "trace_s": 0.1,
+                "hbm": {"args": 1.0, "peak": 2.0}, "attrs": {}})
+    sink.write({"type": "program", "site": "cholesky.dist",
+                "event": "retrace", "attrs": {}})
+    sink.close()
+    assert obs.validate_file(path) == []
+
+    bad = str(tmp_path / "prog_bad.jsonl")
+    sink = obs.JsonlSink(bad)
+    sink.write({"type": "program", "event": "compile",
+                "compile_s": 0.5, "attrs": {}})              # no site
+    sink.write({"type": "program", "site": "s", "event": "compile",
+                "compile_s": float("nan"), "attrs": {}})     # NaN wall
+    sink.write({"type": "program", "site": "s", "event": "compile",
+                "compile_s": 0.1, "hbm": {"peak": float("inf")},
+                "attrs": {}})                                # inf HBM
+    sink.write({"type": "program", "site": "s", "event": "link",
+                "attrs": {}})                                # bad event
+    sink.write({"type": "program", "site": "s", "event": "retrace",
+                "compile_s": float("nan"), "attrs": {}})     # NaN anywhere
+    sink.close()
+    errs = obs.validate_file(bad)
+    assert len(errs) == 5
+    assert any("without a site" in e for e in errs)
+    assert any("compile_s" in e for e in errs)
+    assert any("hbm['peak']" in e for e in errs)
+    assert any("compile|retrace" in e for e in errs)
+
+
+def test_validator_require_telemetry(tmp_path):
+    """--require-telemetry: compile observation + HBM accounting +
+    retrace evidence must ALL be present; each missing leg fails
+    independently, and each leg accepts either the metrics-snapshot or
+    the program-record form."""
+    path = str(tmp_path / "tele.jsonl")
+    sink = obs.JsonlSink(path)
+    sink.write({"type": "program", "site": "s", "event": "compile",
+                "compile_s": 0.2, "attrs": {}})
+    sink.write({"type": "metrics", "metrics": [
+        {"name": "dlaf_hbm_bytes", "kind": "gauge",
+         "labels": {"what": "peak", "site": "s"}, "value": 1024.0},
+        {"name": "dlaf_retrace_total", "kind": "counter",
+         "labels": {"site": "s"}, "value": 1.0}]})
+    sink.close()
+    assert obs.validate_file(path, require_telemetry=True) == []
+
+    # program records ALONE satisfy all three legs: a run killed before
+    # the final metrics snapshot still validates on its record trail
+    recs_only = str(tmp_path / "tele_recs.jsonl")
+    sink = obs.JsonlSink(recs_only)
+    sink.write({"type": "program", "site": "s", "event": "retrace",
+                "attrs": {}})
+    sink.write({"type": "program", "site": "s", "event": "compile",
+                "compile_s": 0.2, "hbm": {"peak": 1024.0}, "attrs": {}})
+    sink.close()
+    assert obs.validate_file(recs_only, require_telemetry=True) == []
+
+    partial = str(tmp_path / "tele_partial.jsonl")
+    sink = obs.JsonlSink(partial)
+    sink.write({"type": "log", "level": "info", "logger": "t", "msg": "m",
+                "fields": {}})
+    sink.close()
+    errs = obs.validate_file(partial, require_telemetry=True)
+    assert len(errs) == 3
+    assert any("compile-seconds" in e for e in errs)
+    assert any("HBM accounting" in e for e in errs)
+    assert any("retrace evidence" in e for e in errs)
+    # one leg present, two missing: fails on exactly the missing two
+    compile_only = str(tmp_path / "tele_compile_only.jsonl")
+    sink = obs.JsonlSink(compile_only)
+    sink.write({"type": "program", "site": "s", "event": "compile",
+                "compile_s": 0.2, "attrs": {}})
+    sink.close()
+    errs = obs.validate_file(compile_only, require_telemetry=True)
+    assert len(errs) == 2
+    assert any("HBM accounting" in e for e in errs)
+    assert any("retrace evidence" in e for e in errs)
 
 
 # ---------------------------------------------------------------------------
