@@ -179,7 +179,7 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
     # the stage arms must never drift from the other history entries
     from measure_common import append_history, best_time
 
-    best_t = best_time(measure, reps=3)
+    best_t, last = best_time(measure, reps=3, return_last=True)
     best_g = flops / best_t / 1e9
     log(f"[{variant}] best of 3: {best_t:.4f}s {best_g:.1f} GFlop/s")
 
@@ -187,7 +187,16 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
                           source="bench.py", variant=variant,
                           dtype="float64", workload=base)
     from dlaf_tpu import obs
+    from dlaf_tpu.obs import accuracy
 
+    if base == "tridiag" and accuracy.enabled():
+        # paired perf+accuracy record (DLAF_ACCURACY, docs/accuracy.md):
+        # the D&C eigenvector block's orthogonality defect is the cheap
+        # invariant this arm can check without a reference decomposition
+        accuracy.emit("bench", "tridiag_orthogonality",
+                      accuracy.array_orthogonality(last), n=n, nb=nb,
+                      c=200.0, dtype=np.float64, of=last,
+                      attrs={"variant": variant})
     obs.emit_event("bench_result", payload=line)
     obs.flush()
     print(json.dumps(line), flush=True)
@@ -305,6 +314,17 @@ def run_variant() -> None:
     line = append_history(platform, n, nb, best_g, best_t, source="bench.py",
                           variant=variant, dtype=np.dtype(dtype).name,
                           donate=True)
+    from dlaf_tpu.obs import accuracy
+
+    if accuracy.enabled():
+        # paired perf+accuracy record for the A/B arm (DLAF_ACCURACY,
+        # docs/accuracy.md): probe the LAST timed factor against the
+        # retained reference — a bad Ozaki peel or a wrong lookahead mask
+        # shows up here as a bound_ratio jump next to its GFlop/s number
+        value = accuracy.cholesky_residual("L", ref, out)
+        accuracy.emit("bench", "cholesky_residual", value, n=n, nb=nb,
+                      c=60.0, dtype=dtype, of=out.storage,
+                      attrs={"variant": variant})
     # primary result channel: the obs JSONL artifact (the parent points
     # DLAF_METRICS_PATH at a per-variant file and reads the bench_result
     # record back — structured, alongside this child's spans/counters —
@@ -503,6 +523,9 @@ def sweep(platform: str) -> None:
             continue
         env = dict(os.environ)
         env["DLAF_BENCH_VARIANT"] = variant
+        # every arm's artifact carries a paired accuracy record next to
+        # its bench_result (docs/accuracy.md); explicit env still wins
+        env.setdefault("DLAF_ACCURACY", "1")
         art = os.path.join(art_dir, f"{variant}.jsonl")
         # the sink appends: drop any artifact from a previous sweep in a
         # reused DLAF_BENCH_OBS_DIR so a child that dies before emitting

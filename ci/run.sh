@@ -38,10 +38,15 @@ case "$TIER" in
     # telemetry (ISSUE 7): compile walls, retrace counters, and HBM
     # gauges must land in the artifact; obs.aggregate merges the
     # per-rank files into one timeline and exports a Chrome trace
+    # accuracy telemetry rides the same run (DLAF_ACCURACY=1,
+    # docs/accuracy.md): every timed run probes its factor in-graph and
+    # the merged artifact must carry the accuracy records
+    # (--require-accuracy) that scripts/accuracy_gate.py gates below
     OBS_DIR=$(mktemp -d)
     OBS_ART="$OBS_DIR/miniapp_cholesky.r%r.jsonl"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
       DLAF_METRICS_PATH="$OBS_ART" DLAF_PROGRAM_TELEMETRY=1 \
+      DLAF_ACCURACY=1 \
       DLAF_CHOLESKY_LOOKAHEAD=1 DLAF_COMM_LOOKAHEAD=1 \
       python -m dlaf_tpu.miniapp.miniapp_cholesky -m 256 -b 64 \
         --grid-rows 2 --grid-cols 2 --nruns 2
@@ -49,7 +54,7 @@ case "$TIER" in
       -o "$OBS_DIR/merged.jsonl" --chrome "$OBS_DIR/trace.json"
     python -m dlaf_tpu.obs.validate "$OBS_DIR/merged.jsonl" \
       --require-spans --require-gflops --require-collectives \
-      --require-comm-overlap --require-telemetry
+      --require-comm-overlap --require-telemetry --require-accuracy
     # the Chrome export must be valid trace-event JSON with spans from
     # EVERY rank that produced an artifact
     python - "$OBS_DIR" <<'EOF'
@@ -79,6 +84,28 @@ EOF
       echo "bench_gate FAILED to flag a 20% injected slowdown" >&2; exit 1
     fi
     echo "bench_gate correctly flagged the injected slowdown"
+    echo "== smoke: accuracy gate (fresh artifact + corruption drill) =="
+    # the fresh accuracy records of the run above must pass BOTH gate
+    # legs (analytic c*n*eps budget + drift vs the committed
+    # .accuracy_history.jsonl), the history must validate standalone,
+    # and the corrupt-collective drill — a REAL injected fault through
+    # health.inject, not a synthetic number — must trip the gate
+    python -m dlaf_tpu.obs.validate --accuracy-history .accuracy_history.jsonl
+    python scripts/accuracy_gate.py --replay
+    python scripts/accuracy_gate.py --fresh "$OBS_DIR/merged.jsonl"
+    # require SPECIFICALLY exit 1 + a REGRESSION verdict: a crash in the
+    # inject path (any other nonzero exit) must not masquerade as the
+    # corruption-detection proof
+    drill_rc=0
+    python scripts/accuracy_gate.py --inject corrupt_collective \
+      > "$OBS_DIR/accuracy_drill.log" 2>&1 || drill_rc=$?
+    if [ "$drill_rc" -ne 1 ] \
+        || ! grep -q "regressed key(s)" "$OBS_DIR/accuracy_drill.log"; then
+      echo "accuracy_gate injection drill did not trip cleanly" \
+           "(rc=$drill_rc)" >&2
+      cat "$OBS_DIR/accuracy_drill.log" >&2; exit 1
+    fi
+    echo "accuracy_gate correctly flagged the injected corruption"
     echo "== smoke: fault-injection / graceful-degradation artifact =="
     # drive the robustness layer end-to-end (docs/robustness.md): a tiny
     # non-SPD robust_cholesky must recover through shift-retry (leaving

@@ -389,6 +389,23 @@ class Configuration:
     #: silently taking the degraded path. The CI/bring-up stance where a
     #: missing native library must fail the job, not slow it 100x.
     strict: bool = False
+    #: Accuracy telemetry (``DLAF_ACCURACY``, docs/accuracy.md): "1" arms
+    #: the in-graph numerical-quality probes (dlaf_tpu.obs.accuracy) —
+    #: miniapps and bench arms compute a stochastic Hutchinson residual
+    #: estimate per timed run (O(n^2) device work, no full-matrix host
+    #: fetch) and the D&C eigensolver records its per-level deflation
+    #: fraction, each landing as an ``accuracy`` JSONL record (site,
+    #: metric, value, bound_ratio = value/(c*n*eps_eff) with the
+    #: platform-honest eps of miniapp/checks.effective_eps) plus a
+    #: ``dlaf_accuracy_ratio{site,metric}`` gauge. "full" upgrades the
+    #: probes to the exact tile-wise Frobenius residual (O(n^3) device
+    #: work, still no host round trip). "0" (default) emits nothing and
+    #: is a bitwise passthrough: factor outputs are identical with the
+    #: knob on or off (the probes are separate programs over the outputs;
+    #: pinned by tests/test_accuracy.py). ``--check-result`` always
+    #: verifies regardless of the knob — the knob only picks the
+    #: estimator mode ("0" checks with the "1" probe).
+    accuracy: str = "0"
     #: Program telemetry (``DLAF_PROGRAM_TELEMETRY``): the algorithm entry
     #: points and the library's cached-program sites record per-site
     #: compile walls (``dlaf_compile_seconds{site}``), trace counts
@@ -466,6 +483,7 @@ _VALID_CHOICES = {
     "hegst_impl": ("blocked", "twosolve", "auto"),
     "bcast_impl": ("psum", "tree"),
     "log": ("debug", "info", "warning", "error", "off"),
+    "accuracy": ("0", "1", "full"),
 }
 
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import Optional
 
 import numpy as np
 
@@ -457,6 +458,22 @@ def _count_merges(mode: str, n: int = 1) -> None:
         obs.counter("dlaf_dc_merges_total", mode=mode).inc(n)
 
 
+#: Per-level deflation accounting (DLAF_ACCURACY, docs/accuracy.md):
+#: while a list is installed here, :func:`_merge_ctl_pre` appends one
+#: ``(merge size n, deflated count)`` pair per merge — the heavily
+#: data-dependent quantity arXiv:2112.09017's D&C throughput hinges on.
+#: Scoped per tree level by :func:`_tridiag_dc` (the only writer of this
+#: global; the solver is not re-entrant) and emitted as
+#: ``accuracy`` records ``site=tridiag_solver,
+#: metric=dc_deflation_fraction`` with the level in the attrs.
+_DEFLATION_SINK: Optional[list] = None
+
+
+def _log_deflation(n: int, deflated: int) -> None:
+    if _DEFLATION_SINK is not None:
+        _DEFLATION_SINK.append((n, deflated))
+
+
 @dataclasses.dataclass
 class _MergeCtl:
     """Host control state of one Cuppen merge, split in two phases so the
@@ -520,6 +537,7 @@ def _merge_ctl_pre(lam1, lam2, z, rho_signed, use_device: bool,
         ctl.decoupled = True
         ctl.lam = lam[fin]
         ctl.fin = fin
+        _log_deflation(ctl.n, ctl.n)    # every pole is an eigenvalue
         return ctl
     zn = z / np.sqrt(znorm2)
     ctl.rho_n = rho_n = rho * znorm2
@@ -539,6 +557,7 @@ def _merge_ctl_pre(lam1, lam2, z, rho_signed, use_device: bool,
     ctl.idx_defl = np.nonzero(~live)[0]
     k = ctl.k = ctl.idx_live.shape[0]
     ctl.kb = 1 << max(0, (k - 1).bit_length())
+    _log_deflation(ctl.n, ctl.n - k)
     if k == 0:
         return ctl
     ctl.dsk = dsk = ds[ctl.idx_live]
@@ -902,7 +921,17 @@ def _tridiag_dc(d, e, nb: int, use_device: bool, mesh, level_batch: bool):
     ``level_batch`` (and ``use_device``) same-shape merges of one level
     run as single vmapped dispatches; otherwise each merge runs the
     serialized :func:`_merge` — same per-merge math in either walk (the
-    merges of a level are independent, so order cannot change results)."""
+    merges of a level are independent, so order cannot change results).
+
+    Under ``DLAF_ACCURACY`` != "0" each level additionally emits one
+    ``accuracy`` record with its deflation fraction (deflated poles /
+    merged poles — the data-dependent work reduction every D&C
+    throughput number implicitly depends on; docs/accuracy.md)."""
+    global _DEFLATION_SINK
+    from ..obs import accuracy
+
+    collect = accuracy.enabled()
+    n_total = d.shape[0]
     d_adj, leaves, levels, root = _merge_schedule(d, e, nb)
     res = {}
     for leaf in leaves:
@@ -910,7 +939,21 @@ def _tridiag_dc(d, e, nb: int, use_device: bool, mesh, level_batch: bool):
                        e[leaf.off: leaf.off + leaf.n - 1])
         res[leaf] = (lam, jnp.asarray(q) if use_device else q)
     for h in sorted(levels):
-        _run_level(levels[h], res, use_device, mesh, level_batch)
+        if collect:
+            _DEFLATION_SINK = sink = []
+        try:
+            _run_level(levels[h], res, use_device, mesh, level_batch)
+        finally:
+            _DEFLATION_SINK = None
+        if collect and sink:
+            merged = sum(m for m, _ in sink)
+            deflated = sum(k for _, k in sink)
+            accuracy.emit(
+                "tridiag_solver", "dc_deflation_fraction",
+                deflated / merged if merged else 0.0, n=n_total, nb=nb,
+                c=None, dtype=np.float64,
+                attrs={"level": h, "merges": len(sink),
+                       "merged_poles": merged, "deflated_poles": deflated})
     return res[root]
 
 

@@ -17,7 +17,6 @@ import time
 
 import numpy as np
 
-from . import checks
 from .. import config
 from ..eigensolver.band_to_tridiag import band_to_tridiag
 from ..types import total_ops, type_letter
@@ -71,6 +70,8 @@ def run(argv=None) -> list[dict]:
 def check(band, b, res, n) -> None:
     import scipy.linalg as sla
 
+    from ..obs import accuracy
+
     a = np.zeros((n, n), dtype=band.dtype)
     for r in range(b + 1):
         d = band[r, : n - r]
@@ -80,11 +81,15 @@ def check(band, b, res, n) -> None:
     w_ref = np.linalg.eigvalsh(a)
     w_tri = sla.eigvalsh_tridiagonal(res.d, res.e)
     resid = np.abs(w_ref - w_tri).max() / max(np.abs(w_ref).max(), 1e-30)
-    eps, eps_label = checks.effective_eps(np.float64, of=res.d)
-    tol = 100 * n * eps
-    status = "PASSED" if resid < tol else "FAILED"
-    print(f"check: {status} residual={resid:.3e} tol={tol:.3e}{eps_label}", flush=True)
-    if resid >= tol:
+    # host-computed by construction (the check compares eigenvalue sets,
+    # not a matrix residual) — still recorded through the shared
+    # accuracy emitter so the artifact carries this family's quality too
+    rec = accuracy.emit("miniapp_band_to_tridiag", "eigenvalue_drift",
+                        resid, n=n, nb=b, c=100.0, dtype=np.float64,
+                        of=res.d, attrs={"check": True})
+    status = "PASSED" if rec.passed else "FAILED"
+    print(f"check: {status} residual={resid:.3e} tol={rec.tol:.3e}{rec.eps_label}", flush=True)
+    if not rec.passed:
         sys.exit(1)
 
 

@@ -31,6 +31,7 @@ import os
 import time
 from typing import Optional
 
+from . import accuracy as accuracy
 from . import logging as _logging
 from . import metrics as _metrics
 from . import sinks as _sinks
@@ -40,7 +41,8 @@ from ._state import LOG_LEVELS, STATE, current_rank
 from .logging import Logger, get_logger
 from .metrics import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
                       Gauge, Histogram, Registry, prometheus_text)
-from .sinks import (SCHEMA_VERSION, JsonlSink, append_history_line,
+from .sinks import (SCHEMA_VERSION, JsonlSink,
+                    accuracy_record_to_history_line, append_history_line,
                     expand_rank_template, read_history_records, read_records,
                     validate_file, validate_history_records, validate_records)
 from .trace import (NOOP_CTX, NOOP_SPAN, Span, current_span, entry_span,
@@ -58,6 +60,7 @@ __all__ = [
     "LOG_LEVELS", "start_profiler", "stop_profiler", "telemetry",
     "set_rank", "current_rank", "expand_rank_template",
     "append_history_line", "read_history_records", "validate_history_records",
+    "accuracy", "accuracy_record_to_history_line",
 ]
 
 

@@ -19,7 +19,11 @@ reports what single-rank summaries cannot see:
   run wall, the cross-rank aligned fraction (how much of the name's wall
   coincides on all ranks), and the ``*_lookahead`` knob attrs the entry
   spans carried — the measured counterpart of the structural jaxpr pins
-  (docs/lookahead.md, docs/comm_overlap.md).
+  (docs/lookahead.md, docs/comm_overlap.md);
+* **accuracy** — per (site, metric): each rank's record count and worst
+  ``bound_ratio`` from the merged ``accuracy`` records (the DLAF_ACCURACY
+  trail, docs/accuracy.md), nonfinite estimates flagged loudly — a
+  corrupted rank tops the table.
 
 ``--chrome`` exports the merged spans as Chrome/Perfetto trace events
 (``pid`` = rank, host spans nested by time on one track, ``program``
@@ -163,6 +167,67 @@ def format_skew_table(rows, top_n: int = 25) -> list:
                          if c else f"{'-':>12s}      ")
         lines.append(f"{row['name'][:32]:<32s} " + "  ".join(cells)
                      + f"  {row['skew_s'] * 1e3:9.2f}")
+    return lines
+
+
+def accuracy_rows(records) -> list:
+    """Per (site, metric): per-rank record count, worst (max) finite
+    ``bound_ratio``, worst value, and nonfinite count from the merged
+    ``accuracy`` records (docs/accuracy.md) — nonfinite-first, then by
+    worst ratio, so a corrupted rank tops the table."""
+    per: dict = {}
+    for r in records:
+        if r.get("type") != "accuracy":
+            continue
+        cell = per.setdefault((r.get("site", "?"), r.get("metric", "?")), {}) \
+            .setdefault(r.get("rank", 0),
+                        {"count": 0, "worst_ratio": None, "worst_value": None,
+                         "nonfinite": 0})
+        cell["count"] += 1
+        if r.get("nonfinite"):
+            cell["nonfinite"] += 1
+        for key, field in (("bound_ratio", "worst_ratio"),
+                           ("value", "worst_value")):
+            v = r.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and (cell[field] is None or v > cell[field]):
+                cell[field] = v
+    rows = []
+    for (site, metric), per_rank in per.items():
+        rows.append({
+            "site": site, "metric": metric, "per_rank": per_rank,
+            "nonfinite": sum(c["nonfinite"] for c in per_rank.values()),
+            "worst_ratio": max((c["worst_ratio"] for c in per_rank.values()
+                                if c["worst_ratio"] is not None),
+                               default=None)})
+    rows.sort(key=lambda row: (-row["nonfinite"],
+                               -(row["worst_ratio"] or -1.0)))
+    return rows
+
+
+def format_accuracy_table(rows, top_n: int = 25) -> list:
+    """Printable lines for the accuracy table (shared with
+    ``scripts/profile_summary.py`` — single owner, not a fork)."""
+    lines = []
+    for row in rows[:top_n]:
+        cells = []
+        for rank, c in sorted(row["per_rank"].items()):
+            if c["nonfinite"]:
+                shown = "NONFINITE"
+            elif c["worst_ratio"] is not None:
+                shown = "%.3g" % c["worst_ratio"]
+            elif c["worst_value"] is not None:
+                # informational metric (no budget): show the raw value
+                shown = "%.3g*" % c["worst_value"]
+            else:
+                shown = "-"
+            cells.append("r%s=%s x%d" % (rank, shown, c["count"]))
+        worst = "-" if row["worst_ratio"] is None \
+            else "%.3g" % row["worst_ratio"]
+        flag = "  !! NONFINITE" if row["nonfinite"] else ""
+        lines.append("%s/%s: worst bound_ratio %s  [%s]%s"
+                     % (row["site"], row["metric"], worst,
+                        " ".join(cells), flag))
     return lines
 
 
@@ -390,6 +455,13 @@ def main(argv=None) -> int:
     if rows:
         print("\n== per-rank span skew ==")
         for line in format_skew_table(rows, top_n):
+            print(f"  {line}")
+
+    acc = accuracy_rows(view)
+    if acc:
+        print("\n== accuracy (worst bound_ratio per rank; docs/accuracy.md)"
+              " ==")
+        for line in format_accuracy_table(acc, top_n):
             print(f"  {line}")
 
     imb = collective_imbalance(view)

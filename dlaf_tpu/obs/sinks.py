@@ -35,6 +35,17 @@ Schema (version 1). Every record carries ``v`` (int schema version),
     optional ``trace_s``), optional ``hbm`` object of finite byte gauges
     (``args``/``output``/``temp``/``peak`` from
     ``compiled.memory_analysis()``), ``attrs`` object.
+``accuracy``
+    Numerical-quality record (:mod:`dlaf_tpu.obs.accuracy`, the
+    ``DLAF_ACCURACY`` knob; docs/accuracy.md): ``site`` str, ``metric``
+    str, ``platform`` str, ``n``/``nb`` non-negative ints, ``dtype``
+    str, ``attrs`` object; ``value`` finite >= 0 — or null with
+    ``nonfinite: true``, the corruption signal the accuracy gate treats
+    as an automatic regression. Budgeted metrics additionally carry
+    finite ``bound_ratio = value / (c * n * eps_eff)`` >= 0 plus the
+    ``c``/``eps_eff`` they were normalized with (informational metrics,
+    e.g. the D&C deflation fraction, omit all three); a record may not
+    carry both ``bound_ratio`` and ``nonfinite``.
 
 Every record additionally carries an optional ``rank`` (int >= 0,
 ``jax.process_index()``) — stamped by the sink once the rank is known, so
@@ -62,7 +73,8 @@ from typing import Optional
 
 SCHEMA_VERSION = 1
 
-KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program")
+KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
+               "accuracy")
 
 
 def expand_rank_template(path: str) -> str:
@@ -201,6 +213,36 @@ def _validate_program(r: dict, where: str, errors: list) -> None:
         errors.append(f"{where}: program attrs must be an object")
 
 
+def _validate_accuracy(r: dict, where: str, errors: list) -> None:
+    for key in ("site", "metric", "platform", "dtype"):
+        if not isinstance(r.get(key), str) or not r.get(key):
+            errors.append(f"{where}: accuracy record without a {key}")
+    for key in ("n", "nb"):
+        if not isinstance(r.get(key), int) or isinstance(r.get(key), bool) \
+                or r.get(key, -1) < 0:
+            errors.append(f"{where}: accuracy {key} must be a non-negative "
+                          "int")
+    value = r.get("value")
+    if r.get("nonfinite") is True:
+        if value is not None:
+            errors.append(f"{where}: nonfinite accuracy record must carry "
+                          "value null")
+        if "bound_ratio" in r:
+            # a NaN estimate has no meaningful budget ratio; carrying one
+            # would let a corrupted run scrape as a (finite) number
+            errors.append(f"{where}: nonfinite accuracy record must not "
+                          "carry bound_ratio")
+    elif not _finite(value) or value < 0:
+        errors.append(f"{where}: accuracy value missing/non-finite/negative "
+                      "(use value null + nonfinite true for corrupted "
+                      "estimates)")
+    for key in ("bound_ratio", "c", "eps_eff"):
+        if key in r and (not _finite(r[key]) or r[key] < 0):
+            errors.append(f"{where}: accuracy {key} non-finite/negative")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: accuracy attrs must be an object")
+
+
 def _validate_metrics(r: dict, where: str, errors: list) -> None:
     entries = r.get("metrics")
     if not isinstance(entries, list):
@@ -227,7 +269,7 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_collectives=False, require_retries=False,
                      require_fallbacks=False, require_comm_overlap=False,
                      require_dc_batch=False, require_bt_overlap=False,
-                     require_telemetry=False) -> list:
+                     require_telemetry=False, require_accuracy=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -250,10 +292,14 @@ def validate_records(records, require_spans=False, require_gflops=False,
     by EITHER a metrics snapshot (``dlaf_compile_seconds`` histogram /
     ``dlaf_hbm_bytes`` gauge / ``dlaf_retrace_total`` counter) or the
     per-event ``program`` records, so a run killed before the final
-    snapshot landed still validates on its record trail."""
+    snapshot landed still validates on its record trail — and
+    (``require_accuracy``) at least one ``accuracy`` record with a finite
+    value AND a finite ``bound_ratio`` (the DLAF_ACCURACY audit trail,
+    docs/accuracy.md: an informational-only or all-nonfinite artifact
+    must not satisfy the accuracy obligation)."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
-    n_dc_batched = n_bt_overlap = 0
+    n_dc_batched = n_bt_overlap = n_accuracy = 0
     n_compile_obs = n_hbm = n_retrace = 0
     overlap_axes, byte_axes = set(), set()
     for i, r in enumerate(records):
@@ -288,6 +334,10 @@ def validate_records(records, require_spans=False, require_gflops=False,
             if isinstance(hbm, dict) and hbm \
                     and all(_finite(v) for v in hbm.values()):
                 n_hbm += 1
+        elif rtype == "accuracy":
+            _validate_accuracy(r, where, errors)
+            if _finite(r.get("value")) and _finite(r.get("bound_ratio")):
+                n_accuracy += 1
         elif rtype == "span":
             _validate_span(r, where, errors)
             n_spans += 1
@@ -369,6 +419,9 @@ def validate_records(records, require_spans=False, require_gflops=False,
             errors.append("artifact contains no retrace evidence "
                           "(dlaf_retrace_total counter >= 1 or program "
                           "retrace record)")
+    if require_accuracy and n_accuracy == 0:
+        errors.append("artifact contains no accuracy record with finite "
+                      "value and bound_ratio")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "
@@ -406,66 +459,99 @@ def validate_file(path: str, **require) -> list:
 
 
 # ---------------------------------------------------------------------------
-# Bench-history line schema (.bench_history.jsonl)
+# History line schemas (.bench_history.jsonl / .accuracy_history.jsonl)
 # ---------------------------------------------------------------------------
-# Bare measurement lines (no v/type/ts envelope — the file predates the
-# obs schema and BASELINE.md cites it verbatim), but schema-owned HERE so
-# bench.py's replayed-history headline lookup and scripts/bench_gate.py's
-# baselines never silently ingest a malformed or non-finite entry.
+# Bare measurement lines (no v/type/ts envelope — the bench file predates
+# the obs schema and BASELINE.md cites it verbatim), but schema-owned
+# HERE — ONE validating reader parameterized by ``kind`` — so bench.py's
+# replayed-history headline lookup, scripts/bench_gate.py, and
+# scripts/accuracy_gate.py all read through the same code path and never
+# silently ingest a malformed or non-finite entry (ISSUE 8 satellite: no
+# second bespoke history parser).
 
-#: (field, required, finiteness) — numeric fields must be finite; string
-#: fields must be non-empty strings.
-HISTORY_NUMERIC_FIELDS = ("gflops", "t", "n", "nb")
-HISTORY_STRING_FIELDS = ("variant", "platform", "dtype", "ts", "source")
+#: ``kind`` -> (numeric fields, string fields): numeric fields must be
+#: finite; string fields must be non-empty strings.
+HISTORY_KINDS = {
+    "bench": (("gflops", "t", "n", "nb"),
+              ("variant", "platform", "dtype", "ts", "source")),
+    "accuracy": (("value", "bound_ratio", "n", "nb"),
+                 ("site", "metric", "platform", "dtype", "ts", "source")),
+}
+
+#: Backward-compatible aliases for the original bench-only schema names.
+HISTORY_NUMERIC_FIELDS, HISTORY_STRING_FIELDS = HISTORY_KINDS["bench"]
 
 
-def validate_history_line(line: dict) -> list:
+def validate_history_line(line: dict, kind: str = "bench") -> list:
     """Error strings for ONE history measurement line (empty = valid)."""
     errors = []
     if not isinstance(line, dict):
-        return ["history line is not an object"]
-    for key in HISTORY_NUMERIC_FIELDS:
+        return [f"{kind} history line is not an object"]
+    numeric, strings = HISTORY_KINDS[kind]
+    for key in numeric:
         if not _finite(line.get(key)):
-            errors.append(f"history field {key!r} missing/non-finite "
+            errors.append(f"{kind} history field {key!r} missing/non-finite "
                           f"(got {line.get(key)!r})")
-    for key in HISTORY_STRING_FIELDS:
+    for key in strings:
         if not isinstance(line.get(key), str) or not line.get(key):
-            errors.append(f"history field {key!r} missing/empty")
+            errors.append(f"{kind} history field {key!r} missing/empty")
     return errors
 
 
-def validate_history_records(records) -> list:
+def validate_history_records(records, kind: str = "bench") -> list:
     errors = []
     for i, line in enumerate(records):
-        for e in validate_history_line(line):
+        for e in validate_history_line(line, kind):
             errors.append(f"entry {i}: {e}")
     return errors
 
 
-def read_history_records(path: str) -> list:
-    """Parse + validate the append-only bench history; raises ValueError
-    on an unparsable or schema-invalid line (loud by contract: a bad line
-    would otherwise skew every replayed-history headline and every
-    bench-gate baseline derived from the file)."""
+def read_history_records(path: str, kind: str = "bench") -> list:
+    """Parse + validate an append-only measurement history; raises
+    ValueError on an unparsable or schema-invalid line (loud by contract:
+    a bad line would otherwise skew every replayed-history headline and
+    every gate baseline derived from the file)."""
     records = read_records(path)
-    errors = validate_history_records(records)
+    errors = validate_history_records(records, kind)
     if errors:
-        raise ValueError(f"{path}: invalid bench history: "
+        raise ValueError(f"{path}: invalid {kind} history: "
                          + "; ".join(errors[:5])
                          + (f" (+{len(errors) - 5} more)"
                             if len(errors) > 5 else ""))
     return records
 
 
-def append_history_line(path: str, line: dict) -> dict:
-    """Validate + append one measurement line to the history log (the
-    single write path — scripts/measure_common.append_history routes
-    through here). Raises ValueError instead of writing a line the
-    readers would have to reject."""
-    errors = validate_history_line(line)
+def append_history_line(path: str, line: dict, kind: str = "bench") -> dict:
+    """Validate + append one measurement line to a history log (the
+    single write path — scripts/measure_common routes through here).
+    Raises ValueError instead of writing a line the readers would have
+    to reject."""
+    errors = validate_history_line(line, kind)
     if errors:
-        raise ValueError("refusing to append invalid bench history line: "
+        raise ValueError(f"refusing to append invalid {kind} history line: "
                          + "; ".join(errors))
     with open(path, "a") as f:
         f.write(json.dumps(line) + "\n")
     return line
+
+
+def accuracy_record_to_history_line(rec: dict) -> Optional[dict]:
+    """Project one ``accuracy`` JSONL record onto the accuracy-history
+    line shape (the ``--fresh`` ingestion of scripts/accuracy_gate.py —
+    shared here so the gate and any future appender agree on the
+    mapping). Returns None for records that carry no gateable budget
+    (informational metrics without ``bound_ratio``); a nonfinite record
+    maps to ``bound_ratio: inf`` — NOT JSON-appendable, by design: the
+    gate must trip on it, never archive it."""
+    if rec.get("type") != "accuracy":
+        return None
+    if rec.get("nonfinite") is True:
+        value = ratio = float("inf")
+    elif _finite(rec.get("value")) and _finite(rec.get("bound_ratio")):
+        value, ratio = rec["value"], rec["bound_ratio"]
+    else:
+        return None
+    return {"site": rec.get("site"), "metric": rec.get("metric"),
+            "platform": rec.get("platform"), "dtype": rec.get("dtype"),
+            "n": rec.get("n"), "nb": rec.get("nb"),
+            "value": value, "bound_ratio": ratio}

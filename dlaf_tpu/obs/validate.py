@@ -33,12 +33,22 @@ Flags:
                             accounting, and retrace evidence — each leg
                             satisfiable by a metrics snapshot OR by the
                             per-event program records
+    --require-accuracy      fail unless >= 1 accuracy record carries a
+                            finite value AND a finite bound_ratio (the
+                            DLAF_ACCURACY audit trail, docs/accuracy.md;
+                            informational-only or all-nonfinite artifacts
+                            do not satisfy it)
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
                             non-empty variant/platform/dtype/ts/source)
                             instead of an obs artifact; incompatible with
                             the --require-* flags
+    --accuracy-history      validate the file as an append-only accuracy
+                            history log (.accuracy_history.jsonl: finite
+                            value/bound_ratio/n/nb, non-empty site/metric/
+                            platform/dtype/ts/source); incompatible with
+                            --history and the --require-* flags
     --prom                  print the last metrics snapshot as Prometheus
                             text exposition after validating
 
@@ -63,11 +73,13 @@ def main(argv=None) -> int:
     known = {"--require-spans", "--require-gflops", "--require-collectives",
              "--require-retries", "--require-fallbacks",
              "--require-comm-overlap", "--require-dc-batch",
-             "--require-bt-overlap", "--require-telemetry", "--history",
+             "--require-bt-overlap", "--require-telemetry",
+             "--require-accuracy", "--history", "--accuracy-history",
              "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
+    history_modes = flags & {"--history", "--accuracy-history"}
     if len(paths) != 1 or flags - known \
-            or ("--history" in flags and requires):
+            or (history_modes and requires) or len(history_modes) > 1:
         print(__doc__, file=sys.stderr)
         return 2
     path = paths[0]
@@ -76,13 +88,14 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"INVALID {path}: {e}", file=sys.stderr)
         return 1
-    if "--history" in flags:
-        errors = validate_history_records(records)
+    if history_modes:
+        kind = "accuracy" if "--accuracy-history" in flags else "bench"
+        errors = validate_history_records(records, kind)
         if errors:
             for e in errors:
                 print(f"INVALID {path}: {e}", file=sys.stderr)
             return 1
-        print(f"VALID {path}: {len(records)} history entries")
+        print(f"VALID {path}: {len(records)} {kind} history entries")
         return 0
     errors = validate_records(
         records,
@@ -94,7 +107,8 @@ def main(argv=None) -> int:
         require_comm_overlap="--require-comm-overlap" in flags,
         require_dc_batch="--require-dc-batch" in flags,
         require_bt_overlap="--require-bt-overlap" in flags,
-        require_telemetry="--require-telemetry" in flags)
+        require_telemetry="--require-telemetry" in flags,
+        require_accuracy="--require-accuracy" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -102,9 +116,11 @@ def main(argv=None) -> int:
     n_spans = sum(r.get("type") == "span" for r in records)
     n_logs = sum(r.get("type") == "log" for r in records)
     n_progs = sum(r.get("type") == "program" for r in records)
+    n_acc = sum(r.get("type") == "accuracy" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
+    extra += f", {n_acc} accuracy records" if n_acc else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

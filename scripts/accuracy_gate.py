@@ -1,0 +1,331 @@
+#!/usr/bin/env python
+"""CI accuracy-regression gate over the append-only accuracy history.
+
+    python scripts/accuracy_gate.py --fresh obs_artifact.jsonl [...]
+    python scripts/accuracy_gate.py --replay                  # hermetic CI
+    python scripts/accuracy_gate.py --inject corrupt_collective  # drill
+
+The accuracy counterpart of ``scripts/bench_gate.py`` (ISSUE 8,
+docs/accuracy.md): fresh ``accuracy`` records (``dlaf_tpu.obs.accuracy``
+— the ``DLAF_ACCURACY`` knob's artifact trail) are gated per key
+``(site, metric, platform, n, nb, dtype)`` on TWO legs:
+
+* **analytic budget** — the record's ``bound_ratio = value /
+  (c * n * eps_eff)`` must stay below ``--budget`` (default 1.0: the
+  residual may not exceed its c*n*eps backward-error budget, with
+  ``eps_eff`` the platform-honest epsilon of
+  ``miniapp/checks.effective_eps``). This leg needs NO history — it
+  gates every key, including brand-new ones;
+* **history drift** — the fresh worst ratio must stay below ``--drift``
+  (default 4.0) times the median historical ratio of the same key from
+  the git-tracked ``.accuracy_history.jsonl``. Keys with fewer than
+  ``--min-history`` (default 3) entries are drift-report-only (a new
+  site needs a few rounds of history before drift can gate it; the
+  budget leg still applies).
+
+A **non-finite** fresh estimate (``nonfinite: true`` records — NaN/Inf
+residuals, the signature of real corruption) is an automatic regression
+on any key.
+
+Fresh measurements come from ``--fresh`` files — obs JSONL artifacts
+whose ``accuracy`` records carry the estimates, or bare accuracy-history
+line files. ``--replay`` instead replays each history key's median entry
+as the fresh measurement (hermetic: clean committed history must exit
+0). ``--inject nan_tile|corrupt_collective`` runs the built-in
+corruption drill: a tiny Cholesky is factored with the named
+``dlaf_tpu.health.inject`` fault armed, probed with the shared device
+estimator, and the resulting records are gated — the drill MUST exit
+nonzero, proving the gate trips on real corruption, not only on
+synthetic numbers (``ci/run.sh smoke`` asserts exactly that).
+
+``--record-fresh`` appends the passing fresh lines (stamped ts/source)
+to the history — how a key accumulates the entries the drift leg needs.
+
+Both gates share ONE validating history reader
+(``dlaf_tpu.obs.sinks.read_history_records``, parameterized by kind):
+a malformed or non-finite history line fails the gate loudly instead of
+skewing a baseline.
+
+Exit status: 0 = no regression; 1 = regression (or invalid history /
+no usable fresh measurements); 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlaf_tpu.obs.sinks import (accuracy_record_to_history_line,
+                                append_history_line, read_history_records,
+                                read_records, validate_history_line)
+
+INJECT_MODES = ("nan_tile", "corrupt_collective")
+
+
+def measurement_key(line: dict) -> tuple:
+    """The baseline key: (site, metric, platform, n, nb, dtype)."""
+    return (line.get("site"), line.get("metric"), line.get("platform"),
+            line.get("n"), line.get("nb"), line.get("dtype"))
+
+
+def fmt_key(key: tuple) -> str:
+    site, metric, platform, n, nb, dtype = key
+    return f"{site}/{metric} [{platform}] n={n} nb={nb} {dtype}"
+
+
+def load_fresh(paths) -> list:
+    """Measurement lines from ``--fresh`` files: ``accuracy`` records of
+    obs artifacts (projected onto the history-line shape by the shared
+    ``accuracy_record_to_history_line``), or bare accuracy-history
+    lines. Invalid lines are rejected loudly; nonfinite records ride
+    through as ``bound_ratio: inf`` so the gate can trip on them."""
+    fresh = []
+    for path in paths:
+        for r in read_records(path):
+            if not isinstance(r, dict):
+                raise ValueError(f"{path}: non-object record")
+            if r.get("type") == "accuracy":
+                line = accuracy_record_to_history_line(r)
+                if line is None:
+                    continue        # informational metric (no budget)
+            elif "bound_ratio" in r and "type" not in r:
+                line = r            # bare history-style line
+            else:
+                continue            # spans/metrics/etc. ride along
+            if not (isinstance(line.get("bound_ratio"), float)
+                    and math.isinf(line["bound_ratio"])):
+                # artifact records carry no ts/source (the sink stamps ts
+                # on the envelope, not the payload); stamp placeholders so
+                # the SHARED history validator checks the rest of the line
+                probe = dict(line)
+                probe.setdefault("ts", "fresh")
+                probe.setdefault("source", "fresh")
+                errors = validate_history_line(probe, kind="accuracy")
+                if errors:
+                    raise ValueError(f"{path}: invalid fresh accuracy "
+                                     "measurement: " + "; ".join(errors))
+            fresh.append(line)
+    return fresh
+
+
+def baselines(history) -> dict:
+    """{key: (median bound_ratio, n_history)} — the plain median: an
+    accuracy baseline must track the typical estimate, and neither one
+    lucky low probe nor one noisy high one should move it."""
+    per_key: dict = {}
+    for line in history:
+        per_key.setdefault(measurement_key(line), []).append(
+            line["bound_ratio"])
+    return {key: (statistics.median(vals), len(vals))
+            for key, vals in per_key.items()}
+
+
+def run_gate(history, fresh, *, budget: float, drift: float,
+             min_history: int, log=print) -> int:
+    """Gate fresh worst-per-key bound ratios; returns the number of
+    regressed keys. Keys without fresh measurements are skipped (the
+    gate judges what this run measured); thin-history keys are
+    drift-report-only but still budget-gated."""
+    base = baselines(history)
+    fresh_worst: dict = {}
+    for line in fresh:
+        key = measurement_key(line)
+        ratio = line.get("bound_ratio")
+        if key not in fresh_worst or ratio > fresh_worst[key]:
+            fresh_worst[key] = ratio
+    regressions = 0
+    for key in sorted(fresh_worst, key=fmt_key):
+        worst = fresh_worst[key]
+        if not math.isfinite(worst):
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: non-finite accuracy estimate "
+                "(corrupted result)")
+            continue
+        if worst > budget:
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: bound_ratio {worst:.3g} > "
+                f"analytic budget {budget:.3g} (residual exceeds its "
+                "c*n*eps_eff backward-error bound)")
+            continue
+        if key not in base:
+            log(f"NEW        {fmt_key(key)}: bound_ratio {worst:.3g} <= "
+                f"budget {budget:.3g} (no history; drift leg report-only)")
+            continue
+        bl, n_hist = base[key]
+        ceiling = drift * bl
+        if n_hist < min_history:
+            log(f"THIN       {fmt_key(key)}: bound_ratio {worst:.3g} vs "
+                f"median {bl:.3g} ({n_hist} < {min_history} entries; drift "
+                "leg report-only)")
+            continue
+        if worst > ceiling:
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: bound_ratio {worst:.3g} > "
+                f"{ceiling:.3g} (drift {drift:g}x over median {bl:.3g} of "
+                f"{n_hist} entries)")
+        else:
+            log(f"OK         {fmt_key(key)}: bound_ratio {worst:.3g} <= "
+                f"min(budget {budget:.3g}, drift ceiling {ceiling:.3g}) "
+                f"({n_hist} entries)")
+    return regressions
+
+
+def run_inject_drill(kind: str, log=print) -> list:
+    """The corruption drill: factor a tiny HPD matrix with the named
+    ``health.inject`` fault armed and return the fresh accuracy lines of
+    the probed (corrupted) factor. ``corrupt_collective`` poisons the
+    nth traced diagonal broadcast of a 2x2-grid distributed Cholesky;
+    ``nan_tile`` poisons one element of a locally factored L. Runs on
+    whatever backend is up (CI pins JAX_PLATFORMS=cpu with 4 virtual
+    devices)."""
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=4").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import dlaf_tpu.config as config
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.health import inject
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.obs import accuracy
+
+    config.initialize()
+    n, nb = 64, 16
+    size, block = GlobalElementSize(n, n), TileElementSize(nb, nb)
+    if kind == "corrupt_collective":
+        from dlaf_tpu.comm.grid import Grid
+
+        mat = Matrix.from_element_fn(hpd_element_fn(n, np.float64), size,
+                                     block, grid=Grid(2, 2))
+        with inject.corrupt_collective("bcast"):
+            fac = cholesky("L", mat)
+    else:
+        mat = Matrix.from_element_fn(hpd_element_fn(n, np.float64), size,
+                                     block)
+        # pin the poison into the referenced (strict lower) triangle: a
+        # seed-drawn element could land above the diagonal, where the
+        # uplo="L" probe's tril mask would zero it and the must-trip
+        # drill would silently pass
+        fac = inject.nan_tile(cholesky("L", mat), tile=(2, 1),
+                              element=(3, 3))
+    value = accuracy.cholesky_residual("L", mat, fac)
+    res = accuracy.emit("accuracy_gate.drill", "cholesky_residual", value,
+                        n=n, nb=nb, c=60.0, dtype=np.float64,
+                        of=fac.storage, attrs={"inject": kind},
+                        record=False)
+    ratio = res.bound_ratio if res.finite else float("inf")
+    log(f"accuracy_gate: drill [{kind}] probed residual "
+        f"{value!r} -> bound_ratio {ratio!r}")
+    return [{"site": res.site, "metric": res.metric,
+             "platform": accuracy._platform_of(fac.storage),
+             "dtype": "float64", "n": n, "nb": nb,
+             "value": value if res.finite else float("inf"),
+             "bound_ratio": ratio}]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="accuracy-regression gate (see module docstring)")
+    ap.add_argument("--history", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".accuracy_history.jsonl"))
+    ap.add_argument("--fresh", nargs="*", default=[],
+                    help="obs artifacts (accuracy records) or bare "
+                         "accuracy-history line files")
+    ap.add_argument("--replay", action="store_true",
+                    help="replay each history key's median entry as the "
+                         "fresh measurement (hermetic CI mode)")
+    ap.add_argument("--inject", choices=INJECT_MODES,
+                    help="run the built-in corruption drill and gate its "
+                         "records (CI requires a nonzero exit)")
+    ap.add_argument("--budget", type=float, default=1.0,
+                    help="analytic bound_ratio ceiling (history-free leg)")
+    ap.add_argument("--drift", type=float, default=4.0,
+                    help="allowed factor over the median historical ratio")
+    ap.add_argument("--min-history", type=int, default=3)
+    ap.add_argument("--record-fresh", action="store_true",
+                    help="append passing fresh lines (stamped ts/source) "
+                         "to the history log")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    modes = sum([bool(args.fresh), args.replay, args.inject is not None])
+    if modes != 1:
+        print("accuracy_gate: need exactly one of --fresh / --replay / "
+              "--inject", file=sys.stderr)
+        return 2
+    if args.budget <= 0 or args.drift < 1.0:
+        print("accuracy_gate: budget must be > 0 and drift >= 1",
+              file=sys.stderr)
+        return 2
+
+    if os.path.exists(args.history):
+        try:
+            history = read_history_records(args.history, kind="accuracy")
+        except (OSError, ValueError) as e:
+            print(f"accuracy_gate: {e}", file=sys.stderr)
+            return 1
+    else:
+        history = []        # budget leg still gates; drift is report-only
+    if args.replay:
+        per_key: dict = {}
+        for line in history:
+            per_key.setdefault(measurement_key(line), []).append(line)
+        fresh = []
+        for lines in per_key.values():
+            lines.sort(key=lambda ln: ln["bound_ratio"])
+            fresh.append(lines[len(lines) // 2])
+        mode = "replay"
+        if not history:
+            print("accuracy_gate: --replay needs a history file",
+                  file=sys.stderr)
+            return 1
+    elif args.inject:
+        fresh = run_inject_drill(args.inject)
+        mode = f"inject {args.inject}"
+    else:
+        try:
+            fresh = load_fresh(args.fresh)
+        except (OSError, ValueError) as e:
+            print(f"accuracy_gate: {e}", file=sys.stderr)
+            return 1
+        mode = f"fresh x{len(args.fresh)}"
+    if not fresh:
+        print("accuracy_gate: no fresh accuracy measurements found",
+              file=sys.stderr)
+        return 1
+
+    print(f"accuracy_gate: {mode}, {len(history)} history entries, "
+          f"{len(fresh)} fresh measurements (budget {args.budget:g}, "
+          f"drift {args.drift:g}x, min-history {args.min_history})")
+    regressions = run_gate(history, fresh, budget=args.budget,
+                           drift=args.drift, min_history=args.min_history)
+    if regressions:
+        print(f"accuracy_gate: {regressions} regressed key(s)",
+              file=sys.stderr)
+        return 1
+    if args.record_fresh:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime())
+        for line in fresh:
+            append_history_line(args.history,
+                                dict(line, ts=ts, source="accuracy_gate"),
+                                kind="accuracy")
+        print(f"accuracy_gate: recorded {len(fresh)} fresh line(s) to "
+              f"{args.history}")
+    print("accuracy_gate: no regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

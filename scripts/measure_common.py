@@ -106,6 +106,33 @@ def append_history(platform: str, n: int, nb: int, gflops: float, t: float,
     return line
 
 
+def append_accuracy_history(platform: str, site: str, metric: str, n: int,
+                            nb: int, value: float, bound_ratio: float,
+                            source: str, dtype: str = "float64"):
+    """Append one accuracy measurement to the git-tracked append-only
+    accuracy history (``.accuracy_history.jsonl`` — the drift baseline of
+    ``scripts/accuracy_gate.py``). Line schema owned by
+    ``dlaf_tpu.obs.sinks`` (kind="accuracy", the same validating reader
+    the gates share); a non-finite value raises here, loudly, instead of
+    poisoning every later drift baseline. Disk errors stay non-fatal."""
+    import time as _time
+
+    line = {"site": site, "metric": metric, "platform": platform,
+            "dtype": dtype, "n": n, "nb": nb, "value": float(value),
+            "bound_ratio": float(bound_ratio),
+            "ts": _time.strftime("%Y-%m-%dT%H:%M:%S", _time.gmtime()),
+            "source": source}
+    from dlaf_tpu.obs import append_history_line
+
+    try:
+        append_history_line(os.path.join(repo_root(),
+                                         ".accuracy_history.jsonl"), line,
+                            kind="accuracy")
+    except OSError as e:
+        log(f"accuracy history append failed: {e!r}")
+    return line
+
+
 def peel(x, s: int):
     """Stacked int8 Ozaki slices + the row scale (micro-kernel input)."""
     import jax.numpy as jnp
@@ -173,6 +200,12 @@ def cholesky_arm(impl: str, slices: int, dot: str, *, n: int = 4096,
             f"({'PASS' if out['check'] else 'FAIL'})")
         if jax.devices()[0].platform == "tpu" and out["check"]:
             append_history("tpu", n, nb, g, t, f"{source} {key}")
+            # paired accuracy entry: every durable perf point carries its
+            # residual grade, so accuracy_gate's drift baseline grows
+            # alongside the bench one (docs/accuracy.md)
+            append_accuracy_history("tpu", "cholesky_arm",
+                                    "cholesky_residual", n, nb, resid,
+                                    resid / tol, f"{source} {key}")
         return out
     finally:
         for k_ in ("DLAF_CHOLESKY_TRAILING", "DLAF_OZAKI_IMPL",

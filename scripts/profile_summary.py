@@ -106,6 +106,16 @@ def summarize_jsonl(path: str, top_n: int) -> None:
             print(f"  {a['compile']:8.2f} s compile  x{a['n']:<3d} "
                   f"traces {retraces.get(site, a['n']):<3d} {site}{peak}")
 
+    if any(r.get("type") == "accuracy" for r in records):
+        # accuracy table code is obs.aggregate's — single owner, not a
+        # fork (docs/accuracy.md)
+        from dlaf_tpu.obs.aggregate import (accuracy_rows,
+                                            format_accuracy_table)
+
+        print("\n== accuracy (worst bound_ratio per rank) ==")
+        for line in format_accuracy_table(accuracy_rows(records), top_n):
+            print(f"  {line}")
+
     if snaps:
         print("\n== counters (last snapshot) ==")
         for m in snaps[-1]["metrics"]:

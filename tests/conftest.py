@@ -97,6 +97,8 @@ _QUICK_TESTS = {
     ("test_obs.py", "test_miniapp_cholesky_metrics_integration"),
     ("test_telemetry.py", "test_telemetry_call_records_compile_and_retrace"),
     ("test_telemetry.py", "test_bench_gate_committed_history_replays_clean"),
+    ("test_accuracy.py", "test_probe_within_variance_bound"),
+    ("test_accuracy.py", "test_gate_legs"),
 }
 
 

@@ -1,0 +1,669 @@
+"""Tests for ISSUE 8: accuracy telemetry — in-graph quality probes,
+accuracy records + history, and the accuracy-regression gate.
+
+Covers: the DLAF_ACCURACY knob end-to-end (stochastic probe vs exact
+dense residual within its variance bound across dtype x uplo x {local,
+2x2 dist}; "full" == exact; the "0" bitwise-passthrough contract on the
+factor outputs), the estimator family (cholesky/trsm/hegst/eigen/
+orthogonality), the ``accuracy`` record schema + ``--require-accuracy``
+validator leg + CLI exit codes, the D&C per-level deflation records,
+the shared kind-parameterized history reader, and
+``scripts/accuracy_gate.py`` (budget/drift/nonfinite legs, replay,
+injection drill).
+"""
+
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.obs import accuracy
+from dlaf_tpu.obs.sinks import (append_history_line, read_history_records,
+                                validate_file, validate_records)
+
+SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def accuracy_reset():
+    """Leave every test with the suite's default unobserved config."""
+    yield
+    for key in ("DLAF_METRICS_PATH", "DLAF_LOG", "DLAF_ACCURACY"):
+        os.environ.pop(key, None)
+    obs._reset_for_tests()
+    C.finalize()
+    C.initialize()
+
+
+def _hpd(n, dtype=np.float64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    if np.dtype(dtype).kind == "c":
+        x = x + 1j * rng.standard_normal((n, n))
+    a = x @ x.conj().T + n * np.eye(n)
+    return np.asarray(a, dtype=dtype)
+
+
+def _perturbed_factor(uplo, mat, scale=1e-8, seed=3):
+    """A factor with a deliberate O(scale) error, so the residual sits
+    far above the probe's own rounding floor."""
+    fac = cholesky(uplo, mat)
+    rng = np.random.default_rng(seed)
+    noise = rng.standard_normal(fac.storage.shape)
+    return fac.with_storage(fac.storage + scale * noise.astype(
+        np.asarray(fac.storage).dtype))
+
+
+def _exact_cholesky_residual(uplo, a, fac):
+    f = fac.to_numpy()
+    t = np.tril(f) if uplo == "L" else np.triu(f)
+    z = t @ t.conj().T if uplo == "L" else t.conj().T @ t
+    return float(np.linalg.norm(z - a) / np.linalg.norm(a))
+
+
+# ---------------------------------------------------------------------------
+# estimator: probe vs exact (the variance-bound satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("dist", [False, True])
+def test_probe_within_variance_bound(dtype, uplo, dist):
+    n, nb = 96, 32
+    a = _hpd(n, dtype)
+    grid = Grid(2, 2) if dist else None
+    mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+    fac = _perturbed_factor(uplo, mat)
+    exact = _exact_cholesky_residual(uplo, a, fac)
+    assert exact > 1e-10          # perturbation dominates rounding
+    est = accuracy.cholesky_residual(uplo, mat, fac, mode="1")
+    # k=8 Hutchinson: relative std of the squared estimate <= sqrt(2/8);
+    # the seeded estimate must sit within a factor of 4 of the truth
+    assert exact / 4 < est < exact * 4, (est, exact)
+    full = accuracy.cholesky_residual(uplo, mat, fac, mode="full")
+    assert full == pytest.approx(exact, rel=1e-10)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_dist_matches_local(uplo):
+    """The distributed estimate equals the single-chip estimate of the
+    same factor to rounding (the cross-rank all_reduce reassociates the
+    partial sums — the documented exception to bitwise, docs/accuracy.md)
+    and is itself bitwise-reproducible call to call."""
+    n, nb = 96, 16
+    a = _hpd(n)
+    lmat = Matrix.from_global(a, TileElementSize(nb, nb))
+    lfac = _perturbed_factor(uplo, lmat)
+    dmat = Matrix.from_global(a, TileElementSize(nb, nb), grid=Grid(2, 2))
+    dfac = dmat.with_storage(
+        Matrix.from_global(lfac.to_numpy(), TileElementSize(nb, nb),
+                           grid=Grid(2, 2)).storage)
+    for mode in ("1", "full"):
+        lv = accuracy.cholesky_residual(uplo, lmat, lfac, mode=mode)
+        dv = accuracy.cholesky_residual(uplo, dmat, dfac, mode=mode)
+        assert dv == pytest.approx(lv, rel=1e-10), (mode, lv, dv)
+        # determinism: the same distributed program on the same data
+        # returns the identical float (fixed probe seed + reduction shape)
+        assert accuracy.cholesky_residual(uplo, dmat, dfac, mode=mode) == dv
+
+
+# ---------------------------------------------------------------------------
+# DLAF_ACCURACY=0 bitwise passthrough (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dist", [False, True])
+def test_accuracy_knob_is_bitwise_passthrough(dist):
+    """Factor outputs are identical with the knob off and on (probes are
+    separate programs over the outputs, never fused into the
+    factorization) — local and distributed."""
+    n, nb = 64, 16
+    a = _hpd(n)
+    grid = Grid(2, 2) if dist else None
+
+    def factor():
+        mat = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+        return mat, cholesky("L", mat)
+
+    os.environ["DLAF_ACCURACY"] = "0"
+    C.initialize()
+    _, f0 = factor()
+    bytes0 = np.asarray(f0.storage).tobytes()
+    os.environ["DLAF_ACCURACY"] = "1"
+    C.initialize()
+    mat1, f1 = factor()
+    # run the probe too: computing it must not perturb anything
+    value = accuracy.cholesky_residual("L", mat1, f1)
+    assert math.isfinite(value)
+    assert np.asarray(f1.storage).tobytes() == bytes0
+
+
+# ---------------------------------------------------------------------------
+# estimator family: trsm / hegst / eigen / orthogonality
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("side,uplo,op,diag", [
+    ("L", "L", "N", "N"), ("L", "U", "C", "U"),
+    ("R", "L", "T", "N"), ("R", "U", "N", "U")])
+@pytest.mark.parametrize("dist", [False, True])
+def test_trsm_estimator(side, uplo, op, diag, dist):
+    from dlaf_tpu.algorithms.triangular import triangular_solve
+
+    m, n, nb = 64, 32, 16
+    adim = m if side == "L" else n
+    rng = np.random.default_rng(1)
+    # small off-diagonal + dominant diagonal: well-conditioned for BOTH
+    # diag modes (diag="U" replaces the stored diagonal with ones, so a
+    # large off-diagonal would make the unit-triangular system
+    # exponentially ill-conditioned and the residual itself noisy)
+    a = rng.standard_normal((adim, adim)) * (0.5 / adim) + 2.0 * np.eye(adim)
+    b = rng.standard_normal((m, n))
+    grid = Grid(2, 2) if dist else None
+    am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+    bm = Matrix.from_global(b, TileElementSize(nb, nb), grid=grid)
+    out = triangular_solve(side, uplo, op, diag, 1.0, am, bm)
+    t = np.tril(a) if uplo == "L" else np.triu(a)
+    if diag == "U":
+        np.fill_diagonal(t, 1.0)
+    t = {"N": t, "T": t.T, "C": t.conj().T}[op]
+    x = out.to_numpy()
+    exact = np.linalg.norm((t @ x if side == "L" else x @ t) - b) \
+        / np.linalg.norm(b)
+    full = accuracy.trsm_residual(side, uplo, op, diag, 1.0, am, bm, out,
+                                  mode="full")
+    assert full == pytest.approx(exact, rel=1e-6, abs=1e-14)
+    est = accuracy.trsm_residual(side, uplo, op, diag, 1.0, am, bm, out,
+                                 mode="1")
+    assert math.isfinite(est) and est < 1e-12   # solved system: tiny
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+@pytest.mark.parametrize("dist", [False, True])
+def test_hegst_estimator(uplo, dist):
+    from dlaf_tpu.algorithms.gen_to_std import gen_to_std
+
+    n, nb = 64, 16
+    a = _hpd(n, seed=5)
+    bmat = _hpd(n, seed=6)
+    grid = Grid(2, 2) if dist else None
+    am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+    bf = cholesky(uplo, Matrix.from_global(bmat, TileElementSize(nb, nb),
+                                           grid=grid))
+    out = gen_to_std(uplo, am, bf)
+    f = bf.to_numpy()
+    c = out.to_numpy()
+    if uplo == "L":
+        t = np.tril(f)
+        ch = np.tril(c) + np.tril(c, -1).conj().T
+        z = t @ ch @ t.conj().T
+    else:
+        t = np.triu(f)
+        ch = np.triu(c) + np.triu(c, 1).conj().T
+        z = t.conj().T @ ch @ t
+    exact = np.linalg.norm(z - a) / np.linalg.norm(a)
+    full = accuracy.hegst_residual(uplo, am, bf, out, mode="full")
+    assert full == pytest.approx(exact, rel=1e-8, abs=1e-14)
+    est = accuracy.hegst_residual(uplo, am, bf, out, mode="1")
+    assert math.isfinite(est) and est < 1e-12
+
+
+@pytest.mark.parametrize("dist", [False, True])
+def test_eigen_estimators(dist):
+    n, nb = 64, 16
+    a = _hpd(n, seed=7)
+    lam, z = np.linalg.eigh(a)
+    # perturb Z so every metric sits far above its rounding floor — a
+    # spuriously-zero estimator leg cannot hide under an abs tolerance
+    rng = np.random.default_rng(8)
+    z = z + 1e-7 * rng.standard_normal((n, n))
+    grid = Grid(2, 2) if dist else None
+    am = Matrix.from_global(a, TileElementSize(nb, nb), grid=grid)
+    zm = Matrix.from_global(z, TileElementSize(nb, nb), grid=grid)
+    full = accuracy.eigen_residuals("L", am, lam, zm, mode="full")
+    exact = np.linalg.norm(a @ z - z * lam[None, :]) / np.linalg.norm(a)
+    assert exact > 1e-9
+    assert full["eigen_residual"] == pytest.approx(exact, rel=1e-8)
+    exact_orth = np.linalg.norm(z.conj().T @ z - np.eye(n))
+    assert full["orthogonality"] == pytest.approx(exact_orth, rel=1e-8)
+    cols = np.linalg.norm(a @ z - z * lam[None, :], axis=0)
+    exact_max = cols.max() / np.linalg.norm(a)
+    assert full["eigenpair_max"] == pytest.approx(exact_max, rel=1e-8)
+    est = accuracy.eigen_residuals("L", am, lam, zm, mode="1")
+    assert exact / 4 < est["eigen_residual"] < exact * 4
+    assert exact_orth / 4 < est["orthogonality"] < exact_orth * 4
+    # the sampled max is a lower bound on the true max (subset of pairs)
+    assert 0 < est["eigenpair_max"] <= exact_max * (1 + 1e-8)
+
+
+def test_zero_reference_f32_guard():
+    """An all-zero float32 reference must estimate 0.0, not NaN: the
+    zero-denominator guard has to be representable in the computation
+    dtype (a fixed 1e-300 rounds to 0.0f and 0/0 would NaN — flagging an
+    uncorrupted run as corrupted)."""
+    z = Matrix.from_global(np.zeros((32, 32), np.float32),
+                           TileElementSize(16, 16))
+    for mode in ("1", "full"):
+        assert accuracy.cholesky_residual("L", z, z, mode=mode) == 0.0
+
+
+def test_array_orthogonality():
+    rng = np.random.default_rng(11)
+    q, _ = np.linalg.qr(rng.standard_normal((48, 48)))
+    assert accuracy.array_orthogonality(q, mode="full") < 1e-13
+    exact = np.linalg.norm((2 * q).T @ (2 * q) - np.eye(48))
+    assert accuracy.array_orthogonality(2 * q, mode="full") == \
+        pytest.approx(exact, rel=1e-10)
+    est = accuracy.array_orthogonality(2 * q, mode="1")
+    assert exact / 4 < est < exact * 4
+
+
+# ---------------------------------------------------------------------------
+# records, schema, validator
+# ---------------------------------------------------------------------------
+
+def _arm(tmp_path, mode="1"):
+    path = str(tmp_path / "acc.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, accuracy=mode))
+    return path
+
+
+def test_emit_record_and_gauge(tmp_path):
+    path = _arm(tmp_path)
+    res = accuracy.emit("site_x", "metric_y", 1.5e-15, n=128, nb=32,
+                        c=60.0, dtype=np.float64, attrs={"uplo": "L"})
+    assert res.passed and res.bound_ratio == pytest.approx(
+        1.5e-15 / res.tol)
+    obs.flush()
+    recs = obs.read_records(path)
+    acc = [r for r in recs if r.get("type") == "accuracy"]
+    assert len(acc) == 1
+    r = acc[0]
+    assert r["site"] == "site_x" and r["metric"] == "metric_y"
+    assert r["value"] == 1.5e-15 and r["n"] == 128 and r["nb"] == 32
+    assert r["dtype"] == "float64" and r["platform"]
+    assert r["attrs"]["uplo"] == "L" and r["attrs"]["mode"] == "1"
+    assert math.isfinite(r["bound_ratio"]) and r["c"] == 60.0
+    assert not validate_records(recs, require_accuracy=True)
+    g = obs.registry().gauge("dlaf_accuracy_ratio", site="site_x",
+                             metric="metric_y").snapshot()
+    assert g["value"] == pytest.approx(res.bound_ratio)
+
+
+def test_emit_nonfinite_record(tmp_path):
+    path = _arm(tmp_path)
+    res = accuracy.emit("site_x", "metric_y", float("nan"), n=64, nb=16,
+                        c=60.0, dtype=np.float64)
+    assert not res.passed and not res.finite and res.bound_ratio is None
+    obs.flush()
+    recs = obs.read_records(path)
+    r = [x for x in recs if x.get("type") == "accuracy"][0]
+    assert r["value"] is None and r["nonfinite"] is True
+    assert "bound_ratio" not in r
+    # schema-valid, but does NOT satisfy --require-accuracy
+    assert not validate_records(recs)
+    assert validate_records(recs, require_accuracy=True)
+    cnt = obs.registry().counter("dlaf_accuracy_nonfinite_total",
+                                 site="site_x", metric="metric_y").snapshot()
+    assert cnt["value"] == 1
+
+
+def test_emit_informational_metric(tmp_path):
+    """c=None (e.g. the deflation fraction): no bound_ratio, no gauge,
+    schema-valid, but not --require-accuracy evidence."""
+    path = _arm(tmp_path)
+    res = accuracy.emit("tridiag_solver", "dc_deflation_fraction", 0.5,
+                        n=256, nb=32, c=None, dtype=np.float64,
+                        attrs={"level": 1})
+    assert res.passed and res.tol is None and res.bound_ratio is None
+    obs.flush()
+    recs = obs.read_records(path)
+    r = [x for x in recs if x.get("type") == "accuracy"][0]
+    assert "bound_ratio" not in r and "c" not in r
+    assert not validate_records(recs)
+    assert validate_records(recs, require_accuracy=True)
+
+
+def test_accuracy_schema_rejections():
+    base = {"type": "accuracy", "v": 1, "ts": 1.0, "site": "s",
+            "metric": "m", "platform": "cpu", "n": 64, "nb": 16,
+            "dtype": "float64", "value": 1e-15, "bound_ratio": 1e-3,
+            "attrs": {}}
+    assert not validate_records([dict(base)])
+    assert validate_records([dict(base, value=float("nan"))])
+    assert validate_records([dict(base, value=None)])          # no nonfinite
+    assert validate_records([dict(base, value=None, nonfinite=True)])
+    ok_nonfinite = dict(base, value=None, nonfinite=True)
+    ok_nonfinite.pop("bound_ratio")
+    assert not validate_records([ok_nonfinite])
+    assert validate_records([dict(base, site="")])
+    assert validate_records([dict(base, n=-1)])
+    assert validate_records([dict(base, bound_ratio=float("inf"))])
+    assert validate_records([dict(base, attrs="nope")])
+
+
+def test_validator_cli_exit_codes(tmp_path):
+    """Exit codes pinned like PR 7's: 2 for usage errors (unknown flag,
+    incompatible modes), 1 for an empty artifact under the new
+    requirement, 0 for a valid accuracy history."""
+    art = tmp_path / "a.jsonl"
+    art.write_text("")
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "dlaf_tpu.obs.validate", *args],
+            capture_output=True, env=env, cwd=REPO).returncode
+
+    assert run(str(art), "--require-accuracy") == 1
+    assert run(str(art), "--no-such-flag") == 2
+    assert run(str(art), "--history", "--require-accuracy") == 2
+    assert run(str(art), "--accuracy-history", "--require-accuracy") == 2
+    assert run(str(art), "--history", "--accuracy-history") == 2
+    hist = tmp_path / "h.jsonl"
+    hist.write_text(json.dumps(
+        {"site": "s", "metric": "m", "platform": "cpu", "dtype": "float64",
+         "n": 64, "nb": 16, "value": 1e-15, "bound_ratio": 1e-3,
+         "ts": "t", "source": "test"}) + "\n")
+    assert run(str(hist), "--accuracy-history") == 0
+    assert run(str(hist), "--history") == 1      # wrong kind must fail
+
+
+# ---------------------------------------------------------------------------
+# shared history reader (satellite: one validating reader, no second parser)
+# ---------------------------------------------------------------------------
+
+def test_history_reader_kinds(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    line = {"site": "s", "metric": "m", "platform": "cpu",
+            "dtype": "float64", "n": 64, "nb": 16, "value": 1e-15,
+            "bound_ratio": 1e-3, "ts": "t", "source": "test"}
+    append_history_line(path, line, kind="accuracy")
+    assert read_history_records(path, kind="accuracy") == [line]
+    with pytest.raises(ValueError):
+        append_history_line(path, dict(line, bound_ratio=float("nan")),
+                            kind="accuracy")
+    with pytest.raises(ValueError):
+        append_history_line(path, dict(line, site=""), kind="accuracy")
+    # a bench line is NOT a valid accuracy line and vice versa — the one
+    # reader, parameterized, keeps the two schemas honest
+    bench = {"variant": "ozaki", "platform": "tpu", "dtype": "float64",
+             "n": 4096, "nb": 256, "gflops": 100.0, "t": 1.0,
+             "ts": "t", "source": "test"}
+    with pytest.raises(ValueError):
+        append_history_line(path, bench, kind="accuracy")
+    bpath = str(tmp_path / "b.jsonl")
+    append_history_line(bpath, bench)            # default kind: bench
+    assert read_history_records(bpath) == [bench]
+    with pytest.raises(ValueError):
+        read_history_records(bpath, kind="accuracy")
+
+
+def test_gates_share_one_reader():
+    """Both gate scripts read history through obs.sinks'
+    read_history_records — neither carries a bespoke parser."""
+    import accuracy_gate
+    import bench_gate
+
+    assert bench_gate.read_history_records is read_history_records
+    assert accuracy_gate.read_history_records is read_history_records
+
+
+# ---------------------------------------------------------------------------
+# D&C deflation records
+# ---------------------------------------------------------------------------
+
+def test_deflation_records(tmp_path):
+    from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+
+    path = _arm(tmp_path)
+    rng = np.random.default_rng(2)
+    n = 96
+    tridiag_solver(rng.standard_normal(n), rng.standard_normal(n - 1), 16)
+    obs.flush()
+    recs = obs.read_records(path)
+    defl = [r for r in recs if r.get("type") == "accuracy"
+            and r.get("metric") == "dc_deflation_fraction"]
+    assert defl, "no deflation records emitted"
+    assert not validate_records(recs)
+    levels = set()
+    for r in defl:
+        assert r["site"] == "tridiag_solver"
+        assert 0.0 <= r["value"] <= 1.0
+        assert r["attrs"]["merges"] >= 1
+        assert r["attrs"]["deflated_poles"] <= r["attrs"]["merged_poles"]
+        levels.add(r["attrs"]["level"])
+    assert len(levels) == len(defl)     # one record per tree level
+
+
+def test_deflation_off_by_default(tmp_path):
+    from dlaf_tpu.eigensolver.tridiag_solver import tridiag_solver
+
+    path = str(tmp_path / "acc.jsonl")
+    C.initialize(C.Configuration(metrics_path=path))     # accuracy="0"
+    rng = np.random.default_rng(2)
+    tridiag_solver(rng.standard_normal(64), rng.standard_normal(63), 16)
+    obs.flush()
+    recs = obs.read_records(path)
+    assert not any(r.get("type") == "accuracy" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# miniapp integration: stdout contract + artifact records
+# ---------------------------------------------------------------------------
+
+CHECK_RE = re.compile(
+    r"^check: (PASSED|FAILED) residual=\d\.\d{3}e[+-]\d+ "
+    r"tol=\d\.\d{3}e[+-]\d+( \[.*\])?$")
+
+
+def _arm_env(tmp_path):
+    """Arm via env (miniapp run() re-initializes config from env/CLI, so
+    a user-struct metrics_path would be dropped)."""
+    path = str(tmp_path / "acc.jsonl")
+    os.environ["DLAF_METRICS_PATH"] = path
+    os.environ["DLAF_ACCURACY"] = "1"
+    C.initialize()
+    return path
+
+
+def test_miniapp_check_stdout_contract(tmp_path, capsys):
+    """The `check:` line format is bit-for-bit the historical contract
+    (existing CI greps key on it), now fed by the device estimator."""
+    from dlaf_tpu.miniapp import miniapp_cholesky
+
+    path = _arm_env(tmp_path)
+    miniapp_cholesky.run(["-m", "64", "-b", "16", "--nruns", "1",
+                          "--check-result", "last"])
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("check:")]
+    assert len(lines) == 1 and CHECK_RE.match(lines[0]), lines
+    assert "PASSED" in lines[0]
+    obs.flush()
+    recs = obs.read_records(path)
+    assert not validate_records(recs, require_accuracy=True)
+    acc = [r for r in recs if r.get("type") == "accuracy"]
+    # exactly ONE record for the checked run: the check emits it, and
+    # the timed-run emission skips (no double probe / duplicate rows)
+    assert len(acc) == 1
+    assert acc[0]["attrs"].get("check") is True
+
+
+def test_miniapp_check_distributed(capsys):
+    from dlaf_tpu.miniapp import miniapp_cholesky
+
+    miniapp_cholesky.run(["-m", "64", "-b", "16", "--grid-rows", "2",
+                          "--grid-cols", "2", "--nruns", "1",
+                          "--check-result", "last"])
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("check:")]
+    assert len(lines) == 1 and "PASSED" in lines[0]
+
+
+def test_miniapp_trsm_and_hegst_checks(capsys):
+    from dlaf_tpu.miniapp import (miniapp_gen_to_std,
+                                  miniapp_triangular_solver)
+
+    miniapp_triangular_solver.run(["-m", "64", "-n", "32", "-b", "16",
+                                   "--check-result", "last"])
+    miniapp_gen_to_std.run(["-m", "64", "-b", "16",
+                            "--check-result", "last"])
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("check:")]
+    assert len(lines) == 2
+    for ln in lines:
+        assert CHECK_RE.match(ln) and "PASSED" in ln, ln
+
+
+def test_miniapp_eigensolver_check(tmp_path, capsys):
+    from dlaf_tpu.miniapp import miniapp_eigensolver
+
+    path = _arm_env(tmp_path)
+    miniapp_eigensolver.run(["-m", "64", "-b", "16", "--nruns", "1",
+                             "--check-result", "last"])
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("check:")]
+    assert len(lines) == 1 and CHECK_RE.match(lines[0]) \
+        and "PASSED" in lines[0]
+    obs.flush()
+    recs = obs.read_records(path)
+    metrics = {r["metric"] for r in recs if r.get("type") == "accuracy"}
+    assert {"eigen_residual", "eigenpair_max", "orthogonality",
+            "dc_deflation_fraction"} <= metrics
+
+
+# ---------------------------------------------------------------------------
+# accuracy gate
+# ---------------------------------------------------------------------------
+
+def _hist_line(ratio, **over):
+    line = {"site": "s", "metric": "m", "platform": "cpu",
+            "dtype": "float64", "n": 64, "nb": 16, "value": ratio * 1e-12,
+            "bound_ratio": ratio, "ts": "t", "source": "test"}
+    line.update(over)
+    return line
+
+
+def test_gate_legs():
+    from accuracy_gate import run_gate
+
+    hist = [_hist_line(0.001), _hist_line(0.0012), _hist_line(0.0008)]
+    logs = []
+    # clean: within budget and drift
+    assert run_gate(hist, [_hist_line(0.002)], budget=1.0, drift=4.0,
+                    min_history=3, log=logs.append) == 0
+    # drift trip: 10x the median
+    assert run_gate(hist, [_hist_line(0.01)], budget=1.0, drift=4.0,
+                    min_history=3, log=logs.append) == 1
+    # budget trip, even with no history for the key
+    assert run_gate([], [_hist_line(1.5)], budget=1.0, drift=4.0,
+                    min_history=3, log=logs.append) == 1
+    # nonfinite trip
+    assert run_gate(hist, [_hist_line(float("inf"))], budget=1.0,
+                    drift=4.0, min_history=3, log=logs.append) == 1
+    # thin history: drift leg report-only, budget still gates
+    thin = hist[:2]
+    assert run_gate(thin, [_hist_line(0.01)], budget=1.0, drift=4.0,
+                    min_history=3, log=logs.append) == 0
+    assert run_gate(thin, [_hist_line(1.5)], budget=1.0, drift=4.0,
+                    min_history=3, log=logs.append) == 1
+    assert any("THIN" in ln for ln in logs)
+    assert any("REGRESSION" in ln for ln in logs)
+
+
+def test_gate_cli_modes_and_committed_history(tmp_path):
+    """CLI exit codes pinned; the committed .accuracy_history.jsonl must
+    replay clean (the hermetic CI leg)."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    gate = os.path.join(SCRIPTS, "accuracy_gate.py")
+
+    def run(*args):
+        return subprocess.run([sys.executable, gate, *args],
+                              capture_output=True, env=env,
+                              cwd=REPO).returncode
+
+    assert run() == 2                          # no mode selected
+    assert run("--replay", "--fresh", "x") == 2   # two modes
+    assert run("--replay", "--budget", "0") == 2
+    assert run("--replay", "--drift", "0.5") == 2
+    assert run("--replay") == 0                # committed history: clean
+    missing = str(tmp_path / "none.jsonl")
+    assert run("--replay", "--history", missing) == 1
+
+
+def test_gate_fresh_from_artifact(tmp_path):
+    """accuracy records flow from an obs artifact through the shared
+    projection into the gate; informational records are skipped."""
+    from accuracy_gate import load_fresh, run_gate
+
+    path = _arm(tmp_path)
+    accuracy.emit("s", "m", 1e-15, n=64, nb=16, c=60.0, dtype=np.float64)
+    accuracy.emit("tridiag_solver", "dc_deflation_fraction", 0.5, n=64,
+                  nb=16, c=None, dtype=np.float64)
+    accuracy.emit("s", "bad", float("nan"), n=64, nb=16, c=60.0,
+                  dtype=np.float64)
+    obs.flush()
+    fresh = load_fresh([path])
+    assert len(fresh) == 2          # budgeted + nonfinite; info skipped
+    assert run_gate([], fresh, budget=1.0, drift=4.0, min_history=3,
+                    log=lambda *_: None) == 1    # the nonfinite one
+
+
+def test_gate_inject_drill_trips():
+    """The real-fault drill (nan_tile: a poisoned local factor) must
+    yield a nonfinite fresh line that regresses the gate."""
+    from accuracy_gate import run_gate, run_inject_drill
+
+    fresh = run_inject_drill("nan_tile", log=lambda *_: None)
+    assert len(fresh) == 1
+    assert math.isinf(fresh[0]["bound_ratio"])
+    assert run_gate([], fresh, budget=1.0, drift=4.0, min_history=3,
+                    log=lambda *_: None) == 1
+
+
+def test_gate_inject_corrupt_collective_trips():
+    from accuracy_gate import run_gate, run_inject_drill
+
+    fresh = run_inject_drill("corrupt_collective", log=lambda *_: None)
+    assert run_gate([], fresh, budget=1.0, drift=4.0, min_history=3,
+                    log=lambda *_: None) == 1
+
+
+# ---------------------------------------------------------------------------
+# aggregate table
+# ---------------------------------------------------------------------------
+
+def test_aggregate_accuracy_rows():
+    from dlaf_tpu.obs.aggregate import accuracy_rows, format_accuracy_table
+
+    recs = [
+        {"type": "accuracy", "site": "s", "metric": "m", "rank": 0,
+         "value": 1e-15, "bound_ratio": 2e-4},
+        {"type": "accuracy", "site": "s", "metric": "m", "rank": 1,
+         "value": 2e-15, "bound_ratio": 4e-4},
+        {"type": "accuracy", "site": "s", "metric": "bad", "rank": 1,
+         "value": None, "nonfinite": True},
+        {"type": "span", "name": "x", "dur_s": 0.1},
+    ]
+    rows = accuracy_rows(recs)
+    assert len(rows) == 2
+    assert rows[0]["metric"] == "bad" and rows[0]["nonfinite"] == 1
+    assert rows[1]["worst_ratio"] == pytest.approx(4e-4)
+    assert rows[1]["per_rank"][0]["worst_ratio"] == pytest.approx(2e-4)
+    lines = format_accuracy_table(rows)
+    assert any("NONFINITE" in ln for ln in lines)
+    assert any("s/m" in ln for ln in lines)
