@@ -178,7 +178,49 @@ print(f"eigensolver smoke ok: n={n} residual={resid:.2e}")
 obs.flush()
 EOF
     python -m dlaf_tpu.obs.validate "$EIG_ART" \
-      --require-spans --require-dc-batch --require-bt-overlap ;;
+      --require-spans --require-dc-batch --require-bt-overlap
+    echo "== smoke: sanitizers (debug_nans + transfer guard happy path) =="
+    # dynamic counterpart of the static no-host-callback audit below: a
+    # tiny local AND 2x2-distributed cholesky must neither produce NaNs
+    # on the happy path (jax_debug_nans re-executes op-by-op on any NaN)
+    # nor fetch device values mid-factorization (device->host transfer
+    # guard; result fetch happens AFTER the guard, the caller's explicit
+    # decision — the same contract test_health pins for with_info)
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+      python - <<'EOF'
+import numpy as np
+import jax
+import dlaf_tpu.config as C
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+for grid_shape in (None, (2, 2)):
+    x = rng.standard_normal((32, 32))
+    a = x @ x.T + 32 * np.eye(32)
+    grid = Grid(*grid_shape) if grid_shape else None
+    label = "2x2" if grid_shape else "local"
+    # phase 1: NaN sanitizer armed, full run + fetch
+    jax.config.update("jax_debug_nans", True)
+    try:
+        fac = cholesky("L", Matrix.from_global(a, TileElementSize(8, 8),
+                                               grid=grid))
+        l = np.tril(fac.to_numpy())
+    finally:
+        jax.config.update("jax_debug_nans", False)
+    assert np.isfinite(l).all()
+    assert np.allclose(l @ l.T, a, atol=1e-8), abs(l @ l.T - a).max()
+    # phase 2: transfer guard armed — the hot path must not host-sync
+    mat = Matrix.from_global(a, TileElementSize(8, 8), grid=grid)
+    with jax.transfer_guard_device_to_host("disallow"):
+        fac = cholesky("L", mat)
+        jax.block_until_ready(fac.storage)
+    print(f"sanitizer smoke ok: {label} (debug_nans + transfer guard)")
+EOF
+    ;;
   main)
     python -m pytest tests/ -q -m "not slow" ;;
   full)
@@ -215,6 +257,50 @@ EOF
   *)
     echo "usage: ci/run.sh [smoke|main|full]" >&2; exit 2 ;;
 esac
+
+echo "== static analysis gate (jaxpr auditor + convention linter) =="
+# every tier: the graph auditor traces every builder on the 8-virtual-
+# device CPU platform (no compile/exec) and the AST linter walks
+# dlaf_tpu/; any finding not in the committed .analysis_baseline.json
+# fails the tier (docs/static_analysis.md)
+python -m dlaf_tpu.analysis
+
+echo "== static analysis must-trip drills =="
+# like the bench/accuracy gates, the analysis gate must PROVE it can
+# fail: each seeded-bad program must exit SPECIFICALLY 1 with its rule
+# named in the log (exit 3 = the checker lost its teeth; any other exit
+# = a crash masquerading as detection). Deliberately per-drill fresh
+# interpreters — the exit-code contract IS the thing under test; the
+# six processes cost ~45 s total, within every tier's budget
+ANALYSIS_DRILL_LOG=$(mktemp)
+# the drill list comes from the registry itself (--list-drills), so a
+# drill added to analysis/drills.py is automatically exercised here; the
+# CLI prints "drill <name>: tripped [<rules>] as required" only when
+# every expected rule was reported, and exits 3 when a checker lost its
+# teeth — so rc=1 + that line IS the proof, with the rules named
+ANALYSIS_DRILLS=$(python -m dlaf_tpu.analysis --list-drills)
+[ -n "$ANALYSIS_DRILLS" ] || { echo "no analysis drills found" >&2; exit 1; }
+for drill in $ANALYSIS_DRILLS; do
+  drill_rc=0
+  python -m dlaf_tpu.analysis --drill "$drill" \
+    > "$ANALYSIS_DRILL_LOG" 2>&1 || drill_rc=$?
+  if [ "$drill_rc" -ne 1 ] \
+      || ! grep -q "as required" "$ANALYSIS_DRILL_LOG"; then
+    echo "analysis drill $drill did not trip cleanly" \
+         "(rc=$drill_rc, wanted rc=1 + 'tripped ... as required')" >&2
+    cat "$ANALYSIS_DRILL_LOG" >&2; exit 1
+  fi
+  grep "as required" "$ANALYSIS_DRILL_LOG"
+done
+
+echo "== ruff check (style linter; config in pyproject.toml) =="
+if command -v ruff >/dev/null 2>&1; then
+  ruff check .
+else
+  # hermetic CI images may lack ruff; the repo-specific conventions are
+  # still enforced by the dlaf_tpu.analysis gate above
+  echo "ruff not installed in this environment; skipping"
+fi
 
 echo "== driver entry: single-device compile check =="
 python - <<'EOF'

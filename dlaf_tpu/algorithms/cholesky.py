@@ -47,8 +47,7 @@ from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, pad_diag_identity_dyn,
                             transpose_col_to_rows, transpose_row_to_cols,
                             uniform_slot_start)
-from ..matrix.tiling import (storage_tile_grid, tiles_to_global,
-                             global_to_tiles, global_to_tiles_donated,
+from ..matrix.tiling import (storage_tile_grid, global_to_tiles_donated,
                              to_global, quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl

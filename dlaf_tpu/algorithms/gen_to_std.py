@@ -59,8 +59,8 @@ from ..matrix.distribution import assert_slot_aligned
 from ..matrix.matrix import Matrix
 from ..matrix.panel import (DistContext, transpose_col_to_rows,
                             transpose_row_to_cols)
-from ..matrix.tiling import (global_to_tiles, storage_tile_grid,
-                             tiles_to_global, global_to_tiles_donated,
+from ..matrix.tiling import (storage_tile_grid, tiles_to_global,
+                             global_to_tiles_donated,
                              quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops import mixed as mx

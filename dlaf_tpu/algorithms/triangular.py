@@ -40,9 +40,8 @@ from ..matrix.panel import (DistContext, bcast_diag, bcast_diag_dyn, col_panel,
                             pad_diag_identity_dyn, row_panel, row_panel_dyn,
                             transpose_col_to_rows, transpose_row_to_cols,
                             uniform_slot_start)
-from ..matrix.tiling import (global_to_tiles, tiles_to_global,
-                             global_to_tiles_donated, to_global,
-                             quiet_donation, donate_argnums_kw)
+from ..matrix.tiling import (tiles_to_global, global_to_tiles_donated,
+                             to_global, quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..types import telescope_windows, total_ops
 

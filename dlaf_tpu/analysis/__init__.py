@@ -1,0 +1,40 @@
+"""Static-analysis layer: jaxpr graph auditor + repo-convention linter.
+
+The reference DLA-Future leans on compiler-enforced invariants (its
+sender/receiver typing makes a mis-ordered collective a type error);
+the Python/JAX port lost that compiler, and until this package existed
+its hardest guarantees lived in bespoke per-test jaxpr walkers and
+reviewer memory. This layer restores them as machine-checked rules
+(docs/static_analysis.md):
+
+* :mod:`.depgraph` — the shared jaxpr dependency/traversal vocabulary
+  (transitive closures, emission order, collective enumeration,
+  scan-body descent) the structural test pins are written in.
+* :mod:`.graphcheck` — traces every builder (unrolled/scan x local/dist
+  x uplo x knob combos) abstractly on virtual meshes and audits
+  semantic invariants: no conditional (rank-varying) collectives, no
+  host callbacks in hot paths, no silent f64->f32 demotion on the
+  native routes, no dead scan carries / dropped scan outputs, no
+  materialized intermediates blowing past a configurable multiple of
+  the program's input bytes.
+* :mod:`.lint` — an AST convention linter: config knobs must be
+  registered ``Configuration`` fields, traced-code metric mutation must
+  use the guarded trace-time pattern, no ``np.*`` on traced values in
+  the algorithm layers, host syncs (``jax.device_get``/``print``) only
+  at allow-listed sites. ``# dlaf: disable=RULE(reason)`` suppresses a
+  finding on its line — the reason is mandatory.
+* ``python -m dlaf_tpu.analysis`` — the CI gate: runs both, diffs
+  against the committed ``.analysis_baseline.json``, exits 1 on any new
+  finding. ``--drill`` runs the seeded-bad must-trip programs
+  (:mod:`.drills`) that prove the gate can fail.
+
+Import note: this module stays jax-free at import time so the CLI can
+force the virtual CPU device count before jax loads (same constraint as
+tests/conftest.py).
+"""
+
+from .findings import (Finding, diff_baseline, load_baseline,  # noqa: F401
+                       write_baseline)
+
+#: Repo-root-relative path of the committed findings baseline.
+BASELINE_PATH = ".analysis_baseline.json"

@@ -1,0 +1,377 @@
+"""AST-based repo-convention linter (docs/static_analysis.md).
+
+The conventions this repo runs on — knobs are ``Configuration`` fields
+with env/CLI layering, trace-time metric mutation is guarded so programs
+stay zero-cost with metrics off, the algorithm layers never touch
+``np.*`` on traced values, host syncs live only where a host sync is the
+point — were enforced by reviewer memory. This linter makes them
+machine-checked:
+
+``lint-unregistered-knob``
+    A literal ``DLAF_<NAME>`` environment read inside ``dlaf_tpu/``
+    whose ``<name>`` is not a registered ``Configuration`` field: an
+    unlayered side-channel knob that ``--dlaf:`` CLI flags, the struct
+    API, and ``print_config`` cannot see.
+
+``lint-unguarded-traced-metric``
+    Metric mutation (``...counter(...).inc/observe``) in the traced
+    layers (``algorithms/``, ``comm/``, ``eigensolver/``,
+    ``tile_ops/``) in a function with no ``metrics_active()`` guard.
+    The documented trace-time pattern (see ``comm.collectives._record``)
+    keeps instrumented call sites zero-allocation no-ops when metrics
+    are off.
+
+``lint-np-in-traced``
+    ``np.*`` applied to a parameter of a traced function in
+    ``algorithms/``/``eigensolver/`` (functions decorated with
+    ``jax.jit``, or nested defs inside a ``_build_*`` builder — the
+    traced program bodies). Host numpy on traced values either silently
+    constant-folds the tracer era value or raises at trace time;
+    trace-time numpy on *static* index math (builder-level, outside the
+    program body) is fine and not flagged. Dataflow is approximated one
+    hop: only direct uses of the traced function's own parameters are
+    flagged — precise, no false positives, and exactly the shape a
+    refactor accident takes.
+
+``lint-host-sync``
+    ``jax.device_get`` / ``.block_until_ready()`` / ``print()`` outside
+    the allow-listed host-boundary sites (miniapps, obs, sync modules,
+    printing/memory utilities, the tridiag host-control stage, config's
+    ``print_config``). Hot-path library code must stay asynchronous.
+
+``lint-suppression-reason``
+    A ``# dlaf: disable=RULE`` comment with no parenthesized reason:
+    every suppression must say why, or it rots.
+
+Suppression: append ``# dlaf: disable=RULE(reason)`` to the offending
+line (any line of a multi-line statement). The reason is mandatory; the
+comment suppresses only that rule on that line. Only real comment
+tokens count — docstrings and string literals quoting the syntax, like
+this one, are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+#: Paths (posix, repo-root-relative prefixes) where each rule applies.
+TRACED_DIRS = ("dlaf_tpu/algorithms/", "dlaf_tpu/comm/",
+               "dlaf_tpu/eigensolver/", "dlaf_tpu/tile_ops/")
+NP_TRACED_DIRS = ("dlaf_tpu/algorithms/", "dlaf_tpu/eigensolver/")
+
+#: Sites where a host sync IS the contract (drivers print results, sync
+#: modules block by definition, the obs layer is the host boundary, the
+#: tridiag D&C control loop is the documented host-sequential stage —
+#: docs/eigensolver_perf.md).
+HOST_SYNC_ALLOWED = (
+    "dlaf_tpu/miniapp/", "dlaf_tpu/obs/", "dlaf_tpu/config.py",
+    "dlaf_tpu/common/sync.py", "dlaf_tpu/comm/sync.py",
+    "dlaf_tpu/matrix/printing.py", "dlaf_tpu/matrix/memory.py",
+    "dlaf_tpu/eigensolver/tridiag_solver.py",
+    "dlaf_tpu/native/", "dlaf_tpu/tpu_info.py",
+    # the analysis layer itself is a host-side CLI/reporting tool
+    "dlaf_tpu/analysis/",
+)
+
+#: Literal DLAF_* env names that are deliberately NOT Configuration
+#: fields. Keep this list short and justified; prefer an in-code
+#: ``# dlaf: disable=lint-unregistered-knob(reason)`` for one-off test
+#: hooks so the justification sits next to the read.
+NON_KNOB_ENV: Set[str] = set()
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dlaf:\s*disable=([A-Za-z0-9_-]+)\s*(\(([^)]*)\))?")
+
+_ENV_READ_FUNCS = {"get", "setdefault", "pop"}
+
+
+def _config_knob_names() -> Set[str]:
+    """Registered Configuration field names (no jax import needed)."""
+    from dlaf_tpu.config import Configuration
+
+    return {f.name for f in dataclasses.fields(Configuration)}
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+def _attr_chain(node) -> List[str]:
+    """['obs', 'counter'] for ``obs.counter``; [] for non-name chains."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("<expr>")
+    return list(reversed(parts))
+
+
+def _contains_name(node, name: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == name:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == name:
+            return True
+    return False
+
+
+def _is_env_read(call: ast.Call) -> Optional[str]:
+    """The literal env-var name read by this call, if it is one."""
+    chain = _attr_chain(call.func)
+    literal = None
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        literal = call.args[0].value
+    if chain[-2:] in (["environ", f] for f in _ENV_READ_FUNCS) \
+            or chain[-2:] == ["os", "getenv"]:
+        return literal
+    return None
+
+
+def _env_subscript_name(node: ast.Subscript) -> Optional[str]:
+    # Load context only: os.environ["DLAF_X"] = v is a WRITE (propagating
+    # a setting to a child process), not an unregistered-knob read
+    if not isinstance(node.ctx, ast.Load):
+        return None
+    chain = _attr_chain(node.value)
+    if chain[-1:] == ["environ"] and isinstance(node.slice, ast.Constant) \
+            and isinstance(node.slice.value, str):
+        return node.slice.value
+    return None
+
+
+def _decorated_jit(fn: ast.FunctionDef) -> bool:
+    return any(_contains_name(d, "jit") for d in fn.decorator_list)
+
+
+@dataclasses.dataclass
+class _Scope:
+    """Lexical function-nesting info for every AST node."""
+
+    parents: Dict[int, ast.AST]
+
+    def chain(self, node) -> List[ast.FunctionDef]:
+        """Enclosing FunctionDefs, innermost first."""
+        out = []
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parents.get(id(cur))
+        return out
+
+    def traced_function(self, node) -> Optional[ast.FunctionDef]:
+        """The innermost enclosing function whose body is traced: a
+        jit-decorated def, or any def nested inside a ``_build_*``
+        builder (the program bodies the builders return)."""
+        chain = self.chain(node)
+        for i, fn in enumerate(chain):
+            if _decorated_jit(fn):
+                return chain[0]
+            if fn.name.startswith("_build_") and i > 0:
+                # node sits in a def nested inside the builder
+                return chain[0]
+        return None
+
+
+def _parent_map(tree) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+# ---------------------------------------------------------------------------
+# Per-file lint
+# ---------------------------------------------------------------------------
+
+def _suppressions(src: str) -> Tuple[Dict[int, Set[str]], List[Finding]]:
+    """Line -> suppressed rules, plus findings for reason-less ones.
+
+    Scans real COMMENT tokens only (tokenize, not raw lines), so a
+    docstring or string literal QUOTING the suppression syntax is
+    neither a phantom bare-suppression finding nor a silent suppressor.
+    Tokenization errors end the scan early; such files surface as
+    ``lint-syntax-error`` from the AST parse."""
+    import io
+    import tokenize
+
+    by_line: Dict[int, Set[str]] = {}
+    bad: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            for m in _SUPPRESS_RE.finditer(tok.string):
+                rule, reason = m.group(1), (m.group(3) or "").strip()
+                if reason:
+                    by_line.setdefault(tok.start[0], set()).add(rule)
+                else:
+                    bad.append((tok.start[0], rule))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return by_line, bad
+
+
+def lint_source(src: str, path: str) -> List[Finding]:
+    """All lint findings for one file's source. ``path`` must be the
+    repo-root-relative posix path — the rules scope on it."""
+    path = path.replace(os.sep, "/")
+    findings: List[Finding] = []
+    suppressed, bare = _suppressions(src)
+
+    def emit(rule: str, node, message: str, detail: str) -> None:
+        lineno = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", None) or lineno
+        # a multi-line statement is suppressible from any of its lines
+        if any(rule in suppressed.get(ln, ()) for ln in range(lineno, end + 1)):
+            return
+        findings.append(Finding(rule, f"{path}:{lineno}", message,
+                                key_detail=f"{path}|{detail}"))
+
+    for lineno, rule in bare:
+        node = ast.Constant(value=None)
+        node.lineno = lineno
+        emit("lint-suppression-reason", node,
+             f"suppression of {rule} carries no (reason) — say why or "
+             f"remove it", f"bare-suppression|{rule}")
+
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        findings.append(Finding("lint-syntax-error", f"{path}:{e.lineno}",
+                                f"file does not parse: {e.msg}",
+                                key_detail=f"{path}|syntax"))
+        return findings
+
+    scope = _Scope(_parent_map(tree))
+    knobs = _config_knob_names()
+    in_traced_dirs = path.startswith(TRACED_DIRS)
+    in_np_dirs = path.startswith(NP_TRACED_DIRS)
+    host_sync_applies = (path.startswith("dlaf_tpu/")
+                        and not path.startswith(HOST_SYNC_ALLOWED))
+
+    for node in ast.walk(tree):
+        # ---- lint-unregistered-knob ----
+        env_name = None
+        if isinstance(node, ast.Call):
+            env_name = _is_env_read(node)
+        elif isinstance(node, ast.Subscript):
+            env_name = _env_subscript_name(node)
+        if env_name and env_name.startswith("DLAF_") \
+                and env_name not in NON_KNOB_ENV \
+                and env_name[len("DLAF_"):].lower() not in knobs:
+            emit("lint-unregistered-knob", node,
+                 f"env read of {env_name} which is not a registered "
+                 f"Configuration field — unlayered side-channel knob "
+                 f"(register it in dlaf_tpu/config.py or suppress with "
+                 f"a reason)", f"knob|{env_name}")
+
+        if not isinstance(node, ast.Call):
+            continue
+
+        # ---- lint-unguarded-traced-metric ----
+        if in_traced_dirs and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("inc", "observe"):
+            recv = node.func.value
+            is_metric = isinstance(recv, ast.Call) and \
+                _attr_chain(recv.func)[-1:] in (["counter"], ["gauge"],
+                                                ["histogram"])
+            if is_metric:
+                fns = scope.chain(node)
+                guarded = any(_contains_name(fn, "metrics_active")
+                              for fn in fns)
+                if not guarded:
+                    emit("lint-unguarded-traced-metric", node,
+                         "metric mutation in a traced layer without a "
+                         "metrics_active() guard — use the trace-time "
+                         "pattern (comm.collectives._record)",
+                         f"metric|{_attr_chain(recv.func)[-1]}|"
+                         f"{fns[0].name if fns else '<module>'}")
+
+        # ---- lint-np-in-traced ----
+        if in_np_dirs:
+            chain = _attr_chain(node.func)
+            if len(chain) >= 2 and chain[0] == "np":
+                traced = scope.traced_function(node)
+                if traced is not None:
+                    params = {a.arg for a in traced.args.args
+                              + traced.args.posonlyargs
+                              + traced.args.kwonlyargs}
+                    used = {sub.id for arg in node.args
+                            for sub in ast.walk(arg)
+                            if isinstance(sub, ast.Name)}
+                    hit = params & used
+                    if hit:
+                        emit("lint-np-in-traced", node,
+                             f"np.{'.'.join(chain[1:])} applied to traced "
+                             f"parameter(s) {sorted(hit)} of "
+                             f"{traced.name}() — use jnp inside traced "
+                             f"code",
+                             f"np|{traced.name}|{'.'.join(chain[1:])}")
+
+        # ---- lint-host-sync ----
+        if host_sync_applies:
+            chain = _attr_chain(node.func)
+            sync_kind = None
+            if chain[-1:] == ["device_get"]:
+                sync_kind = "jax.device_get"
+            elif chain[-1:] == ["block_until_ready"]:
+                sync_kind = ".block_until_ready()"
+            elif chain == ["print"]:
+                sync_kind = "print"
+            if sync_kind:
+                fns = scope.chain(node)
+                emit("lint-host-sync", node,
+                     f"{sync_kind} outside the allow-listed host-boundary "
+                     f"sites — hot-path library code must stay async "
+                     f"(allowlist in analysis/lint.py, or suppress with "
+                     f"a reason)",
+                     f"sync|{sync_kind}|{fns[0].name if fns else '<module>'}")
+
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Repo walk
+# ---------------------------------------------------------------------------
+
+def iter_py_files(root: str, subdirs: Sequence[str] = ("dlaf_tpu",),
+                  ) -> Iterable[str]:
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run(root: str = ".", subdirs: Sequence[str] = ("dlaf_tpu",),
+        ) -> List[Finding]:
+    """Lint every ``.py`` file under ``root``'s ``subdirs``. An empty
+    walk raises: zero files scanned must never report as a clean gate
+    (a wrong ``--root`` would otherwise silently disable the linter)."""
+    findings: List[Finding] = []
+    paths = list(iter_py_files(root, subdirs))
+    if not paths:
+        raise FileNotFoundError(
+            f"no .py files under {root!r} subdirs {tuple(subdirs)} — "
+            f"wrong --root? the lint gate refuses to pass vacuously")
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, root)
+        findings.extend(lint_source(src, rel))
+    return findings

@@ -17,7 +17,6 @@ in the per-``k`` factorization loops (the loop is unrolled at trace time).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
 
 from ..common.sync import hard_fence
 from ..matrix import memory

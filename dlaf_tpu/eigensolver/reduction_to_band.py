@@ -53,8 +53,7 @@ from ..matrix.panel import (DistContext, gather_col_panel_ordered,
                             gather_sub_panel, gather_sub_panel_dyn,
                             pad_sub_panel_to_tiles, tiles_of_rolled,
                             uniform_slot_start)
-from ..matrix.tiling import (global_to_tiles, storage_tile_grid,
-                             tiles_to_global, global_to_tiles_donated,
+from ..matrix.tiling import (storage_tile_grid, global_to_tiles_donated,
                              to_global, quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops.lapack import larft

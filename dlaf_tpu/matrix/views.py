@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 
 from ..common.asserts import dlaf_assert
-from ..common.index2d import GlobalElementIndex, GlobalTileIndex, TileElementSize
+from ..common.index2d import GlobalElementIndex, GlobalTileIndex
 from ..types import SizeType
 from .distribution import Distribution
 

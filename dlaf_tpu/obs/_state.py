@@ -11,7 +11,6 @@ stay allocation-free when observability is off.
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 #: DLAF_LOG levels, lowest first. "off" silences everything.
 LOG_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40, "off": 99}

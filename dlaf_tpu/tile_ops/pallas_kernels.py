@@ -99,7 +99,9 @@ def supports_pallas_update(dtype, platform: str) -> bool:
 
     dtype_ok = jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
                                     jnp.dtype(jnp.bfloat16))
-    supported = dtype_ok if os.environ.get("DLAF_FORCE_PALLAS_UPDATE") == "1" \
+    supported = dtype_ok if os.environ.get(
+        "DLAF_FORCE_PALLAS_UPDATE"  # dlaf: disable=lint-unregistered-knob(CI/test hook forcing the pallas route on CPU interpret mode; not a user-facing runtime knob)
+    ) == "1" \
         else (platform == "tpu" and dtype_ok)
     if supported:
         from ..health.registry import route_available

@@ -40,7 +40,6 @@ no usable fresh measurements); 2 = usage error.
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import statistics
 import sys

@@ -9,7 +9,7 @@
 # Results land in $OUT (default /tmp/tpu_session_<ts>/).
 
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-/tmp/tpu_session_$(date +%H%M)}
 mkdir -p "$OUT"
 echo "results -> $OUT" >&2

@@ -4,7 +4,7 @@
 # Results land in $OUT (default /tmp/tpu_session2_<ts>/).
 
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-/tmp/tpu_session2_$(date +%H%M)}
 mkdir -p "$OUT"
 # persist every step's XLA programs (hegst/red2band compiles cost minutes;

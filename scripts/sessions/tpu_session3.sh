@@ -7,7 +7,7 @@
 # Results land in $OUT (default /tmp/tpu_session3_<ts>/).
 
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 # default under the repo: a container reset must not eat session logs
 # (round-2 lesson — the git-tracked history survived, a /tmp log did not)
 OUT=${OUT:-$(pwd)/.session3_$(date +%m%d_%H%M)}

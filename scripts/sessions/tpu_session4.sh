@@ -7,7 +7,7 @@
 # Results land in $OUT (default <repo>/.session4_<ts>/).
 
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 # default under the repo: a container reset must not eat session logs
 OUT=${OUT:-$(pwd)/.session4_$(date +%m%d_%H%M)}
 mkdir -p "$OUT"

@@ -6,7 +6,7 @@
 # an on-device bit-compare discriminate MXU-accumulation error from
 # route-independent platform error). Armed on scripts/tpu_watch.sh.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4b_$(date +%m%d_%H%M)}
 mkdir -p "$OUT"
 export DLAF_COMPILATION_CACHE_DIR="$(pwd)/.jax_cache"

@@ -10,7 +10,7 @@
 # carries --check-result last: a first-ever hardware number without a
 # residual is not a number.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4c_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

@@ -8,12 +8,12 @@
 # short certain wins first, the long rehearsal last so a mid-window
 # wedge costs the least.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4d_$(date +%m%d_%H%M)}
 export OUT
 # the 4c ladder shares this OUT; suppress its summary — session_summary
 # must run exactly once per directory (it appends duplicates on re-run)
-SKIP_SUMMARY=1 bash scripts/tpu_session4c.sh
+SKIP_SUMMARY=1 bash scripts/sessions/tpu_session4c.sh
 
 source "$(dirname "$0")/session_lib.sh"
 

@@ -16,7 +16,7 @@
 # 4. HEGST d/16384 twosolve — config-#3-family scaling point on the
 #    measured-winning form (385 GF/s at 8192).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4e_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

@@ -15,7 +15,7 @@
 # 4. N=12288 config #1 post-fix — re-pin the measured single-chip
 #    ceiling point (188.9 GF/s pre-fix) at true f64 grade.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4f_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

@@ -13,7 +13,7 @@
 # 4. HEGST d/16384 twosolve donated — 4f runtime-OOMed pre-donation;
 #    twosolve now consumes ah/x at each solve and B at the factor.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4g_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

@@ -11,7 +11,7 @@
 # 5. eigensolver 8192 rehearsal re-pin (donation now rides the
 #    dominant red2band stage; 158.5 s pre-donation).
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session4h_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

@@ -4,6 +4,6 @@
 # leftovers). Each session's run() helper re-probes health before every
 # arm, so a mid-chain wedge skips cleanly instead of hanging.
 set -u
-cd "$(dirname "$0")/.."
-OUT="$(pwd)/.session5a_live" bash scripts/tpu_session5a.sh
-OUT="$(pwd)/.session5b_live" bash scripts/tpu_session5b.sh
+cd "$(dirname "$0")/../.."
+OUT="$(pwd)/.session5a_live" bash scripts/sessions/tpu_session5a.sh
+OUT="$(pwd)/.session5b_live" bash scripts/sessions/tpu_session5b.sh

@@ -18,7 +18,7 @@
 # 8. eigensolver 8192 with phase table (verdict item 4).
 # 9. compile frontier nt=64/128 (verdict item 5) — heavyweight, last.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session5a_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

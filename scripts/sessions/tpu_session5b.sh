@@ -15,7 +15,7 @@
 # 4-6. 4h leftovers: red2band 12288 + HEGST d/12288 twosolve (first
 #    >8192 family points), TRSM 8192 re-pin under donate_b.
 set -u
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/../.."
 OUT=${OUT:-$(pwd)/.session5b_$(date +%m%d_%H%M)}
 source "$(dirname "$0")/session_lib.sh"
 

@@ -1,17 +1,17 @@
 #!/usr/bin/env bash
 # Tunnel watcher: probe the accelerator every PERIOD seconds; the moment a
-# probe succeeds, run the one-shot measurement session (scripts/tpu_session.sh)
+# probe succeeds, run the one-shot measurement session (scripts/sessions/tpu_session.sh)
 # and exit. The v5e tunnel has shown short healthy windows between long
 # wedges (docs/BENCH_LOG_r2.md); this catches the next window unattended.
 #
 #   OUT=/tmp/tpu_session_X PERIOD=600 MAX_HOURS=10 \
-#     SESSION=scripts/tpu_session2.sh bash scripts/tpu_watch.sh
+#     SESSION=scripts/sessions/tpu_session2.sh bash scripts/tpu_watch.sh
 
 set -u
 cd "$(dirname "$0")/.."
 PERIOD=${PERIOD:-600}
 MAX_HOURS=${MAX_HOURS:-10}
-SESSION=${SESSION:-scripts/tpu_session.sh}
+SESSION=${SESSION:-scripts/sessions/tpu_session.sh}
 [ -f "$SESSION" ] || { echo "SESSION $SESSION: no such file" >&2; exit 1; }
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 

@@ -99,6 +99,8 @@ _QUICK_TESTS = {
     ("test_telemetry.py", "test_bench_gate_committed_history_replays_clean"),
     ("test_accuracy.py", "test_probe_within_variance_bound"),
     ("test_accuracy.py", "test_gate_legs"),
+    ("test_analysis.py", "test_drills_trip_their_rules"),
+    ("test_analysis.py", "test_lint_repo_is_clean"),
 }
 
 

@@ -25,12 +25,12 @@ import pytest
 import dlaf_tpu.config as C
 from dlaf_tpu import obs
 from dlaf_tpu.algorithms.cholesky import cholesky
-from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+from dlaf_tpu.common.index2d import TileElementSize
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.matrix.matrix import Matrix
 from dlaf_tpu.obs import accuracy
 from dlaf_tpu.obs.sinks import (append_history_line, read_history_records,
-                                validate_file, validate_records)
+                                validate_records)
 
 SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "scripts")

@@ -402,10 +402,10 @@ def test_lookahead_breaks_serial_chain():
     transitively depend on step k's bulk trailing product, while the
     serialized program's potrf must. Checked on the traced jaxpr of the
     local biggemm form (bulk product = the (m-w, m-w)/(m, m) trailing
-    dot), which is exactly the dependency XLA's scheduler sees."""
-    import jax
-
+    dot), which is exactly the dependency XLA's scheduler sees — via the
+    shared walker vocabulary in dlaf_tpu.analysis.depgraph."""
     from dlaf_tpu.algorithms.cholesky import _cholesky_local
+    from dlaf_tpu.analysis import depgraph
 
     import jax.numpy as jnp
 
@@ -413,36 +413,21 @@ def test_lookahead_breaks_serial_chain():
     a = jnp.asarray(hpd_matrix(n, np.float64, seed=3))
 
     def deps_of_second_potrf(lookahead):
-        jaxpr = jax.make_jaxpr(
+        eqns = depgraph.trace(
             lambda x: _cholesky_local.__wrapped__(
                 x, uplo="L", nb=nb, trailing="biggemm",
-                lookahead=lookahead))(a).jaxpr
-        producers = {}
-        for eq in jaxpr.eqns:
-            for v in eq.outvars:
-                producers[v] = eq
-        chol_eqns = [eq for eq in jaxpr.eqns
-                     if eq.primitive.name == "cholesky"]
-        assert len(chol_eqns) == 3, [e.primitive.name for e in jaxpr.eqns]
-        # transitive producer closure of the SECOND potrf's inputs
-        seen, todo = set(), list(chol_eqns[1].invars)
-        closure = []
-        while todo:
-            v = todo.pop()
-            eq = producers.get(v)
-            if eq is None or id(eq) in seen:
-                continue
-            seen.add(id(eq))
-            closure.append(eq)
-            todo.extend(v2 for v2 in eq.invars
-                        if not isinstance(v2, jax.core.Literal))
+                lookahead=lookahead), a).jaxpr.eqns
+        chol = depgraph.positions(eqns, "cholesky")
+        assert len(chol) == 3, [e.primitive.name for e in eqns]
         # step 0's bulk trailing product: a dot_general with a square
         # output of the trailing(-rest) extent. w=8, m=16: rest is (8,8)
         # under lookahead, full (16,16) without.
         bulk_shapes = {(16, 16)} if not lookahead else {(8, 8)}
-        return any(eq.primitive.name == "dot_general"
-                   and tuple(eq.outvars[0].aval.shape) in bulk_shapes
-                   for eq in closure)
+        # transitive producer closure of the SECOND potrf's inputs
+        return depgraph.depends_on(
+            eqns, chol[1],
+            lambda e: (e.primitive.name == "dot_general"
+                       and tuple(e.outvars[0].aval.shape) in bulk_shapes))
 
     assert deps_of_second_potrf(lookahead=False), \
         "serialized form lost its bulk dependency — test is stale"

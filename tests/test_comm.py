@@ -5,7 +5,6 @@ all_reduce / p2p at several grid shapes and both rank orderings,
 ``grids_6_ranks.h``) using shard_map over virtual devices.
 """
 
-import functools
 
 import numpy as np
 import pytest

@@ -11,16 +11,18 @@ don't live in their own test files (cholesky/trsm knob pins are in
 test_cholesky.py / test_triangular.py) ride along here.
 
 All checks run on traced jaxprs over the 8-device CPU mesh — no
-compilation, no execution.
+compilation, no execution. The walking itself lives in
+``dlaf_tpu.analysis.depgraph`` (shared with the ``graphcheck`` auditor);
+this file only keeps the builder-specific predicates and assertions.
 """
 
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 import dlaf_tpu.config as config
+from dlaf_tpu.analysis import depgraph
 from dlaf_tpu.comm.grid import Grid
 from dlaf_tpu.common.index2d import TileElementSize
 from dlaf_tpu.matrix.matrix import Matrix
@@ -31,61 +33,30 @@ def _mat(a, nb, grid):
                               grid=grid)
 
 
-def _inner_eqns(fn, *args):
-    """Equations inside the builder's shard_map body."""
-    jaxpr = jax.make_jaxpr(fn)(*args)
-    [eq] = [e for e in jaxpr.jaxpr.eqns
-            if "shard_map" in e.primitive.name]
-    inner = eq.params["jaxpr"]
-    return getattr(inner, "eqns", None) or inner.jaxpr.eqns
+#: Equations inside the builder's shard_map body.
+_inner_eqns = depgraph.shard_map_body
 
+#: Body equations of the FIRST lax.scan among the eqns.
+_scan_body_eqns = depgraph.scan_body
 
-def _scan_body_eqns(eqns):
-    """Body equations of the FIRST lax.scan among ``eqns``."""
-    scans = [e for e in eqns if e.primitive.name == "scan"]
-    assert scans, "no scan in traced program"
-    return scans[0].params["jaxpr"].jaxpr.eqns
+_closure = depgraph.closure
 
-
-def _closure(eqns, seed_invars):
-    """Transitive producer closure of ``seed_invars`` within ``eqns``."""
-    producers = {}
-    for e in eqns:
-        for v in e.outvars:
-            producers[v] = e
-    seen, todo, out = set(), list(seed_invars), []
-    while todo:
-        v = todo.pop()
-        if isinstance(v, jax.core.Literal):
-            continue
-        e = producers.get(v)
-        if e is None or id(e) in seen:
-            continue
-        seen.add(id(e))
-        out.append(e)
-        todo.extend(e.invars)
-    return out
-
-
-def _is_bulk_dot(e):
-    """The bulk trailing product of every dist builder under test is the
-    only dot_general with a 4D (tile-pair grid) output; panel solves,
-    strips and W/M products are <= 3D."""
-    return (e.primitive.name == "dot_general"
-            and len(e.outvars[0].aval.shape) == 4)
+#: The bulk trailing product of every dist builder under test is the only
+#: dot_general with a 4D (tile-pair grid) output; panel solves, strips
+#: and W/M products are <= 3D (depgraph.is_bulk_dot's default).
+_is_bulk_dot = depgraph.is_bulk_dot
 
 
 def _ag_positions(eqns):
-    return [i for i, e in enumerate(eqns)
-            if e.primitive.name == "all_gather"]
+    return depgraph.positions(eqns, "all_gather")
 
 
 def _bulk_positions(eqns):
-    return [i for i, e in enumerate(eqns) if _is_bulk_dot(e)]
+    return depgraph.positions(eqns, _is_bulk_dot)
 
 
 def _depends_on_bulk(eqns, idx):
-    return any(_is_bulk_dot(e) for e in _closure(eqns, eqns[idx].invars))
+    return depgraph.depends_on(eqns, idx, _is_bulk_dot)
 
 
 # ---------------------------------------------------------------------------

@@ -9,7 +9,6 @@ emit per-step records whose derived GFlop/s is finite, locally and —
 with collective byte counters — on a 2x2 grid.
 """
 
-import json
 import math
 import os
 

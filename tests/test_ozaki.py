@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 import jax.numpy as jnp
-from jax import lax
 
 from dlaf_tpu.tile_ops.ozaki import matmul_f64, syrk_f64
 from dlaf_tpu.tile_ops.mixed import potrf_refined, tri_inv_refined

@@ -12,7 +12,6 @@ semantics (zero-tail columns -> tau = 0), and the config wire-in
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from dlaf_tpu.tile_ops.qr_panel import (householder_qr, panel_qr,

@@ -7,7 +7,6 @@ rectangular blocks, batched forms.
 
 import numpy as np
 import pytest
-import scipy.linalg as sla
 
 import jax.numpy as jnp
 
