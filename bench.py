@@ -103,7 +103,69 @@ def _cache_dir() -> str:
 #: "auto" cannot blur the A/B; results carry a "workload" field so they
 #: never take the cholesky headline. The mfu table's stage rows read
 #: these labels (scripts/mfu_table.py _FAMILIES).
-STAGE_BASES = ("tridiag", "btr2b", "btb2t")
+#: "fpanel" (ISSUE 10): the fused-Pallas-panel A/B arm — an f32 local
+#: cholesky pair ("fpanel" pins DLAF_PANEL_IMPL=xla via env so the TPU
+#: auto can't blur the comparison, "fpanel+fp1" pins fused; same
+#: discipline as the "+la1"/comm arms). Sized off-TPU via
+#: DLAF_BENCH_FPANEL_N (the fused kernels run in interpret mode there).
+STAGE_BASES = ("tridiag", "btr2b", "btb2t", "fpanel")
+
+
+def _run_fpanel_variant(variant: str, platform: str) -> None:
+    """Measure one fused-panel A/B arm (f32 local cholesky; the knob was
+    pinned by the caller): same artifact/stdout protocol as the other
+    arms, ``workload="fpanel"`` so the cholesky headline (a different
+    dtype + flop tier) never picks it up. Off-TPU the fused route runs
+    the kernels in interpret mode — tiny N keeps that inside the sweep
+    budget while still exercising the full routed program."""
+    import dlaf_tpu.config as config
+    from dlaf_tpu.algorithms.cholesky import cholesky
+    from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.miniapp.generators import hpd_element_fn
+    from dlaf_tpu.types import total_ops
+
+    n = int(os.environ.get("DLAF_BENCH_FPANEL_N") or
+            (os.environ.get("DLAF_BENCH_N", "4096")
+             if platform == "tpu" else "256"))
+    nb = min(int(os.environ.get("DLAF_BENCH_NB", "256")),
+             max(n // 4, 32))    # keep a real multi-step panel chain
+    log(f"[{variant}] fused-panel arm on {platform}: n={n} nb={nb} "
+        f"panel_impl={config.get_configuration().panel_impl}")
+    ref = Matrix.from_element_fn(hpd_element_fn(n, np.float32),
+                                 GlobalElementSize(n, n),
+                                 TileElementSize(nb, nb), dtype=np.float32)
+    flops = total_ops(np.float32, n**3 / 6, n**3 / 6)
+
+    def measure():
+        mat = ref.with_storage(ref.storage + 0)
+        return cholesky("L", mat, donate=True).storage
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from measure_common import append_history, best_time
+
+    best_t, last = best_time(measure, reps=3, return_last=True)
+    best_g = flops / best_t / 1e9
+    log(f"[{variant}] best of 3: {best_t:.4f}s {best_g:.1f} GFlop/s")
+    line = append_history(platform, n, nb, best_g, best_t,
+                          source="bench.py", variant=variant,
+                          dtype="float32", donate=True, workload="fpanel")
+    from dlaf_tpu import obs
+    from dlaf_tpu.obs import accuracy
+
+    if accuracy.enabled():
+        # paired accuracy record like every timed arm (docs/accuracy.md):
+        # a wrong fused-kernel ladder shows up as a bound_ratio jump
+        # right next to its GFlop/s number
+        out = ref.with_storage(last)
+        value = accuracy.cholesky_residual("L", ref, out)
+        accuracy.emit("bench", "cholesky_residual", value, n=n, nb=nb,
+                      c=60.0, dtype=np.float32, of=last,
+                      attrs={"variant": variant})
+    obs.emit_event("bench_result", payload=line)
+    obs.flush()
+    print(json.dumps(line), flush=True)
 
 
 def _run_stage_variant(variant: str, base: str, mods: set) -> None:
@@ -119,8 +181,14 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
                           "1" if "dcb1" in mods else "0")
     os.environ.setdefault("DLAF_BT_LOOKAHEAD",
                           "1" if "btla1" in mods else "0")
+    if base == "fpanel":
+        os.environ.setdefault("DLAF_PANEL_IMPL",
+                              "fused" if "fp1" in mods else "xla")
     config.initialize()
     platform = jax.devices()[0].platform
+    if base == "fpanel":
+        _run_fpanel_variant(variant, platform)
+        return
     # stage arms default to a smaller N off-TPU: the local red2band that
     # feeds the bt arm compiles per-panel, and the CPU fallback sweep's
     # budget belongs to the headline arms
@@ -483,9 +551,12 @@ def sweep(platform: str) -> None:
     # bt_lookahead — ISSUE 6) run LAST: the headline cholesky sweep owns
     # the budget, and the stage pairs are informational artifact rows
     ab_arm = "ozaki_dots" if platform == "tpu" else "ozaki_concat"
+    # the fused-panel pair (ISSUE 10) rides after the stage arms: f32,
+    # its own workload label, plain arm pinned to panel_impl=xla
     order = ["ozaki", "ozaki+la1", ab_arm, "xla", "scan", "scan+la1",
              "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm",
-             "tridiag", "tridiag+dcb1", "btr2b", "btr2b+btla1", "btb2t"]
+             "tridiag", "tridiag+dcb1", "btr2b", "btr2b+btla1", "btb2t",
+             "fpanel", "fpanel+fp1"]
 
     def _known(v):
         b = v[: -len("+la1")] if v.endswith("+la1") else v

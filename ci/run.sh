@@ -143,6 +143,121 @@ obs.flush()
 EOF
     python -m dlaf_tpu.obs.validate "$HEALTH_ART" \
       --require-spans --require-retries --require-fallbacks
+    echo "== smoke: fused Pallas panel route (panel_impl=fused) =="
+    # tiny local + 2x2-distributed f32 cholesky on the FUSED panel route
+    # (off-TPU the kernels run in interpret mode, docs/pallas_panel.md);
+    # the artifact must carry the trace-time
+    # dlaf_panel_kernel_total{impl="fused"} counters AND a finite
+    # accuracy record next to them
+    PANEL_ART=$(mktemp -d)/panel_metrics.jsonl
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+      DLAF_METRICS_PATH="$PANEL_ART" DLAF_PANEL_IMPL=fused DLAF_ACCURACY=1 \
+      python - <<'EOF'
+import numpy as np
+import scipy.linalg as sla
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.obs import accuracy
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((64, 64)).astype(np.float32)
+a = x @ x.T + 64 * np.eye(64, dtype=np.float32)
+ref = sla.cholesky(a, lower=True)
+for grid_shape in (None, (2, 2)):
+    grid = Grid(*grid_shape) if grid_shape else None
+    mat = Matrix.from_global(a, TileElementSize(16, 16), grid=grid)
+    fac = cholesky("L", mat)
+    rel = abs(np.tril(fac.to_numpy()) - ref).max() / abs(ref).max()
+    assert rel < 1e-5, rel
+    accuracy.emit("ci_panel", "cholesky_residual",
+                  accuracy.cholesky_residual(
+                      "L", Matrix.from_global(a, TileElementSize(16, 16),
+                                              grid=grid), fac),
+                  n=64, nb=16, c=60.0, dtype=np.float32, of=fac.storage)
+fused = obs.registry().counter("dlaf_panel_kernel_total", impl="fused",
+                               op="potrf").snapshot()
+assert fused["value"] >= 8, fused   # 4 steps x (local + dist)
+print("fused panel smoke ok:", fused)
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$PANEL_ART" --require-accuracy
+    python - "$PANEL_ART" <<'EOF'
+import json, sys
+recs = [json.loads(line) for line in open(sys.argv[1])]
+mets = [m for r in recs if r.get("type") == "metrics"
+        for m in r["metrics"]]
+fused = [m for m in mets if m["name"] == "dlaf_panel_kernel_total"
+         and m["labels"].get("impl") == "fused"]
+assert fused and all(m["value"] > 0 for m in fused), fused
+print(f"panel artifact ok: {len(fused)} fused kernel counter series")
+EOF
+    echo "== smoke: disable_pallas must-trip drill (panel route) =="
+    # non-strict leg: the injected pallas-off must COUNT the degradation
+    # at site=panel and once-announce it; strict leg: the same injection
+    # must exit SPECIFICALLY 1 with DegradationError named (any other
+    # exit = a crash masquerading as detection — PR 8/9 drill contract)
+    PANEL_DRILL_LOG=$(mktemp)
+    drill0_rc=0
+    # metrics must be armed or the fallback counter is a no-op singleton
+    DLAF_PANEL_IMPL=fused DLAF_METRICS_PATH=$(mktemp -d)/panel_drill.jsonl \
+      python - > "$PANEL_DRILL_LOG" 2>&1 <<'EOF' || drill0_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.health import inject
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((32, 32)).astype(np.float32)
+a = x @ x.T + 32 * np.eye(32, dtype=np.float32)
+with inject.disable_pallas():
+    cholesky("L", Matrix.from_global(a, TileElementSize(8, 8)))
+c = obs.registry().counter("dlaf_fallback_total", site="panel",
+                           reason="injected_off").snapshot()
+assert c["value"] >= 1, c
+print("panel fallback counted:", c)
+EOF
+    if [ "$drill0_rc" -ne 0 ] \
+        || ! grep -q "panel fallback counted" "$PANEL_DRILL_LOG"; then
+      echo "panel fallback counter leg failed (rc=$drill0_rc)" >&2
+      cat "$PANEL_DRILL_LOG" >&2; exit 1
+    fi
+    grep -q "degraded path at 'panel'" "$PANEL_DRILL_LOG" || {
+      echo "panel degradation was not once-announced" >&2
+      cat "$PANEL_DRILL_LOG" >&2; exit 1; }
+    drill_rc=0
+    DLAF_PANEL_IMPL=fused DLAF_STRICT=1 python - > "$PANEL_DRILL_LOG" 2>&1 \
+      <<'EOF' || drill_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.health import inject
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((32, 32)).astype(np.float32)
+a = x @ x.T + 32 * np.eye(32, dtype=np.float32)
+with inject.disable_pallas():
+    cholesky("L", Matrix.from_global(a, TileElementSize(8, 8)))
+raise SystemExit(3)   # reaching here = the strict raise never fired
+EOF
+    if [ "$drill_rc" -ne 1 ] \
+        || ! grep -q "DegradationError" "$PANEL_DRILL_LOG"; then
+      echo "disable_pallas panel drill did not trip cleanly" \
+           "(rc=$drill_rc, wanted rc=1 + DegradationError)" >&2
+      cat "$PANEL_DRILL_LOG" >&2; exit 1
+    fi
+    echo "disable_pallas panel drill tripped as required (DegradationError)"
     echo "== smoke: eigensolver pipeline (batched D&C + pipelined bt) =="
     # distributed eigensolver on a 2x2 virtual-CPU grid with the two
     # ISSUE-6 knobs pinned ON (the CPU auto would resolve both off): the

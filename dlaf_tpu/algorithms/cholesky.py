@@ -53,6 +53,7 @@ from ..tile_ops import blas as tb
 from ..tile_ops import lapack as tl
 from ..tile_ops import mixed as mx
 from ..tile_ops import ozaki as oz
+from ..tile_ops import pallas_panel as ppan
 from ..tile_ops.pallas_kernels import masked_trailing_update, supports_pallas_update
 from ..types import ceil_div, telescope_segments, telescope_windows, total_ops
 
@@ -97,10 +98,13 @@ def _count_step_modes(algo: str, overlapped: int, serialized: int) -> None:
 
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing",
-                                             "lookahead", "with_info"),
+                                             "lookahead", "with_info",
+                                             "panel_fused",
+                                             "panel_interpret"),
                    donate_argnums=0)
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
-                    lookahead: bool = False, with_info: bool = False):
+                    lookahead: bool = False, with_info: bool = False,
+                    panel_fused: bool = False, panel_interpret: bool = False):
     n = a.shape[0]
     # "ozaki": route the flops-dominant trailing update through int8 MXU
     # passes (tile_ops.ozaki) — f64 and complex128 (4-real-product form);
@@ -157,13 +161,20 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
             # tile_ops.mixed): emulated-f64 potrf/trsm are the wall-clock
             # bottleneck on TPU, not the trailing flops. The fused form
             # shares the f32 seed solves between factor and inverse — one
-            # f32 cholesky + one f32 solve per step instead of two solves
+            # f32 cholesky + one f32 solve per step instead of two solves.
+            # Counted under impl="xla" like every non-fused panel kernel
+            # (the mixed form is still an XLA op chain)
+            ppan.count_panel_kernel("xla", "potrf")
             fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
             other = "U" if uplo == "L" else "L"
             diag = fac + tb.tri_mask(blk, other, k=-1)
         else:
+            # panel_impl route (docs/pallas_panel.md): the fused Pallas
+            # potrf collapses XLA's blocked-cholesky thunk chain into one
+            # VMEM-resident kernel; "xla" keeps tl.potrf
             fac_inv = None
-            diag = tl.potrf(uplo, blk)
+            diag = ppan.panel_potrf(uplo, blk, fused=panel_fused,
+                                  interpret=panel_interpret)
         a = a.at[k0:k1, k0:k1].set(diag)
         if k1 == n:
             break
@@ -179,13 +190,20 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
                 # the panel solve is one gemm instead of an emulated trsm;
                 # the gemm itself rides the int8 MXU path like the trailing
                 # update (native emulated-f64 gemm is ~3x slower)
+                ppan.count_panel_kernel("xla", "solve")
                 panel = tb.mm_mxu(colsrc, jnp.conj(fac_inv).T)
             elif trailing == "invgemm":
+                ppan.count_panel_kernel("xla", "solve")
                 # explicit small triangular inverse, panel formed on the MXU
                 dinv = tb.trsm("L", "L", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
                 panel = colsrc @ jnp.conj(dinv).T
+            elif panel_fused:
+                # one grid-batched Pallas kernel for the whole strip
+                panel = ppan.panel_solve("R", "L", "C", "N", diag, colsrc,
+                                       fused=True, interpret=panel_interpret)
             else:
+                ppan.count_panel_kernel("xla", "solve")
                 panel = tb.trsm("R", "L", "C", "N", diag, colsrc)
             a = a.at[k1:, k0:k1].set(panel)
             la = None
@@ -248,12 +266,18 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
             # upper: A = U^H U; panel is a block row
             rowsrc = a[k0:k1, k1:] if la is None else la[1]
             if use_oz:
+                ppan.count_panel_kernel("xla", "solve")
                 panel = tb.mm_mxu(jnp.conj(fac_inv).T, rowsrc)
             elif trailing == "invgemm":
+                ppan.count_panel_kernel("xla", "solve")
                 dinv = tb.trsm("L", "U", "N", "N", diag,
                                jnp.eye(k1 - k0, dtype=a.dtype))
                 panel = jnp.conj(dinv).T @ rowsrc
+            elif panel_fused:
+                panel = ppan.panel_solve("L", "U", "C", "N", diag, rowsrc,
+                                       fused=True, interpret=panel_interpret)
             else:
+                ppan.count_panel_kernel("xla", "solve")
                 panel = tb.trsm("L", "U", "C", "N", diag, rowsrc)
             a = a.at[k0:k1, k1:].set(panel)
             la = None
@@ -308,11 +332,13 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
                                              "use_mixed", "lookahead",
-                                             "with_info"),
+                                             "with_info", "panel_fused",
+                                             "panel_interpret"),
                    donate_argnums=0)
 def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                          use_mixed: bool = False, lookahead: bool = False,
-                         with_info: bool = False):
+                         with_info: bool = False, panel_fused: bool = False,
+                         panel_interpret: bool = False):
     """``lax.scan`` formulation of the local factorization: ONE compiled
     step body, looped ``nt`` times with uniform full-size shapes.
 
@@ -356,19 +382,27 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
             k0 = k * nb
             blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
             if use_mixed:
+                ppan.count_panel_kernel("xla", "potrf")
                 fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
                 diag = fac + tb.tri_mask(blk, other, k=-1)
             else:
                 fac_inv = None
-                diag = tl.potrf(uplo, blk)
+                diag = ppan.panel_potrf(uplo, blk, fused=panel_fused,
+                                      interpret=panel_interpret)
             acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
             below = rows >= k0 + nb      # (m,) rows/cols past the pivot
             if uplo == "L":
                 col = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
                 if use_mixed:
+                    ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(col, inv_t) if use_mxu else col @ inv_t
+                elif panel_fused:
+                    pfull = ppan.panel_solve("R", "L", "C", "N", diag, col,
+                                           fused=True,
+                                           interpret=panel_interpret)
                 else:
+                    ppan.count_panel_kernel("xla", "solve")
                     pfull = tb.trsm("R", "L", "C", "N", diag, col)
                 panel = jnp.where(below[:, None], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
@@ -386,9 +420,15 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
             else:
                 row = jax.lax.dynamic_slice(acc, (k0, 0), (nb, m))
                 if use_mixed:
+                    ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(inv_t, row) if use_mxu else inv_t @ row
+                elif panel_fused:
+                    pfull = ppan.panel_solve("L", "U", "C", "N", diag, row,
+                                           fused=True,
+                                           interpret=panel_interpret)
                 else:
+                    ppan.count_panel_kernel("xla", "solve")
                     pfull = tb.trsm("L", "U", "C", "N", diag, row)
                 panel = jnp.where(below[None, :], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
@@ -432,11 +472,13 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
             k0 = k * nb
             blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
             if use_mixed:
+                ppan.count_panel_kernel("xla", "potrf")
                 fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
                 diag = fac + tb.tri_mask(blk, other, k=-1)
             else:
                 fac_inv = None
-                diag = tl.potrf(uplo, blk)
+                diag = ppan.panel_potrf(uplo, blk, fused=panel_fused,
+                                      interpret=panel_interpret)
             acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
             below = rows >= k0 + nb
             tri = (rows[:, None] >= rows[None, :] if uplo == "L"
@@ -445,9 +487,15 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
             if uplo == "L":
                 col = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
                 if use_mixed:
+                    ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(col, inv_t) if use_mxu else col @ inv_t
+                elif panel_fused:
+                    pfull = ppan.panel_solve("R", "L", "C", "N", diag, col,
+                                           fused=True,
+                                           interpret=panel_interpret)
                 else:
+                    ppan.count_panel_kernel("xla", "solve")
                     pfull = tb.trsm("R", "L", "C", "N", diag, col)
                 panel = jnp.where(below[:, None], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
@@ -470,9 +518,15 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
             else:
                 row = jax.lax.dynamic_slice(acc, (k0, 0), (nb, m))
                 if use_mixed:
+                    ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(inv_t, row) if use_mxu else inv_t @ row
+                elif panel_fused:
+                    pfull = ppan.panel_solve("L", "U", "C", "N", diag, row,
+                                           fused=True,
+                                           interpret=panel_interpret)
                 else:
+                    ppan.count_panel_kernel("xla", "solve")
                     pfull = tb.trsm("L", "U", "C", "N", diag, row)
                 panel = jnp.where(below[None, :], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
@@ -562,7 +616,7 @@ def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
 def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                          use_mxu=False, use_mixed=False, cplx=False,
                          use_oz_pallas=False, lookahead=False,
-                         comm_la=False, with_info=False):
+                         comm_la=False, with_info=False, panel_fused=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
@@ -657,11 +711,15 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         # each step pays one f32 cholesky + ONE f32 solve, not two)
         lkk_inv = None
         if use_mixed:
+            ppan.count_panel_kernel("xla", "potrf")
             other = "U" if uplo == "L" else "L"
             fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
             lkk = fac + tb.tri_mask(diag, other, k=-1)
         else:
-            lkk = tl.potrf(uplo, diag)
+            # panel_impl route (docs/pallas_panel.md): fused VMEM potrf
+            # kernel or XLA's blocked-cholesky thunk chain
+            lkk = ppan.panel_potrf(uplo, diag, fused=panel_fused,
+                                 interpret=pallas_interpret)
         if k == nt - 1:
             return lkk, None, None, None
 
@@ -678,8 +736,10 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             # ranks' carried tiles are stale pre-bulk values, but every
             # use of `pan` is gated by the owner-column keep/bcast masks)
             colsrc = lt[lu_r:, kc] if la is None else la[0][lu_r - la[1]:]
-            pan = tb.trsm_panel("R", "L", "C", "N", lkk, colsrc,
-                                inv_a=lkk_inv)
+            pan = ppan.panel_solve("R", "L", "C", "N", lkk, colsrc,
+                                 fused=panel_fused,
+                                 interpret=pallas_interpret,
+                                 inv_a=lkk_inv)
             pan = jnp.where(row_valid[:, None, None], pan,
                             jnp.zeros_like(pan))
             # -- panel broadcast (reference broadcast_panel.h:101-193) ---
@@ -703,8 +763,9 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
         rowsrc = lt[kr, lu_c:] if la is None else la[0][lu_c - la[1]:]
-        pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowsrc,
-                            inv_a=lkk_inv)
+        pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowsrc,
+                             fused=panel_fused, interpret=pallas_interpret,
+                             inv_a=lkk_inv)
         pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
         # col-wise down the mesh, then all_gather along the column axis
         # to index the transposed panel by local rows
@@ -994,7 +1055,8 @@ def _dist_factor_info(lt, dist):
 def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                               use_mixed=False, cplx=False,
                               use_oz_pallas=False, pallas_interpret=False,
-                              lookahead=False, with_info=False):
+                              lookahead=False, with_info=False,
+                              panel_fused=False):
     """``lax.scan`` form of the distributed factorization: ONE compiled
     step body looped ``nt`` times inside the ``shard_map``.
 
@@ -1043,12 +1105,14 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             pad = jnp.arange(mb) >= ts   # short-edge mask
             diag = pad_diag_identity_dyn(diag, ts)
             if use_mixed:
+                ppan.count_panel_kernel("xla", "potrf")
                 other = "U" if uplo == "L" else "L"
                 fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
                 lkk = fac + tb.tri_mask(diag, other, k=-1)
             else:
                 lkk_inv = None
-                lkk = tl.potrf(uplo, diag)
+                lkk = ppan.panel_potrf(uplo, diag, fused=panel_fused,
+                                     interpret=pallas_interpret)
             # un-pad: the written diagonal tile keeps stored edge zeros
             lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
             upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
@@ -1064,8 +1128,10 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                 # -- panel trsm over the segment's local row slots -------
                 colk = jax.lax.dynamic_slice(
                     lt, (0, kc, 0, 0), (ltr_s, 1, mb, mb))[:, 0]
-                pan = tb.trsm_panel("R", "L", "C", "N", lkk, colk,
-                                    inv_a=lkk_inv)
+                pan = ppan.panel_solve("R", "L", "C", "N", lkk, colk,
+                                     fused=panel_fused,
+                                     interpret=pallas_interpret,
+                                     inv_a=lkk_inv)
                 pan = jnp.where(row_valid[:, None, None], pan, 0)
                 keep = (is_owner_c & row_valid)[:, None, None]
                 lt = jax.lax.dynamic_update_slice(
@@ -1101,8 +1167,10 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                 # -- mirrored sweep: panel is block row kr ---------------
                 rowk = jax.lax.dynamic_slice(
                     lt, (kr, 0, 0, 0), (1, ltc_s, mb, mb))[0]
-                pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowk,
-                                    inv_a=lkk_inv)
+                pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowk,
+                                     fused=panel_fused,
+                                     interpret=pallas_interpret,
+                                     inv_a=lkk_inv)
                 pan = jnp.where(col_valid[:, None, None], pan, 0)
                 keep = (is_owner_r & col_valid)[:, None, None]
                 lt = jax.lax.dynamic_update_slice(
@@ -1190,12 +1258,14 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             pad = jnp.arange(mb) >= ts
             diag = pad_diag_identity_dyn(diag, ts)
             if use_mixed:
+                ppan.count_panel_kernel("xla", "potrf")
                 other = "U" if uplo == "L" else "L"
                 fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
                 lkk = fac + tb.tri_mask(diag, other, k=-1)
             else:
                 lkk_inv = None
-                lkk = tl.potrf(uplo, diag)
+                lkk = ppan.panel_potrf(uplo, diag, fused=panel_fused,
+                                     interpret=pallas_interpret)
             lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
             upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
             lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
@@ -1210,8 +1280,10 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             if uplo == "L":
                 colk = jax.lax.dynamic_slice(
                     lt, (0, kc, 0, 0), (ltr_s, 1, mb, mb))[:, 0]
-                pan = tb.trsm_panel("R", "L", "C", "N", lkk, colk,
-                                    inv_a=lkk_inv)
+                pan = ppan.panel_solve("R", "L", "C", "N", lkk, colk,
+                                     fused=panel_fused,
+                                     interpret=pallas_interpret,
+                                     inv_a=lkk_inv)
                 pan = jnp.where(row_valid[:, None, None], pan, 0)
                 keep = (is_owner_c & row_valid)[:, None, None]
                 lt = jax.lax.dynamic_update_slice(
@@ -1270,8 +1342,10 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             # -- mirrored sweep (uplo='U') ------------------------------
             rowk = jax.lax.dynamic_slice(
                 lt, (kr, 0, 0, 0), (1, ltc_s, mb, mb))[0]
-            pan = tb.trsm_panel("L", "U", "C", "N", lkk, rowk,
-                                inv_a=lkk_inv)
+            pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowk,
+                                 fused=panel_fused,
+                                 interpret=pallas_interpret,
+                                 inv_a=lkk_inv)
             pan = jnp.where(col_valid[:, None, None], pan, 0)
             keep = (is_owner_r & col_valid)[:, None, None]
             lt = jax.lax.dynamic_update_slice(
@@ -1394,7 +1468,8 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
 def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
                           use_oz_pallas=False, scan=False, donate=False,
-                          lookahead=False, comm_la=False, with_info=False):
+                          lookahead=False, comm_la=False, with_info=False,
+                          panel_fused=False):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type
     donate_kw = donate_argnums_kw(donate, 0)
@@ -1407,7 +1482,8 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
             cplx=dtype.startswith("complex"),
             use_oz_pallas=use_oz_pallas,
             pallas_interpret=pallas_interpret,
-            lookahead=lookahead, with_info=with_info), **donate_kw)
+            lookahead=lookahead, with_info=with_info,
+            panel_fused=panel_fused), **donate_kw)
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
@@ -1415,7 +1491,8 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                                         use_oz_pallas=use_oz_pallas,
                                         lookahead=lookahead,
                                         comm_la=comm_la,
-                                        with_info=with_info),
+                                        with_info=with_info,
+                                        panel_fused=panel_fused),
                    **donate_kw)
 
 
@@ -1482,6 +1559,13 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
 
     lookahead = resolved_cholesky_lookahead() and trailing != "xla"
     comm_la = lookahead and resolved_comm_lookahead()
+    # fused Pallas panel route (panel_impl knob, docs/pallas_panel.md):
+    # resolved ONCE per entry (single owner pallas_panel.panel_uses_fused
+    # — dtype/block policy + injection gate + fallback accounting) and
+    # threaded into every builder as a static/cache-key argument; the
+    # whole-matrix "xla" trailing delegation has no panel chain to route
+    panel_fused = trailing != "xla" and ppan.panel_uses_fused(
+        dt, mat.block_size.row)
     # entry span: host wall around trace+dispatch, unfenced (device
     # completion is the caller's fence — the miniapp span carries the
     # honest GFlop/s); attrs and the reference flop model build lazily
@@ -1490,6 +1574,7 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
         n=n, nb=mat.block_size.row, uplo=uplo, dtype=dt.name,
         trailing=trailing, lookahead=int(lookahead),
         comm_lookahead=int(comm_la),
+        panel_impl="fused" if panel_fused else "xla",
         grid=f"{grid_shape[0]}x{grid_shape[1]}"))
     # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
     # resolution local and distributed, single owner in tile_ops.blas);
@@ -1502,17 +1587,23 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
             # program telemetry (DLAF_PROGRAM_TELEMETRY): compile wall /
             # retraces / HBM footprint per site; off = the same jitted
             # callables, bitwise no-op (docs/observability.md)
+            # off-TPU the fused panel kernels run in interpret mode
+            # (same convention as the pallas trailing kernels)
+            panel_interp = jax.default_backend() != "tpu"
             if trailing == "scan":
                 out = obs.telemetry.call(
                     "cholesky.local_scan", _cholesky_local_scan, a,
                     uplo=uplo, nb=mat.block_size.row, use_mxu=use_mxu,
                     use_mixed=use_mixed, lookahead=lookahead,
-                    with_info=with_info)
+                    with_info=with_info, panel_fused=panel_fused,
+                    panel_interpret=panel_fused and panel_interp)
             else:
                 out = obs.telemetry.call(
                     "cholesky.local", _cholesky_local, a, uplo=uplo,
                     nb=mat.block_size.row, trailing=trailing,
-                    lookahead=lookahead, with_info=with_info)
+                    lookahead=lookahead, with_info=with_info,
+                    panel_fused=panel_fused,
+                    panel_interpret=panel_fused and panel_interp)
             info = None
             if with_info:
                 out, info = out
@@ -1559,7 +1650,8 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                                # scan bodies overlap by construction; the
                                # hoist (and cache key) is unrolled-only
                                comm_la=comm_la and not scan_mode,
-                               with_info=with_info)
+                               with_info=with_info,
+                               panel_fused=panel_fused)
     with entry_span, quiet_donation():
         if with_info:
             storage, info = obs.telemetry.call("cholesky.dist", fn,

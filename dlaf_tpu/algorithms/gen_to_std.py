@@ -64,6 +64,7 @@ from ..matrix.tiling import (storage_tile_grid, tiles_to_global,
                              quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
 from ..tile_ops import mixed as mx
+from ..tile_ops import pallas_panel as ppan
 from ..tile_ops import ozaki as oz
 from ..types import ceil_div
 from .triangular import triangular_solve
@@ -93,19 +94,26 @@ def _gen_to_std_twosolve(uplo: str, a: Matrix, b_factor: Matrix,
 # Local blocked form (reference impl.h:169-266 call_L / call_U local)
 # ---------------------------------------------------------------------------
 
-def _hegst_diag(uplo: str, akk, lkk, inv=None):
+def _hegst_diag(uplo: str, akk, lkk, inv=None, fused=False,
+                interpret=False):
     """Transformed diagonal block, full Hermitian form: W = inv(L) herm(Akk)
     inv(L)^H (uplo='L') / inv(U^H) herm(Akk) inv(U) (uplo='U'). The two
-    block-size solves follow the f64_trsm knob via trsm_panel; ``inv`` is
-    the optional precomputed refined inverse of ``lkk``'s triangle, shared
-    with the step's panel solve so the mixed route derives it ONCE."""
+    block-size solves follow the f64_trsm knob via trsm_panel — or, under
+    ``panel_impl="fused"`` (``fused=True``, docs/pallas_panel.md), the
+    fused Pallas panel-solve kernels; ``inv`` is the optional precomputed
+    refined inverse of ``lkk``'s triangle, shared with the step's panel
+    solve so the mixed route derives it ONCE."""
     ah = tb.hermitian_from(akk, uplo)
     if uplo == "L":
-        w = tb.trsm_panel("L", "L", "N", "N", lkk, ah, inv_a=inv)
-        w = tb.trsm_panel("R", "L", "C", "N", lkk, w, inv_a=inv)
+        w = ppan.panel_solve("L", "L", "N", "N", lkk, ah, inv_a=inv,
+                             fused=fused, interpret=interpret)
+        w = ppan.panel_solve("R", "L", "C", "N", lkk, w, inv_a=inv,
+                             fused=fused, interpret=interpret)
     else:
-        w = tb.trsm_panel("L", "U", "C", "N", lkk, ah, inv_a=inv)
-        w = tb.trsm_panel("R", "U", "N", "N", lkk, w, inv_a=inv)
+        w = ppan.panel_solve("L", "U", "C", "N", lkk, ah, inv_a=inv,
+                             fused=fused, interpret=interpret)
+        w = ppan.panel_solve("R", "U", "N", "N", lkk, w, inv_a=inv,
+                             fused=fused, interpret=interpret)
     # the algorithm reads W as Hermitian-stored from its uplo triangle (the
     # reference's hemmPanelTile does the same with the written tile)
     return tb.hermitian_from(w, uplo)
@@ -123,9 +131,13 @@ def _step_inv(uplo: str, lkk):
 @register_program_cache
 # both operands are the entry point's freshly built global-layout copies
 # (the caller's matrices are re-read only at the final triangle merge)
-@functools.partial(jax.jit, static_argnames=("uplo", "nb", "lookahead"),
+@functools.partial(jax.jit, static_argnames=("uplo", "nb", "lookahead",
+                                             "panel_fused",
+                                             "panel_interpret"),
                    donate_argnums=(0, 1))
-def _hegst_local_blocked(a, l, *, uplo: str, nb: int, lookahead: bool = False):
+def _hegst_local_blocked(a, l, *, uplo: str, nb: int, lookahead: bool = False,
+                         panel_fused: bool = False,
+                         panel_interpret: bool = False):
     """Unrolled blocked two-sided transform on the global 2D array.
 
     Per step (uplo='L', LAPACK xHEGST itype=1 structure, which the
@@ -163,13 +175,16 @@ def _hegst_local_blocked(a, l, *, uplo: str, nb: int, lookahead: bool = False):
                 if k1 < n:
                     a = a.at[k1:, :k0].add(-tb.gemm(l[k1:, k0:k1], rowk))
             w = _hegst_diag(uplo, a[k0:k1, k0:k1] if la is None else la[0],
-                            lkk, inv=lkk_inv)
+                            lkk, inv=lkk_inv, fused=panel_fused,
+                            interpret=panel_interpret)
             a = a.at[k0:k1, k0:k1].set(w)
             if k1 == n:
                 continue
             p = a[k1:, k0:k1] if la is None else la[1]
             l21 = l[k1:, k0:k1]
-            p = tb.trsm_panel("R", "L", "C", "N", lkk, p, inv_a=lkk_inv)
+            p = ppan.panel_solve("R", "L", "C", "N", lkk, p, inv_a=lkk_inv,
+                                 fused=panel_fused,
+                                 interpret=panel_interpret)
             p = p - 0.5 * tb.gemm(l21, w)
             la = None
             if lookahead:
@@ -200,13 +215,16 @@ def _hegst_local_blocked(a, l, *, uplo: str, nb: int, lookahead: bool = False):
                 if k1 < n:
                     a = a.at[:k0, k1:].add(-tb.gemm(colk, l[k0:k1, k1:]))
             w = _hegst_diag(uplo, a[k0:k1, k0:k1] if la is None else la[0],
-                            lkk, inv=lkk_inv)
+                            lkk, inv=lkk_inv, fused=panel_fused,
+                            interpret=panel_interpret)
             a = a.at[k0:k1, k0:k1].set(w)
             if k1 == n:
                 continue
             p = a[k0:k1, k1:] if la is None else la[1]
             u12 = l[k0:k1, k1:]
-            p = tb.trsm_panel("L", "U", "C", "N", lkk, p, inv_a=lkk_inv)
+            p = ppan.panel_solve("L", "U", "C", "N", lkk, p, inv_a=lkk_inv,
+                                 fused=panel_fused,
+                                 interpret=panel_interpret)
             p = p - 0.5 * tb.gemm(w, u12)
             la = None
             if lookahead:
@@ -279,7 +297,8 @@ def _row_strip_product(x_tile, y_tiles, cplx: bool, use_mxu: bool):
 
 
 def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
-                      lookahead=False, comm_la=False):
+                      lookahead=False, comm_la=False, panel_fused=False,
+                      panel_interpret=False):
     """shard_map'd blocked HEGST over the 2D mesh, k-loop unrolled.
 
     Per step k (uplo='L'): broadcast the L diag + col-panel (row-wise and
@@ -362,15 +381,17 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
         # correct on the owner (the only contributor bcast/keep select)
         cand = lt[kr, kc] if la is None else la[0][kr - la[1]]
         akk = cc.bcast2d(cand, owner_r, owner_c)
-        w = _hegst_diag("L", akk, lkk, inv=lkk_inv)
+        w = _hegst_diag("L", akk, lkk, inv=lkk_inv, fused=panel_fused,
+                        interpret=panel_interpret)
         if k == nt - 1 or nrows == 0:
             return lkk, lkk_inv, vr_l, akk, w, None, None, None, None
 
         # -- panel: trsm right with Lkk + first half-hemm -----------------
-        pan = tb.trsm_panel("R", "L", "C", "N", lkk,
-                            lt[lu_r:, kc] if la is None
-                            else la[0][lu_r - la[1]:],
-                            inv_a=lkk_inv)
+        pan = ppan.panel_solve("R", "L", "C", "N", lkk,
+                               lt[lu_r:, kc] if la is None
+                               else la[0][lu_r - la[1]:],
+                               inv_a=lkk_inv, fused=panel_fused,
+                               interpret=panel_interpret)
         pan = pan - 0.5 * jnp.einsum("rab,bd->rad", vr_l, w)
         pan = jnp.where(row_valid[:, None, None], pan, 0)
         ncols = ltc - lu_c
@@ -508,15 +529,17 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
 
         cand = lt[kr, kc] if la is None else la[0][kc - la[1]]
         akk = cc.bcast2d(cand, owner_r, owner_c)
-        w = _hegst_diag("U", akk, ukk, inv=ukk_inv)
+        w = _hegst_diag("U", akk, ukk, inv=ukk_inv, fused=panel_fused,
+                        interpret=panel_interpret)
         if k == nt - 1 or ncols == 0:
             return ukk, ukk_inv, vc_u, akk, w, None, None, None, None
 
         # -- panel: trsm left with Ukk^H + first half-hemm ----------------
-        pan = tb.trsm_panel("L", "U", "C", "N", ukk,
-                            lt[kr, lu_c:] if la is None
-                            else la[0][lu_c - la[1]:],
-                            inv_a=ukk_inv)
+        pan = ppan.panel_solve("L", "U", "C", "N", ukk,
+                               lt[kr, lu_c:] if la is None
+                               else la[0][lu_c - la[1]:],
+                               inv_a=ukk_inv, fused=panel_fused,
+                               interpret=panel_interpret)
         pan = pan - 0.5 * jnp.einsum("ab,rbd->rad", w, vc_u)
         pan = jnp.where(col_valid[:, None, None], pan, 0)
         nrows = ltr - lu_r
@@ -693,10 +716,13 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
 @register_program_cache
 @functools.lru_cache(maxsize=64)
 def _dist_hegst_cached(dist, mesh, dtype, uplo, use_mxu, donate=False,
-                       lookahead=False, comm_la=False):
+                       lookahead=False, comm_la=False, panel_fused=False,
+                       panel_interpret=False):
     return jax.jit(_build_dist_hegst(dist, mesh, uplo, use_mxu=use_mxu,
                                      cplx=dtype.startswith("complex"),
-                                     lookahead=lookahead, comm_la=comm_la),
+                                     lookahead=lookahead, comm_la=comm_la,
+                                     panel_fused=panel_fused,
+                                     panel_interpret=panel_interpret),
                    **donate_argnums_kw(donate, 0))
 
 
@@ -750,11 +776,17 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
     # auto step mode exists to avoid (round-3 advisory)
     use_twosolve = hegst_impl == "twosolve" or \
         resolve_step_mode(a.dist.nr_tiles.row) == "scan"
+    # fused panel route for the BLOCKED forms' diag hegst + panel trsm
+    # chain (docs/pallas_panel.md); twosolve has no per-step panel chain
+    # of its own — its pivot solves route inside triangular_solve
+    panel_fused = not use_twosolve and ppan.panel_uses_fused(
+        np.dtype(a.dtype), a.block_size.row)
     entry_span = obs.entry_span("gen_to_std", lambda: dict(
         flops=total_ops(np.dtype(a.dtype), n**3 / 2, n**3 / 2),
         n=n, nb=a.block_size.row, uplo=uplo,
         dtype=np.dtype(a.dtype).name,
         impl="twosolve" if use_twosolve else hegst_impl,
+        panel_impl="fused" if panel_fused else "xla",
         grid=f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"))
     if use_twosolve:
         with entry_span:
@@ -778,7 +810,10 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
             # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
             out = obs.telemetry.call(
                 "gen_to_std.local", _hegst_local_blocked, g, lg, uplo=uplo,
-                nb=a.block_size.row, lookahead=lookahead)
+                nb=a.block_size.row, lookahead=lookahead,
+                panel_fused=panel_fused,
+                panel_interpret=panel_fused
+                and jax.default_backend() != "tpu")
             out_m = a.with_storage(global_to_tiles_donated(out, a.dist))
         res = mops.merge_triangle(out_m, a, uplo, donate_orig=donate)
         return (res, info) if with_info else res
@@ -788,9 +823,12 @@ def gen_to_std(uplo: str, a: Matrix, b_factor: Matrix, *,
                         what="gen_to_std(A, B_factor)")
     dt = np.dtype(a.dtype)
     use_mxu = tb.f64_gemm_uses_mxu(dt, a.block_size.row)
+    platform = next(iter(a.grid.mesh.devices.flat)).platform
     fn = _dist_hegst_cached(a.dist, a.grid.mesh, dt.name, uplo, use_mxu,
                             donate=donate, lookahead=lookahead,
-                            comm_la=comm_la)
+                            comm_la=comm_la, panel_fused=panel_fused,
+                            panel_interpret=panel_fused
+                            and platform != "tpu")
     with entry_span, quiet_donation():
         res = a.with_storage(obs.telemetry.call(
             "gen_to_std.dist", fn, a.storage, b_factor.storage))

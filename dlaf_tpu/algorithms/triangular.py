@@ -43,6 +43,7 @@ from ..matrix.panel import (DistContext, bcast_diag, bcast_diag_dyn, col_panel,
 from ..matrix.tiling import (tiles_to_global, global_to_tiles_donated,
                              to_global, quiet_donation, donate_argnums_kw)
 from ..tile_ops import blas as tb
+from ..tile_ops import pallas_panel as ppan
 from ..types import telescope_windows, total_ops
 
 
@@ -118,7 +119,8 @@ def _mult_local(a, b, alpha, *, side, uplo, op, diag):
 # Distributed substitution (solve) — reference solver/triangular/impl.h
 # ---------------------------------------------------------------------------
 
-def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
+def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
+                      panel_fused=False, panel_interpret=False):
     nt = dist_a.nr_tiles.row
     n = dist_a.size.row
     mb = dist_a.block_size.row
@@ -139,7 +141,11 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
             if side == "L":
                 # solve op(Akk) Xk = Bk for tile row k of B (all local cols)
                 bk = row_panel(ctx_b, ltb, k, 0)
-                xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
+                # pivot-diag solve on the panel_impl route (fused Pallas
+                # strip kernel or the XLA chain; docs/pallas_panel.md)
+                xk = ppan.panel_solve("L", uplo, op, diag, akk, bk,
+                                      fused=panel_fused,
+                                      interpret=panel_interpret)
                 own = ctx_b.rank_r == ctx_b.owner_r(k)
                 row = ctx_b.kr(k)
                 ltb = ltb.at[row].set(jnp.where(own, xk, ltb[row]))
@@ -167,7 +173,9 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
             else:
                 # solve Xk op(Akk) = Bk for tile col k of B (all local rows)
                 bk = col_panel(ctx_b, ltb, k, 0)
-                xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
+                xk = ppan.panel_solve("R", uplo, op, diag, akk, bk,
+                                      fused=panel_fused,
+                                      interpret=panel_interpret)
                 own = ctx_b.rank_c == ctx_b.owner_c(k)
                 col = ctx_b.kc(k)
                 ltb = ltb.at[:, col].set(jnp.where(own, xk, ltb[:, col]))
@@ -202,7 +210,8 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
 
 
 def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
-                           lookahead=False, comm_la=False):
+                           lookahead=False, comm_la=False,
+                           panel_fused=False, panel_interpret=False):
     """``lax.scan`` form of the distributed solve (config
     ``dist_step_mode="scan"``): one compiled step body per telescoped
     segment, looped over the segment's steps — the same O(1)-compile /
@@ -244,7 +253,9 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                 akk = pad_diag_identity_dyn(akk, jnp.minimum(mb, n - k * mb))
                 if side == "L":
                     bk = row_panel_dyn(ctx_b, sub, k, row_off=lu0)
-                    xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
+                    xk = ppan.panel_solve("L", uplo, op, diag, akk, bk,
+                                          fused=panel_fused,
+                                          interpret=panel_interpret)
                     own = ctx_b.rank_r == ctx_b.owner_r(k)
                     row = ctx_b.kr(k) - lu0
                     cur = jax.lax.dynamic_slice(
@@ -264,7 +275,9 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                     upd = tb.contract("rab,cbd->rcad", e, xk)
                     return sub - upd, None
                 bk = col_panel_dyn(ctx_b, sub, k, col_off=lu0)
-                xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
+                xk = ppan.panel_solve("R", uplo, op, diag, akk, bk,
+                                      fused=panel_fused,
+                                      interpret=panel_interpret)
                 own = ctx_b.rank_c == ctx_b.owner_c(k)
                 col = ctx_b.kc(k) - lu0
                 cur = jax.lax.dynamic_slice(
@@ -317,7 +330,9 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                 akk = pad_diag_identity_dyn(akk, jnp.minimum(mb, n - k * mb))
                 if side == "L":
                     bk = row_panel_dyn(ctx_b, sub, k, row_off=lu0)
-                    xk = tb.trsm_panel("L", uplo, op, diag, akk, bk)
+                    xk = ppan.panel_solve("L", uplo, op, diag, akk, bk,
+                                          fused=panel_fused,
+                                          interpret=panel_interpret)
                     own = ctx_b.rank_r == ctx_b.owner_r(k)
                     row = ctx_b.kr(k) - lu0
                     cur = jax.lax.dynamic_slice(
@@ -365,7 +380,9 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                                         e, jnp.zeros_like(e))
                     return (sub, pe_next, xk), None
                 bk = col_panel_dyn(ctx_b, sub, k, col_off=lu0)
-                xk = tb.trsm_panel("R", uplo, op, diag, akk, bk)
+                xk = ppan.panel_solve("R", uplo, op, diag, akk, bk,
+                                      fused=panel_fused,
+                                      interpret=panel_interpret)
                 own = ctx_b.rank_c == ctx_b.owner_c(k)
                 col = ctx_b.kc(k) - lu0
                 cur = jax.lax.dynamic_slice(
@@ -687,14 +704,18 @@ def _unit_diag(t, diag):
 @functools.lru_cache(maxsize=128)
 def _dist_solve_cached(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                        scan=False, donate_b=False, lookahead=False,
-                       comm_la=False):
+                       comm_la=False, panel_fused=False,
+                       panel_interpret=False):
     if scan:
         built = _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op,
                                        diag, dtype, lookahead=lookahead,
-                                       comm_la=comm_la)
+                                       comm_la=comm_la,
+                                       panel_fused=panel_fused,
+                                       panel_interpret=panel_interpret)
     else:
         built = _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op,
-                                  diag, dtype)
+                                  diag, dtype, panel_fused=panel_fused,
+                                  panel_interpret=panel_interpret)
     return jax.jit(built, **donate_argnums_kw(donate_b, 1))
 
 
@@ -744,13 +765,20 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
     # on the solve dimension n = A's order, free dimension the other
     sdim = a.size.row
     free = b.size.col if side == "L" else b.size.row
+    # fused panel route applies to the DISTRIBUTED pivot-diag chain only
+    # (the local solve is one whole-matrix op — no per-step panel chain);
+    # resolved once here so the entry span and the builders agree
+    dist_run = not (a.grid is None or a.grid.num_devices == 1)
+    panel_fused = dist_run and ppan.panel_uses_fused(np.dtype(a.dtype),
+                                                     a.block_size.row)
     entry_span = obs.entry_span("triangular_solve", lambda: dict(
         flops=total_ops(np.dtype(b.dtype), free * sdim**2 / 2,
                         free * sdim**2 / 2),
         side=side, uplo=uplo, op=op, diag=diag, m=b.size.row,
         n=b.size.col, nb=b.block_size.row, dtype=np.dtype(b.dtype).name,
+        panel_impl="fused" if panel_fused else "xla",
         grid=f"{b.dist.grid_size.row}x{b.dist.grid_size.col}"))
-    if a.grid is None or a.grid.num_devices == 1:
+    if not dist_run:
         with entry_span, quiet_donation():
             bm = to_global(b.storage, b.dist, donate_b)
             am = tiles_to_global(a.storage, a.dist)
@@ -770,11 +798,17 @@ def triangular_solve(side: str, uplo: str, op: str, diag: str, alpha,
     # docs/lookahead.md); comm_lookahead additionally hoists the A-panel
     # collectives ahead of the deferred bulk (docs/comm_overlap.md)
     la = scan_mode and resolved_cholesky_lookahead()
+    # pivot-diag chain on the fused Pallas route when panel_impl says so
+    # (docs/pallas_panel.md); panel_fused resolved above, a cache-key arg
+    platform = next(iter(a.grid.mesh.devices.flat)).platform
     fn = _dist_solve_cached(a.dist, b.dist, a.grid.mesh, side, uplo, op, diag,
                             np.dtype(a.dtype).name,
                             scan=scan_mode, donate_b=donate_b,
                             lookahead=la,
-                            comm_la=la and resolved_comm_lookahead())
+                            comm_la=la and resolved_comm_lookahead(),
+                            panel_fused=panel_fused,
+                            panel_interpret=panel_fused
+                            and platform != "tpu")
     with entry_span, quiet_donation():
         # program telemetry (DLAF_PROGRAM_TELEMETRY): off = passthrough
         res = b.with_storage(obs.telemetry.call(

@@ -101,7 +101,13 @@ def pinned_native_config():
             f64_gemm="native", f64_trsm="native", qr_panel="geqrf",
             cholesky_trailing="loop", cholesky_lookahead="0",
             comm_lookahead="0", dc_level_batch="0", bt_lookahead="0",
-            hegst_impl="blocked", dist_step_mode="unrolled", log="off"))
+            hegst_impl="blocked", dist_step_mode="unrolled",
+            # panel_impl pinned to the XLA route so the precision-
+            # demotion and route audits keep auditing the native path;
+            # the fused route gets its OWN f32 traced-program entries
+            # (program_specs *.fpanel variants, built with an explicit
+            # panel_fused=True)
+            panel_impl="xla", log="off"))
         yield
     finally:
         os.environ.update(saved)
@@ -186,6 +192,32 @@ def program_specs(rows: int = 2, cols: int = 2, n: int = 24, nb: int = 4,
                                       lookahead=True, comm_la=True,
                                       with_info=True), (st,)))
 
+    # ---- fused Pallas panel route (panel_impl="fused"; f32 — the route's
+    # supported dtype, so the precision rule sees no wide values to
+    # demote). Built with an EXPLICIT panel_fused=True: the pinned
+    # native config above keeps the knob itself on "xla", these specs
+    # audit the fused programs the TPU auto resolution emits. ----
+    f32 = jnp.float32
+    st32 = jax.ShapeDtypeStruct((str_, stc, nb, nb), f32)
+    loc32 = jax.ShapeDtypeStruct((n, n), f32)
+    alpha32 = jax.ShapeDtypeStruct((), f32)
+    for uplo in ("L", "U"):
+        add(f"cholesky.local.fpanel.{uplo}.la1",
+            lambda uplo=uplo: (
+                lambda x: _cholesky_local.__wrapped__(
+                    x, uplo=uplo, nb=nb, trailing="loop", lookahead=True,
+                    panel_fused=True, panel_interpret=True), (loc32,)))
+        add(f"cholesky.dist.fpanel.{uplo}.la1.comm1",
+            lambda uplo=uplo: (
+                _build_dist_cholesky(dist, grid.mesh, uplo, False, True,
+                                     lookahead=True, comm_la=True,
+                                     panel_fused=True), (st32,)))
+    add("cholesky.dist_scan.fpanel.L.la1",
+        lambda: (_build_dist_cholesky_scan(dist, grid.mesh, "L",
+                                           lookahead=True,
+                                           pallas_interpret=True,
+                                           panel_fused=True), (st32,)))
+
     # ---- distributed triangular solve / multiply ----
     from dlaf_tpu.algorithms.triangular import (_build_dist_mult,
                                                 _build_dist_mult_scan,
@@ -202,6 +234,17 @@ def program_specs(rows: int = 2, cols: int = 2, n: int = 24, nb: int = 4,
                 _build_dist_solve_scan(dist, dist, grid.mesh, side, uplo,
                                        op, "N", "float64", lookahead=True,
                                        comm_la=True), (st, st, alpha)))
+    add("solve.dist.fpanel.LLN",
+        lambda: (_build_dist_solve(dist, dist, grid.mesh, "L", "L", "N",
+                                   "N", "float32", panel_fused=True,
+                                   panel_interpret=True),
+                 (st32, st32, alpha32)))
+    add("solve.dist_scan.fpanel.LLN.la1",
+        lambda: (_build_dist_solve_scan(dist, dist, grid.mesh, "L", "L",
+                                        "N", "N", "float32",
+                                        lookahead=True, panel_fused=True,
+                                        panel_interpret=True),
+                 (st32, st32, alpha32)))
     add("mult.dist.LLN",
         lambda: (_build_dist_mult(dist, dist, grid.mesh, "L", "L", "N",
                                   "N", "float64"), (st, st, alpha)))
@@ -219,6 +262,10 @@ def program_specs(rows: int = 2, cols: int = 2, n: int = 24, nb: int = 4,
                 lambda uplo=uplo, la=la, comm=comm: (
                     _build_dist_hegst(dist, grid.mesh, uplo, lookahead=la,
                                       comm_la=comm), (st, st)))
+    add("hegst.dist.fpanel.L.la1.comm1",
+        lambda: (_build_dist_hegst(dist, grid.mesh, "L", lookahead=True,
+                                   comm_la=True, panel_fused=True,
+                                   panel_interpret=True), (st32, st32)))
 
     # ---- reduction to band (local + dist, unrolled + scan) ----
     from dlaf_tpu.eigensolver.reduction_to_band import (

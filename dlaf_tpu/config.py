@@ -240,6 +240,24 @@ class Configuration:
     #: exit 1; not a Mosaic legalization failure), so the fused kernels have
     #: never executed on silicon (docs/ROUND4.md).
     ozaki_impl: str = "jnp"
+    #: Panel factorization kernels for the blocked algorithms' per-step
+    #: potrf + panel-TRSM chain (tile_ops/pallas_panel.py,
+    #: docs/pallas_panel.md): "xla" (the generic route — XLA's blocked
+    #: Cholesky thunk chain for the diagonal tile, a separate
+    #: TriangularSolve per panel strip), "fused" (single-``pallas_call``
+    #: VMEM-resident kernels: micro-blocked right-looking potrf ladder +
+    #: grid-batched strip solve with the factor's inverse in scratch —
+    #: one kernel dispatch per panel step instead of a latency-bound
+    #: thunk chain per tile), or "auto" (default): fused on TPU for
+    #: f32/bf16 inputs, xla elsewhere (f64/c128 keep their own mixed/
+    #: ozaki panel treatment — see ``f64_trsm``). An explicit "fused"
+    #: with an unsupported dtype registers the degradation at
+    #: ``dlaf_fallback_total{site="panel"}`` (DLAF_STRICT raises);
+    #: off-TPU the fused kernels run in interpret mode (CI/parity).
+    #: Results are ulp-close, not bitwise, across the two impls; all
+    #: knob contracts (lookahead/comm_lookahead/with_info) stay bitwise
+    #: WITHIN each impl (tests/test_pallas_panel.py).
+    panel_impl: str = "auto"
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
     #: under TPU f64 emulation), "mixed" (f32 seed + Newton refinement,
     #: tile_ops/mixed.py: refined explicit inverse + matmul for per-tile
@@ -473,6 +491,7 @@ _VALID_CHOICES = {
     "bt_lookahead": ("0", "1", "auto"),
     "f64_gemm": ("native", "mxu", "auto"),
     "f64_trsm": ("native", "mixed", "auto"),
+    "panel_impl": ("fused", "xla", "auto"),
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
     "ozaki_group": ("dots", "concat", "auto"),
@@ -638,6 +657,20 @@ def resolved_f64_trsm() -> str:
         tpu_choice="mixed", other_choice="native",
         detail="f32-seed Newton-refined panel solves measured +0.6 ms/step "
                "vs +15.7 for native-f64 panels — 2026-08-01 v5e session")
+
+
+def resolved_panel_impl() -> str:
+    """``panel_impl`` with "auto" resolved: fused on TPU, xla elsewhere
+    (platform leg only — the dtype/block-size leg lives in
+    ``tile_ops.pallas_panel.panel_uses_fused``, the route's single
+    owner)."""
+    return resolve_platform_auto(
+        get_configuration().panel_impl, knob="panel_impl",
+        tpu_choice="fused", other_choice="xla",
+        detail="the per-step potrf+trsm chain is latency-bound on TPU "
+               "(MFU table: 1.9-7.3% with neither roofline binding); the "
+               "fused Pallas panel kernels collapse it to one dispatch "
+               "per step (docs/pallas_panel.md)")
 
 
 def resolved_cholesky_lookahead() -> bool:

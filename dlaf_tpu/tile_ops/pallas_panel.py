@@ -1,0 +1,434 @@
+"""Fused Pallas panel factorization — the ``tpu_lapack`` panel shim.
+
+The blocked factorizations' critical path is the per-step PANEL chain:
+potrf on the diagonal tile, then the panel TRSM against it. On the XLA
+route both lower to chains of small latency-bound thunks (XLA's generic
+blocked Cholesky emits a while loop of tiny solves; the panel trsm is a
+separate TriangularSolve op), so every step pays dispatch latency that no
+amount of MXU throughput can hide — the 1.9-7.3% MFU signature in
+BASELINE.md where neither the compute nor the HBM roofline binds. The
+reference dispatches exactly this path to hand-tuned ``cusolver`` tile
+kernels; this module is the TPU analog (BASELINE north star "tpu_lapack
+shim"): Pallas kernels that factor/solve the whole panel without leaving
+VMEM, one ``pallas_call`` per panel step instead of one XLA op (or op
+chain) per tile.
+
+Kernels
+-------
+
+:func:`fused_potrf`
+    Right-looking Cholesky of ONE nb x nb tile, entirely in VMEM: the
+    kernel body is statically unrolled over a micro-block ladder (width
+    :data:`MICRO`) — within a micro-block, ``rsqrt``-scaled column
+    updates (VPU rank-1s on the narrow micro-panel); between
+    micro-blocks, ONE MXU ``dot_general`` applies the rank-``MICRO``
+    trailing update. Exact right-looking flops, no HBM round trips
+    between columns. Failure semantics match ``tile_ops.lapack
+    .potrf_info``'s contract: a non-positive pivot turns into
+    ``rsqrt(d) = NaN/inf`` which propagates into every later column, so
+    the factor's diagonal is non-finite from the first failing column on
+    (the info scan reads exactly that prefix).
+
+:func:`fused_panel_solve`
+    The panel TRSM applied to the stacked strip of below-diagonal tiles
+    with the factored diagonal held in VMEM: the kernel grids over the
+    strip's tile axis; grid step 0 builds the triangular inverse of the
+    diagonal factor into VMEM scratch (micro-blocked substitution,
+    statically unrolled), and every step then applies it as ONE MXU gemm
+    — the TPU grid is sequential, so the scratch inverse persists across
+    steps and is derived once per ``pallas_call``, not once per tile.
+
+Numerics contract: the fused route is NOT bitwise-equal to the XLA route
+(different factorization order within the tile; explicit-inverse solve
+application) — parity is pinned at documented ulp-level bounds instead
+(tests/test_pallas_panel.py, docs/pallas_panel.md). WITHIN the fused
+route all the bitwise knob contracts hold unchanged (``cholesky_lookahead``
+/ ``comm_lookahead`` on/off, ``with_info`` on/off): the kernels are pure
+deterministic functions and those knobs only reorder emission.
+
+Supported dtypes: float32 / bfloat16 (MXU-native; compute in f32, cast
+back). float64/complex stay on the XLA (or mixed) route — on TPU their
+panel latency problem is already attacked by ``tile_ops.mixed``'s
+f32-seed-plus-Newton path, whose *seed* is exactly the shape this kernel
+accelerates next.
+
+Status: validated in interpret mode (CPU CI) like every Pallas kernel in
+this repo — the axon tunnel's remote compile helper still rejects all
+``pallas_call`` compiles (docs/ROUND4.md), so silicon timing is pending.
+
+Routing (``panel_impl`` knob — "fused" / "xla" / "auto"): single owner
+:func:`panel_uses_fused`; the builders call :func:`panel_potrf` /
+:func:`panel_solve`, which also maintain the trace-time
+``dlaf_panel_kernel_total{impl,op}`` counters. ``auto`` = fused on TPU
+for f32/bf16 inputs, xla elsewhere. An EXPLICIT ``panel_impl="fused"``
+with an unsupported dtype registers through
+``health.registry.report_fallback(site="panel")`` (counted, strict-mode
+raise); ``health.inject.disable_pallas`` covers the route like every
+pallas kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import obs
+
+#: Micro-block width of the potrf ladder and the in-kernel triangular
+#: inverse: 8 = the f32 sublane, so every micro-panel/row op is at least
+#: one full VPU sublane wide.
+MICRO = 8
+
+#: Largest diagonal-tile edge the fused panel route accepts (route
+#: policy, like pallas_ozaki.MASKED_MB_MAX): the potrf ladder and the
+#: solve's scratch inverse hold O(nb^2) f32 working values in VMEM —
+#: ~0.75 MiB at nb=256 plus the strip tile being solved; 512 would put
+#: the solve step's live set past comfortable double-buffering.
+PANEL_MB_MAX = 256
+
+_SUPPORTED = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+
+
+def _pad_size(m: int, interpret: bool) -> int:
+    """Padded square edge: micro-block multiple always; full (8, 128)
+    lane alignment when headed for the Mosaic compiler (interpret mode
+    keeps the pad minimal so tiny-tile tests stay cheap)."""
+    s = -(-m // MICRO) * MICRO
+    if not interpret:
+        s = -(-s // 128) * 128
+    return s
+
+
+def _identity_pad(a, s: int):
+    """Embed the (m, m) block top-left in an (s, s) identity-padded
+    block: ``chol(blkdiag(A, I)) = blkdiag(chol(A), I)`` and a
+    triangular ``blkdiag(T, I)`` inverts blockwise, so the pad region
+    never contaminates the sliced-back result."""
+    m = a.shape[-1]
+    if s == m:
+        return a
+    pad = jnp.arange(s) >= m
+    out = jnp.zeros((s, s), a.dtype).at[:m, :m].set(a)
+    return out + jnp.diag(pad.astype(a.dtype))
+
+
+# ---------------------------------------------------------------------------
+# fused_potrf
+# ---------------------------------------------------------------------------
+
+def _potrf_ladder(x, s: int):
+    """Statically-unrolled right-looking micro-block ladder on the
+    f32 lower triangle ``x`` (strictly-upper entries are never read:
+    the column mask zeroes them before use, and the caller tril-masks
+    the result). ``rsqrt``-scaled columns: a non-positive pivot yields
+    NaN/inf that propagates to every later column — the
+    ``potrf_info`` failure contract.
+
+    In-kernel updates use ``lax.dynamic_update_slice`` with static
+    starts (jnp ``.at`` set/add lowers to a scatter whose empty index
+    array Pallas rejects as a captured constant)."""
+    upd_at = jax.lax.dynamic_update_slice
+    for j0 in range(0, s, MICRO):
+        m = s - j0
+        p = x[j0:, j0:j0 + MICRO]                      # (m, MICRO) panel
+        rows = jax.lax.broadcasted_iota(jnp.int32, (m,), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (MICRO,), 0)
+        for jj in range(MICRO):
+            d = p[jj, jj]
+            col = jnp.where(rows >= jj, p[:, jj] * jax.lax.rsqrt(d), 0.0)
+            # rank-1 update of the micro-panel's LATER columns only;
+            # the factor row entries of those columns are col[:MICRO]
+            later = jnp.where(cols > jj, col[:MICRO], 0.0)
+            p = p - col[:, None] * later[None, :]
+            p = jnp.where((cols == jj)[None, :], col[:, None], p)
+        x = upd_at(x, p, (j0, j0))
+        if j0 + MICRO < s:
+            l21 = p[MICRO:, :]                          # (m-MICRO, MICRO)
+            upd = jax.lax.dot_general(
+                l21, l21, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            j1 = j0 + MICRO
+            x = upd_at(x, x[j1:, j1:] - upd, (j1, j1))
+    return x
+
+
+def _make_potrf_kernel(uplo: str, s: int):
+    def kernel(a_ref, out_ref):
+        a = a_ref[...].astype(jnp.float32)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        tril = rows >= cols
+        if uplo == "L":
+            f = _potrf_ladder(jnp.where(tril, a, 0.0), s)
+            # factor in the stored triangle, the other passes through
+            out = jnp.where(tril, f, a)
+        else:
+            # U^H U = A from the stored UPPER triangle: run the ladder
+            # on A^T's lower triangle, transpose the factor back
+            at = jnp.where(tril, a.T, 0.0)
+            f = _potrf_ladder(at, s).T
+            out = jnp.where(~tril | (rows == cols), f, a)
+        out_ref[...] = out.astype(out_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("uplo", "interpret"))
+def _fused_potrf(a, *, uplo: str, interpret: bool = False):
+    m = a.shape[-1]
+    s = _pad_size(m, interpret)
+    ap = _identity_pad(a, s)
+    out = pl.pallas_call(
+        _make_potrf_kernel(uplo, s),
+        out_shape=jax.ShapeDtypeStruct((s, s), a.dtype),
+        interpret=interpret,
+    )(ap)
+    return out[:m, :m]
+
+
+def fused_potrf(uplo: str, a, *, interpret: bool = False):
+    """Cholesky factor of one SPD block stored in ``uplo``, as ONE fused
+    Pallas kernel (micro-blocked right-looking ladder in VMEM). Same
+    LAPACK storage semantics as ``tile_ops.lapack.potrf``: the factor
+    lands in the ``uplo`` triangle, the opposite triangle of ``a``
+    passes through. f32/bf16 only (computed in f32)."""
+    assert a.ndim == 2 and a.shape[-1] == a.shape[-2], a.shape
+    assert jnp.dtype(a.dtype) in _SUPPORTED, a.dtype
+    fn = _fused_potrf
+    if not _tracing(a):
+        return obs.telemetry.call("pallas_panel.potrf", fn, a, uplo=uplo,
+                                  interpret=interpret)
+    return fn(a, uplo=uplo, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# fused_panel_solve
+# ---------------------------------------------------------------------------
+
+def _micro_inv_lower(d):
+    """Inverse of a MICRO x MICRO lower-triangular block by statically
+    unrolled forward substitution (all columns at once): row i of X is
+    ``(e_i - D[i, :i] X[:i]) / D[i, i]``."""
+    w = d.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (w,), 0)
+    x = jnp.zeros((w, w), d.dtype)
+    for i in range(w):
+        e = (cols == i).astype(d.dtype)
+        if i:
+            e = (e - d[i:i + 1, :i] @ x[:i, :]).reshape(w)
+        x = jax.lax.dynamic_update_slice(x, (e / d[i, i])[None], (i, 0))
+    return x
+
+
+def _tri_inv_lower(t, s: int):
+    """Inverse of the (s, s) lower triangle ``t``, micro-blocked and
+    statically unrolled: each ladder step inverts its MICRO-wide
+    diagonal block by substitution and fills the block row below the
+    already-inverted prefix with two small gemms
+    (``-D^-1 R X_prefix``)."""
+    upd_at = jax.lax.dynamic_update_slice
+    x = jnp.zeros_like(t)
+    for j0 in range(0, s, MICRO):
+        dinv = _micro_inv_lower(t[j0:j0 + MICRO, j0:j0 + MICRO])
+        if j0:
+            r = t[j0:j0 + MICRO, :j0]
+            blkrow = -(dinv @ (r @ x[:j0, :j0]))
+            x = upd_at(x, blkrow, (j0, 0))
+        x = upd_at(x, dinv, (j0, j0))
+    return x
+
+
+def _make_solve_kernel(uplo: str, op: str, diag: str, s: int):
+    """Right-side canonical solve kernel: each grid step computes
+    ``out = b_block @ op(inv(T))`` with ``T`` the stored (identity-
+    padded) triangle. The scratch inverse is built ONCE at grid step 0
+    (the TPU grid is sequential, so it persists across steps)."""
+
+    def kernel(a_ref, b_ref, out_ref, inv_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            t = a_ref[...].astype(jnp.float32)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            tri = rows >= cols if uplo == "L" else rows <= cols
+            t = jnp.where(tri, t, 0.0)
+            if diag == "U":
+                ondiag = rows == cols
+                t = jnp.where(ondiag, 1.0, t)
+            if uplo == "L":
+                inv_ref[...] = _tri_inv_lower(t, s)
+            else:
+                inv_ref[...] = _tri_inv_lower(t.T, s).T
+
+        b = b_ref[...].astype(jnp.float32)
+        inv = inv_ref[...]
+        # contract b's columns against op(inv): "N" uses inv's rows,
+        # "T"/"C" (real dtypes only) its columns
+        rhs_dim = 0 if op == "N" else 1
+        out = jax.lax.dot_general(
+            b, inv, dimension_numbers=(((1,), (rhs_dim,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[...] = out.astype(out_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("uplo", "op", "diag",
+                                             "interpret"))
+def _fused_solve_rows(a, b, *, uplo: str, op: str, diag: str,
+                      interpret: bool = False):
+    """Canonical right-side solve ``X op(T) = B`` over the rows of the
+    2D ``b`` (free axis first): rows are independent, so the kernel
+    grids over row blocks of the padded triangle's edge."""
+    na = a.shape[-1]
+    f = b.shape[0]
+    s = _pad_size(na, interpret)
+    ap = _identity_pad(a, s)
+    rb = s
+    fp = -(-max(f, 1) // rb) * rb
+    bp = jnp.zeros((fp, s), b.dtype).at[:f, :na].set(b)
+    out = pl.pallas_call(
+        _make_solve_kernel(uplo, op, diag, s),
+        grid=(fp // rb,),
+        in_specs=[
+            pl.BlockSpec((s, s), lambda i: (0, 0)),
+            pl.BlockSpec((rb, s), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((rb, s), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((fp, s), b.dtype),
+        scratch_shapes=[pltpu.VMEM((s, s), jnp.float32)],
+        interpret=interpret,
+    )(ap, bp)
+    return out[:f, :na]
+
+
+def fused_panel_solve(side: str, uplo: str, op: str, diag: str, a, b, *,
+                      alpha=1.0, interpret: bool = False):
+    """Panel TRSM against ONE triangular block ``a``, fused: one
+    ``pallas_call`` for the WHOLE (possibly batched) strip ``b``,
+    batched over the strip's tile axis via the Pallas grid, with the
+    factored diagonal (its in-kernel triangular inverse) held in VMEM
+    scratch across grid steps.
+
+    Same call convention as ``tile_ops.blas.trsm_panel`` (solve
+    ``op(A) X = alpha B`` for side='L' / ``X op(A) = alpha B`` for 'R';
+    ``b`` 2D or a stacked (R, nb, nb) tile batch). Left-side solves are
+    mapped to the right-side canonical kernel through the transpose
+    identity ``op(A) X = B  <=>  X^T op'(A) = B^T`` (real dtypes: 'C'
+    == 'T'); the transposes are cheap XLA relayouts outside the single
+    kernel. f32/bf16 only."""
+    assert a.ndim == 2 and jnp.dtype(a.dtype) in _SUPPORTED, (a.shape,
+                                                              a.dtype)
+    out_dtype = b.dtype
+    if alpha != 1.0:
+        b = (alpha * b).astype(out_dtype)
+    flip = {"N": "T", "T": "N", "C": "N"}
+    if side == "L":
+        bt = jnp.swapaxes(b, -1, -2)
+        out = fused_panel_solve("R", uplo, flip[op], diag, a, bt,
+                                interpret=interpret)
+        return jnp.swapaxes(out, -1, -2)
+    shape = b.shape
+    b2 = b.reshape(-1, shape[-1])
+    kw = dict(uplo=uplo, op="T" if op == "C" else op, diag=diag,
+              interpret=interpret)
+    if not _tracing(a, b2):
+        out = obs.telemetry.call("pallas_panel.solve", _fused_solve_rows,
+                                 a, b2, **kw)
+    else:
+        out = _fused_solve_rows(a, b2, **kw)
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Routing — the panel_impl knob's single owner
+# ---------------------------------------------------------------------------
+
+def _tracing(*arrs) -> bool:
+    """Are we inside a jax trace? (telemetry.call AOT-compiles on
+    concrete args only — inside a builder's jit the kernels inline.)"""
+    return any(isinstance(x, jax.core.Tracer) for x in arrs)
+
+
+def panel_uses_fused(dtype, nb: int, platform=None) -> bool:
+    """Will the panel chain route through the fused Pallas kernels under
+    the current config? Single owner of the ``panel_impl`` route
+    decision (mirrors ``blas.f64_gemm_uses_mxu`` /
+    ``trsm_panel_uses_mixed``): callers resolve it ONCE per entry and
+    thread it into the builders as a static/cache-key argument.
+
+    * ``"xla"`` — never.
+    * ``"auto"`` — fused on TPU for f32/bf16 tiles within
+      :data:`PANEL_MB_MAX`; everything else is route POLICY (uncounted).
+    * ``"fused"`` (explicit) — fused wherever supported (off-TPU the
+      call sites run the kernels in interpret mode); an unsupported
+      dtype/block registers through ``health.registry.report_fallback``
+      (``dlaf_fallback_total{site="panel"}``, strict-mode raise).
+
+    ``health.inject.disable_pallas`` forces the gate closed; when that
+    flips a would-be-True answer the degradation is counted at
+    ``site="panel"`` like every pallas route.
+    """
+    from ..config import get_configuration, resolved_panel_impl
+    from ..health.registry import report_fallback, route_available
+
+    impl = resolved_panel_impl()
+    if impl != "fused":
+        return False
+    supported = jnp.dtype(dtype) in _SUPPORTED and nb <= PANEL_MB_MAX
+    if not supported:
+        if get_configuration().panel_impl == "fused":
+            # the user explicitly asked for the fused route: landing on
+            # XLA is a degradation, not policy — counted, strict raises
+            report_fallback(
+                "panel", "unsupported_dtype"
+                if jnp.dtype(dtype) not in _SUPPORTED else "block_too_large",
+                detail=f"dtype={np.dtype(dtype).name} nb={nb} (fused panel "
+                       f"needs f32/bf16, nb<={PANEL_MB_MAX})")
+        return False
+    return route_available("pallas", "panel")
+
+
+def count_panel_kernel(impl: str, op: str) -> None:
+    """Trace-time panel-kernel accounting (once per emitted kernel in
+    the compiled program): how many panel potrf/solve steps route
+    through the fused kernels vs the XLA op chain."""
+    if obs.metrics_active():
+        obs.counter("dlaf_panel_kernel_total", impl=impl, op=op).inc()
+
+
+def panel_potrf(uplo: str, a, *, fused: bool, interpret: bool = False):
+    """Route one diagonal-tile potrf: the fused Pallas kernel or the
+    XLA route (``tile_ops.lapack.potrf``), counted either way under
+    ``dlaf_panel_kernel_total{impl, op="potrf"}``."""
+    if fused:
+        count_panel_kernel("fused", "potrf")
+        return fused_potrf(uplo, a, interpret=interpret)
+    from . import lapack as tl
+
+    count_panel_kernel("xla", "potrf")
+    return tl.potrf(uplo, a)
+
+
+def panel_solve(side: str, uplo: str, op: str, diag: str, a, b, *,
+                fused: bool, interpret: bool = False, inv_a=None,
+                alpha=1.0):
+    """Route one panel strip solve: the fused grid-batched kernel or
+    the XLA route (``tile_ops.blas.trsm_panel``, which itself honors
+    the ``f64_trsm`` mixed path and consumes ``inv_a``), counted under
+    ``dlaf_panel_kernel_total{impl, op="solve"}``."""
+    if fused:
+        count_panel_kernel("fused", "solve")
+        return fused_panel_solve(side, uplo, op, diag, a, b, alpha=alpha,
+                                 interpret=interpret)
+    from . import blas as tb
+
+    count_panel_kernel("xla", "solve")
+    return tb.trsm_panel(side, uplo, op, diag, a, b, alpha=alpha,
+                         inv_a=inv_a)

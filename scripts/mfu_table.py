@@ -126,6 +126,35 @@ def oz_compute_ceiling(chip: str, dot: str = "bf16") -> float:
     return CHIPS[chip][dot] / OZ_PAIRS / 1e9
 
 
+#: Modeled per-step panel-chain latency (seconds) of the CURRENT product
+#: route: the 2026-08-01 v5e panel-chain probes measured the mixed
+#: (f32-seed + Newton) potrf+trsm chain at ~+0.6 ms/step over pure gemm
+#: at nb=256 (config.py ``f64_trsm`` docstring) — a latency- not
+#: flops-bound figure, so it is held flat across the nb=256..512 configs
+#: (a model, stated so future PRs can refine it with measured numbers).
+#: The fused Pallas panel route (``panel_impl``, docs/pallas_panel.md)
+#: replaces the chain with TWO kernel dispatches per step — modeled
+#: ~0.1 ms/step pending silicon — which is the ~6x panel-ceiling lift
+#: the ``fpanel`` / ``fpanel+fp1`` bench arms exist to measure.
+PANEL_STEP_S = 0.6e-3
+
+#: Families whose per-step panel chain serializes across steps (step
+#: k+1's panel consumes step k's strip): the chain is a WALL-CLOCK FLOOR
+#: of nt * PANEL_STEP_S even under perfect lookahead/comm overlap, so
+#: ``flops / floor`` is a hard ceiling like the rooflines.
+_PANEL_CHAIN_FAMILIES = ("cholesky", "trsm", "hegst")
+
+
+def panel_ceiling(family: str, n: int, nb: int):
+    """Panel-critical-path ceiling in GF/s (steps x modeled panel
+    latency), or None for families without a serialized per-step panel
+    chain."""
+    if family not in _PANEL_CHAIN_FAMILIES:
+        return None
+    nt = -(-n // nb)
+    return _FLOPS_MODEL[family](n) / (nt * PANEL_STEP_S) / 1e9
+
+
 def chol_hbm_ceiling(chip: str, n: int, nb: int) -> float:
     """HBM-roofline GF/s for the blocked Cholesky's ozaki trailing path
     (traffic model in the module docstring; real-arithmetic flops n^3/3)."""
@@ -378,16 +407,20 @@ def build_rows(with_ici=True):
         hbm = (chol_hbm_ceiling(chip, n, nb)
                if family in ("cholesky", "trsm", "hegst") else None)
         ici = ici_ceiling(family, n, nb, grid, chip) if with_ici else None
-        candidates = [comp] + [x for x in (hbm, ici) if x is not None]
+        panel = panel_ceiling(family, n, nb)
+        candidates = [comp] + [x for x in (hbm, ici, panel)
+                               if x is not None]
         ceil = min(candidates)
-        bound = ("ici" if ici is not None and ceil == ici
+        bound = ("panel" if panel is not None and ceil == panel
+                 else "ici" if ici is not None and ceil == ici
                  else "hbm" if hbm is not None and ceil == hbm else "mxu")
         n_m, nb_m = _MEAS_AT.get(label, (n, nb))
         got = measured(family, n_m, nb_m)
         mfu = f"{100.0 * got / ceil:.1f}%" if got else "—"
         rows.append((label, f"ozaki s={OZ_SLICES} (bf16 dots)",
                      f"{comp:.0f}", f"{hbm:.0f}" if hbm else "—",
-                     f"{ici:.0f}" if ici else "—", bound,
+                     f"{ici:.0f}" if ici else "—",
+                     f"{panel:.0f}" if panel else "—", bound,
                      f"{got:.1f}" if got else "pending", mfu, note))
     return rows
 
@@ -415,10 +448,19 @@ def render(with_ici=True) -> str:
             "stage traffic; the `#5 stage` rows carry each trailing "
             "stage's own flop model and roofline (`dc_level_batch` / "
             "`bt_lookahead`, docs/eigensolver_perf.md), so config #5 "
-            "reads per stage instead of through a red2band proxy.\n\n"
+            "reads per stage instead of through a red2band proxy. "
+            "`panel ceil` (step-chain families) = flops / (steps x "
+            "modeled per-step panel-chain latency, "
+            f"{PANEL_STEP_S * 1e3:.1f} ms from the 2026-08-01 probes) — "
+            "the serial panel floor NO overlap can beat; where it binds "
+            "(`bound=panel`), the fused Pallas panel kernels "
+            "(`panel_impl`, docs/pallas_panel.md) are the lever, modeled "
+            "~6x higher at 2 dispatches/step (A/B via the bench "
+            "`fpanel`/`fpanel+fp1` arms).\n\n"
             "| config | route | compute ceil GF/s | HBM ceil GF/s "
-            "| ICI ceil GF/s | bound | measured GF/s | MFU | note |\n"
-            "|---|---|---|---|---|---|---|---|---|\n")
+            "| ICI ceil GF/s | panel ceil GF/s | bound | measured GF/s "
+            "| MFU | note |\n"
+            "|---|---|---|---|---|---|---|---|---|---|\n")
     body = "".join("| " + " | ".join(r) + " |\n"
                    for r in build_rows(with_ici))
     return head + body + END

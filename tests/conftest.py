@@ -79,6 +79,8 @@ _QUICK_TESTS = {
     ("test_ozaki.py", "test_accuracy_f64_grade"),
     ("test_ozaki.py", "test_syrk_matches_matmul"),
     ("test_pallas_kernels.py", "test_masked_trailing_update"),
+    ("test_pallas_panel.py", "test_fused_potrf_parity"),
+    ("test_pallas_panel.py", "test_fused_step_emits_one_kernel_per_panel_op"),
     ("test_tile_ops.py", "test_gemm"),
     ("test_tile_ops.py", "test_lange"),
     ("test_matrix.py", "test_matrix_roundtrip_local"),

@@ -278,6 +278,23 @@ def test_bench_headline_ignores_stage_arms():
     assert out["value"] == 41.0 and "xla" in out["metric"]
 
 
+def test_bench_headline_ignores_fpanel_arms():
+    # the fused-panel A/B pair (ISSUE 10) is an f32 arm with its own
+    # workload label — a (cheap-dtype) faster number must never take the
+    # f64 cholesky headline, and the pair must be known to the sweep
+    bench = _load_bench_module()
+    results = [
+        {"variant": "loop", "platform": "tpu", "dtype": "float64",
+         "gflops": 41.0, "ts": "t1"},
+        {"variant": "fpanel+fp1", "platform": "tpu", "dtype": "float32",
+         "gflops": 4000.0, "workload": "fpanel", "ts": "t2"},
+    ]
+    out = bench.assemble_headline(results, 4096, 256,
+                                  hist_lookup=lambda **kw: None)
+    assert out["value"] == 41.0 and "loop" in out["metric"]
+    assert "fpanel" in bench.STAGE_BASES
+
+
 def test_bench_headline_stage_arms_only():
     # every cholesky arm died, only stage arms landed: the headline is
     # the replayed TPU history entry when one exists, and None (sweep
