@@ -258,6 +258,153 @@ EOF
       cat "$PANEL_DRILL_LOG" >&2; exit 1
     fi
     echo "disable_pallas panel drill tripped as required (DegradationError)"
+    echo "== smoke: batched serving layer (warm queue stream, ISSUE 11) =="
+    # drive serve.Queue end-to-end (docs/serving.md): warmup a bucket
+    # set, then a seeded mixed-shape cholesky/solve/eigh request stream
+    # — the artifact must carry >= 1 batched dispatch, all-hit cache
+    # (post-warmup contract), finite per-request latency, per-request
+    # accuracy records, and zero post-warmup retraces (--require-serve)
+    SERVE_DIR=$(mktemp -d)
+    SERVE_ART="$SERVE_DIR/serve_metrics.jsonl"
+    DLAF_METRICS_PATH="$SERVE_ART" DLAF_PROGRAM_TELEMETRY=1 \
+      DLAF_ACCURACY=1 DLAF_SERVE_BUCKETS=32,64 DLAF_SERVE_BATCH=4 \
+      python - <<'EOF'
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.serve import Queue, Request, get_service
+
+C.initialize()
+rng = np.random.default_rng(0)
+
+
+def hpd(n):
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+reqs = [Request(op="cholesky", a=hpd(int(rng.integers(17, 33))))
+        for _ in range(8)]
+for _ in range(4):
+    n = int(rng.integers(17, 33))
+    reqs.append(Request(op="solve",
+                        a=np.tril(rng.standard_normal((n, n)))
+                        + 3 * np.eye(n),
+                        b=rng.standard_normal((n, 5))))
+for _ in range(4):
+    x = rng.standard_normal((int(rng.integers(17, 33)),) * 2)
+    reqs.append(Request(op="eigh", a=(x + x.T) / 2))
+q = Queue()
+q.warmup(reqs)
+tickets = [q.submit(r) for r in reqs]
+q.flush()
+assert all(t.done for t in tickets)
+for t in tickets:
+    a = np.asarray(t.request.a)
+    assert t.info == 0, (t.request.op, t.info)
+    if t.request.op == "cholesky":
+        fac = np.tril(t.result())
+        ref = np.tril(a) + np.tril(a, -1).T
+        assert np.allclose(fac @ fac.T, ref, atol=1e-8)
+    elif t.request.op == "solve":
+        x = t.result()
+        assert np.allclose(np.tril(a) @ x, np.asarray(t.request.b),
+                           atol=1e-8)
+    else:
+        w, v = t.result()
+        assert np.allclose(a @ v, v * w[None, :], atol=1e-8)
+st = get_service().stats()
+assert st["misses"] == 0 and st["hit_rate"] == 1.0, st
+print(f"serve smoke ok: {q.requests} requests over {q.dispatches} "
+      f"dispatches, {st['warmups']} warmed programs, hit rate "
+      f"{st['hit_rate']:.2f}")
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$SERVE_ART" --require-serve
+    echo "== smoke: serve evict/miss must-trip drill =="
+    # an evicted bucket hit by the next in-bucket request, and an
+    # out-of-bucket shape, must BOTH recompile and bump the miss
+    # counter (rc 0 + marker = the metrics recorded it); then the
+    # drill's own artifact must FAIL --require-serve (miss dispatches +
+    # a retraced serve site) — proving the validator leg has teeth
+    SERVE_DRILL_ART="$SERVE_DIR/serve_drill.jsonl"
+    SERVE_DRILL_LOG=$(mktemp)
+    drill_rc=0
+    DLAF_METRICS_PATH="$SERVE_DRILL_ART" DLAF_PROGRAM_TELEMETRY=1 \
+      DLAF_SERVE_BUCKETS=32 DLAF_SERVE_BATCH=2 \
+      python - > "$SERVE_DRILL_LOG" 2>&1 <<'EOF' || drill_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.serve import Queue, Request, get_service
+
+C.initialize()
+rng = np.random.default_rng(1)
+
+
+def hpd(n):
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+q = Queue()
+sample = [Request(op="cholesky", a=hpd(24))]
+q.warmup(sample)
+(spec,) = q.warmup_specs(sample)
+svc = get_service()
+assert svc.evict(spec), "warm bucket was not resident"
+base = svc.stats()
+# leg 1: the evicted bucket's next in-bucket request must recompile
+q.submit(Request(op="cholesky", a=hpd(24)))
+q.submit(Request(op="cholesky", a=hpd(20)))
+st = svc.stats()
+assert st["misses"] == base["misses"] + 1, (base, st)
+assert st["compiles"] == base["compiles"] + 1, (base, st)
+retrace = obs.registry().counter("dlaf_retrace_total",
+                                 site=spec.site).snapshot()
+assert retrace["value"] >= 2, retrace
+# leg 2: an out-of-bucket shape (above every configured ceiling) lands
+# in a cold power-of-two bucket — another miss + compile
+q.submit(Request(op="cholesky", a=hpd(40)))
+q.submit(Request(op="cholesky", a=hpd(40)))
+st2 = svc.stats()
+assert st2["misses"] == st["misses"] + 1, (st, st2)
+assert st2["compiles"] == st["compiles"] + 1, (st, st2)
+print(f"serve evict drill ok: misses {base['misses']}->{st2['misses']}, "
+      f"recompiles {base['compiles']}->{st2['compiles']}, "
+      f"retrace[{spec.site}]={retrace['value']}")
+obs.flush()
+EOF
+    if [ "$drill_rc" -ne 0 ] \
+        || ! grep -q "serve evict drill ok" "$SERVE_DRILL_LOG"; then
+      echo "serve evict/miss drill failed (rc=$drill_rc)" >&2
+      cat "$SERVE_DRILL_LOG" >&2; exit 1
+    fi
+    grep "serve evict drill ok" "$SERVE_DRILL_LOG"
+    if python -m dlaf_tpu.obs.validate "$SERVE_DRILL_ART" --require-serve \
+        > /dev/null 2>&1; then
+      echo "--require-serve FAILED to flag the evict-drill artifact" \
+           "(miss dispatches + retraced serve site)" >&2; exit 1
+    fi
+    echo "--require-serve correctly rejected the evict-drill artifact"
+    echo "== smoke: serve bench arm + speedup gate =="
+    # the serving workload arm (bench.py, workload=serve) must clear the
+    # ISSUE-11 floor — batched entry >= 3x a loop of singleton cholesky
+    # calls — enforced by bench_gate's history-free speedup leg; an
+    # absurd floor must trip it (the leg's own must-trip)
+    SERVE_BENCH_ART="$SERVE_DIR/serve_bench.jsonl"
+    # history redirected: a CI container's numbers must never enter the
+    # git-tracked drift baselines (the gate reads the obs artifact)
+    DLAF_BENCH_VARIANT=serve DLAF_METRICS_PATH="$SERVE_BENCH_ART" \
+      DLAF_BENCH_HISTORY_PATH="$SERVE_DIR/bench_history.jsonl" \
+      DLAF_ACCURACY=1 python bench.py > /dev/null
+    python scripts/bench_gate.py --fresh "$SERVE_BENCH_ART"
+    if python scripts/bench_gate.py --fresh "$SERVE_BENCH_ART" \
+        --min-serve-speedup 1000 > /dev/null 2>&1; then
+      echo "bench_gate FAILED to flag a sub-floor serve speedup" >&2
+      exit 1
+    fi
+    echo "bench_gate serve-speedup leg trips as required"
     echo "== smoke: eigensolver pipeline (batched D&C + pipelined bt) =="
     # distributed eigensolver on a 2x2 virtual-CPU grid with the two
     # ISSUE-6 knobs pinned ON (the CPU auto would resolve both off): the

@@ -3,6 +3,7 @@
 ``multiplication::triangular``/``general``, ``eigensolver::genToStd``,
 ``permutations::permute``, ``auxiliary::norm``)."""
 
+from .batched import cholesky_batched, eigh_batched, solve_batched
 from .cholesky import cholesky
 from .qr import t_factor
 from .gen_to_std import gen_to_std
@@ -13,6 +14,9 @@ from .triangular import triangular_multiply, triangular_solve
 
 __all__ = [
     "cholesky",
+    "cholesky_batched",
+    "eigh_batched",
+    "solve_batched",
     "t_factor",
     "gen_to_std",
     "general_sub_multiply",

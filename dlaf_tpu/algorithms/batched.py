@@ -1,0 +1,236 @@
+"""Batched many-problem entry points (leading batch axis).
+
+The production serving regime (ROADMAP item 1; arXiv:2112.09017's
+batch-small-problems idiom, already proven inside the level-batched D&C
+merge driver of PR 6) is millions of SMALL solve/EVP requests, where
+per-request dispatch/retrace/compile latency — not the MXU — bounds
+throughput. This module promotes that idiom to the public API: one
+vmapped program factors/solves/diagonalizes a whole ``(B, n, n)`` batch
+per dispatch, compiled once per shape bucket and served warm from the
+:mod:`dlaf_tpu.serve` program cache.
+
+Three entry points, each the vmapped form of a pinned singleton kernel:
+
+* :func:`cholesky_batched` — per-lane Cholesky over the ``uplo``
+  triangle, riding the whole-matrix XLA route of the local builder
+  (``_cholesky_local(trailing="xla")``): for serve-sized problems the
+  blocked panel chain buys nothing, and the fused whole-matrix
+  factorization is the one route whose vmapped lanes are **bitwise
+  identical** to the unbatched singleton program on the supported
+  backends (pinned by tests/test_serve.py).
+* :func:`solve_batched` — per-lane triangular solve (all
+  side/uplo/op/diag combos, per-lane ``alpha``), the batched form of
+  ``_solve_local``.
+* :func:`eigh_batched` — per-lane Hermitian eigendecomposition of the
+  ``uplo`` triangle (ascending eigenvalues + eigenvector columns).
+
+Parity contract (docs/serving.md): a batched dispatch and a loop of
+B=1 dispatches of the SAME bucket program are bitwise identical lane
+for lane — XLA's batched lowerings are lane-deterministic and
+batch-size-invariant, so pad lanes are provably inert. The rank-2
+(no-batch-axis) lowering of the triangular solve differs from its
+batched form at the ~1 ulp level on some backends, which is why the
+singleton comparator IS the B=1 program (``*_batched`` with ``B == 1``)
+rather than a differently-lowered scalar entry; the Cholesky and eigh
+kernels are additionally bitwise against their unbatched forms.
+
+``with_info=True`` returns a per-element int32 info VECTOR ``(B,)`` —
+the singleton info contract (:mod:`dlaf_tpu.health.info`) vmapped:
+0 per clean lane, else the 1-based first failing/singular column of
+that lane. :func:`dlaf_tpu.health.robust_cholesky_batched` is the
+recovery driver over it (re-shifts and re-dispatches only the failed
+lanes).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import obs
+from ..common.asserts import dlaf_assert
+from ..health import info as hinfo
+from ..types import total_ops
+from .cholesky import _cholesky_local
+from .triangular import _solve_local
+
+#: Default block size of the batched bucket programs. The whole-matrix
+#: serve routes do not block internally, but ``nb`` stays a first-class
+#: bucket-key member (ISSUE 11) so a future blocked batched route slots
+#: in without a cache-key migration.
+DEFAULT_NB = 256
+
+
+def default_nb(n: int) -> int:
+    return max(1, min(int(n), DEFAULT_NB))
+
+
+# ---------------------------------------------------------------------------
+# Singleton kernels (the functions the bucket programs vmap)
+# ---------------------------------------------------------------------------
+
+def cholesky_one(a, *, uplo: str, nb: int, with_info: bool = False):
+    """ONE lane of the batched Cholesky: the local builder's whole-matrix
+    XLA route (triangle pass-through semantics preserved; in-graph info
+    composition shared with ``cholesky(..., with_info=True)``)."""
+    return _cholesky_local.__wrapped__(a, uplo=uplo, nb=nb, trailing="xla",
+                                       with_info=with_info)
+
+
+def solve_one(a, b, alpha, *, side: str, uplo: str, op: str, diag: str,
+              with_info: bool = False):
+    """ONE lane of the batched triangular solve: ``op(A) X = alpha B``
+    (side='L') / ``X op(A) = alpha B`` (side='R') over the ``uplo``
+    triangle. ``with_info`` adds the singular-diagonal detection of
+    ``health.matrix_diag_info`` (zero OR non-finite diagonal; constant 0
+    for unit-diagonal solves, which never read the stored diagonal)."""
+    x = _solve_local.__wrapped__(a, b, alpha, side=side, uplo=uplo, op=op,
+                                 diag=diag)
+    if not with_info:
+        return x
+    if diag == "U":
+        info = jnp.zeros((), jnp.int32)
+    else:
+        d = jnp.diagonal(a)
+        info = hinfo.first_bad_info(hinfo.bad_diag_mask(d, singular=True))
+    return x, info
+
+
+def eigh_one(a, *, uplo: str, with_info: bool = False):
+    """ONE lane of the batched Hermitian eigensolver: eigenvalues
+    (ascending) + eigenvector columns of the matrix whose ``uplo``
+    triangle is stored in ``a`` (the other triangle is ignored — the
+    library-wide triangle contract, built explicitly here so the
+    backend's symmetrization can never read pass-through data).
+    ``with_info`` flags non-finite eigenvalues (1-based first bad
+    index), the in-graph convergence-corruption signal."""
+    if uplo == "L":
+        ah = jnp.tril(a) + jnp.conj(jnp.tril(a, -1)).swapaxes(-1, -2)
+    else:
+        ah = jnp.triu(a) + jnp.conj(jnp.triu(a, 1)).swapaxes(-1, -2)
+    w, v = jnp.linalg.eigh(ah, symmetrize_input=False)
+    if not with_info:
+        return w, v
+    return w, v, hinfo.first_bad_info(~jnp.isfinite(w))
+
+
+# ---------------------------------------------------------------------------
+# Public batched entry points
+# ---------------------------------------------------------------------------
+
+def _check_batch(a, what: str) -> tuple:
+    dlaf_assert(hasattr(a, "ndim") and a.ndim == 3,
+                f"{what}: expected a (B, n, n) batch, got "
+                f"shape {getattr(a, 'shape', None)}")
+    b_, n, n2 = a.shape
+    dlaf_assert(n == n2, f"{what}: lanes must be square, got {a.shape}")
+    dlaf_assert(b_ >= 1, f"{what}: empty batch")
+    return b_, n
+
+
+def cholesky_batched(uplo: str, a, *, nb: int = None,
+                     with_info: bool = False, donate: bool = False,
+                     service=None):
+    """Cholesky-factorize every lane of the ``(B, n, n)`` batch ``a`` in
+    its ``uplo`` triangle with ONE compiled, vmapped program served from
+    the :mod:`dlaf_tpu.serve` program cache (warm after
+    ``serve.warmup``; per-bucket hit/miss/compile metrics either way).
+
+    Returns the ``(B, n, n)`` factor batch (per-lane ``uplo`` triangle =
+    factor, other triangle passes through), plus a per-lane int32 info
+    vector when ``with_info=True``. ``donate=True`` donates ``a``'s
+    buffer to the dispatch (the queue's hot path — the padded batch it
+    owns); ``a`` must not be used afterwards.
+    """
+    dlaf_assert(uplo in ("L", "U"),
+                f"cholesky_batched: uplo must be 'L' or 'U', got {uplo!r}")
+    b_, n = _check_batch(a, "cholesky_batched")
+    from ..serve.programs import cholesky_spec, get_service
+
+    dt = np.dtype(a.dtype)
+    spec = cholesky_spec(batch=b_, n=n, nb=nb or default_nb(n),
+                         dtype=dt.name, uplo=uplo, with_info=with_info,
+                         donate=donate)
+    svc = service if service is not None else get_service()
+    entry_span = obs.entry_span("cholesky_batched", lambda: dict(
+        flops=b_ * total_ops(dt, n**3 / 6, n**3 / 6), batch=b_, n=n,
+        nb=spec.nb, uplo=uplo, dtype=dt.name))
+    with entry_span:
+        return svc.run(spec, a)
+
+
+def solve_batched(side: str, uplo: str, op: str, diag: str, alpha, a, b,
+                  *, nb: int = None, with_info: bool = False,
+                  donate_b: bool = False, service=None):
+    """Triangular-solve every lane: ``op(A_i) X_i = alpha_i B_i``
+    (side='L') / ``X_i op(A_i) = alpha_i B_i`` (side='R') for the
+    ``(B, n, n)`` triangle batch ``a`` and ``(B, n, nrhs)`` (side='L';
+    ``(B, nrhs, n)`` side='R') rhs batch ``b``, one vmapped bucket
+    program per (n, nrhs, dtype, side/uplo/op/diag) key. ``alpha`` may
+    be a scalar or a per-lane ``(B,)`` vector (a traced operand — it is
+    never part of the bucket key). ``with_info=True`` adds the per-lane
+    singular-diagonal info vector. ``donate_b=True`` donates the rhs
+    buffer (the entry's output aliases it)."""
+    for name, val, choices in (("side", side, ("L", "R")),
+                               ("uplo", uplo, ("L", "U")),
+                               ("op", op, ("N", "T", "C")),
+                               ("diag", diag, ("N", "U"))):
+        dlaf_assert(val in choices,
+                    f"solve_batched: {name} must be one of {choices}, "
+                    f"got {val!r}")
+    b_, n = _check_batch(a, "solve_batched")
+    dlaf_assert(hasattr(b, "ndim") and b.ndim == 3 and b.shape[0] == b_,
+                f"solve_batched: rhs must be (B, ., .) with B={b_}, got "
+                f"shape {getattr(b, 'shape', None)}")
+    solve_dim = b.shape[1] if side == "L" else b.shape[2]
+    nrhs = b.shape[2] if side == "L" else b.shape[1]
+    dlaf_assert(solve_dim == n,
+                f"solve_batched: rhs solve dimension {solve_dim} != n={n}")
+    from ..serve.programs import get_service, solve_spec
+
+    dt = np.dtype(a.dtype)
+    spec = solve_spec(batch=b_, n=n, nrhs=nrhs, nb=nb or default_nb(n),
+                      dtype=dt.name, side=side, uplo=uplo, transa=op,
+                      diag=diag, with_info=with_info, donate=donate_b)
+    svc = service if service is not None else get_service()
+    alpha_vec = jnp.broadcast_to(jnp.asarray(alpha, dtype=dt), (b_,))
+    entry_span = obs.entry_span("solve_batched", lambda: dict(
+        flops=b_ * total_ops(dt, n**2 * nrhs / 2, n**2 * nrhs / 2),
+        batch=b_, n=n, nrhs=nrhs, nb=spec.nb, side=side, uplo=uplo, op=op,
+        diag=diag, dtype=dt.name))
+    with entry_span:
+        return svc.run(spec, a, b, alpha_vec)
+
+
+def eigh_batched(uplo: str, a, *, nb: int = None, with_info: bool = False,
+                 donate: bool = False, service=None):
+    """Eigendecompose every Hermitian lane of the ``(B, n, n)`` batch
+    ``a`` (``uplo`` triangle stored; the other triangle is ignored) with
+    one vmapped bucket program. Returns ``(w, v)`` — eigenvalues
+    ``(B, n)`` ascending, eigenvector columns ``(B, n, n)`` — plus the
+    per-lane non-finite-eigenvalue info vector when ``with_info=True``.
+    """
+    dlaf_assert(uplo in ("L", "U"),
+                f"eigh_batched: uplo must be 'L' or 'U', got {uplo!r}")
+    b_, n = _check_batch(a, "eigh_batched")
+    from ..serve.programs import eigh_spec, get_service
+
+    dt = np.dtype(a.dtype)
+    spec = eigh_spec(batch=b_, n=n, nb=nb or default_nb(n), dtype=dt.name,
+                     uplo=uplo, with_info=with_info, donate=donate)
+    svc = service if service is not None else get_service()
+    entry_span = obs.entry_span("eigh_batched", lambda: dict(
+        flops=b_ * total_ops(dt, 5 * n**3 / 3, 5 * n**3 / 3), batch=b_,
+        n=n, nb=spec.nb, uplo=uplo, dtype=dt.name))
+    with entry_span:
+        return svc.run(spec, a)
+
+
+#: spec.op -> the singleton kernel the bucket program vmaps (consumed by
+#: serve.programs.program_builder and the graphcheck serve specs).
+SINGLETON_KERNELS = {
+    "cholesky": cholesky_one,
+    "solve": solve_one,
+    "eigh": eigh_one,
+}

@@ -308,6 +308,29 @@ def program_specs(rows: int = 2, cols: int = 2, n: int = 24, nb: int = 4,
                  (jax.ShapeDtypeStruct((n_sweeps, n_steps, nb), f64),
                   jax.ShapeDtypeStruct((n_sweeps, n_steps), f64),
                   jax.ShapeDtypeStruct((n,), f64), st)))
+
+    # ---- serve batched bucket programs (ISSUE 11, docs/serving.md):
+    # the vmapped forms the program service compiles, built through the
+    # SAME builder the service uses (serve.programs.program_builder) so
+    # the audited programs are the served programs; f64 on the pinned
+    # native config, with_info on (the serving default). ----
+    from dlaf_tpu.serve.programs import (cholesky_spec, eigh_spec,
+                                         program_builder, solve_spec)
+
+    serve_specs = [
+        cholesky_spec(batch=3, n=n, nb=nb, dtype="float64", uplo="L"),
+        cholesky_spec(batch=3, n=n, nb=nb, dtype="float64", uplo="U"),
+        solve_spec(batch=3, n=n, nrhs=nb, nb=nb, dtype="float64",
+                   side="L", uplo="L", transa="N", diag="N"),
+        solve_spec(batch=3, n=n, nrhs=nb, nb=nb, dtype="float64",
+                   side="R", uplo="U", transa="C", diag="N"),
+        eigh_spec(batch=3, n=n, nb=nb, dtype="float64", uplo="L"),
+    ]
+    for sspec in serve_specs:
+        tag = (f"{sspec.side}{sspec.uplo}{sspec.transa}"
+               if sspec.op == "solve" else sspec.uplo)
+        add(f"serve.{sspec.op}.batched.{tag}",
+            lambda sspec=sspec: program_builder(sspec)[:2])
     return specs
 
 

@@ -77,6 +77,11 @@ HOST_SYNC_ALLOWED = (
     "dlaf_tpu/native/", "dlaf_tpu/tpu_info.py",
     # the analysis layer itself is a host-side CLI/reporting tool
     "dlaf_tpu/analysis/",
+    # the serving front end IS the host boundary: the queue assembles
+    # batches on host, evaluates deadlines against a host clock, and
+    # fences dispatches for honest per-request latency records
+    # (docs/serving.md) — its syncs are the contract, not a leak
+    "dlaf_tpu/serve/",
 )
 
 #: Literal DLAF_* env names that are deliberately NOT Configuration

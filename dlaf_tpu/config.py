@@ -424,6 +424,41 @@ class Configuration:
     #: verifies regardless of the knob — the knob only picks the
     #: estimator mode ("0" checks with the "1" probe).
     accuracy: str = "0"
+    #: Bucket ceilings of the serving layer (``DLAF_SERVE_BUCKETS``,
+    #: docs/serving.md): a comma-separated ascending list of matrix sizes
+    #: (e.g. "32,64,128") that :class:`dlaf_tpu.serve.Queue` rounds
+    #: incoming request shapes up to — one compiled (and ideally warmed)
+    #: batched program per ceiling. Empty (default) = power-of-two
+    #: ceilings chosen per request (next power of two >= n, min 8); a
+    #: request larger than the largest explicit ceiling also falls back
+    #: to the next power of two, so no shape is ever rejected (it just
+    #: pays a cold compile — the cache-miss signal the serve metrics
+    #: surface).
+    serve_buckets: str = ""
+    #: Lanes per batched serve dispatch (``DLAF_SERVE_BATCH``): the
+    #: bucket's vmapped program factors this many problems per dispatch;
+    #: the queue dispatches early on deadline expiry with the missing
+    #: lanes identity-padded (provably inert — docs/serving.md padding
+    #: contract). 16 is the smallest batch for which the measured
+    #: dispatch-overhead amortization clears the ISSUE-11 3x
+    #: requests/s bar with margin on every platform.
+    serve_batch: int = 16
+    #: Queue deadline in milliseconds (``DLAF_SERVE_DEADLINE_MS``): a
+    #: bucket with pending requests older than this dispatches at the
+    #: next ``submit``/``poll`` even if not full. The queue never runs a
+    #: background thread — expiry is evaluated against the injected
+    #: clock at those calls, so dispatch composition is deterministic
+    #: and testable (docs/serving.md deadline semantics).
+    serve_deadline_ms: float = 50.0
+    #: LRU byte budget of the serve program cache
+    #: (``DLAF_SERVE_CACHE_BYTES``): compiled bucket programs are
+    #: retained up to this many bytes (per-program cost =
+    #: ``memory_analysis()`` peak where the backend reports one, an
+    #: aval-derived estimate otherwise), evicting
+    #: least-recently-dispatched unpinned programs first;
+    #: ``serve.ProgramService.pin`` exempts a program from eviction.
+    #: 0 (default) = unbounded.
+    serve_cache_bytes: int = 0
     #: Program telemetry (``DLAF_PROGRAM_TELEMETRY``): the algorithm entry
     #: points and the library's cached-program sites record per-site
     #: compile walls (``dlaf_compile_seconds{site}``), trace counts
@@ -524,8 +559,36 @@ def _validate(cfg: Configuration) -> None:
     if cfg.mixed_seed_base < 1:
         raise ValueError(f"mixed_seed_base={cfg.mixed_seed_base}: must be >= 1"
                          " (the recursive seed's leaf size)")
+    if cfg.serve_batch < 1:
+        raise ValueError(f"serve_batch={cfg.serve_batch}: must be >= 1 "
+                         "(lanes per batched serve dispatch)")
+    if not cfg.serve_deadline_ms >= 0:
+        raise ValueError(f"serve_deadline_ms={cfg.serve_deadline_ms}: must "
+                         "be >= 0 (0 = dispatch at the first poll)")
+    if cfg.serve_cache_bytes < 0:
+        raise ValueError(f"serve_cache_bytes={cfg.serve_cache_bytes}: must "
+                         "be >= 0 (0 = unbounded)")
+    parse_serve_buckets(cfg.serve_buckets)   # raises on a malformed list
     # cholesky_trailing is validated against VALID_TRAILING at the use site
     # (algorithms/cholesky.py) to keep the list next to the implementations
+
+
+def parse_serve_buckets(value: str) -> tuple:
+    """``serve_buckets`` parsed to an ascending tuple of positive ints
+    (empty tuple = the power-of-two auto policy). A malformed list must
+    fail loudly at initialize(), not silently misroute every request to
+    the auto buckets."""
+    if not str(value).strip():
+        return ()
+    try:
+        buckets = tuple(int(tok) for tok in str(value).split(","))
+    except ValueError:
+        raise ValueError(f"serve_buckets={value!r}: must be a "
+                         "comma-separated list of positive ints")
+    if any(b < 1 for b in buckets) or list(buckets) != sorted(set(buckets)):
+        raise ValueError(f"serve_buckets={value!r}: ceilings must be "
+                         "positive, strictly ascending, and unique")
+    return buckets
 
 
 _active: Optional[Configuration] = None

@@ -31,16 +31,18 @@ from .registry import (FALLBACK_COUNTER, report_fallback, route_available,  # no
 
 __all__ = [
     "CheckError", "DegradationError", "FactorizationError", "HealthError",
-    "FALLBACK_COUNTER", "RETRY_COUNTER", "RecoveryResult",
+    "FALLBACK_COUNTER", "RETRY_COUNTER", "BatchRecoveryResult",
+    "RecoveryResult",
     "check_finite", "inject", "info", "matrix_diag_info", "registry",
-    "report_fallback", "robust_cholesky", "route_available",
-    "run_with_fallback", "shift_diagonal", "strict_mode",
+    "report_fallback", "robust_cholesky", "robust_cholesky_batched",
+    "route_available", "run_with_fallback", "shift_diagonal", "strict_mode",
 ]
 
 #: Symbols served lazily from .recovery (it imports the matrix layer;
 #: keeping it out of package-import time lets low-level modules — comm,
 #: tile_ops — consult .inject/.registry without an import cycle).
-_LAZY = ("robust_cholesky", "RecoveryResult", "RETRY_COUNTER",
+_LAZY = ("robust_cholesky", "robust_cholesky_batched", "RecoveryResult",
+         "BatchRecoveryResult", "RETRY_COUNTER",
          "check_finite", "shift_diagonal", "recovery")
 
 

@@ -173,6 +173,134 @@ def robust_cholesky(uplo: str, mat, *, max_attempts: int = 4,
                              shifts=tuple(shifts), infos=tuple(infos))
 
 
+@dataclasses.dataclass(frozen=True)
+class BatchRecoveryResult:
+    """Outcome of a successful :func:`robust_cholesky_batched`.
+
+    ``out`` holds the ``(B, n, n)`` factor batch; ``attempts`` is the
+    max attempts any lane needed (1 = no recovery anywhere);
+    ``lane_attempts`` counts attempts per lane; ``shifts`` records the
+    per-attempt shared shift scale (first is 0.0); ``infos`` the
+    per-attempt full-batch info vectors (lanes already clean in an
+    earlier attempt repeat their 0)."""
+
+    out: object
+    attempts: int
+    lane_attempts: tuple
+    shifts: tuple
+    infos: tuple
+
+
+def robust_cholesky_batched(uplo: str, a, *, nb: Optional[int] = None,
+                            max_attempts: int = 4,
+                            shift: Optional[float] = None,
+                            shift_growth: float = 1e4,
+                            service=None) -> BatchRecoveryResult:
+    """Batched :func:`robust_cholesky`: factorize the ``(B, n, n)`` batch
+    ``a`` through :func:`dlaf_tpu.algorithms.batched.cholesky_batched`
+    with per-LANE shift-retry recovery.
+
+    Attempt 0 factors the whole batch unshifted. On nonzero lane infos,
+    ONLY the failed lanes are re-shifted from the ORIGINAL batch
+    (``A_i + alpha*I``; ``alpha`` defaults to ``sqrt(eps) * max|A|`` over
+    the batch and grows by ``shift_growth`` per retry) and re-dispatched
+    as ONE batch through the SAME warm bucket program — the still-clean
+    slots ride as inert identity pad lanes, so a retry never compiles a
+    second program or re-factors a lane that already succeeded. Retries
+    count per lane under ``dlaf_retry_total{algo="cholesky_batched",
+    lane}``; each attempt is a ``robust_cholesky_batched.attempt`` span
+    with ``attempt``/``shift``/``lanes`` attrs. Exhaustion raises
+    :class:`FactorizationError` whose ``failing_column`` is the first
+    still-failing lane's info and whose ``infos`` carry every still-bad
+    lane's final info.
+
+    The original ``a`` must stay live across attempts (each retry
+    re-shifts the failed subset from it); every dispatched working batch
+    is donated internally.
+    """
+    from ..algorithms.batched import cholesky_batched, default_nb
+
+    if max_attempts < 1:
+        raise ValueError(f"max_attempts={max_attempts}: must be >= 1")
+    if shift is not None and not shift > 0:
+        raise ValueError(f"shift={shift}: must be > 0 (or None for the "
+                         "sqrt(eps)*max|A| default)")
+    if not shift_growth > 1:
+        raise ValueError(f"shift_growth={shift_growth}: must be > 1")
+    a = np.asarray(a)
+    if a.ndim != 3 or a.shape[1] != a.shape[2]:
+        raise ValueError(f"robust_cholesky_batched: expected a (B, n, n) "
+                         f"batch, got shape {a.shape}")
+    if checks_enabled():
+        count = int(np.sum(~np.isfinite(a)))
+        if count:
+            obs.counter("dlaf_check_failures_total",
+                        what="cholesky_batched input").inc()
+            raise CheckError("cholesky_batched input", count)
+    b_, n = a.shape[0], a.shape[1]
+    nb = nb if nb is not None else default_nb(n)
+    eye = np.eye(n, dtype=a.dtype)
+    log = obs.get_logger("health")
+    alpha = 0.0
+    shifts, infos_hist = [], []
+    lane_attempts = np.zeros(b_, dtype=int)
+    out = None
+    failed = np.arange(b_)
+    for attempt in range(max_attempts):
+        span = obs.span("robust_cholesky_batched.attempt", attempt=attempt,
+                        shift=float(alpha), lanes=len(failed), batch=b_,
+                        n=n, uplo=uplo, dtype=np.dtype(a.dtype).name)
+        with span:
+            # donated working batch of the FULL bucket width: failed
+            # lanes re-shifted from the original, remaining slots inert
+            # identity pad lanes (same program, cache stays warm)
+            work = np.broadcast_to(eye, a.shape).copy()
+            work[failed] = a[failed] + alpha * eye
+            fac, info_dev = cholesky_batched(uplo, work, nb=nb,
+                                             with_info=True, donate=True,
+                                             service=service)
+            info = np.asarray(info_dev)      # the one host sync/attempt
+            span.set_attr("failed", int(np.count_nonzero(info[failed])))
+        lane_attempts[failed] += 1
+        # full-batch info vector for the record: untouched lanes are 0
+        full_info = np.zeros(b_, dtype=info.dtype)
+        full_info[failed] = info[failed]
+        shifts.append(float(alpha))
+        infos_hist.append(tuple(int(i) for i in full_info))
+        newly_ok = failed[full_info[failed] == 0]
+        if out is None:
+            out = fac
+        elif len(newly_ok):
+            out = jnp.asarray(out).at[newly_ok].set(fac[newly_ok])
+        failed = failed[full_info[failed] != 0]
+        if len(failed) == 0:
+            return BatchRecoveryResult(
+                out, attempts=int(lane_attempts.max(initial=1)),
+                lane_attempts=tuple(int(x) for x in lane_attempts),
+                shifts=tuple(shifts), infos=tuple(infos_hist))
+        if attempt + 1 < max_attempts:
+            for lane in failed:
+                obs.counter(RETRY_COUNTER, algo="cholesky_batched",
+                            lane=int(lane)).inc()
+            if alpha == 0.0:
+                amax = float(np.abs(a).max(initial=0.0)) or 1.0
+                eps = float(np.finfo(np.dtype(a.dtype).type(0).real.dtype
+                                     ).eps)
+                alpha = shift if shift is not None \
+                    else float(np.sqrt(eps)) * amax
+            else:
+                alpha *= shift_growth
+            log.warning(
+                f"cholesky_batched: {len(failed)} of {b_} lanes failed at "
+                f"attempt {attempt} (infos "
+                f"{[int(full_info[i]) for i in failed]}); retrying the "
+                f"subset with diagonal shift {alpha:.3e}", n=n, uplo=uplo,
+                attempt=attempt, lanes=len(failed))
+    bad = [int(full_info[i]) for i in failed]
+    raise FactorizationError(failing_column=bad[0], attempts=max_attempts,
+                             shifts=tuple(shifts), infos=tuple(bad))
+
+
 def _default_shift(mat) -> float:
     """Initial shift scale: ``sqrt(eps) * max|A|`` — large enough to
     regularize rounding-level indefiniteness in one step, small enough to

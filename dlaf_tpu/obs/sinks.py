@@ -47,6 +47,23 @@ Schema (version 1). Every record carries ``v`` (int schema version),
     e.g. the D&C deflation fraction, omit all three); a record may not
     carry both ``bound_ratio`` and ``nonfinite``.
 
+``serve``
+    Serving-layer record (:mod:`dlaf_tpu.serve`, docs/serving.md), two
+    events: ``dispatch`` — one batched bucket dispatch (``op`` str,
+    ``bucket_n`` int >= 1, ``nrhs`` int >= 0, ``dtype`` str, ``lanes``
+    int in [0, batch], ``batch`` int >= 1, ``cache`` "hit" | "miss",
+    finite ``dispatch_s`` >= 0) — and ``request`` — one served request
+    (``op`` str, ``n`` int >= 1, ``bucket_n`` >= n, ``dtype`` str,
+    finite ``queue_s``/``total_s`` >= 0, ``attrs`` object). The
+    ``--require-serve`` CI obligation (a WARMED steady-state serving
+    artifact): >= 1 dispatch with >= 2 occupied lanes, every dispatch a
+    cache hit (zero misses — the post-warmup contract), >= 1 request
+    with finite latency, >= 1 ``accuracy`` record from site ``serve``
+    with finite value AND bound_ratio, and no
+    ``dlaf_retrace_total{site=serve.*}`` counter at >= 2 (a serve
+    program traced twice = an evicted/cold bucket recompiled
+    mid-stream).
+
 Every record additionally carries an optional ``rank`` (int >= 0,
 ``jax.process_index()``) — stamped by the sink once the rank is known, so
 multi-host artifacts merge per rank (``python -m dlaf_tpu.obs.aggregate``;
@@ -74,7 +91,7 @@ from typing import Optional
 SCHEMA_VERSION = 1
 
 KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
-               "accuracy")
+               "accuracy", "serve")
 
 
 def expand_rank_template(path: str) -> str:
@@ -243,6 +260,53 @@ def _validate_accuracy(r: dict, where: str, errors: list) -> None:
         errors.append(f"{where}: accuracy attrs must be an object")
 
 
+def _validate_serve(r: dict, where: str, errors: list) -> None:
+    event = r.get("event")
+    if event not in ("dispatch", "request"):
+        errors.append(f"{where}: serve event must be dispatch|request, "
+                      f"got {event!r}")
+        return
+    for key in ("op", "dtype"):
+        if not isinstance(r.get(key), str) or not r.get(key):
+            errors.append(f"{where}: serve record without a {key}")
+    if not isinstance(r.get("bucket_n"), int) \
+            or isinstance(r.get("bucket_n"), bool) or r.get("bucket_n", 0) < 1:
+        errors.append(f"{where}: serve bucket_n must be a positive int")
+    if event == "dispatch":
+        lanes, batch = r.get("lanes"), r.get("batch")
+        if not isinstance(r.get("nrhs"), int) \
+                or isinstance(r.get("nrhs"), bool) or r.get("nrhs", -1) < 0:
+            errors.append(f"{where}: serve dispatch nrhs must be a "
+                          "non-negative int")
+        if not isinstance(batch, int) or isinstance(batch, bool) or batch < 1:
+            errors.append(f"{where}: serve dispatch batch must be a "
+                          "positive int")
+        if not isinstance(lanes, int) or isinstance(lanes, bool) \
+                or lanes < 0 or (isinstance(batch, int) and lanes > batch):
+            errors.append(f"{where}: serve dispatch lanes must be an int "
+                          "in [0, batch]")
+        if r.get("cache") not in ("hit", "miss"):
+            errors.append(f"{where}: serve dispatch cache must be "
+                          f"hit|miss, got {r.get('cache')!r}")
+        if not _finite(r.get("dispatch_s")) or r.get("dispatch_s", -1) < 0:
+            errors.append(f"{where}: serve dispatch_s "
+                          "missing/non-finite/negative")
+    else:
+        if not isinstance(r.get("n"), int) or isinstance(r.get("n"), bool) \
+                or r.get("n", 0) < 1:
+            errors.append(f"{where}: serve request n must be a positive int")
+        elif isinstance(r.get("bucket_n"), int) \
+                and r["bucket_n"] < r["n"]:
+            errors.append(f"{where}: serve request bucket_n < n — the "
+                          "bucket must be a ceiling")
+        for key in ("queue_s", "total_s"):
+            if not _finite(r.get(key)) or r.get(key, -1) < 0:
+                errors.append(f"{where}: serve request {key} "
+                              "missing/non-finite/negative")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: serve attrs must be an object")
+
+
 def _validate_metrics(r: dict, where: str, errors: list) -> None:
     entries = r.get("metrics")
     if not isinstance(entries, list):
@@ -269,7 +333,8 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_collectives=False, require_retries=False,
                      require_fallbacks=False, require_comm_overlap=False,
                      require_dc_batch=False, require_bt_overlap=False,
-                     require_telemetry=False, require_accuracy=False) -> list:
+                     require_telemetry=False, require_accuracy=False,
+                     require_serve=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -296,11 +361,23 @@ def validate_records(records, require_spans=False, require_gflops=False,
     (``require_accuracy``) at least one ``accuracy`` record with a finite
     value AND a finite ``bound_ratio`` (the DLAF_ACCURACY audit trail,
     docs/accuracy.md: an informational-only or all-nonfinite artifact
-    must not satisfy the accuracy obligation)."""
+    must not satisfy the accuracy obligation), and (``require_serve``)
+    the warmed steady-state serving obligation (docs/serving.md): >= 1
+    ``serve`` dispatch record with >= 2 occupied lanes, ZERO dispatch
+    records with ``cache: miss``, >= 1 request record with finite
+    latency, >= 1 accuracy record from site ``serve`` (finite value +
+    bound_ratio), and no serve-site retrace evidence at count >= 2 (a
+    ``dlaf_retrace_total{site=serve.*}`` counter >= 2, or two program
+    retrace records for one serve site — either means a bucket program
+    recompiled mid-stream, the exact latency cliff warmup exists to
+    prevent)."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = n_accuracy = 0
     n_compile_obs = n_hbm = n_retrace = 0
+    n_serve_batched = n_serve_miss = n_serve_requests = 0
+    n_serve_accuracy = 0
+    serve_retrace_sites = {}          # serve.* site -> trace evidence count
     overlap_axes, byte_axes = set(), set()
     for i, r in enumerate(records):
         where = f"record {i}"
@@ -330,6 +407,10 @@ def validate_records(records, require_spans=False, require_gflops=False,
             # final metrics snapshot landed still wrote its audit trail
             if r.get("event") == "retrace":
                 n_retrace += 1
+                site = r.get("site")
+                if isinstance(site, str) and site.startswith("serve."):
+                    serve_retrace_sites[site] = \
+                        serve_retrace_sites.get(site, 0) + 1
             hbm = r.get("hbm")
             if isinstance(hbm, dict) and hbm \
                     and all(_finite(v) for v in hbm.values()):
@@ -338,6 +419,19 @@ def validate_records(records, require_spans=False, require_gflops=False,
             _validate_accuracy(r, where, errors)
             if _finite(r.get("value")) and _finite(r.get("bound_ratio")):
                 n_accuracy += 1
+                if r.get("site") == "serve":
+                    n_serve_accuracy += 1
+        elif rtype == "serve":
+            _validate_serve(r, where, errors)
+            if r.get("event") == "dispatch":
+                if isinstance(r.get("lanes"), int) and r["lanes"] >= 2 \
+                        and r.get("cache") == "hit":
+                    n_serve_batched += 1
+                if r.get("cache") == "miss":
+                    n_serve_miss += 1
+            elif r.get("event") == "request" \
+                    and _finite(r.get("total_s")):
+                n_serve_requests += 1
         elif rtype == "span":
             _validate_span(r, where, errors)
             n_spans += 1
@@ -385,6 +479,11 @@ def validate_records(records, require_spans=False, require_gflops=False,
                     n_hbm += 1
                 if m.get("name") == "dlaf_retrace_total" and m["value"] >= 1:
                     n_retrace += 1
+                    site = (m.get("labels") or {}).get("site", "")
+                    if str(site).startswith("serve.") and m["value"] >= 2:
+                        serve_retrace_sites[site] = max(
+                            serve_retrace_sites.get(site, 0),
+                            int(m["value"]))
         elif rtype == "log":
             if not isinstance(r.get("msg"), str):
                 errors.append(f"{where}: log without msg")
@@ -422,6 +521,24 @@ def validate_records(records, require_spans=False, require_gflops=False,
     if require_accuracy and n_accuracy == 0:
         errors.append("artifact contains no accuracy record with finite "
                       "value and bound_ratio")
+    if require_serve:
+        if n_serve_batched == 0:
+            errors.append("artifact contains no batched serve dispatch "
+                          "(dispatch record with lanes >= 2, cache hit)")
+        if n_serve_miss > 0:
+            errors.append(f"artifact contains {n_serve_miss} serve "
+                          "dispatch(es) with cache miss — a warmed "
+                          "steady-state stream must be all hits")
+        if n_serve_requests == 0:
+            errors.append("artifact contains no serve request record with "
+                          "finite latency")
+        if n_serve_accuracy == 0:
+            errors.append("artifact contains no per-request accuracy "
+                          "record (site serve, finite value+bound_ratio)")
+        hot = sorted(s for s, c in serve_retrace_sites.items() if c >= 2)
+        if hot:
+            errors.append("serve bucket program(s) retraced mid-stream "
+                          f"(count >= 2): {hot}")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "

@@ -38,6 +38,14 @@ Flags:
                             DLAF_ACCURACY audit trail, docs/accuracy.md;
                             informational-only or all-nonfinite artifacts
                             do not satisfy it)
+    --require-serve         fail unless the artifact carries a warmed
+                            steady-state serving trail (docs/serving.md):
+                            >= 1 batched serve dispatch (lanes >= 2,
+                            cache hit), ZERO cache-miss dispatches, >= 1
+                            request record with finite latency, >= 1
+                            per-request accuracy record (site serve),
+                            and no serve bucket program retraced twice
+                            (dlaf_retrace_total{site=serve.*} < 2)
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
@@ -74,8 +82,8 @@ def main(argv=None) -> int:
              "--require-retries", "--require-fallbacks",
              "--require-comm-overlap", "--require-dc-batch",
              "--require-bt-overlap", "--require-telemetry",
-             "--require-accuracy", "--history", "--accuracy-history",
-             "--prom"}
+             "--require-accuracy", "--require-serve", "--history",
+             "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
     if len(paths) != 1 or flags - known \
@@ -108,7 +116,8 @@ def main(argv=None) -> int:
         require_dc_batch="--require-dc-batch" in flags,
         require_bt_overlap="--require-bt-overlap" in flags,
         require_telemetry="--require-telemetry" in flags,
-        require_accuracy="--require-accuracy" in flags)
+        require_accuracy="--require-accuracy" in flags,
+        require_serve="--require-serve" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -117,10 +126,12 @@ def main(argv=None) -> int:
     n_logs = sum(r.get("type") == "log" for r in records)
     n_progs = sum(r.get("type") == "program" for r in records)
     n_acc = sum(r.get("type") == "accuracy" for r in records)
+    n_serve = sum(r.get("type") == "serve" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
     extra += f", {n_acc} accuracy records" if n_acc else ""
+    extra += f", {n_serve} serve records" if n_serve else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

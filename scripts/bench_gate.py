@@ -33,6 +33,14 @@ The history is schema-validated first (``dlaf_tpu.obs.sinks`` history
 schema — the ``--history`` mode of the validator CLI): a malformed or
 non-finite line fails the gate loudly instead of skewing a baseline.
 
+``workload="serve"`` lines (bench.py's serving arm, docs/serving.md)
+additionally face a HISTORY-FREE absolute leg: their batched-vs-
+loop-of-singles ``speedup`` field must be >= ``--min-serve-speedup``
+(default 3.0 — the ISSUE-11 acceptance floor). Like accuracy_gate's
+analytic-budget leg, this gates a brand-new serve measurement before
+any history accumulates, and a committed serve history line keeps the
+floor enforced in every ``--replay``.
+
 Exit status: 0 = no regression; 1 = regression (or invalid history /
 no usable fresh measurements); 2 = usage error.
 """
@@ -40,6 +48,7 @@ no usable fresh measurements); 2 = usage error.
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import statistics
 import sys
@@ -96,13 +105,23 @@ def baselines(history, best_k: int) -> dict:
             for key, vals in per_key.items()}
 
 
+DEFAULT_MIN_SERVE_SPEEDUP = 3.0
+
+
 def run_gate(history, fresh, *, tolerance: float, min_history: int,
-             best_k: int, log=print) -> int:
+             best_k: int, log=print,
+             min_serve_speedup: float = DEFAULT_MIN_SERVE_SPEEDUP) -> int:
     """Compare fresh bests against history baselines; returns the number
     of regressed keys. Keys without fresh measurements are skipped (the
     gate judges what this run measured, not what it skipped — bench.py's
     budget/wedge handling legitimately drops arms); keys with thin
-    history are report-only."""
+    history are report-only.
+
+    ``workload="serve"`` lines additionally carry the ISSUE-11 absolute
+    floor: the batched-vs-loop-of-singles ``speedup`` field (bench.py's
+    serve arm) must be >= ``min_serve_speedup`` — this leg is
+    history-free (like accuracy_gate's analytic-budget leg), so a
+    first-round serve measurement already gates."""
     base = baselines(history, best_k)
     fresh_best: dict = {}
     for line in fresh:
@@ -131,6 +150,30 @@ def run_gate(history, fresh, *, tolerance: float, min_history: int,
         else:
             log(f"OK         {fmt_key(key)}: {new:.2f} >= {floor:.2f} GF/s "
                 f"(baseline {bl:.2f}, {n_hist} entries)")
+    # serve-speedup floor: judge the BEST fresh speedup per key (the
+    # bench protocol is best-of, and one slow pass must not trip a key
+    # whose best pass cleared the bar)
+    best_speedup: dict = {}
+    for line in fresh:
+        if line.get("workload") != "serve":
+            continue
+        s = line.get("speedup")
+        if not isinstance(s, (int, float)) or isinstance(s, bool) \
+                or not math.isfinite(s):
+            continue
+        key = measurement_key(line)
+        if key not in best_speedup or s > best_speedup[key]:
+            best_speedup[key] = float(s)
+    for key in sorted(best_speedup, key=fmt_key):
+        s = best_speedup[key]
+        if s < min_serve_speedup:
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: batched-vs-singles speedup "
+                f"{s:.2f}x < {min_serve_speedup:.1f}x (ISSUE-11 serving "
+                "floor; history-free leg)")
+        else:
+            log(f"OK         {fmt_key(key)}: batched-vs-singles speedup "
+                f"{s:.2f}x >= {min_serve_speedup:.1f}x")
     return regressions
 
 
@@ -154,6 +197,10 @@ def main(argv=None) -> int:
                     help="scale every fresh measurement by (1 - F): the "
                          "synthetic-regression drill (CI runs F=0.2 and "
                          "requires a nonzero exit)")
+    ap.add_argument("--min-serve-speedup", type=float,
+                    default=DEFAULT_MIN_SERVE_SPEEDUP,
+                    help="history-free floor on the serve arm's batched-"
+                         "vs-singles speedup field (ISSUE 11: >= 3x)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -202,7 +249,8 @@ def main(argv=None) -> int:
           f"{args.min_history}, best-k {args.best_k})")
     regressions = run_gate(history, fresh, tolerance=args.tolerance,
                            min_history=args.min_history,
-                           best_k=args.best_k)
+                           best_k=args.best_k,
+                           min_serve_speedup=args.min_serve_speedup)
     if regressions:
         print(f"bench_gate: {regressions} regressed key(s)",
               file=sys.stderr)
