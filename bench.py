@@ -117,7 +117,14 @@ def _cache_dir() -> str:
 #: batched-vs-singles "speedup" field scripts/bench_gate.py holds to
 #: the >= 3x ISSUE-11 floor. Sized via DLAF_BENCH_SERVE_N /
 #: DLAF_BENCH_SERVE_REQS.
-STAGE_BASES = ("tridiag", "btr2b", "btb2t", "fpanel", "serve")
+#: "overload" (ISSUE 12, docs/robustness.md): the overload-protection
+#: arm — a burst stream at 2x the queue's DLAF_SERVE_MAX_DEPTH admission
+#: bound; records accepted requests/s (gflops slot), p99 latency of the
+#: ACCEPTED requests (t slot), shed rate, and the maximum pending depth
+#: observed — asserting in-arm that depth never exceeded the bound and
+#: no accepted ticket was stranded. workload="overload" keeps it out of
+#: every headline. Sized via DLAF_BENCH_SERVE_N / DLAF_BENCH_OVERLOAD_DEPTH.
+STAGE_BASES = ("tridiag", "btr2b", "btb2t", "fpanel", "serve", "overload")
 
 
 def _run_fpanel_variant(variant: str, platform: str) -> None:
@@ -307,6 +314,95 @@ def _run_serve_variant(variant: str, platform: str) -> None:
     print(json.dumps(line), flush=True)
 
 
+def _run_overload_variant(variant: str, platform: str) -> None:
+    """Measure the serving queue's overload protection (ISSUE 12,
+    docs/robustness.md): a deterministic burst of 2x the
+    ``DLAF_SERVE_MAX_DEPTH`` admission bound per pass — the queue must
+    shed the overflow fast (OverloadError), keep pending depth at or
+    under the bound, and serve every ACCEPTED request with bounded p99.
+    Records accepted requests/s (gflops slot), accepted p99 seconds (t
+    slot), the shed rate, and the max observed depth; workload="overload"
+    keeps the line out of every headline. The arm FAILS (raises) if depth
+    ever exceeds the bound or an accepted ticket is stranded — the
+    queue-memory-bounded claim is asserted, not just logged."""
+    from dlaf_tpu.health.errors import OverloadError
+    from dlaf_tpu.serve import Queue, Request
+
+    bn = int(os.environ.get("DLAF_BENCH_SERVE_N", "32"))
+    max_depth = int(os.environ.get("DLAF_BENCH_OVERLOAD_DEPTH", "16"))
+    rng = np.random.default_rng(bn * 31 + max_depth)
+    n_reqs = 2 * max_depth              # the 2x-capacity burst
+    problems = []
+    for _ in range(n_reqs):
+        n = int(rng.integers(bn // 2 + 1, bn + 1))
+        x = rng.standard_normal((n, n))
+        problems.append(x @ x.T + n * np.eye(n))
+    # batch > max_depth: the bucket cannot drain mid-burst, so the
+    # admission bound genuinely binds (arrival faster than dispatch —
+    # the overload regime this arm certifies)
+    q = Queue(buckets=(bn,), batch=n_reqs, deadline_s=1e9,
+              max_depth=max_depth, shed=True)
+    q.warmup([Request(op="cholesky", a=problems[0])])
+    log(f"[{variant}] overload arm on {platform}: bucket={bn} "
+        f"max_depth={max_depth} burst={n_reqs} (2x capacity)")
+    best_t, p99 = float("inf"), float("nan")
+    shed_total = accepted_total = 0
+    max_seen = 0
+    for i in range(3):
+        tickets, shed = [], 0
+        t0 = time.perf_counter()
+        for a in problems:
+            try:
+                tickets.append(q.submit(Request(op="cholesky", a=a)))
+            except OverloadError:
+                shed += 1
+            max_seen = max(max_seen, q.pending())
+        q.flush()
+        t = time.perf_counter() - t0
+        stranded = [tk for tk in tickets
+                    if not tk.done and tk.error is None]
+        if stranded:
+            raise RuntimeError(f"overload arm stranded {len(stranded)} "
+                               "accepted ticket(s)")
+        if max_seen > max_depth:
+            raise RuntimeError(f"overload arm: pending depth {max_seen} "
+                               f"exceeded DLAF_SERVE_MAX_DEPTH={max_depth}")
+        lat = [tk.total_s for tk in tickets if tk.done]
+        shed_total += shed
+        accepted_total += len(tickets)
+        log(f"[{variant}] pass {i}: {t:.4f}s accepted={len(tickets)} "
+            f"shed={shed} depth<= {max_seen} "
+            f"p99 {np.percentile(lat, 99):.4f}s")
+        if t < best_t:
+            best_t, p99 = t, float(np.percentile(lat, 99))
+    accepted_per_pass = accepted_total // 3
+    rps = accepted_per_pass / best_t
+    shed_rate = shed_total / (3 * n_reqs)
+    st = q.stats()
+    log(f"[{variant}] accepted {rps:.1f} req/s (p99 {p99:.4f}s), shed "
+        f"rate {shed_rate:.2f}, max depth {max_seen}/{max_depth}, "
+        f"queue stats {dict((k, v) for k, v in st.items() if k != 'buckets')}")
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from measure_common import append_history
+
+    line = append_history(platform, bn, bn, rps, p99, source="bench.py",
+                          variant=variant, dtype="float64",
+                          workload="overload",
+                          extra={"shed_rate": round(float(shed_rate), 3),
+                                 "shed": shed_total,
+                                 "accepted": accepted_total,
+                                 "burst": n_reqs,
+                                 "max_depth": max_depth,
+                                 "max_depth_seen": max_seen})
+    from dlaf_tpu import obs
+
+    obs.emit_event("bench_result", payload=line)
+    obs.flush()
+    print(json.dumps(line), flush=True)
+
+
 def _run_stage_variant(variant: str, base: str, mods: set) -> None:
     """Measure one eigensolver-stage arm; same artifact/stdout protocol as
     the cholesky arms (bench_result record + one JSON line)."""
@@ -330,6 +426,9 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
         return
     if base == "serve":
         _run_serve_variant(variant, platform)
+        return
+    if base == "overload":
+        _run_overload_variant(variant, platform)
         return
     # stage arms default to a smaller N off-TPU: the local red2band that
     # feeds the bt arm compiles per-panel, and the CPU fallback sweep's
@@ -698,7 +797,7 @@ def sweep(platform: str) -> None:
     order = ["ozaki", "ozaki+la1", ab_arm, "xla", "scan", "scan+la1",
              "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm",
              "tridiag", "tridiag+dcb1", "btr2b", "btr2b+btla1", "btb2t",
-             "fpanel", "fpanel+fp1", "serve"]
+             "fpanel", "fpanel+fp1", "serve", "overload"]
 
     def _known(v):
         b = v[: -len("+la1")] if v.endswith("+la1") else v

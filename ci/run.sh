@@ -405,6 +405,200 @@ EOF
       exit 1
     fi
     echo "bench_gate serve-speedup leg trips as required"
+    echo "== smoke: chaos drill 1 — preempt at b2t -> resume -> identical =="
+    # the kill-and-resume proof (docs/robustness.md §5), CROSS-PROCESS:
+    # (a) an uninterrupted reference run records its eigenpairs; (b) a
+    # checkpointing run is killed by inject.preempt at the b2t stage
+    # boundary (must die with PreemptionError, nonzero exit); (c) a fresh
+    # process resumes from the on-disk checkpoints and must reproduce the
+    # reference BITWISE; the shared artifact must then validate under
+    # --require-resilience (resume records present, no breaker open)
+    RESUME_TMP=$(mktemp -d)
+    RESIL_ART="$RESUME_TMP/resilience.jsonl"
+    python - "$RESUME_TMP" <<'EOF'
+import sys
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.eigensolver.eigensolver import eigensolver
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(12)
+n, nb = 48, 8
+x = rng.standard_normal((n, n))
+a = (x + x.T) / 2
+res = eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)))
+np.savez(f"{sys.argv[1]}/ref.npz", w=np.asarray(res.eigenvalues),
+         v=res.eigenvectors.to_numpy())
+print("reference eigenpairs recorded")
+EOF
+    preempt_rc=0
+    DLAF_RESUME_DIR="$RESUME_TMP/ck" DLAF_METRICS_PATH="$RESIL_ART" \
+      python - > "$RESUME_TMP/preempt.log" 2>&1 <<'EOF' || preempt_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.eigensolver.eigensolver import eigensolver
+from dlaf_tpu.health import inject
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(12)
+n, nb = 48, 8
+x = rng.standard_normal((n, n))
+a = (x + x.T) / 2
+with inject.preempt("b2t"):
+    eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)))
+raise SystemExit(3)   # reaching here = the preemption never fired
+EOF
+    if [ "$preempt_rc" -eq 0 ] || [ "$preempt_rc" -eq 3 ] \
+        || ! grep -q "PreemptionError" "$RESUME_TMP/preempt.log"; then
+      echo "preemption drill did not kill the pipeline (rc=$preempt_rc)" >&2
+      cat "$RESUME_TMP/preempt.log" >&2; exit 1
+    fi
+    DLAF_RESUME_DIR="$RESUME_TMP/ck" DLAF_METRICS_PATH="$RESIL_ART" \
+      python - "$RESUME_TMP" <<'EOF'
+import sys
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.eigensolver.eigensolver import eigensolver
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(12)
+n, nb = 48, 8
+x = rng.standard_normal((n, n))
+a = (x + x.T) / 2
+res = eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)),
+                  resume=True)
+ref = np.load(f"{sys.argv[1]}/ref.npz")
+np.testing.assert_array_equal(np.asarray(res.eigenvalues), ref["w"])
+np.testing.assert_array_equal(res.eigenvectors.to_numpy(), ref["v"])
+print("kill -> resume -> eigenpairs BITWISE identical to the "
+      "uninterrupted run")
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$RESIL_ART" --require-resilience
+    echo "== smoke: chaos drill 2 — dispatch retry + breaker teeth =="
+    # leg A: fail_dispatch twice -> the policy engine retries and the
+    # stream succeeds; the artifact's retry records satisfy
+    # --require-resilience. leg B (separate process/artifact): a
+    # sustained fault exhausts the retries, the bucket breaker OPENS, and
+    # the process dies mid-storm (os._exit models the real crash) — that
+    # artifact must be REJECTED by --require-resilience (breaker left
+    # open), proving the gate has teeth
+    RETRY_DIR=$(mktemp -d)
+    DLAF_METRICS_PATH="$RETRY_DIR/retry.jsonl" python - <<'EOF'
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.health import inject
+from dlaf_tpu.serve import ProgramService, Queue, Request
+
+C.initialize()
+rng = np.random.default_rng(3)
+x = rng.standard_normal((24, 24))
+a = x @ x.T + 24 * np.eye(24)
+q = Queue(ProgramService(), batch=2, deadline_s=1e9, buckets=(32,),
+          retry_attempts=3)
+with inject.fail_dispatch(nth=0, count=2):
+    t1 = q.submit(Request(op="cholesky", a=a))
+    t2 = q.submit(Request(op="cholesky", a=a + np.eye(24)))
+assert t1.done and t2.done, "retry did not recover the dispatch"
+retries = [m for m in obs.registry().snapshot()
+           if m["name"] == "dlaf_retry_total"
+           and m["labels"].get("site", "").startswith("serve.")]
+assert retries and sum(m["value"] for m in retries) >= 2, retries
+print(f"fail_dispatch x2 recovered by retry "
+      f"({int(sum(m['value'] for m in retries))} retries counted)")
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$RETRY_DIR/retry.jsonl" \
+      --require-resilience
+    DLAF_METRICS_PATH="$RETRY_DIR/breaker.jsonl" python - <<'EOF'
+import os
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.health import circuit, inject
+from dlaf_tpu.health.errors import CircuitOpenError
+from dlaf_tpu.serve import ProgramService, Queue, Request
+
+C.initialize()
+rng = np.random.default_rng(4)
+x = rng.standard_normal((24, 24))
+a = x @ x.T + 24 * np.eye(24)
+q = Queue(ProgramService(), batch=1, deadline_s=1e9, buckets=(32,),
+          retry_attempts=3)
+with inject.fail_dispatch(nth=0, count=100):
+    try:
+        q.submit(Request(op="cholesky", a=a))
+        raise SystemExit(3)   # the sustained fault must fail the dispatch
+    except RuntimeError:
+        pass
+    (bucket,) = q.stats()["buckets"].values()
+    assert bucket["breaker"] == "open", bucket
+    try:
+        q.submit(Request(op="cholesky", a=a))
+        raise SystemExit(3)   # the open breaker must fail fast
+    except CircuitOpenError:
+        pass
+    print("thrice-consecutive failure opened the breaker; fails fast")
+    obs.flush()
+    # model the real incident: the process dies while the breaker is
+    # open (skip atexit/injection cleanup — the artifact must end in
+    # the tripped state the validator exists to reject)
+    os._exit(0)
+EOF
+    if python -m dlaf_tpu.obs.validate "$RETRY_DIR/breaker.jsonl" \
+        --require-resilience > /dev/null 2>&1; then
+      echo "--require-resilience FAILED to reject the open-breaker" \
+           "artifact" >&2; exit 1
+    fi
+    echo "--require-resilience correctly rejected the open-breaker artifact"
+    echo "== smoke: chaos drill 3 — overload shed, bounded depth =="
+    # a burst at 2x DLAF_SERVE_MAX_DEPTH: the overflow must shed fast
+    # with OverloadError (counted per bucket), pending depth must NEVER
+    # exceed the bound, and every accepted ticket must complete — zero
+    # stranded (docs/serving.md overload protection)
+    DLAF_SERVE_MAX_DEPTH=8 DLAF_METRICS_PATH="$RETRY_DIR/overload.jsonl" \
+      python - <<'EOF'
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.health.errors import OverloadError
+from dlaf_tpu.serve import ProgramService, Queue, Request
+
+C.initialize()
+rng = np.random.default_rng(5)
+q = Queue(ProgramService(), batch=16, deadline_s=1e9, buckets=(16,))
+assert q.max_depth == 8, q.max_depth     # the env knob reached the queue
+tickets, shed, max_seen = [], 0, 0
+for i in range(16):                      # 2x the admission bound
+    x = rng.standard_normal((12, 12))
+    try:
+        tickets.append(q.submit(Request(op="cholesky",
+                                        a=x @ x.T + 12 * np.eye(12))))
+    except OverloadError:
+        shed += 1
+    max_seen = max(max_seen, q.pending())
+assert shed == 8 and len(tickets) == 8, (shed, len(tickets))
+assert max_seen <= 8, f"depth {max_seen} exceeded the bound"
+q.flush()
+stranded = [t for t in tickets if not t.done and t.error is None]
+assert not stranded, f"{len(stranded)} stranded tickets"
+assert q.stats()["shed"] == 8, q.stats()
+snap = [m for m in obs.registry().snapshot()
+        if m["name"] == "dlaf_serve_shed_total"]
+assert snap and sum(m["value"] for m in snap) == 8, snap
+print(f"overload drill ok: shed={shed}, max depth {max_seen}/8, "
+      f"0 stranded of {len(tickets)} accepted")
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$RETRY_DIR/overload.jsonl"
     echo "== smoke: eigensolver pipeline (batched D&C + pipelined bt) =="
     # distributed eigensolver on a 2x2 virtual-CPU grid with the two
     # ISSUE-6 knobs pinned ON (the CPU auto would resolve both off): the

@@ -49,7 +49,9 @@ from .grid import COL_AXIS, ROW_AXIS, Grid
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None,
-                         timeout: Optional[float] = 300.0) -> None:
+                         timeout: Optional[float] = 300.0,
+                         connect_attempts: int = 3,
+                         connect_backoff_s: float = 1.0) -> None:
     """Establish the cross-host process world (the ``mpi_init`` analog).
 
     On Cloud TPU all arguments are auto-discovered; elsewhere pass the
@@ -58,15 +60,24 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     reference's "MPI_Init before everything", ``communication/init.h``).
     No-op when the world has a single process and no coordinator is given.
 
-    ``timeout`` bounds the coordinator connect (seconds; None = the JAX
-    default). A pod job where one host never starts otherwise hangs the
-    whole world silently at bring-up; with the bound, the failure comes
-    back as a RuntimeError naming the coordinator, the world shape, and
-    the usual causes — actionable from a single host's log.
+    ``timeout`` bounds each coordinator-connect attempt (seconds; None =
+    the JAX default). The connect runs on the shared
+    :mod:`dlaf_tpu.health.policy` engine: a transient bring-up failure
+    (timeout / connection refused / unreachable — :func:`_is_bringup_
+    failure`) retries up to ``connect_attempts`` times with exponential
+    backoff from ``connect_backoff_s`` (deterministic seeded jitter; one
+    ``dlaf_retry_total{site="multihost.connect"}`` + ``resilience``
+    record per retry), because a coordinator that is still scheduling is
+    the COMMON pod bring-up race. Caller bugs (double init, bad args)
+    raise immediately with their own message. Exhaustion keeps the
+    pinned contract: a RuntimeError naming the coordinator, the world
+    shape, and the usual causes — actionable from a single host's log.
     """
     if coordinator_address is None and num_processes in (None, 1):
         return  # single-controller run — nothing to establish
     import inspect
+
+    from ..health.policy import RetryPolicy, with_policy
 
     kwargs = {}
     if timeout is not None:
@@ -74,10 +85,17 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         params = inspect.signature(jax.distributed.initialize).parameters
         if "initialization_timeout" in params:
             kwargs["initialization_timeout"] = int(timeout)
-    try:
+
+    def _connect():
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id, **kwargs)
+
+    policy = RetryPolicy(max_attempts=max(int(connect_attempts), 1),
+                         backoff_base_s=float(connect_backoff_s),
+                         retryable=_is_bringup_failure)
+    try:
+        with_policy("multihost.connect", _connect, policy=policy)
     except Exception as e:
         if not _is_bringup_failure(e):
             raise   # caller bugs (double init, bad args) keep their message

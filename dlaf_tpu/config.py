@@ -450,6 +450,55 @@ class Configuration:
     #: clock at those calls, so dispatch composition is deterministic
     #: and testable (docs/serving.md deadline semantics).
     serve_deadline_ms: float = 50.0
+    #: Admission bound of the serving queue (``DLAF_SERVE_MAX_DEPTH``,
+    #: docs/serving.md overload protection): the maximum TOTAL number of
+    #: pending (undispatched) requests across every bucket. At the bound
+    #: the queue either sheds (``serve_shed``) or force-dispatches the
+    #: fullest bucket — either way pending depth provably never exceeds
+    #: this knob, so queue memory is bounded under overload. 0 (default)
+    #: = unbounded (the pre-PR-12 behavior).
+    serve_max_depth: int = 0
+    #: Overload response at the ``serve_max_depth`` bound
+    #: (``DLAF_SERVE_SHED``): True (default) fails the submit fast with a
+    #: structured :class:`dlaf_tpu.health.errors.OverloadError` (shed
+    #: counted per bucket under ``dlaf_serve_shed_total``); False applies
+    #: backpressure instead — the fullest bucket is dispatched inline to
+    #: make room, trading submit latency for zero sheds.
+    serve_shed: bool = True
+    #: Dispatch retry budget of the serving queue
+    #: (``DLAF_SERVE_RETRY_ATTEMPTS``): each batch dispatch runs under a
+    #: health.policy RetryPolicy with this many total attempts, so a
+    #: transiently failing dispatch (the PR-12 motivation: it used to
+    #: poison its tickets with no retry) re-runs before the tickets are
+    #: poisoned. 1 = no retry.
+    serve_retry_attempts: int = 3
+    #: Base backoff between serve dispatch retry attempts, milliseconds
+    #: (``DLAF_SERVE_RETRY_BACKOFF_MS``; exponential growth + the policy
+    #: engine's deterministic seeded jitter). 0 (default) retries
+    #: immediately — dispatch failures are dominated by deterministic
+    #: causes (compile error, OOM) where waiting buys nothing; set it
+    #: when fronting genuinely transient infrastructure.
+    serve_retry_backoff_ms: float = 0.0
+    #: Circuit-breaker opening threshold (``DLAF_CIRCUIT_THRESHOLD``,
+    #: docs/robustness.md): consecutive failures at one site before the
+    #: breaker opens (closed -> open) and calls fail fast with
+    #: health.CircuitOpenError instead of re-running a failing dispatch/
+    #: primary.
+    circuit_threshold: int = 3
+    #: Circuit-breaker cooldown, seconds (``DLAF_CIRCUIT_COOLDOWN_S``):
+    #: how long an open breaker rejects calls before letting ONE half-open
+    #: probe through (success closes it, failure re-opens).
+    circuit_cooldown_s: float = 30.0
+    #: Stage-checkpoint directory for preemption-safe pipeline resume
+    #: (``DLAF_RESUME_DIR``, docs/robustness.md §5): when non-empty, the
+    #: eigensolver pipeline writes an atomic versioned checkpoint after
+    #: each stage (red2band, b2t, tridiag, bt_b2t, bt_r2b) and
+    #: ``eigensolver(..., resume=True)`` skips stages whose checkpoint
+    #: manifest matches the run's config/grid/dtype fingerprint — a
+    #: preempted multi-minute pipeline restarts from the last completed
+    #: stage instead of from scratch, bitwise-identically per stage on
+    #: the native routes. Empty (default) = no checkpointing.
+    resume_dir: str = ""
     #: LRU byte budget of the serve program cache
     #: (``DLAF_SERVE_CACHE_BYTES``): compiled bucket programs are
     #: retained up to this many bytes (per-program cost =
@@ -568,6 +617,21 @@ def _validate(cfg: Configuration) -> None:
     if cfg.serve_cache_bytes < 0:
         raise ValueError(f"serve_cache_bytes={cfg.serve_cache_bytes}: must "
                          "be >= 0 (0 = unbounded)")
+    if cfg.serve_max_depth < 0:
+        raise ValueError(f"serve_max_depth={cfg.serve_max_depth}: must be "
+                         ">= 0 (0 = unbounded pending depth)")
+    if cfg.serve_retry_attempts < 1:
+        raise ValueError(f"serve_retry_attempts={cfg.serve_retry_attempts}: "
+                         "must be >= 1 (1 = no dispatch retry)")
+    if not cfg.serve_retry_backoff_ms >= 0:
+        raise ValueError(f"serve_retry_backoff_ms="
+                         f"{cfg.serve_retry_backoff_ms}: must be >= 0")
+    if cfg.circuit_threshold < 1:
+        raise ValueError(f"circuit_threshold={cfg.circuit_threshold}: must "
+                         "be >= 1 (consecutive failures before opening)")
+    if not cfg.circuit_cooldown_s >= 0:
+        raise ValueError(f"circuit_cooldown_s={cfg.circuit_cooldown_s}: "
+                         "must be >= 0 (open -> half-open probe delay)")
     parse_serve_buckets(cfg.serve_buckets)   # raises on a malformed list
     # cholesky_trailing is validated against VALID_TRAILING at the use site
     # (algorithms/cholesky.py) to keep the list next to the implementations

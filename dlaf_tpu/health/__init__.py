@@ -22,27 +22,36 @@ The robustness layer (docs/robustness.md), four surfaces:
 
 from __future__ import annotations
 
-from . import info, inject, registry  # noqa: F401
-from .errors import (CheckError, DegradationError, FactorizationError,  # noqa: F401
-                     HealthError)
+from . import circuit, info, inject, policy, registry  # noqa: F401
+from .circuit import CIRCUIT_GAUGE, CircuitBreaker, breaker  # noqa: F401
+from .errors import (CheckError, CircuitOpenError,  # noqa: F401
+                     DeadlineExceededError, DegradationError,
+                     FactorizationError, HealthError, OverloadError,
+                     PreemptionError, ResumeError)
 from .info import matrix_diag_info  # noqa: F401
+from .policy import (DEADLINE_COUNTER, RETRY_COUNTER, RetryPolicy,  # noqa: F401
+                     with_policy)
 from .registry import (FALLBACK_COUNTER, report_fallback, route_available,  # noqa: F401
                        run_with_fallback, strict_mode)
 
 __all__ = [
-    "CheckError", "DegradationError", "FactorizationError", "HealthError",
-    "FALLBACK_COUNTER", "RETRY_COUNTER", "BatchRecoveryResult",
-    "RecoveryResult",
-    "check_finite", "inject", "info", "matrix_diag_info", "registry",
-    "report_fallback", "robust_cholesky", "robust_cholesky_batched",
-    "route_available", "run_with_fallback", "shift_diagonal", "strict_mode",
+    "CheckError", "CircuitBreaker", "CircuitOpenError",
+    "DeadlineExceededError", "DegradationError", "FactorizationError",
+    "HealthError", "OverloadError", "PreemptionError", "ResumeError",
+    "CIRCUIT_GAUGE", "DEADLINE_COUNTER", "FALLBACK_COUNTER",
+    "RETRY_COUNTER", "BatchRecoveryResult", "RecoveryResult", "RetryPolicy",
+    "breaker", "check_finite", "circuit", "inject", "info",
+    "matrix_diag_info", "policy", "registry", "report_fallback", "resume",
+    "robust_cholesky", "robust_cholesky_batched", "route_available",
+    "run_with_fallback", "shift_diagonal", "strict_mode", "with_policy",
 ]
 
-#: Symbols served lazily from .recovery (it imports the matrix layer;
-#: keeping it out of package-import time lets low-level modules — comm,
-#: tile_ops — consult .inject/.registry without an import cycle).
+#: Symbols served lazily from .recovery / .resume (they import the matrix
+#: layer; keeping them out of package-import time lets low-level modules —
+#: comm, tile_ops — consult .inject/.registry/.policy without an import
+#: cycle).
 _LAZY = ("robust_cholesky", "robust_cholesky_batched", "RecoveryResult",
-         "BatchRecoveryResult", "RETRY_COUNTER",
+         "BatchRecoveryResult",
          "check_finite", "shift_diagonal", "recovery")
 
 
@@ -53,4 +62,10 @@ def __getattr__(name: str):
         recovery = importlib.import_module(".recovery", __name__)
         globals()["recovery"] = recovery
         return recovery if name == "recovery" else getattr(recovery, name)
+    if name == "resume":
+        import importlib
+
+        resume = importlib.import_module(".resume", __name__)
+        globals()["resume"] = resume
+        return resume
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -61,6 +61,110 @@ class DegradationError(HealthError):
             "— unset DLAF_STRICT to allow the fallback")
 
 
+class DeadlineExceededError(HealthError):
+    """An attempt ran past its :class:`~dlaf_tpu.health.policy.RetryPolicy`
+    per-attempt deadline, or a queued serving request expired before its
+    batch dispatched (``Request.deadline_s``; docs/robustness.md §2).
+
+    Attributes:
+        site: the policy/queue site that enforced the deadline.
+        elapsed_s: how long the attempt/wait actually took (including any
+            :func:`dlaf_tpu.health.inject.hang` clock-aware stall).
+        deadline_s: the budget that was exceeded.
+        attempt: 0-based attempt index (0 for queue-expiry).
+    """
+
+    def __init__(self, site: str, elapsed_s: float, deadline_s: float,
+                 attempt: int = 0):
+        self.site = str(site)
+        self.elapsed_s = float(elapsed_s)
+        self.deadline_s = float(deadline_s)
+        self.attempt = int(attempt)
+        super().__init__(
+            f"deadline exceeded at {self.site!r}: attempt {self.attempt} "
+            f"took {self.elapsed_s:.3f}s against a {self.deadline_s:.3f}s "
+            "budget")
+
+
+class CircuitOpenError(HealthError):
+    """A circuit breaker (:mod:`dlaf_tpu.health.circuit`) is open: the
+    site failed ``threshold`` consecutive times and calls fail fast until
+    the cooldown lets a half-open probe through.
+
+    Attributes:
+        site: the breaker's site label (``dlaf_circuit_state{site}``).
+        retry_in_s: seconds until the next half-open probe is admitted
+            (0.0 when a probe is already in flight).
+    """
+
+    def __init__(self, site: str, retry_in_s: float = 0.0):
+        self.site = str(site)
+        self.retry_in_s = float(max(retry_in_s, 0.0))
+        super().__init__(
+            f"circuit open at {self.site!r}: failing fast (next probe in "
+            f"{self.retry_in_s:.3f}s) — see dlaf_circuit_state{{site}}")
+
+
+class OverloadError(HealthError):
+    """The serving queue is at its ``DLAF_SERVE_MAX_DEPTH`` admission
+    bound and sheds the submit instead of growing unboundedly
+    (docs/serving.md overload protection).
+
+    Attributes:
+        depth: pending depth at the rejection.
+        max_depth: the configured bound.
+        op / bucket_n: the bucket the shed was counted against.
+    """
+
+    def __init__(self, depth: int, max_depth: int, op: str = "",
+                 bucket_n: int = 0):
+        self.depth = int(depth)
+        self.max_depth = int(max_depth)
+        self.op = str(op)
+        self.bucket_n = int(bucket_n)
+        super().__init__(
+            f"serve queue overloaded: {self.depth} pending >= "
+            f"DLAF_SERVE_MAX_DEPTH={self.max_depth}; shedding "
+            f"{self.op or '?'}(n<={self.bucket_n}) — submit again after "
+            "draining, or raise the bound")
+
+
+class PreemptionError(HealthError):
+    """The pipeline was preempted at a stage boundary
+    (:func:`dlaf_tpu.health.inject.preempt` in drills; the real signal in
+    production). With ``DLAF_RESUME_DIR`` set, every completed stage's
+    checkpoint is already on disk — rerun with ``resume=True``.
+
+    Attributes:
+        stage: the stage boundary where the preemption fired.
+    """
+
+    def __init__(self, stage: str):
+        self.stage = str(stage)
+        super().__init__(
+            f"preempted at stage boundary {self.stage!r} — completed "
+            "stages are checkpointed under DLAF_RESUME_DIR; rerun with "
+            "resume=True to continue from here")
+
+
+class ResumeError(HealthError):
+    """``resume=True`` could not use the checkpoints under
+    ``DLAF_RESUME_DIR``: no directory configured, an incompatible
+    manifest version, or a fingerprint mismatch (the checkpoints belong
+    to a different config/grid/dtype run).
+
+    Attributes:
+        stage: the stage whose manifest failed (empty for setup errors).
+        detail: what specifically mismatched.
+    """
+
+    def __init__(self, stage: str, detail: str):
+        self.stage = str(stage)
+        self.detail = str(detail)
+        where = f" at stage {self.stage!r}" if self.stage else ""
+        super().__init__(f"cannot resume{where}: {self.detail}")
+
+
 class CheckError(HealthError):
     """The opt-in finite guard (``DLAF_CHECK=1``) found non-finite values.
 

@@ -21,9 +21,26 @@ the injection surface the tests use:
 * :func:`force_native_failure` — context manager: makes
   ``native.bindings`` fail its build/load (covering the cached-error
   re-raise path and every native->numpy chain).
+* :func:`fail_dispatch` — context manager: raises inside the serve
+  dispatch path on the ``nth`` (and the following ``count - 1``, or
+  every ``every``-th) batch dispatch attempt — the transient/flapping
+  dispatch fault the retry-policy and circuit-breaker drills need
+  (docs/robustness.md).
+* :func:`hang` — context manager: arms a CLOCK-AWARE stall at a policy
+  site: the policy engine charges the armed seconds against the
+  attempt's per-attempt deadline without sleeping real wall time, so
+  deadline handling is provable in milliseconds of test time.
+* :func:`preempt` — context manager: kills the eigensolver pipeline
+  with :class:`~dlaf_tpu.health.errors.PreemptionError` at a chosen
+  stage boundary (AFTER that stage's checkpoint landed), so CI can
+  prove kill -> resume -> identical-result end-to-end.
 
 All injection state is process-global and OFF by default; the production
-cost of the hooks is one module-attribute check.
+cost of the hooks is one module-attribute check. Every context is
+reset-safe: its arming clears on exit, and the contexts that can trip
+circuit breakers (:func:`force_native_failure`, :func:`disable_route`,
+:func:`fail_dispatch`) also reset the breakers they may have opened so
+an injected failure storm never fails fast into unrelated code.
 """
 
 from __future__ import annotations
@@ -43,6 +60,15 @@ _COLLECTIVE: Optional[dict] = None
 
 #: Route names currently forced unavailable (see :func:`disable_route`).
 _DISABLED_ROUTES: set = set()
+
+#: Armed dispatch fault: {"nth", "count", "every", "exc", "seen"} or None.
+_FAIL_DISPATCH: Optional[dict] = None
+
+#: Armed clock-aware stalls: policy site -> seconds.
+_HANGS: dict = {}
+
+#: Armed preemption: the stage name to kill at, or None.
+_PREEMPT: Optional[str] = None
 
 
 def _clear_program_caches() -> None:
@@ -156,7 +182,9 @@ def disable_route(name: str):
     """Force route ``name`` unavailable while active; the owning gate
     reports the degradation through :mod:`dlaf_tpu.health.registry`.
     Program caches are cleared on entry and exit — route choices are
-    trace-time decisions."""
+    trace-time decisions. Degradation-site circuit breakers are reset on
+    exit: the injected storm must not leave a breaker failing fast into
+    real runs."""
     with _LOCK:
         _DISABLED_ROUTES.add(name)
     _clear_program_caches()
@@ -166,6 +194,7 @@ def disable_route(name: str):
         with _LOCK:
             _DISABLED_ROUTES.discard(name)
         _clear_program_caches()
+        _reset_breakers("fallback.")
 
 
 def disable_pallas():
@@ -188,11 +217,139 @@ def force_native_failure():
     """Make ``native.bindings`` build/load fail while active (drives every
     native->numpy chain and the cached-error re-raise path). The bindings
     cache is reset on entry and exit so neither a pre-loaded library nor
-    the injected failure leaks across the boundary."""
+    the injected failure leaks across the boundary; degradation-site
+    circuit breakers reset both ways for the same reason."""
     from ..native import bindings
 
+    _reset_breakers("fallback.")
     bindings._reset_for_tests(force_failure=True)
     try:
         yield
     finally:
         bindings._reset_for_tests(force_failure=False)
+        _reset_breakers("fallback.")
+
+
+def _reset_breakers(prefix: str) -> None:
+    from . import circuit
+
+    circuit.reset(prefix)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch / policy-engine faults (docs/robustness.md chaos drills)
+# ---------------------------------------------------------------------------
+
+def maybe_fail_dispatch() -> None:
+    """Hook consulted by the serve dispatch path once per batch dispatch
+    ATTEMPT (so policy retries hit the fault again): raises the armed
+    exception when this attempt falls on a faulted index."""
+    with _LOCK:
+        spec = _FAIL_DISPATCH
+        if spec is None:
+            return
+        idx = spec["seen"]
+        spec["seen"] += 1
+        if spec["every"] is not None:
+            hit = idx >= spec["nth"] and (idx - spec["nth"]) \
+                % spec["every"] == 0
+        else:
+            hit = spec["nth"] <= idx < spec["nth"] + spec["count"]
+    if hit:
+        raise spec["exc"](f"injected dispatch fault (attempt {idx})")
+
+
+@contextlib.contextmanager
+def fail_dispatch(nth: int = 0, count: int = 1,
+                  every: Optional[int] = None, exc: type = RuntimeError):
+    """Raise ``exc`` inside the serve dispatch path, deterministically by
+    attempt index: attempts ``nth .. nth+count-1`` fail (or, with
+    ``every``, every ``every``-th attempt from ``nth`` on — the flapping
+    fault the breaker soak test drives). Not reentrant; serve-site
+    breakers are reset on exit so an injected failure storm never leaves
+    a bucket failing fast into real traffic."""
+    global _FAIL_DISPATCH
+    if count < 1:
+        raise ValueError(f"fail_dispatch: count={count} must be >= 1")
+    if every is not None and every < 1:
+        raise ValueError(f"fail_dispatch: every={every} must be >= 1")
+    with _LOCK:
+        if _FAIL_DISPATCH is not None:
+            raise RuntimeError("fail_dispatch is not reentrant")
+        _FAIL_DISPATCH = {"nth": int(nth), "count": int(count),
+                          "every": None if every is None else int(every),
+                          "exc": exc, "seen": 0}
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _FAIL_DISPATCH = None
+        _reset_breakers("serve.")
+
+
+def hang_seconds(site: str) -> float:
+    """Armed clock-aware stall for ``site`` (0.0 when unarmed) — the
+    policy engine adds this to each attempt's measured elapsed time, so a
+    deadline trips without real wall clock (see :func:`hang`)."""
+    with _LOCK:
+        return _HANGS.get(site, 0.0)
+
+
+@contextlib.contextmanager
+def hang(site: str, seconds: float):
+    """Arm a clock-aware stall at policy site ``site``: while active,
+    every attempt the policy engine runs at that site is charged
+    ``seconds`` of extra elapsed time against its per-attempt deadline
+    (``RetryPolicy.attempt_deadline_s``) WITHOUT sleeping — the
+    deterministic stand-in for a hung dispatch/connect that lets deadline
+    handling be proven in milliseconds of test time."""
+    if not seconds >= 0:
+        raise ValueError(f"hang: seconds={seconds} must be >= 0")
+    with _LOCK:
+        if site in _HANGS:
+            raise RuntimeError(f"hang({site!r}) is not reentrant")
+        _HANGS[site] = float(seconds)
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _HANGS.pop(site, None)
+
+
+# ---------------------------------------------------------------------------
+# Preemption (kill-and-resume drills, docs/robustness.md §5)
+# ---------------------------------------------------------------------------
+
+def maybe_preempt(stage: str) -> None:
+    """Hook the pipeline calls at each stage BOUNDARY (after the stage's
+    checkpoint landed): raises PreemptionError when ``stage`` is armed."""
+    with _LOCK:
+        armed = _PREEMPT
+    if armed is not None and armed == stage:
+        from .errors import PreemptionError
+
+        from .. import obs
+
+        obs.emit_event("resilience", site="pipeline", event="preempt",
+                       attrs={"stage": stage})
+        raise PreemptionError(stage)
+
+
+@contextlib.contextmanager
+def preempt(stage: str):
+    """Kill the eigensolver pipeline with
+    :class:`~dlaf_tpu.health.errors.PreemptionError` at stage boundary
+    ``stage`` (one of red2band | b2t | tridiag | bt_b2t | bt_r2b) —
+    AFTER that stage's ``DLAF_RESUME_DIR`` checkpoint was written, so the
+    kill lands exactly where a real preemption is recoverable. Not
+    reentrant; disarms on exit."""
+    global _PREEMPT
+    with _LOCK:
+        if _PREEMPT is not None:
+            raise RuntimeError("preempt is not reentrant")
+        _PREEMPT = str(stage)
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _PREEMPT = None

@@ -1,0 +1,270 @@
+"""Declarative retry/timeout/backoff policy engine (docs/robustness.md §2).
+
+PR 3 left the repo with three hand-rolled retry loops — ``robust_cholesky``'s
+shift ladder, its batched twin, and ``initialize_multihost``'s one-shot
+coordinator connect — each owning its own attempt counting, backoff, and
+accounting. This module is the single engine they (and the PR-12 serving
+dispatch path) now share:
+
+* :class:`RetryPolicy` — the declarative policy: total attempt budget,
+  exponential backoff with DETERMINISTIC seeded jitter (same policy + same
+  retry index => same delay, so drills and tests replay exactly), a
+  per-attempt deadline, and retryable-error classification.
+* :func:`with_policy` — run an exception-deciding callable under a policy
+  (optionally behind a :class:`~dlaf_tpu.health.circuit.CircuitBreaker`):
+  retryable failures re-run with backoff, non-retryable ones raise
+  immediately, exhaustion re-raises the last error.
+* :func:`attempts` — the outcome-deciding driver beneath ``with_policy``,
+  for loops whose "failure" is data (a nonzero Cholesky info), not an
+  exception: the caller marks an attempt failed and the engine owns the
+  retry counting, records, and backoff while the caller keeps its own
+  span/error contracts (``robust_cholesky`` rides this, behavior-pinned).
+
+Accounting, uniform across every site: one ``dlaf_retry_total`` increment
+per retry (labels chosen by the site — ``{site}`` by default, the pinned
+``{algo[,lane]}`` spelling for the recovery drivers), one
+``dlaf_deadline_exceeded_total{site}`` per deadline breach, and one
+``resilience`` JSONL record per retry / give-up / deadline decision
+(schema: :mod:`dlaf_tpu.obs.sinks`; CI obligation:
+``python -m dlaf_tpu.obs.validate --require-resilience``).
+
+Error classification (the docs/robustness.md table): exceptions that name
+a caller bug or a structured health *decision* (``ValueError``/
+``TypeError``/``AssertionError``/``KeyError``/``IndexError``/
+``AttributeError``/``NotImplementedError``/``KeyboardInterrupt``/any
+:class:`~dlaf_tpu.health.errors.HealthError`) are never retried — a retry
+cannot fix them and would mask them. Everything else (``TimeoutError``,
+``ConnectionError``, ``OSError``, runtime/backend errors) defaults to
+retryable; sites narrow this with ``RetryPolicy(retryable=predicate)``.
+
+Deadline semantics: the per-attempt deadline is measured around the
+attempt with the injected ``clock`` plus any armed
+:func:`dlaf_tpu.health.inject.hang` stall (clock-aware — no real wall
+time burns in tests). An attempt that *raises* late is classified like
+any failure; an attempt that *returns* late raises
+:class:`~dlaf_tpu.health.errors.DeadlineExceededError` without retrying —
+the engine cannot cancel completed work and re-running it would be waste,
+so a late success is surfaced as the contract breach it is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from .. import obs
+from .errors import DeadlineExceededError, HealthError
+
+#: Counter incremented once per retry. Labels are site-chosen:
+#: ``{site}`` from :func:`with_policy`, the pinned ``{algo[,lane]}``
+#: spelling from the recovery drivers (docs/robustness.md §2).
+RETRY_COUNTER = "dlaf_retry_total"
+
+#: Counter incremented once per per-attempt-deadline breach (labels: site).
+DEADLINE_COUNTER = "dlaf_deadline_exceeded_total"
+
+#: Exception families a retry can never fix (classification table above).
+NON_RETRYABLE = (ValueError, TypeError, AssertionError, KeyError,
+                 IndexError, AttributeError, NotImplementedError,
+                 HealthError)
+
+
+def default_retryable(exc: BaseException) -> bool:
+    """The default classification: retry anything that is a plain
+    ``Exception`` and not in :data:`NON_RETRYABLE`."""
+    return isinstance(exc, Exception) and not isinstance(exc, NON_RETRYABLE)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """One site's declarative retry policy (module docstring).
+
+    ``max_attempts`` is the TOTAL attempt budget (1 = no retry).
+    ``backoff_base_s`` is the delay before the first retry, growing by
+    ``backoff_growth`` per retry and capped at ``backoff_max_s``;
+    ``jitter`` spreads each delay by up to +-``jitter`` fraction, drawn
+    DETERMINISTICALLY from ``(seed, retry index)`` so a replayed drill
+    backs off identically. ``attempt_deadline_s`` bounds each attempt's
+    wall clock (None = unbounded). ``retryable`` overrides the default
+    error classification (a predicate ``exc -> bool``)."""
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.0
+    backoff_growth: float = 2.0
+    backoff_max_s: float = 60.0
+    jitter: float = 0.1
+    seed: int = 0
+    attempt_deadline_s: Optional[float] = None
+    retryable: Optional[Callable[[BaseException], bool]] = None
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"RetryPolicy.max_attempts={self.max_attempts}:"
+                             " must be >= 1")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("RetryPolicy backoff bounds must be >= 0")
+        if not self.backoff_growth >= 1:
+            raise ValueError(f"RetryPolicy.backoff_growth="
+                             f"{self.backoff_growth}: must be >= 1")
+        if not 0 <= self.jitter < 1:
+            raise ValueError(f"RetryPolicy.jitter={self.jitter}: must be "
+                             "in [0, 1)")
+        if self.attempt_deadline_s is not None \
+                and not self.attempt_deadline_s > 0:
+            raise ValueError(f"RetryPolicy.attempt_deadline_s="
+                             f"{self.attempt_deadline_s}: must be > 0 "
+                             "(or None for unbounded attempts)")
+
+    def is_retryable(self, exc: BaseException) -> bool:
+        pred = self.retryable if self.retryable is not None \
+            else default_retryable
+        return bool(pred(exc))
+
+    def delay_s(self, retry: int) -> float:
+        """Backoff before retry number ``retry`` (0-based): exponential,
+        capped, with the deterministic seeded jitter. Pure function of
+        ``(policy, retry)`` — replays bit-identically."""
+        if self.backoff_base_s <= 0:
+            return 0.0
+        base = min(self.backoff_base_s * self.backoff_growth ** retry,
+                   self.backoff_max_s)
+        if self.jitter <= 0:
+            return base
+        u = float(np.random.default_rng(
+            (int(self.seed), int(retry))).random())
+        return base * (1.0 + self.jitter * (2.0 * u - 1.0))
+
+
+class Attempt:
+    """One attempt of an :func:`attempts` loop. The caller marks it
+    failed (requesting another attempt) via :meth:`fail`; an attempt left
+    unmarked ends the loop as a success."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.failed = False
+        self.reason = ""
+        self.exc: Optional[BaseException] = None
+        self.retry_labels: Optional[tuple] = None
+
+    def fail(self, reason: str = "", exc: Optional[BaseException] = None,
+             retry_labels: Optional[tuple] = None) -> None:
+        """Mark this attempt failed. ``retry_labels`` (a tuple of label
+        dicts) overrides the loop's per-retry counter labels for THIS
+        retry — one ``dlaf_retry_total`` increment per dict (the batched
+        recovery driver counts per lane this way)."""
+        self.failed = True
+        self.reason = str(reason)
+        self.exc = exc
+        if retry_labels is not None:
+            self.retry_labels = tuple(retry_labels)
+
+
+def _emit(site: str, event: str, **fields) -> None:
+    """One resilience JSONL record (no-op with the sink off)."""
+    attrs = fields.pop("attrs", None) or {}
+    obs.emit_event("resilience", site=site, event=event, attrs=attrs,
+                   **fields)
+
+
+def attempts(site: str, policy: RetryPolicy, *,
+             retry_labels: Optional[tuple] = None,
+             sleep: Optional[Callable[[float], None]] = None):
+    """Outcome-driven retry driver: yields :class:`Attempt` objects until
+    the policy is exhausted or an attempt is left unmarked (success).
+
+    The engine owns what every hand-rolled loop used to duplicate: on
+    each marked failure with budget remaining it increments
+    ``dlaf_retry_total`` once per label dict (``retry_labels``, default
+    ``({"site": site},)``; overridable per-attempt via
+    :meth:`Attempt.fail`), emits a ``resilience`` retry record, and
+    sleeps the policy backoff. Exhaustion emits a ``give_up`` record and
+    ends the generator — raising the site's contract error
+    (``FactorizationError``, ...) stays the CALLER's job, which is how
+    ``robust_cholesky`` keeps its pinned error contract."""
+    # sleep defaults LATE (call time, not def time) so tests can
+    # monkeypatch time.sleep and the engine picks it up; deadline
+    # measurement (the clock-aware part) lives in with_policy
+    sleep = time.sleep if sleep is None else sleep
+    base_labels = tuple(retry_labels) if retry_labels is not None \
+        else ({"site": site},)
+    for index in range(policy.max_attempts):
+        a = Attempt(index)
+        yield a
+        if not a.failed:
+            return
+        if index + 1 < policy.max_attempts:
+            for labels in (a.retry_labels or base_labels):
+                obs.counter(RETRY_COUNTER, **labels).inc()
+            delay = policy.delay_s(index)
+            _emit(site, "retry", attempt=index, delay_s=float(delay),
+                  attrs={"reason": a.reason} if a.reason else {})
+            if delay > 0:
+                sleep(delay)
+        else:
+            _emit(site, "give_up", attempt=index,
+                  attrs={"reason": a.reason} if a.reason else {})
+
+
+def with_policy(site: str, fn: Callable, *args,
+                policy: Optional[RetryPolicy] = None,
+                breaker=None,
+                clock: Optional[Callable[[], float]] = None,
+                sleep: Optional[Callable[[float], None]] = None,
+                **kwargs):
+    """Run ``fn(*args, **kwargs)`` under ``policy`` at ``site``; returns
+    ``fn``'s result.
+
+    Retryable failures (``policy.is_retryable``, module classification
+    table) re-run with the policy backoff; non-retryable ones raise
+    immediately; exhaustion re-raises the last error after a ``give_up``
+    record. ``breaker`` (a :class:`~dlaf_tpu.health.circuit.
+    CircuitBreaker`) gates every attempt: an open breaker fails the call
+    fast with :class:`~dlaf_tpu.health.errors.CircuitOpenError`, and each
+    attempt's outcome feeds it — N consecutive attempt failures open it
+    even mid-policy, so the next attempt (and the next call) stops
+    hammering a down dependency.
+
+    The per-attempt deadline is measured with ``clock`` plus any armed
+    :func:`dlaf_tpu.health.inject.hang` stall (clock-aware: deadline
+    drills burn no real wall time); see the module docstring for the
+    late-success semantics."""
+    from . import inject
+
+    clock = time.monotonic if clock is None else clock
+    policy = policy if policy is not None else RetryPolicy()
+    last: Optional[BaseException] = None
+    for a in attempts(site, policy, sleep=sleep):
+        if breaker is not None:
+            breaker.allow()
+        t0 = clock()
+        try:
+            result = fn(*args, **kwargs)
+        except BaseException as e:
+            last = e
+            if breaker is not None:
+                breaker.record_failure()
+            if not policy.is_retryable(e):
+                raise
+            a.fail(reason=type(e).__name__, exc=e)
+            continue
+        elapsed = clock() - t0 + inject.hang_seconds(site)
+        if policy.attempt_deadline_s is not None \
+                and elapsed > policy.attempt_deadline_s:
+            obs.counter(DEADLINE_COUNTER, site=site).inc()
+            _emit(site, "deadline", attempt=a.index,
+                  attrs={"elapsed_s": float(elapsed),
+                         "deadline_s": float(policy.attempt_deadline_s)})
+            if breaker is not None:
+                breaker.record_failure()
+            raise DeadlineExceededError(site, elapsed,
+                                        policy.attempt_deadline_s,
+                                        attempt=a.index)
+        if breaker is not None:
+            breaker.record_success()
+        return result
+    assert last is not None  # attempts() only exhausts on marked failures
+    raise last

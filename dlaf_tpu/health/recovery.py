@@ -28,9 +28,7 @@ import jax.numpy as jnp
 from .. import obs
 from ..config import register_program_cache
 from .errors import CheckError, FactorizationError
-
-#: Counter incremented once per shifted retry (labels: algo).
-RETRY_COUNTER = "dlaf_retry_total"
+from .policy import RETRY_COUNTER, RetryPolicy, attempts  # noqa: F401 — re-export (pinned import site)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,7 +140,14 @@ def robust_cholesky(uplo: str, mat, *, max_attempts: int = 4,
     alpha = 0.0
     shifts, infos = [], []
     log = obs.get_logger("health")
-    for attempt in range(max_attempts):
+    # the shared policy engine owns attempt counting, retry accounting
+    # (one dlaf_retry_total{algo="cholesky"} per retry — the pinned label
+    # spelling), resilience records, and (zero, here) backoff; the shift
+    # ladder, spans, and FactorizationError stay this driver's contract
+    policy = RetryPolicy(max_attempts=max_attempts, backoff_base_s=0.0)
+    for a in attempts("robust_cholesky", policy,
+                      retry_labels=({"algo": "cholesky"},)):
+        attempt = a.index
         span = obs.span("robust_cholesky.attempt", attempt=attempt,
                         shift=float(alpha), n=n, uplo=uplo,
                         dtype=np.dtype(mat.dtype).name)
@@ -158,8 +163,8 @@ def robust_cholesky(uplo: str, mat, *, max_attempts: int = 4,
                 check_finite("cholesky factor", out)
             return RecoveryResult(out, attempt + 1, tuple(shifts),
                                   tuple(infos))
+        a.fail(reason=f"info={info}")
         if attempt + 1 < max_attempts:
-            obs.counter(RETRY_COUNTER, algo="cholesky").inc()
             if alpha == 0.0:
                 alpha = shift if shift is not None else _default_shift(mat)
             else:
@@ -246,7 +251,12 @@ def robust_cholesky_batched(uplo: str, a, *, nb: Optional[int] = None,
     lane_attempts = np.zeros(b_, dtype=int)
     out = None
     failed = np.arange(b_)
-    for attempt in range(max_attempts):
+    # same engine as the singleton driver; retries count PER LANE under
+    # the pinned dlaf_retry_total{algo="cholesky_batched", lane} labels
+    # via the per-attempt retry_labels override
+    policy = RetryPolicy(max_attempts=max_attempts, backoff_base_s=0.0)
+    for att in attempts("robust_cholesky_batched", policy):
+        attempt = att.index
         span = obs.span("robust_cholesky_batched.attempt", attempt=attempt,
                         shift=float(alpha), lanes=len(failed), batch=b_,
                         n=n, uplo=uplo, dtype=np.dtype(a.dtype).name)
@@ -278,10 +288,11 @@ def robust_cholesky_batched(uplo: str, a, *, nb: Optional[int] = None,
                 out, attempts=int(lane_attempts.max(initial=1)),
                 lane_attempts=tuple(int(x) for x in lane_attempts),
                 shifts=tuple(shifts), infos=tuple(infos_hist))
+        att.fail(reason=f"lanes={len(failed)}",
+                 retry_labels=tuple({"algo": "cholesky_batched",
+                                     "lane": int(lane)}
+                                    for lane in failed))
         if attempt + 1 < max_attempts:
-            for lane in failed:
-                obs.counter(RETRY_COUNTER, algo="cholesky_batched",
-                            lane=int(lane)).inc()
             if alpha == 0.0:
                 amax = float(np.abs(a).max(initial=0.0)) or 1.0
                 eps = float(np.finfo(np.dtype(a.dtype).type(0).real.dtype

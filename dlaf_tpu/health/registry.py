@@ -70,17 +70,49 @@ def report_fallback(site: str, reason: str, *,
 
 def run_with_fallback(site: str, primary: Callable, fallback: Callable, *,
                       reason: str = "native_unavailable",
-                      expected: type = Exception):
+                      expected: type = Exception,
+                      use_breaker: bool = True):
     """Run ``primary()``; on ``expected`` record the degradation and run
     ``fallback()`` — the one-policy spelling of the repo's try/except
     chains (the native band-chase/secular/deflate sites). Strict mode
     raises from inside :func:`report_fallback`, so the fallback never
-    executes there."""
+    executes there.
+
+    A per-site circuit breaker (``fallback.<site>``,
+    :mod:`dlaf_tpu.health.circuit`) rides the chain: after
+    ``DLAF_CIRCUIT_THRESHOLD`` consecutive primary failures the breaker
+    opens and the primary is SKIPPED (degradation counted under reason
+    ``circuit_open``) until the cooldown's half-open probe — a
+    segfault-looping native library stops being re-tried on every call.
+    ``use_breaker=False`` opts a site out. The injection contexts reset
+    ``fallback.*`` breakers on exit, so injected storms never leak an
+    open breaker into real runs."""
+    from . import circuit
+    from .errors import CircuitOpenError
+
+    br = circuit.breaker(f"fallback.{site}") if use_breaker else None
+    if br is not None:
+        try:
+            br.allow()
+        except CircuitOpenError as e:
+            report_fallback(site, "circuit_open", exc=e)
+            return fallback()
     try:
-        return primary()
+        result = primary()
     except expected as e:
+        if br is not None:
+            br.record_failure()
         report_fallback(site, reason, exc=e)
         return fallback()
+    except BaseException:
+        # an unexpected error still resolves the breaker's probe slot —
+        # a stuck half-open probe would reject every later call
+        if br is not None:
+            br.record_failure()
+        raise
+    if br is not None:
+        br.record_success()
+    return result
 
 
 def route_available(name: str, site: str, reason: str = "injected_off") -> bool:

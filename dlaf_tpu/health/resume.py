@@ -1,0 +1,159 @@
+"""Preemption-safe pipeline resume (docs/robustness.md §5).
+
+The eigensolver pipeline spans five stages and minutes of multi-chip wall
+clock on preemptible hardware; before PR 12 a preemption at minute N lost
+all N minutes. This module is the generic driver above
+:mod:`dlaf_tpu.matrix.checkpoint`'s stage primitives:
+
+* a :class:`StageCheckpointer` bound to ``DLAF_RESUME_DIR`` (config
+  ``resume_dir``) and a run FINGERPRINT (config/grid/dtype/shape — the
+  identity of the numerical run);
+* ``commit(stage, arrays)`` persists a completed stage atomically
+  (payload then manifest; a kill mid-write leaves no torn stage), emits a
+  ``resilience`` ``checkpoint`` record, and THEN consults
+  :func:`dlaf_tpu.health.inject.maybe_preempt` — so the drill's kill
+  lands exactly at the recoverable boundary;
+* with ``resume=True``, ``completed(stage)`` is True iff the stage's
+  manifest exists AND its fingerprint matches this run's — a manifest
+  from a different config/grid/dtype raises
+  :class:`~dlaf_tpu.health.errors.ResumeError` naming the mismatched
+  keys rather than silently recomputing (or worse, silently loading)
+  someone else's numbers. Each skipped stage emits a ``resume`` record —
+  the audit trail ``--require-resilience`` checks in the CI
+  kill-and-resume drill.
+
+The pipeline (``eigensolver(..., resume=True)``) owns the stage payload
+packing; this module owns directories, manifests, fingerprints, and the
+records. Resumed stages are pinned bitwise against the uninterrupted run
+on the native routes (tests/test_resilience.py): a restored payload is
+the exact bytes the uninterrupted run produced, and every downstream
+stage recomputes from identical inputs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .. import obs
+from ..matrix import checkpoint as _ckpt
+from .errors import ResumeError
+from .inject import maybe_preempt
+
+
+def fingerprint_mismatch(saved: dict, current: dict) -> list:
+    """Keys on which two fingerprints disagree (missing counts)."""
+    keys = set(saved) | set(current)
+    return sorted(k for k in keys if saved.get(k) != current.get(k))
+
+
+class StageCheckpointer:
+    """One pipeline run's checkpoint driver (module docstring).
+
+    ``directory`` empty disables persistence (commits still consult the
+    preemption hook, so ``inject.preempt`` drills work without a resume
+    dir); ``resume=True`` with no directory raises :class:`ResumeError`
+    — a silent full recompute is not what the caller asked for."""
+
+    def __init__(self, pipeline: str, directory: str, fingerprint: dict,
+                 *, resume: bool = False):
+        self.pipeline = str(pipeline)
+        self.directory = (os.path.join(directory, self.pipeline)
+                          if directory else "")
+        self.fingerprint = {k: fingerprint[k] for k in sorted(fingerprint)}
+        self.resume = bool(resume)
+        if self.resume and not self.directory:
+            raise ResumeError(
+                "", "resume=True but no checkpoint directory is "
+                "configured — set DLAF_RESUME_DIR (config resume_dir)")
+
+    def completed(self, stage: str) -> bool:
+        """Is ``stage`` resumable: manifest present, version compatible,
+        fingerprint matching? Only consulted under ``resume=True`` —
+        a fresh run never skips stages, whatever is on disk."""
+        if not (self.resume and self.directory):
+            return False
+        manifest = _ckpt.stage_manifest(self.directory, stage)
+        if manifest is None:
+            return False
+        if manifest.get("version") != _ckpt.STAGE_MANIFEST_VERSION:
+            raise ResumeError(
+                stage, f"manifest version {manifest.get('version')!r} != "
+                f"{_ckpt.STAGE_MANIFEST_VERSION} — written by an "
+                "incompatible dlaf_tpu; clear the resume dir")
+        bad = fingerprint_mismatch(manifest.get("fingerprint") or {},
+                                   self.fingerprint)
+        if bad:
+            saved = manifest.get("fingerprint") or {}
+            raise ResumeError(
+                stage, "checkpoint fingerprint mismatch on "
+                + ", ".join(f"{k} (saved {saved.get(k)!r}, run "
+                            f"{self.fingerprint.get(k)!r})" for k in bad)
+                + " — these checkpoints belong to a different run; clear "
+                  "the resume dir or fix the configuration")
+        return True
+
+    def load(self, stage: str) -> dict:
+        """The completed stage's array payload; emits the ``resume``
+        resilience record (the skip's audit trail)."""
+        arrays, _ = _ckpt.load_stage(self.directory, stage)
+        obs.emit_event("resilience", site=f"{self.pipeline}.{stage}",
+                       event="resume", attrs={"stage": stage})
+        obs.get_logger("health").info(
+            f"{self.pipeline}: stage {stage!r} resumed from checkpoint "
+            f"({self.directory})", stage=stage)
+        return arrays
+
+    def commit(self, stage: str, arrays: Optional[dict] = None,
+               extra: Optional[dict] = None) -> None:
+        """Mark ``stage`` complete: persist (when a directory is
+        configured), record, then hand the preemption hook its window —
+        the kill point of the chaos drill is AFTER the write, exactly
+        where a real preemption is recoverable."""
+        if self.directory and arrays is not None:
+            _ckpt.save_stage(self.directory, stage, arrays,
+                             self.fingerprint, extra=extra)
+            obs.emit_event("resilience", site=f"{self.pipeline}.{stage}",
+                           event="checkpoint", attrs={"stage": stage})
+        maybe_preempt(stage)
+
+
+_warned_multiprocess = False
+
+
+def stage_checkpointer(pipeline: str, fingerprint: dict, *,
+                       resume: bool = False) -> StageCheckpointer:
+    """The pipeline's checkpointer under the config ``resume_dir`` knob
+    (``DLAF_RESUME_DIR``); persistence disabled when the knob is empty
+    (and ``resume=True`` then raises — see :class:`StageCheckpointer`).
+
+    Stage checkpoints are SINGLE-CONTROLLER only: a multi-process world
+    cannot gather sharded storage from one process, and every rank would
+    race ``os.replace`` on the same manifest paths. In a multi-process
+    world the knob is ignored with a once-per-process warning (the
+    pipeline still runs — losing checkpointing must not kill the job it
+    protects), and ``resume=True`` refuses loudly."""
+    from ..config import get_configuration
+
+    directory = get_configuration().resume_dir
+    if directory:
+        import jax
+
+        if jax.process_count() > 1:
+            if resume:
+                raise ResumeError(
+                    "", "DLAF_RESUME_DIR stage checkpoints are "
+                    "single-controller only (sharded storage is not "
+                    "addressable from one process, and ranks would race "
+                    "on the manifest files) — resume on a single "
+                    "controller")
+            global _warned_multiprocess
+            if not _warned_multiprocess:
+                _warned_multiprocess = True
+                obs.get_logger("health").warning(
+                    "DLAF_RESUME_DIR is ignored in a multi-process "
+                    "world: stage checkpoints are single-controller "
+                    "only")
+            directory = ""
+    return StageCheckpointer(pipeline, directory, fingerprint,
+                             resume=resume)

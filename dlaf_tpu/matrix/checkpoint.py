@@ -1,16 +1,30 @@
-"""Matrix persistence via orbax (application-owned checkpoint hook).
+"""Matrix and pipeline-stage persistence (application-owned checkpoints).
 
 The reference has NO checkpoint subsystem (SURVEY §5): applications own
 persistence by wrapping user memory (``matrix/matrix.h:94-109``). This module
-keeps the same stance — nothing in the algorithms checkpoints — but makes the
-application hook concrete for the JAX ecosystem: a distributed
-:class:`~dlaf_tpu.matrix.matrix.Matrix` round-trips through an orbax
-checkpoint (sharded tile storage + the Distribution metadata needed to
-rebuild it on any grid of the same shape).
+keeps the same stance — nothing in the algorithms checkpoints implicitly —
+but makes the application hook concrete for the JAX ecosystem, in two layers:
+
+* **Whole-matrix round trip** (:func:`save` / :func:`load`): a distributed
+  :class:`~dlaf_tpu.matrix.matrix.Matrix` through an orbax checkpoint
+  (sharded tile storage + the Distribution metadata needed to rebuild it on
+  any grid of the same shape).
+* **Stage-level checkpoints** (:func:`save_stage` / :func:`load_stage` /
+  :func:`stage_manifest`, PR 12 — docs/robustness.md §5): versioned,
+  ATOMIC (write-to-temp + ``os.replace``) ``.npz`` payloads plus JSON
+  manifests carrying config/grid/dtype fingerprints, the persistence
+  substrate beneath ``DLAF_RESUME_DIR`` preemption-safe pipeline resume
+  (:mod:`dlaf_tpu.health.resume`). The manifest is written AFTER the
+  payload and its presence IS the completion marker — a process killed
+  mid-write leaves either nothing or a complete stage, never a torn one.
+  :func:`matrix_arrays` / :func:`matrix_from_arrays` flatten a Matrix
+  into such a payload (raw tile storage, NOT the unpadded global view, so
+  the round trip is bitwise including edge-tile padding).
 """
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Optional
 
@@ -123,3 +137,149 @@ def load(path: str, grid: Optional[Grid] = None) -> Matrix:
 
         storage = place(storage, grid.tile_sharding())
     return Matrix(dist, storage, grid)
+
+
+# ---------------------------------------------------------------------------
+# Stage-level checkpoints (DLAF_RESUME_DIR; docs/robustness.md §5)
+# ---------------------------------------------------------------------------
+
+#: Manifest schema version; a loader seeing a different version must
+#: refuse (the resume layer raises ResumeError), never misparse.
+STAGE_MANIFEST_VERSION = 1
+
+
+def matrix_arrays(mat: Matrix, prefix: str = "m") -> dict:
+    """Flatten ``mat`` into a stage-payload array dict: the RAW tile
+    storage (bitwise — edge-tile padding included, so recomputation from
+    a restored matrix sees exactly the bytes the uninterrupted run saw)
+    plus the layout metadata needed to rebuild the Distribution."""
+    return {
+        f"{prefix}.storage": np.asarray(mat.storage),
+        f"{prefix}.meta": np.array(
+            [mat.size.row, mat.size.col,
+             mat.block_size.row, mat.block_size.col,
+             mat.dist.grid_size.row, mat.dist.grid_size.col,
+             mat.dist.source_rank.row, mat.dist.source_rank.col],
+            dtype=np.int64),
+    }
+
+
+def matrix_from_arrays(arrays: dict, prefix: str = "m",
+                       grid: Optional[Grid] = None) -> Matrix:
+    """Rebuild a Matrix from a :func:`matrix_arrays` payload. ``grid``
+    must match the saved grid shape (None only for 1x1 saves) — the same
+    contract as :func:`load`, validated before any Matrix is built."""
+    meta = np.asarray(arrays[f"{prefix}.meta"]).reshape(-1)
+    if meta.shape != (8,):
+        raise ValueError(f"stage payload {prefix!r}: meta shape "
+                         f"{meta.shape}, expected (8,)")
+    size = GlobalElementSize(int(meta[0]), int(meta[1]))
+    block = TileElementSize(int(meta[2]), int(meta[3]))
+    gr, gc = int(meta[4]), int(meta[5])
+    if grid is None:
+        if gr * gc != 1:
+            raise ValueError(f"stage payload {prefix!r}: saved on a "
+                             f"{gr}x{gc} grid; pass a grid= of that shape")
+    elif (grid.size.row, grid.size.col) != (gr, gc):
+        raise ValueError(f"stage payload {prefix!r}: grid mismatch — "
+                         f"saved {gr}x{gc}, loading onto "
+                         f"{grid.size.row}x{grid.size.col}")
+    from .matrix import _make_dist
+    from .tiling import storage_tile_grid
+
+    dist = _make_dist(size, block, grid,
+                      RankIndex2D(int(meta[6]), int(meta[7])))
+    storage = np.asarray(arrays[f"{prefix}.storage"])
+    Sr, Sc, _, _ = storage_tile_grid(dist)
+    expect = (Sr, Sc, block.row, block.col)
+    if tuple(storage.shape) != expect:
+        raise ValueError(f"stage payload {prefix!r}: storage shape "
+                         f"{tuple(storage.shape)} inconsistent with its "
+                         f"metadata (expected {expect})")
+    if grid is not None and grid.num_devices > 1:
+        from .memory import place
+
+        storage = place(storage, grid.tile_sharding())
+    return Matrix(dist, storage, grid)
+
+
+def _atomic_replace(path: str, write_fn) -> None:
+    """Write via a same-directory temp file + ``os.replace`` (atomic on
+    POSIX): readers see the old file or the new one, never a torn one."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _stage_paths(directory: str, stage: str) -> tuple:
+    if not stage or any(c in stage for c in "/\\"):
+        raise ValueError(f"stage name {stage!r} must be a bare identifier")
+    return (os.path.join(directory, f"{stage}.npz"),
+            os.path.join(directory, f"{stage}.json"))
+
+
+def save_stage(directory: str, stage: str, arrays: dict,
+               fingerprint: dict, extra: Optional[dict] = None) -> str:
+    """Persist one completed stage: the array payload (atomic ``.npz``)
+    first, then the manifest (atomic JSON) — manifest presence marks the
+    stage complete. Returns the manifest path."""
+    os.makedirs(directory, exist_ok=True)
+    data_path, man_path = _stage_paths(directory, stage)
+
+    def _write_npz(tmp):
+        # write through an open file object: np.savez(path) would append
+        # its own .npz suffix and break the rename
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+
+    _atomic_replace(data_path, _write_npz)
+    manifest = {"version": STAGE_MANIFEST_VERSION, "stage": stage,
+                "arrays": os.path.basename(data_path),
+                "keys": sorted(arrays),
+                "fingerprint": dict(fingerprint), **(extra or {})}
+
+    def _write_json(tmp):
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, sort_keys=True)
+
+    _atomic_replace(man_path, _write_json)
+    return man_path
+
+
+def stage_manifest(directory: str, stage: str) -> Optional[dict]:
+    """The stage's manifest dict, or None when the stage has not
+    completed (no manifest). An unparsable manifest raises ValueError —
+    corruption must be loud, not "not completed"."""
+    _, man_path = _stage_paths(directory, stage)
+    if not os.path.exists(man_path):
+        return None
+    with open(man_path) as f:
+        try:
+            manifest = json.load(f)
+        except ValueError as e:
+            raise ValueError(f"stage manifest {man_path!r} is corrupt: {e}")
+    if not isinstance(manifest, dict):
+        raise ValueError(f"stage manifest {man_path!r}: not an object")
+    return manifest
+
+
+def load_stage(directory: str, stage: str) -> tuple:
+    """``(arrays dict, manifest dict)`` for a completed stage; raises
+    ValueError when the stage is incomplete or the payload disagrees
+    with its manifest key list."""
+    manifest = stage_manifest(directory, stage)
+    if manifest is None:
+        raise ValueError(f"stage {stage!r} has no manifest under "
+                         f"{directory!r} — not completed")
+    data_path, _ = _stage_paths(directory, stage)
+    with np.load(data_path, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    if sorted(arrays) != manifest.get("keys", sorted(arrays)):
+        raise ValueError(
+            f"stage {stage!r}: payload keys {sorted(arrays)} != manifest "
+            f"keys {manifest.get('keys')} — checkpoint is torn or edited")
+    return arrays, manifest

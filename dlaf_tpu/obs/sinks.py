@@ -47,6 +47,22 @@ Schema (version 1). Every record carries ``v`` (int schema version),
     e.g. the D&C deflation fraction, omit all three); a record may not
     carry both ``bound_ratio`` and ``nonfinite``.
 
+``resilience``
+    Resilience-layer record (:mod:`dlaf_tpu.health.policy` /
+    ``.circuit`` / ``.resume`` and the serve queue's overload path;
+    docs/robustness.md): ``site`` str, ``event`` one of
+    ``retry`` | ``give_up`` | ``deadline`` | ``circuit_open`` |
+    ``circuit_half_open`` | ``circuit_close`` | ``shed`` | ``expired`` |
+    ``checkpoint`` | ``preempt`` | ``resume``, ``attrs`` object;
+    ``retry``/``give_up``/``deadline`` events carry a non-negative int
+    ``attempt`` and ``retry`` a finite ``delay_s >= 0`` (the
+    deterministic backoff actually applied). The
+    ``--require-resilience`` CI obligation: >= 1 ``retry`` or ``resume``
+    record (the recovery actually exercised), AND no
+    ``dlaf_circuit_state`` gauge left at the open value (2) in the LAST
+    metrics snapshot — an artifact that ends with a tripped breaker must
+    fail the gate, not scrape as healthy.
+
 ``serve``
     Serving-layer record (:mod:`dlaf_tpu.serve`, docs/serving.md), two
     events: ``dispatch`` — one batched bucket dispatch (``op`` str,
@@ -91,7 +107,12 @@ from typing import Optional
 SCHEMA_VERSION = 1
 
 KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
-               "accuracy", "serve")
+               "accuracy", "serve", "resilience")
+
+#: The resilience record's event vocabulary (schema above).
+RESILIENCE_EVENTS = ("retry", "give_up", "deadline", "circuit_open",
+                     "circuit_half_open", "circuit_close", "shed",
+                     "expired", "checkpoint", "preempt", "resume")
 
 
 def expand_rank_template(path: str) -> str:
@@ -307,6 +328,27 @@ def _validate_serve(r: dict, where: str, errors: list) -> None:
         errors.append(f"{where}: serve attrs must be an object")
 
 
+def _validate_resilience(r: dict, where: str, errors: list) -> None:
+    if not isinstance(r.get("site"), str) or not r.get("site"):
+        errors.append(f"{where}: resilience record without a site")
+    event = r.get("event")
+    if event not in RESILIENCE_EVENTS:
+        errors.append(f"{where}: resilience event must be one of "
+                      f"{RESILIENCE_EVENTS}, got {event!r}")
+    if event in ("retry", "give_up", "deadline"):
+        attempt = r.get("attempt")
+        if not isinstance(attempt, int) or isinstance(attempt, bool) \
+                or attempt < 0:
+            errors.append(f"{where}: resilience {event} record needs a "
+                          "non-negative int attempt")
+    if event == "retry" and (not _finite(r.get("delay_s"))
+                             or r.get("delay_s", -1) < 0):
+        errors.append(f"{where}: resilience retry record needs finite "
+                      "delay_s >= 0 (the backoff actually applied)")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: resilience attrs must be an object")
+
+
 def _validate_metrics(r: dict, where: str, errors: list) -> None:
     entries = r.get("metrics")
     if not isinstance(entries, list):
@@ -334,7 +376,7 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_fallbacks=False, require_comm_overlap=False,
                      require_dc_batch=False, require_bt_overlap=False,
                      require_telemetry=False, require_accuracy=False,
-                     require_serve=False) -> list:
+                     require_serve=False, require_resilience=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -370,13 +412,20 @@ def validate_records(records, require_spans=False, require_gflops=False,
     ``dlaf_retrace_total{site=serve.*}`` counter >= 2, or two program
     retrace records for one serve site — either means a bucket program
     recompiled mid-stream, the exact latency cliff warmup exists to
-    prevent)."""
+    prevent), and (``require_resilience``) the resilience audit trail
+    (docs/robustness.md): >= 1 ``resilience`` record proving recovery
+    actually ran (event ``retry`` or ``resume``), and NO
+    ``dlaf_circuit_state`` gauge still at the open value (2) in the last
+    metrics snapshot — a run that ended with a breaker tripped failed,
+    whatever else it recorded."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = n_accuracy = 0
     n_compile_obs = n_hbm = n_retrace = 0
     n_serve_batched = n_serve_miss = n_serve_requests = 0
     n_serve_accuracy = 0
+    n_resilience_proof = 0
+    circuit_state = {}                # site -> latest gauge value seen
     serve_retrace_sites = {}          # serve.* site -> trace evidence count
     overlap_axes, byte_axes = set(), set()
     for i, r in enumerate(records):
@@ -421,6 +470,10 @@ def validate_records(records, require_spans=False, require_gflops=False,
                 n_accuracy += 1
                 if r.get("site") == "serve":
                     n_serve_accuracy += 1
+        elif rtype == "resilience":
+            _validate_resilience(r, where, errors)
+            if r.get("event") in ("retry", "resume"):
+                n_resilience_proof += 1
         elif rtype == "serve":
             _validate_serve(r, where, errors)
             if r.get("event") == "dispatch":
@@ -475,6 +528,12 @@ def validate_records(records, require_spans=False, require_gflops=False,
                     n_dc_batched += 1
                 if m.get("name") == "dlaf_fallback_total" and m["value"] > 0:
                     n_fallbacks += 1
+                if m.get("name") == "dlaf_circuit_state":
+                    # records are ordered, so this ends at the LAST
+                    # snapshot's value per site — the state the run
+                    # finished in
+                    site = (m.get("labels") or {}).get("site", "")
+                    circuit_state[site] = float(m["value"])
                 if m.get("name") == "dlaf_hbm_bytes":
                     n_hbm += 1
                 if m.get("name") == "dlaf_retrace_total" and m["value"] >= 1:
@@ -539,6 +598,14 @@ def validate_records(records, require_spans=False, require_gflops=False,
         if hot:
             errors.append("serve bucket program(s) retraced mid-stream "
                           f"(count >= 2): {hot}")
+    if require_resilience:
+        if n_resilience_proof == 0:
+            errors.append("artifact contains no resilience retry/resume "
+                          "record (recovery never exercised)")
+        open_sites = sorted(s for s, v in circuit_state.items() if v >= 2)
+        if open_sites:
+            errors.append("circuit breaker(s) left open at artifact end "
+                          f"(dlaf_circuit_state >= 2): {open_sites}")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "

@@ -46,6 +46,14 @@ Flags:
                             per-request accuracy record (site serve),
                             and no serve bucket program retraced twice
                             (dlaf_retrace_total{site=serve.*} < 2)
+    --require-resilience    fail unless the artifact carries the
+                            resilience audit trail (docs/robustness.md):
+                            >= 1 ``resilience`` record with event retry
+                            or resume (recovery actually exercised), and
+                            NO dlaf_circuit_state gauge left at the open
+                            value (2) in the last metrics snapshot — a
+                            run that ended with a tripped breaker must
+                            fail the gate, not scrape as healthy
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
@@ -82,7 +90,8 @@ def main(argv=None) -> int:
              "--require-retries", "--require-fallbacks",
              "--require-comm-overlap", "--require-dc-batch",
              "--require-bt-overlap", "--require-telemetry",
-             "--require-accuracy", "--require-serve", "--history",
+             "--require-accuracy", "--require-serve",
+             "--require-resilience", "--history",
              "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
@@ -117,7 +126,8 @@ def main(argv=None) -> int:
         require_bt_overlap="--require-bt-overlap" in flags,
         require_telemetry="--require-telemetry" in flags,
         require_accuracy="--require-accuracy" in flags,
-        require_serve="--require-serve" in flags)
+        require_serve="--require-serve" in flags,
+        require_resilience="--require-resilience" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -127,11 +137,13 @@ def main(argv=None) -> int:
     n_progs = sum(r.get("type") == "program" for r in records)
     n_acc = sum(r.get("type") == "accuracy" for r in records)
     n_serve = sum(r.get("type") == "serve" for r in records)
+    n_res = sum(r.get("type") == "resilience" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
     extra += f", {n_acc} accuracy records" if n_acc else ""
     extra += f", {n_serve} serve records" if n_serve else ""
+    extra += f", {n_res} resilience records" if n_res else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

@@ -36,6 +36,33 @@ per-request ``accuracy`` record (site ``serve``); every dispatch and
 request lands as a ``serve`` JSONL record so the validator's
 ``--require-serve`` covers the serving path end to end
 (docs/observability.md).
+
+Resilience (PR 12, docs/robustness.md):
+
+* **Admission control** (``DLAF_SERVE_MAX_DEPTH`` / ``DLAF_SERVE_SHED``):
+  total pending depth is bounded; at the bound a submit either sheds fast
+  with a structured :class:`~dlaf_tpu.health.errors.OverloadError` (shed
+  counted per bucket, ``dlaf_serve_shed_total``) or — shed off —
+  force-dispatches the fullest bucket as backpressure. Either way depth
+  provably never exceeds the bound (queue memory is bounded under
+  overload; bench.py's ``overload`` arm certifies shed rate + p99 at 2x
+  capacity).
+* **Per-request deadlines** (``Request.deadline_s``): at dispatch
+  composition, requests whose wait exceeded their deadline are cancelled
+  with a :class:`~dlaf_tpu.health.errors.DeadlineExceededError` cause
+  (counted ``dlaf_deadline_exceeded_total{site="serve.queue"}`` +
+  per-bucket ``expired``) instead of riding a batch whose result nobody
+  will read.
+* **Retried, breaker-guarded dispatch**: each batch dispatch runs under
+  the shared :mod:`dlaf_tpu.health.policy` engine
+  (``DLAF_SERVE_RETRY_ATTEMPTS``/``DLAF_SERVE_RETRY_BACKOFF_MS``) behind
+  a per-bucket circuit breaker (:mod:`dlaf_tpu.health.circuit`,
+  ``dlaf_circuit_state{site}``) — a transient failure retries before any
+  ticket is poisoned; sustained failure opens the breaker and fails
+  later dispatches fast instead of re-running a broken program.
+* :meth:`Queue.stats` snapshots per-bucket depth / in-flight / shed /
+  expired counts and breaker states (also exported as gauges and printed
+  by ``scripts/profile_summary.py``'s serve section).
 """
 
 from __future__ import annotations
@@ -56,6 +83,9 @@ from .. import obs
 from ..common.asserts import dlaf_assert
 from ..config import (get_configuration, parse_serve_buckets,
                       register_program_cache)
+from ..health import circuit as _circuit
+from ..health.errors import DeadlineExceededError, OverloadError
+from ..health.policy import RetryPolicy, with_policy
 from .programs import (ProgramService, cholesky_spec, eigh_spec,
                        get_service, solve_spec)
 
@@ -101,7 +131,11 @@ class Request:
     """One serving request: ``op`` in :data:`OPS`, ``a`` the ``(n, n)``
     problem (triangle semantics per op), ``b`` the rhs for the solve
     (``(n, nrhs)`` side='L', ``(nrhs, n)`` side='R'), ``alpha`` the
-    solve scale. ``rid`` is stamped by the queue when left None."""
+    solve scale. ``rid`` is stamped by the queue when left None.
+    ``deadline_s`` (None = no deadline) bounds the QUEUE WAIT: a request
+    still pending ``deadline_s`` seconds after submit is cancelled at
+    dispatch composition with a
+    :class:`~dlaf_tpu.health.errors.DeadlineExceededError` cause."""
 
     op: str
     a: Any
@@ -112,6 +146,7 @@ class Request:
     diag: str = "N"
     alpha: float = 1.0
     rid: Optional[int] = None
+    deadline_s: Optional[float] = None
 
 
 class Ticket:
@@ -134,10 +169,14 @@ class Ticket:
 
     def result(self):
         if self.error is not None:
-            # the batch this request rode in failed to dispatch (compile
-            # error, OOM, ...): surface the cause instead of "queued"
+            # the request was not served: expired before dispatch, or the
+            # batch it rode in failed to dispatch (compile error, OOM,
+            # open breaker, ...) — surface the cause instead of "queued"
+            what = ("expired before dispatch"
+                    if isinstance(self.error, DeadlineExceededError)
+                    else "batch dispatch failed")
             raise RuntimeError(
-                f"request {self.request.rid}: batch dispatch failed "
+                f"request {self.request.rid}: {what} "
                 f"({type(self.error).__name__})") from self.error
         if not self.done:
             raise RuntimeError(
@@ -292,7 +331,11 @@ class Queue:
                  batch: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  buckets: Optional[tuple] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 max_depth: Optional[int] = None,
+                 shed: Optional[bool] = None,
+                 retry_attempts: Optional[int] = None,
+                 retry_backoff_s: Optional[float] = None):
         cfg = get_configuration()
         self.service = service if service is not None else get_service()
         self.batch = int(batch if batch is not None else cfg.serve_batch)
@@ -303,6 +346,18 @@ class Queue:
         self.buckets = (tuple(buckets) if buckets is not None
                         else resolve_buckets())
         self.clock = clock
+        self.max_depth = int(max_depth if max_depth is not None
+                             else cfg.serve_max_depth)
+        dlaf_assert(self.max_depth >= 0, f"Queue: max_depth must be >= 0, "
+                    f"got {self.max_depth}")
+        self.shed = bool(cfg.serve_shed if shed is None else shed)
+        self.retry_attempts = int(retry_attempts if retry_attempts
+                                  is not None else cfg.serve_retry_attempts)
+        dlaf_assert(self.retry_attempts >= 1, "Queue: retry_attempts must "
+                    f"be >= 1, got {self.retry_attempts}")
+        self.retry_backoff_s = float(
+            cfg.serve_retry_backoff_ms / 1e3 if retry_backoff_s is None
+            else retry_backoff_s)
         self._pending: dict = {}          # _BucketKey -> [(req, ticket)]
         self._rid = itertools.count()
         # one lock over submit/poll/flush: the service below is already
@@ -311,6 +366,8 @@ class Queue:
         self._lock = threading.RLock()
         self.dispatches = 0
         self.requests = 0
+        self._in_flight = 0               # dispatches currently executing
+        self._counts: dict = {}           # _BucketKey -> {shed, expired}
 
     # -- submission ------------------------------------------------------
 
@@ -339,22 +396,68 @@ class Queue:
                           dtype=np.dtype(a.dtype).name, uplo=req.uplo,
                           side=req.side, transa=req.transa, diag=req.diag)
 
+    def _bucket_counts(self, key: _BucketKey) -> dict:
+        return self._counts.setdefault(
+            key, {"shed": 0, "expired": 0, "dispatches": 0, "failures": 0})
+
+    def _admit(self, key: _BucketKey) -> None:
+        """Admission control (lock held): at the ``max_depth`` bound,
+        shed this submit with OverloadError, or — shed off — dispatch the
+        fullest bucket inline (backpressure) until there is room. Depth
+        therefore provably never exceeds ``max_depth``."""
+        if not self.max_depth:
+            return
+        while self.pending() >= self.max_depth:
+            if self.shed:
+                counts = self._bucket_counts(key)
+                counts["shed"] += 1
+                if obs.metrics_active():
+                    obs.counter("dlaf_serve_shed_total", op=key.op,
+                                bucket_n=key.n).inc()
+                obs.emit_event("resilience", site="serve.queue",
+                               event="shed",
+                               attrs={"op": key.op, "bucket_n": key.n,
+                                      "depth": self.pending(),
+                                      "max_depth": self.max_depth})
+                raise OverloadError(self.pending(), self.max_depth,
+                                    op=key.op, bucket_n=key.n)
+            fullest = max((k for k, v in self._pending.items() if v),
+                          key=lambda k: len(self._pending[k]),
+                          default=None)
+            if fullest is None:
+                return          # nothing pending: the bound cannot bind
+            try:
+                self._dispatch(fullest)
+            except Exception:
+                # the inline dispatch failed for ANOTHER bucket's batch:
+                # its tickets already carry the cause (poisoned by
+                # _dispatch) and its lanes were popped either way, so
+                # room was made — that failure belongs to those tickets,
+                # not to THIS submit, which must still be admitted
+                pass
+
     def submit(self, req: Request) -> Ticket:
         """Enqueue one request; dispatches its bucket immediately when
         the batch fills, and sweeps OTHER buckets' expired deadlines
         (the no-background-thread discipline: submission is the clock
-        edge)."""
+        edge). At the ``max_depth`` admission bound the submit sheds
+        (:class:`~dlaf_tpu.health.errors.OverloadError`, no ticket
+        created — a shed request is never stranded) or applies
+        backpressure, per the ``shed`` knob."""
         with self._lock:
             now = self.clock()
+            key = self._key(req)          # validate BEFORE admission
+            self._admit(key)
             if req.rid is None:
                 req.rid = next(self._rid)
             ticket = Ticket(req, now)
-            key = self._key(req)
             lanes = self._pending.setdefault(key, [])
             lanes.append((req, ticket))
             self.requests += 1
             if obs.metrics_active():
                 obs.counter("dlaf_serve_requests_total", op=req.op).inc()
+                obs.gauge("dlaf_serve_depth", op=key.op,
+                          bucket_n=key.n).set(float(len(lanes)))
             if len(lanes) >= self.batch:
                 self._dispatch(key)
             self.poll(now)
@@ -386,6 +489,42 @@ class Queue:
     def pending(self) -> int:
         return sum(len(v) for v in self._pending.values())
 
+    def stats(self) -> dict:
+        """Operational snapshot (docs/serving.md; printed by
+        scripts/profile_summary.py's serve section): totals — pending
+        depth, in-flight dispatch count (nonzero only when read from
+        WITHIN the dispatching thread, e.g. service hooks or probes —
+        the single submit/poll/flush lock serializes outside readers
+        past the dispatch), requests/dispatches, shed/
+        expired totals, the ``max_depth``/``shed`` admission config —
+        plus a per-bucket table keyed by the bucket program's site label:
+        depth, shed, expired, and the bucket breaker's state ("closed" |
+        "half_open" | "open"; None = the bucket never dispatched)."""
+        with self._lock:
+            buckets = {}
+            for key in set(self._pending) | set(self._counts):
+                counts = self._counts.get(key) or {}
+                site = self._spec(key).site
+                buckets[site] = {
+                    "depth": len(self._pending.get(key, [])),
+                    "shed": counts.get("shed", 0),
+                    "expired": counts.get("expired", 0),
+                    "dispatches": counts.get("dispatches", 0),
+                    "failures": counts.get("failures", 0),
+                    "breaker": _circuit.peek(site),
+                }
+            return {
+                "pending": self.pending(),
+                "in_flight": self._in_flight,
+                "requests": self.requests,
+                "dispatches": self.dispatches,
+                "shed": sum(b["shed"] for b in buckets.values()),
+                "expired": sum(b["expired"] for b in buckets.values()),
+                "max_depth": self.max_depth,
+                "shed_policy": "shed" if self.shed else "backpressure",
+                "buckets": buckets,
+            }
+
     # -- warmup sugar ----------------------------------------------------
 
     def _spec(self, key: _BucketKey):
@@ -416,18 +555,62 @@ class Queue:
 
     def _dispatch(self, key: _BucketKey) -> None:
         lanes = self._pending.pop(key)
+        if obs.metrics_active():
+            obs.gauge("dlaf_serve_depth", op=key.op,
+                      bucket_n=key.n).set(0.0)
+        self._in_flight += 1
         try:
-            self._dispatch_lanes(key, lanes)
+            if self._dispatch_lanes(key, lanes):
+                self._bucket_counts(key)["dispatches"] += 1
         except Exception as e:
-            # a failed dispatch (compile error, OOM, ...) must not
-            # strand its tickets as silently-forever-"queued": poison
-            # them with the cause — result() re-raises it — and let the
-            # exception reach the submitting caller
+            self._bucket_counts(key)["failures"] += 1
+            # a failed dispatch (compile error, OOM, exhausted retries,
+            # open breaker, ...) must not strand its tickets as
+            # silently-forever-"queued": poison them with the cause —
+            # result() re-raises it — and let the exception reach the
+            # submitting caller. Tickets already cancelled (expiry) keep
+            # their own, more precise cause.
             for _, ticket in lanes:
-                ticket.error = e
+                if ticket.error is None and not ticket.done:
+                    ticket.error = e
             raise
+        finally:
+            self._in_flight -= 1
 
-    def _dispatch_lanes(self, key: _BucketKey, lanes: list) -> None:
+    def _expire_lanes(self, key: _BucketKey, lanes: list, now: float
+                      ) -> list:
+        """Cancel requests whose queue wait exceeded their deadline (the
+        dispatch-composition cancellation point: an expired request must
+        not ride a batch whose answer nobody will read); returns the
+        still-live lanes."""
+        live = []
+        for req, ticket in lanes:
+            waited = now - ticket.submitted
+            if req.deadline_s is not None and waited > req.deadline_s:
+                err = DeadlineExceededError("serve.queue", waited,
+                                            req.deadline_s)
+                ticket.error = err
+                self._bucket_counts(key)["expired"] += 1
+                if obs.metrics_active():
+                    obs.counter("dlaf_deadline_exceeded_total",
+                                site="serve.queue").inc()
+                obs.emit_event("resilience", site="serve.queue",
+                               event="expired",
+                               attrs={"rid": req.rid, "op": key.op,
+                                      "bucket_n": key.n,
+                                      "waited_s": float(waited),
+                                      "deadline_s": float(req.deadline_s)})
+            else:
+                live.append((req, ticket))
+        return live
+
+    def _dispatch_lanes(self, key: _BucketKey, lanes: list) -> bool:
+        """Returns whether a program actually ran — an all-expired batch
+        does not count as a dispatch anywhere (stats, records, metrics
+        all stay consistent)."""
+        lanes = self._expire_lanes(key, lanes, self.clock())
+        if not lanes:
+            return False        # everything expired: nothing to run
         reqs = [r for r, _ in lanes]
         tickets = [t for _, t in lanes]
         spec = self._spec(key)
@@ -447,10 +630,26 @@ class Queue:
                              + [np.dtype(key.dtype).type(1.0)]
                              * (self.batch - len(reqs)))
             args += [b_batch, alpha]
+        # dispatch + compile run under the shared policy engine behind
+        # the bucket's circuit breaker: a transient failure (e.g. an
+        # inject.fail_dispatch drill, a flaky tunnel) retries before any
+        # ticket is poisoned; consecutive attempt failures open the
+        # breaker and later dispatches fail fast (CircuitOpenError)
+        breaker = _circuit.breaker(spec.site, clock=self.clock)
+        policy = RetryPolicy(max_attempts=self.retry_attempts,
+                             backoff_base_s=self.retry_backoff_s)
+
+        def _attempt():
+            from ..health import inject
+
+            inject.maybe_fail_dispatch()
+            return self.service.run(spec, *args)
+
         with obs.span("serve.dispatch", op=key.op, bucket_n=key.n,
                       nrhs=key.nrhs, lanes=len(reqs), batch=self.batch,
                       dtype=key.dtype, cache="hit" if resident else "miss"):
-            out = self.service.run(spec, *args)
+            out = with_policy(spec.site, _attempt, policy=policy,
+                              breaker=breaker, clock=self.clock)
         dev_outs, infos = _split_outputs(key.op, out)
         # ONE device->host fetch per dispatch, then zero-cost numpy views
         # per ticket: per-lane device slicing would cost a dispatch per
@@ -503,6 +702,7 @@ class Queue:
                     of=_lane_array(dev_outs),
                     attrs={"op": key.op, "rid": req.rid,
                            "bucket_n": key.n})
+        return True
 
     def _residuals(self, key, reqs, args, lane_outs):
         """Per-real-lane residual vector under DLAF_ACCURACY, else None

@@ -116,6 +116,44 @@ def summarize_jsonl(path: str, top_n: int) -> None:
         for line in format_accuracy_table(accuracy_rows(records), top_n):
             print(f"  {line}")
 
+    serve = [r for r in records if r.get("type") == "serve"]
+    resil = [r for r in records if r.get("type") == "resilience"]
+    if serve or resil:
+        print("\n== serve / resilience ==")
+        reqs = [r for r in serve if r.get("event") == "request"]
+        disp = [r for r in serve if r.get("event") == "dispatch"]
+        if disp:
+            hits = sum(r.get("cache") == "hit" for r in disp)
+            print(f"  {len(disp)} dispatches ({hits} cache hits), "
+                  f"{len(reqs)} requests")
+        if reqs:
+            lat = sorted(r.get("total_s", 0.0) for r in reqs)
+            p99 = lat[min(int(0.99 * len(lat)), len(lat) - 1)]
+            print(f"  request latency: mean {sum(lat) / len(lat) * 1e3:.2f}"
+                  f" ms  p99 {p99 * 1e3:.2f} ms")
+        if resil:
+            events = collections.Counter(r.get("event", "?") for r in resil)
+            print("  resilience events: "
+                  + ", ".join(f"{k}={v}" for k, v in sorted(events.items())))
+        # queue depth / shed / expired / breaker state from the last
+        # snapshot (the gauges Queue.stats() exports — single owner of
+        # the semantics, this is just the offline view)
+        if snaps:
+            rows = [m for m in snaps[-1]["metrics"]
+                    if m.get("name") in ("dlaf_serve_depth",
+                                         "dlaf_serve_shed_total",
+                                         "dlaf_deadline_exceeded_total",
+                                         "dlaf_circuit_state")]
+            for m in sorted(rows, key=lambda m: m["name"]):
+                labels = ",".join(f"{k}={v}" for k, v in
+                                  sorted(m.get("labels", {}).items()))
+                val = m.get("value", 0)
+                state = ""
+                if m["name"] == "dlaf_circuit_state":
+                    state = "  (" + {0: "closed", 1: "half_open",
+                                     2: "open"}.get(int(val), "?") + ")"
+                print(f"  {val:>10.0f}  {m['name']}{{{labels}}}{state}")
+
     if snaps:
         print("\n== counters (last snapshot) ==")
         for m in snaps[-1]["metrics"]:

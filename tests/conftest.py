@@ -96,6 +96,8 @@ _QUICK_TESTS = {
     ("test_aux_components.py", "test_bench_headline_fallback_replays_history"),
     ("test_serve.py", "test_cholesky_batched_bitwise_vs_singles"),
     ("test_serve.py", "test_warmed_queue_artifact_passes_require_serve"),
+    ("test_resilience.py", "test_queue_dispatch_retries_transient_fault"),
+    ("test_resilience.py", "test_eigensolver_preempt_resume_bitwise"),
     ("test_obs.py", "test_noop_fast_path_when_disabled"),
     ("test_obs.py", "test_jsonl_schema_roundtrip"),
     ("test_obs.py", "test_miniapp_cholesky_metrics_integration"),

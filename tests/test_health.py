@@ -39,7 +39,8 @@ def health_reset():
     yield
     os.environ.pop("DLAF_METRICS_PATH", None)
     obs._reset_for_tests()
-    C.finalize()
+    health.circuit.reset()            # no tripped breaker leaks between
+    C.finalize()                      # tests (docs/robustness.md §3)
     C.initialize()
 
 
@@ -451,13 +452,158 @@ def test_multihost_timeout_actionable_error(monkeypatch):
     monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
     with pytest.raises(RuntimeError) as ei:
         multihost.initialize_multihost("10.0.0.1:8476", num_processes=4,
-                                       process_id=1, timeout=5)
+                                       process_id=1, timeout=5,
+                                       connect_attempts=1)
     msg = str(ei.value)
     assert "10.0.0.1:8476" in msg and "timeout=5s" in msg
     assert "firewall" in msg and "SAME" in msg
     assert seen["timeout"] == 5
     # single-process worlds stay a no-op (no coordinator required)
     multihost.initialize_multihost(None, num_processes=1)
+
+
+def test_multihost_connect_retries_transient_failures(monkeypatch,
+                                                      tmp_path):
+    """The coordinator connect rides the shared policy engine (PR 12):
+    a transient bring-up failure retries with backoff and the world
+    comes up on a later attempt; a caller bug raises immediately with
+    its own message (never retried)."""
+    from dlaf_tpu.comm import multihost
+    from dlaf_tpu.health import policy as hpolicy
+
+    _metrics_on(tmp_path)     # arm the registry: the counter assertion
+                              # below must have teeth, not read a no-op
+
+    calls = []
+
+    def flaky_initialize(coordinator_address=None, num_processes=None,
+                         process_id=None, initialization_timeout=None):
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("connection refused")
+
+    slept = []
+    monkeypatch.setattr(jax.distributed, "initialize", flaky_initialize)
+    monkeypatch.setattr(hpolicy.time, "sleep", slept.append)
+    multihost.initialize_multihost("10.0.0.1:8476", num_processes=4,
+                                   process_id=1, connect_attempts=3,
+                                   connect_backoff_s=0.25)
+    assert len(calls) == 3 and len(slept) == 2
+    assert slept[0] < slept[1]           # exponential backoff applied
+    assert obs.registry().counter("dlaf_retry_total",
+                                  site="multihost.connect"
+                                  ).snapshot()["value"] == 2  # one per retry
+
+    calls.clear()
+
+    def buggy_initialize(**kw):
+        calls.append(1)
+        raise ValueError("already initialized")
+
+    monkeypatch.setattr(jax.distributed, "initialize", buggy_initialize)
+    with pytest.raises(ValueError, match="already initialized"):
+        multihost.initialize_multihost("10.0.0.1:8476", num_processes=4,
+                                       process_id=1)
+    assert len(calls) == 1               # caller bugs are never retried
+
+
+# ---------------------------------------------------------------------------
+# DLAF_STRICT coverage audit (PR 12 satellite): EVERY report_fallback site
+# must have a strict-raise assertion in this file — secular and
+# band_to_tridiag are covered by the tests above/below; the rest here. The
+# audit test at the end greps the source so a NEW site cannot land without
+# extending this block.
+# ---------------------------------------------------------------------------
+
+def test_strict_deflate_site_raises(tmp_path):
+    from dlaf_tpu.eigensolver.tridiag_solver import _deflation_scan
+
+    _metrics_on(tmp_path, strict=True)
+    ds = np.array([1.0, 1.0 + 1e-14, 2.0])
+    zs = np.array([0.5, 0.5, 0.5])
+    live = np.ones(3, dtype=bool)
+    with inject.force_native_failure():
+        with pytest.raises(health.DegradationError) as ei:
+            _deflation_scan(ds, zs, live, 1e-8)
+    assert ei.value.site == "deflate"
+
+
+def test_strict_pallas_update_site_raises(tmp_path, monkeypatch, devices8):
+    monkeypatch.setenv("DLAF_FORCE_PALLAS_UPDATE", "1")
+    _metrics_on(tmp_path, strict=True)
+    a = hpd_matrix(8, np.float32)
+    with inject.disable_pallas():
+        with pytest.raises(health.DegradationError) as ei:
+            cholesky("L", Matrix_from(a, 4, Grid(2, 2)))
+    assert ei.value.site == "pallas_update"
+    assert ei.value.reason == "injected_off"
+
+
+def test_strict_ozaki_gemm_site_raises(tmp_path):
+    from dlaf_tpu.tile_ops import blas as tb
+
+    path = str(tmp_path / "strict_oz.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, strict=True,
+                                 f64_gemm="mxu", f64_gemm_min_dim=4))
+    with inject.disable_ozaki():
+        with pytest.raises(health.DegradationError) as ei:
+            tb.f64_gemm_uses_mxu(np.float64, 8)
+    assert ei.value.site == "ozaki_gemm"
+
+
+def test_strict_ozaki_pallas_site_raises(tmp_path, devices8):
+    path = str(tmp_path / "strict_ozp.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, strict=True,
+                                 ozaki_impl="pallas", f64_gemm="mxu",
+                                 f64_gemm_min_dim=4))
+    a = hpd_matrix(16)
+    with inject.disable_pallas():
+        with pytest.raises(health.DegradationError) as ei:
+            cholesky("L", Matrix_from(a, 4, Grid(2, 2)))
+    assert ei.value.site == "ozaki_pallas"
+
+
+def test_strict_panel_site_raises(tmp_path):
+    path = str(tmp_path / "strict_panel.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, strict=True,
+                                 panel_impl="fused"))
+    a = hpd_matrix(16, np.float32)
+    with inject.disable_pallas():
+        with pytest.raises(health.DegradationError) as ei:
+            cholesky("L", Matrix_from(a, 4))
+    assert ei.value.site == "panel"
+
+
+def test_strict_coverage_audit_no_unlisted_site():
+    """The audit itself: every ``report_fallback``/``route_available``
+    site literal in dlaf_tpu/ must be in the strict-covered list below
+    (each entry has a strict-raise test in this file). A new degradation
+    site cannot land without a strict assertion riding along."""
+    import re
+
+    covered = {"secular", "deflate", "band_to_tridiag", "pallas_update",
+               "ozaki_gemm", "ozaki_pallas", "panel"}
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "dlaf_tpu")
+    found = set()
+    pat = re.compile(
+        r"report_fallback\(\s*['\"]([a-z0-9_]+)['\"]"
+        r"|route_available\(\s*['\"][a-z0-9_]+['\"]\s*,"
+        r"\s*['\"]([a-z0-9_]+)['\"]")
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn)).read()
+            for m in pat.finditer(src):
+                found.add(m.group(1) or m.group(2))
+    # registry.py's own "circuit_open" reason-path and docstring mentions
+    # are not sites; the regex only matches call-site literals
+    assert found, "audit found no degradation sites — regex rotted?"
+    assert found <= covered, \
+        f"degradation site(s) {sorted(found - covered)} have no strict-" \
+        "raise test in tests/test_health.py — add one and list it here"
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,692 @@
+"""Tests for ISSUE 12: the production resilience layer.
+
+Covers: the declarative RetryPolicy engine (deterministic seeded
+backoff, error classification, exhaustion, per-attempt deadlines via the
+clock-aware ``inject.hang`` stall), the circuit-breaker state machine
+(open/half-open/close, gauge + resilience records, registry reset-safety)
+and its ``run_with_fallback`` integration, the serving queue's overload
+protection (``DLAF_SERVE_MAX_DEPTH``/``DLAF_SERVE_SHED`` shed vs
+backpressure, per-request deadlines cancelling at dispatch composition,
+retried breaker-guarded dispatch, ``Queue.stats()``), a 16-thread soak
+against a flapping ``fail_dispatch`` fault (no deadlock, no
+double-dispatch, no stranded tickets), the stage-checkpoint substrate
+(atomic manifests, fingerprint/version rejection, matrix payload
+round trips) and the eigensolver kill->resume pin (bitwise vs the
+uninterrupted run at EVERY stage boundary), and the
+``--require-resilience`` validator obligation (docs/robustness.md).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.config as C
+from dlaf_tpu import health, obs
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.health import circuit, inject, policy
+from dlaf_tpu.health.errors import (CircuitOpenError, DeadlineExceededError,
+                                    OverloadError, PreemptionError,
+                                    ResumeError)
+from dlaf_tpu.matrix import checkpoint as ckpt
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.serve import ProgramService, Queue, Request
+from dlaf_tpu.serve import programs as serve_programs
+
+
+@pytest.fixture(autouse=True)
+def resilience_reset():
+    """Every test leaves default config, no metrics, no breakers, and an
+    empty default program service behind."""
+    yield
+    for key in ("DLAF_METRICS_PATH", "DLAF_SERVE_MAX_DEPTH",
+                "DLAF_SERVE_SHED", "DLAF_RESUME_DIR",
+                "DLAF_CIRCUIT_THRESHOLD", "DLAF_CIRCUIT_COOLDOWN_S"):
+        os.environ.pop(key, None)
+    obs._reset_for_tests()
+    circuit.reset()
+    serve_programs._reset_for_tests()
+    C.finalize()
+    C.initialize()
+
+
+def _metrics_on(tmp_path, **cfg):
+    path = str(tmp_path / "resilience.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, log="off", **cfg))
+    return path
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _hpd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+def _records(path):
+    obs.flush()
+    return obs.read_records(path)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / with_policy
+# ---------------------------------------------------------------------------
+
+def test_policy_backoff_deterministic_seeded_jitter():
+    p = policy.RetryPolicy(max_attempts=5, backoff_base_s=1.0,
+                           backoff_growth=2.0, jitter=0.2, seed=7)
+    delays = [p.delay_s(i) for i in range(4)]
+    assert delays == [p.delay_s(i) for i in range(4)]   # replayable
+    # jitter stays within +-20% of the exponential envelope
+    for i, d in enumerate(delays):
+        assert 0.8 * 2.0**i <= d <= 1.2 * 2.0**i
+    # different seed => different jitter draw
+    q = policy.RetryPolicy(max_attempts=5, backoff_base_s=1.0,
+                           backoff_growth=2.0, jitter=0.2, seed=8)
+    assert q.delay_s(0) != p.delay_s(0)
+    # cap applies
+    capped = policy.RetryPolicy(backoff_base_s=10.0, backoff_max_s=15.0,
+                                jitter=0.0)
+    assert capped.delay_s(5) == 15.0
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        policy.RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        policy.RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError):
+        policy.RetryPolicy(backoff_growth=0.5)
+    with pytest.raises(ValueError):
+        policy.RetryPolicy(attempt_deadline_s=0.0)
+
+
+def test_with_policy_retries_then_succeeds(tmp_path):
+    path = _metrics_on(tmp_path)
+    calls, slept = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    p = policy.RetryPolicy(max_attempts=4, backoff_base_s=0.5, jitter=0.0)
+    out = policy.with_policy("t.flaky", flaky, policy=p, sleep=slept.append)
+    assert out == "ok" and len(calls) == 3
+    assert slept == [0.5, 1.0]           # exponential, no jitter
+    assert obs.registry().counter("dlaf_retry_total", site="t.flaky"
+                                  ).snapshot()["value"] == 2
+    recs = [r for r in _records(path) if r.get("type") == "resilience"]
+    assert [r["event"] for r in recs] == ["retry", "retry"]
+    assert [r["attempt"] for r in recs] == [0, 1]
+    assert all(r["site"] == "t.flaky" and r["delay_s"] > 0 for r in recs)
+
+
+def test_with_policy_classification_and_exhaustion(tmp_path):
+    path = _metrics_on(tmp_path)
+    # caller bugs are never retried
+    calls = []
+
+    def bug():
+        calls.append(1)
+        raise ValueError("caller bug")
+
+    with pytest.raises(ValueError):
+        policy.with_policy("t.bug", bug)
+    assert len(calls) == 1
+    # HealthError decisions are never retried either
+    with pytest.raises(OverloadError):
+        policy.with_policy("t.bug2", lambda: (_ for _ in ()).throw(
+            OverloadError(1, 1)))
+    # exhaustion re-raises the LAST error and leaves a give_up record
+    with pytest.raises(TimeoutError):
+        policy.with_policy(
+            "t.dead", lambda: (_ for _ in ()).throw(TimeoutError("down")),
+            policy=policy.RetryPolicy(max_attempts=2, backoff_base_s=0.0))
+    recs = [r for r in _records(path) if r.get("type") == "resilience"
+            and r["site"] == "t.dead"]
+    assert [r["event"] for r in recs] == ["retry", "give_up"]
+
+
+def test_with_policy_deadline_via_clock_aware_hang(tmp_path):
+    """inject.hang charges its stall against the attempt deadline WITHOUT
+    real wall time: the late success raises DeadlineExceededError and
+    counts dlaf_deadline_exceeded_total{site}."""
+    path = _metrics_on(tmp_path)
+    clock = FakeClock()
+    p = policy.RetryPolicy(max_attempts=1, attempt_deadline_s=0.5)
+    t0 = time.monotonic()
+    with inject.hang("t.hang", 30.0):
+        with pytest.raises(DeadlineExceededError) as ei:
+            policy.with_policy("t.hang", lambda: "late", policy=p,
+                               clock=clock)
+    assert time.monotonic() - t0 < 5.0       # no real 30 s burned
+    assert ei.value.site == "t.hang" and ei.value.elapsed_s == 30.0
+    assert ei.value.deadline_s == 0.5
+    assert obs.registry().counter("dlaf_deadline_exceeded_total",
+                                  site="t.hang").snapshot()["value"] == 1
+    recs = [r for r in _records(path) if r.get("type") == "resilience"]
+    assert [r["event"] for r in recs] == ["deadline"]
+    # unarmed: the same call passes (hang is reset-safe)
+    assert policy.with_policy("t.hang", lambda: "fine", policy=p,
+                              clock=clock) == "fine"
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine(tmp_path):
+    path = _metrics_on(tmp_path)
+    clock = FakeClock()
+    br = circuit.CircuitBreaker("t.br", threshold=3, cooldown_s=10.0,
+                                clock=clock)
+    for _ in range(2):
+        br.allow()
+        br.record_failure()
+    assert br.state() == "closed"            # under threshold
+    br.allow()
+    br.record_failure()
+    assert br.state() == "open"              # threshold-th consecutive
+    with pytest.raises(CircuitOpenError) as ei:
+        br.allow()
+    assert 0 < ei.value.retry_in_s <= 10.0
+    clock.t = 11.0
+    br.allow()                               # the half-open probe
+    assert br.state() == "half_open"
+    with pytest.raises(CircuitOpenError):
+        br.allow()                           # one probe at a time
+    br.record_failure()                      # probe failed: re-open
+    assert br.state() == "open"
+    clock.t = 30.0
+    br.allow()
+    br.record_success()                      # probe succeeded: close
+    assert br.state() == "closed"
+    br.allow()                               # closed admits freely
+    # gauge followed every transition; records carry the trail
+    assert obs.registry().gauge("dlaf_circuit_state",
+                                site="t.br").snapshot()["value"] == 0
+    events = [r["event"] for r in _records(path)
+              if r.get("type") == "resilience"]
+    assert events == ["circuit_open", "circuit_half_open", "circuit_open",
+                      "circuit_half_open", "circuit_close"]
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = circuit.CircuitBreaker("t.br2", threshold=2, cooldown_s=10.0)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state() == "closed"            # never 2 CONSECUTIVE
+
+
+def test_breaker_registry_and_reset():
+    a = circuit.breaker("t.reg.a", threshold=1, cooldown_s=99.0)
+    assert circuit.breaker("t.reg.a") is a   # get-or-create
+    a.record_failure()
+    assert circuit.peek("t.reg.a") == "open"
+    assert circuit.peek("t.reg.never") is None
+    dropped = circuit.reset("t.reg.")
+    assert dropped == 1 and circuit.peek("t.reg.a") is None
+
+
+def test_run_with_fallback_breaker_skips_failing_primary(tmp_path):
+    """After threshold consecutive primary failures the breaker opens and
+    the primary is SKIPPED (fallback reason circuit_open) until the
+    cooldown probe; a succeeding probe closes it again."""
+    _metrics_on(tmp_path, circuit_threshold=2, circuit_cooldown_s=3600.0)
+    calls = []
+
+    def primary():
+        calls.append(1)
+        raise RuntimeError("native down")
+
+    for _ in range(2):
+        assert health.run_with_fallback("t_site", primary,
+                                        lambda: "fb") == "fb"
+    assert circuit.peek("fallback.t_site") == "open"
+    assert health.run_with_fallback("t_site", primary, lambda: "fb") == "fb"
+    assert len(calls) == 2                   # third call skipped primary
+    c = obs.registry().counter(health.FALLBACK_COUNTER, site="t_site",
+                               reason="circuit_open").snapshot()
+    assert c["value"] == 1
+    # cooldown elapsed (fake it by resetting): the primary runs again
+    circuit.reset("fallback.")
+    assert health.run_with_fallback("t_site", lambda: "native",
+                                    lambda: "fb") == "native"
+    assert circuit.peek("fallback.t_site") == "closed"
+
+
+# ---------------------------------------------------------------------------
+# Queue: overload protection + deadlines + retried breaker-guarded dispatch
+# ---------------------------------------------------------------------------
+
+def test_queue_sheds_at_max_depth_with_structured_error(tmp_path):
+    path = _metrics_on(tmp_path)
+    clock = FakeClock()
+    q = Queue(ProgramService(), batch=64, deadline_s=1e9, buckets=(16,),
+              clock=clock, max_depth=4, shed=True)
+    tickets = [q.submit(Request(op="cholesky", a=_hpd(8, i)))
+               for i in range(4)]
+    with pytest.raises(OverloadError) as ei:
+        q.submit(Request(op="cholesky", a=_hpd(8, 99)))
+    assert ei.value.depth == 4 and ei.value.max_depth == 4
+    assert ei.value.op == "cholesky" and ei.value.bucket_n == 16
+    assert q.pending() == 4                  # depth never exceeded
+    st = q.stats()
+    assert st["shed"] == 1 and st["max_depth"] == 4
+    assert st["shed_policy"] == "shed"
+    (bucket,) = st["buckets"].values()
+    assert bucket["shed"] == 1 and bucket["depth"] == 4
+    q.flush()
+    assert all(t.done for t in tickets)      # accepted work still served
+    assert obs.registry().counter("dlaf_serve_shed_total", op="cholesky",
+                                  bucket_n=16).snapshot()["value"] == 1
+    sheds = [r for r in _records(path) if r.get("type") == "resilience"
+             and r.get("event") == "shed"]
+    assert len(sheds) == 1 and sheds[0]["site"] == "serve.queue"
+
+
+def test_queue_backpressure_mode_bounds_depth_without_shedding():
+    clock = FakeClock()
+    q = Queue(ProgramService(), batch=64, deadline_s=1e9, buckets=(16,),
+              clock=clock, max_depth=2, shed=False)
+    t1 = q.submit(Request(op="cholesky", a=_hpd(8, 1)))
+    t2 = q.submit(Request(op="cholesky", a=_hpd(8, 2)))
+    assert q.pending() == 2
+    t3 = q.submit(Request(op="cholesky", a=_hpd(8, 3)))
+    # the bound forced an inline dispatch of the fullest bucket
+    assert t1.done and t2.done and not t3.done
+    assert q.pending() == 1 and q.stats()["shed"] == 0
+    # a FAILING inline dispatch must not be misattributed to this
+    # submit: the failed batch's tickets carry the cause, room was made
+    # either way, and the new request is still admitted and ticketed
+    t4 = q.submit(Request(op="cholesky", a=_hpd(8, 4)))
+    assert q.pending() == 2
+    with inject.fail_dispatch(nth=0, count=q.retry_attempts):
+        t5 = q.submit(Request(op="cholesky", a=_hpd(8, 5)))
+    assert t3.error is not None and t4.error is not None   # the cause
+    assert t5.error is None and not t5.done                # admitted
+    assert q.pending() == 1
+    circuit.reset("serve.")
+    q.flush()
+    assert t5.done
+
+
+def test_queue_request_deadline_cancels_at_dispatch(tmp_path):
+    path = _metrics_on(tmp_path)
+    clock = FakeClock()
+    q = Queue(ProgramService(), batch=2, deadline_s=1e9, buckets=(16,),
+              clock=clock)
+    te = q.submit(Request(op="cholesky", a=_hpd(8, 1), deadline_s=0.5))
+    clock.t = 1.0
+    tl = q.submit(Request(op="cholesky", a=_hpd(8, 2)))   # fills the batch
+    assert tl.done and not te.done
+    with pytest.raises(RuntimeError, match="expired before dispatch"):
+        te.result()
+    assert isinstance(te.error, DeadlineExceededError)
+    assert te.error.deadline_s == 0.5 and te.error.elapsed_s == 1.0
+    assert q.stats()["expired"] == 1
+    assert obs.registry().counter("dlaf_deadline_exceeded_total",
+                                  site="serve.queue"
+                                  ).snapshot()["value"] == 1
+    recs = [r for r in _records(path) if r.get("type") == "resilience"
+            and r.get("event") == "expired"]
+    assert len(recs) == 1 and recs[0]["attrs"]["rid"] == te.request.rid
+
+
+def test_queue_all_expired_skips_the_program_entirely():
+    clock = FakeClock()
+
+    class _Counting(ProgramService):
+        runs = 0
+
+        def run(self, spec, *args):
+            _Counting.runs += 1
+            return super().run(spec, *args)
+
+    q = Queue(_Counting(), batch=4, deadline_s=1e9, buckets=(16,),
+              clock=clock)
+    t = q.submit(Request(op="cholesky", a=_hpd(8), deadline_s=0.1))
+    clock.t = 5.0
+    q.flush()
+    assert t.error is not None and _Counting.runs == 0
+
+
+def test_queue_dispatch_retries_transient_fault(tmp_path):
+    path = _metrics_on(tmp_path)
+    q = Queue(ProgramService(), batch=2, deadline_s=1e9, buckets=(16,),
+              clock=FakeClock(), retry_attempts=3)
+    with inject.fail_dispatch(nth=0, count=2):
+        t1 = q.submit(Request(op="cholesky", a=_hpd(8, 1)))
+        t2 = q.submit(Request(op="cholesky", a=_hpd(8, 2)))
+    assert t1.done and t2.done               # recovered within one dispatch
+    fac = np.tril(t1.result())
+    ref = np.tril(_hpd(8, 1)) + np.tril(_hpd(8, 1), -1).T
+    np.testing.assert_allclose(fac @ fac.T, ref, atol=1e-10)
+    recs = [r for r in _records(path) if r.get("type") == "resilience"
+            and r.get("event") == "retry"]
+    assert len(recs) == 2
+    assert not obs.validate_records(obs.read_records(path),
+                                    require_resilience=True)
+
+
+def test_queue_sustained_fault_opens_breaker_and_fails_fast(tmp_path):
+    path = _metrics_on(tmp_path)
+    q = Queue(ProgramService(), batch=1, deadline_s=1e9, buckets=(16,),
+              clock=FakeClock(), retry_attempts=3)
+    with inject.fail_dispatch(nth=0, count=100):
+        with pytest.raises(RuntimeError, match="injected dispatch fault"):
+            q.submit(Request(op="cholesky", a=_hpd(8, 1)))
+        (bucket,) = q.stats()["buckets"].values()
+        assert bucket["breaker"] == "open" and bucket["failures"] == 1
+        # open breaker: fail fast, ticket poisoned with the cause
+        with pytest.raises(CircuitOpenError):
+            q.submit(Request(op="cholesky", a=_hpd(8, 2)))
+        # the artifact carries the open state: --require-resilience rejects
+        obs.flush()
+        errors = obs.validate_records(obs.read_records(path),
+                                      require_resilience=True)
+        assert any("left open" in e for e in errors)
+    # fail_dispatch exit resets serve breakers (reset-safety): traffic OK
+    t = q.submit(Request(op="cholesky", a=_hpd(8, 3)))
+    assert t.done
+
+
+def test_queue_soak_threaded_flapping_fault_no_deadlock_no_double():
+    """The 16-thread soak (ISSUE 12 satellite): a flapping fail_dispatch
+    behind retry_attempts=1 trips the breaker open, the cooldown
+    half-open probe closes it again, and through it all no submit
+    deadlocks, no request dispatches twice, and no concurrent shed
+    decision strands a ticket."""
+    C.initialize(C.Configuration(log="off", circuit_threshold=3,
+                                 circuit_cooldown_s=0.05))
+    served, errors = [], []
+    lock = threading.Lock()
+
+    class _Tracking(ProgramService):
+        def run(self, spec, *args):
+            out = super().run(spec, *args)
+            with lock:
+                served.append(args[0].shape[0])   # lanes per dispatch
+            return out
+
+    q = Queue(_Tracking(), batch=4, deadline_s=1e9, buckets=(8,),
+              max_depth=64, shed=True, retry_attempts=1)
+    q.warmup([Request(op="cholesky", a=_hpd(8))])
+    tickets = []
+
+    def worker(seed):
+        try:
+            t = q.submit(Request(op="cholesky", a=_hpd(8, seed)))
+            with lock:
+                tickets.append(t)
+        except (OverloadError, CircuitOpenError, RuntimeError) as e:
+            with lock:
+                errors.append(e)
+
+    def storm(phase):
+        threads = [threading.Thread(target=worker, args=(phase * 100 + i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "soak deadlocked"
+        try:
+            q.flush()
+        except (CircuitOpenError, RuntimeError):
+            pass                     # flush dispatch poisoned its tickets
+
+    # phase 1: every dispatch attempt fails -> breaker opens mid-storm,
+    # later submits fail fast; every ticket must end terminal
+    with inject.fail_dispatch(nth=0, count=10_000):
+        storm(1)
+        (bucket,) = q.stats()["buckets"].values()
+        assert bucket["breaker"] == "open"
+        assert not served                     # nothing actually dispatched
+    circuit.reset("serve.")                   # context reset + explicit
+    # phase 2: fault gone -> the half-open probe (or fresh breaker)
+    # serves everything; flapping fault every 5th attempt still recovers
+    with inject.fail_dispatch(nth=0, count=1, every=5):
+        storm(2)
+    q.flush()
+    terminal = [t for t in tickets if t.done or t.error is not None]
+    assert len(terminal) == len(tickets), "stranded tickets"
+    # exactly-once dispatch: the program ran once per successful dispatch,
+    # never twice for one bucket pop
+    assert q.dispatches == len(served)
+    assert all(not (t.done and t.error is not None) for t in tickets)
+    done = [t for t in tickets if t.done]
+    assert len(done) >= 10                    # phase 2 really served
+
+
+# ---------------------------------------------------------------------------
+# Stage checkpoints + eigensolver kill-and-resume
+# ---------------------------------------------------------------------------
+
+def test_stage_checkpoint_roundtrip_and_manifest(tmp_path):
+    d = str(tmp_path / "ck")
+    arrays = {"x": np.arange(6.0).reshape(2, 3), "y": np.int64(7)}
+    ckpt.save_stage(d, "s1", arrays, {"n": 8, "dtype": "float64"})
+    man = ckpt.stage_manifest(d, "s1")
+    assert man["version"] == ckpt.STAGE_MANIFEST_VERSION
+    assert man["fingerprint"] == {"n": 8, "dtype": "float64"}
+    out, man2 = ckpt.load_stage(d, "s1")
+    np.testing.assert_array_equal(out["x"], arrays["x"])
+    assert int(out["y"]) == 7 and man2 == man
+    assert ckpt.stage_manifest(d, "nope") is None
+    with pytest.raises(ValueError, match="not completed"):
+        ckpt.load_stage(d, "nope")
+    # no temp files left behind (atomic write-rename discipline)
+    assert not [f for f in os.listdir(d) if ".tmp." in f]
+    with pytest.raises(ValueError, match="bare identifier"):
+        ckpt.save_stage(d, "../evil", arrays, {})
+
+
+def test_stage_checkpoint_corrupt_manifest_is_loud(tmp_path):
+    d = str(tmp_path / "ck")
+    ckpt.save_stage(d, "s1", {"x": np.zeros(2)}, {})
+    with open(os.path.join(d, "s1.json"), "w") as f:
+        f.write("{torn")
+    with pytest.raises(ValueError, match="corrupt"):
+        ckpt.stage_manifest(d, "s1")
+
+
+@pytest.mark.parametrize("grid_shape", [None, (2, 2)])
+def test_matrix_payload_roundtrip_bitwise(grid_shape, devices8):
+    from dlaf_tpu.comm.grid import Grid
+
+    grid = Grid(*grid_shape) if grid_shape else None
+    a = np.arange(13 * 13, dtype=np.float64).reshape(13, 13)
+    mat = Matrix.from_global(a, TileElementSize(4, 4), grid=grid)
+    arrays = ckpt.matrix_arrays(mat, "m")
+    back = ckpt.matrix_from_arrays(arrays, "m", grid)
+    np.testing.assert_array_equal(np.asarray(back.storage),
+                                  np.asarray(mat.storage))
+    np.testing.assert_array_equal(back.to_numpy(), a)
+    if grid is not None:
+        with pytest.raises(ValueError, match="grid"):
+            ckpt.matrix_from_arrays(arrays, "m", None)
+
+
+STAGES = ("red2band", "b2t", "tridiag", "bt_b2t", "bt_r2b")
+
+
+@pytest.mark.parametrize("stage", STAGES)
+def test_eigensolver_preempt_resume_bitwise(stage, tmp_path):
+    """Kill at EVERY stage boundary -> resume -> eigenpairs bitwise
+    identical to the uninterrupted run (the §5 acceptance pin)."""
+    from dlaf_tpu.eigensolver.eigensolver import eigensolver
+
+    rng = np.random.default_rng(0)
+    n, nb = 32, 8
+    x = rng.standard_normal((n, n))
+    a = (x + x.T) / 2
+
+    C.initialize(C.Configuration(log="off"))
+    ref = eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)))
+    refw = np.asarray(ref.eigenvalues)
+    refv = ref.eigenvectors.to_numpy()
+
+    C.initialize(C.Configuration(log="off",
+                                 resume_dir=str(tmp_path / "rd")))
+    with pytest.raises(PreemptionError) as ei:
+        with inject.preempt(stage):
+            eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)))
+    assert ei.value.stage == stage
+    # the killed stage's checkpoint IS on disk (kill after the write)
+    assert ckpt.stage_manifest(str(tmp_path / "rd" / "eigensolver"),
+                               stage) is not None
+    res = eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)),
+                      resume=True)
+    np.testing.assert_array_equal(np.asarray(res.eigenvalues), refw)
+    np.testing.assert_array_equal(res.eigenvectors.to_numpy(), refv)
+
+
+def test_eigensolver_resume_guards(tmp_path):
+    from dlaf_tpu.eigensolver.eigensolver import eigensolver
+
+    rng = np.random.default_rng(1)
+    n, nb = 24, 8
+    x = rng.standard_normal((n, n))
+    a = (x + x.T) / 2
+    mat = lambda: Matrix.from_global(a, TileElementSize(nb, nb))  # noqa: E731
+    # resume without a configured dir refuses loudly
+    C.initialize(C.Configuration(log="off"))
+    with pytest.raises(ResumeError, match="DLAF_RESUME_DIR"):
+        eigensolver("L", mat(), resume=True)
+    # fingerprint mismatch (different uplo) refuses loudly
+    C.initialize(C.Configuration(log="off",
+                                 resume_dir=str(tmp_path / "rd")))
+    eigensolver("L", mat())
+    with pytest.raises(ResumeError, match="fingerprint mismatch"):
+        eigensolver("U", mat(), resume=True)
+    # different input DATA at the same shape/config refuses loudly too —
+    # resume must never silently return another run's eigenpairs
+    x2 = rng.standard_normal((n, n))
+    a2 = (x2 + x2.T) / 2
+    with pytest.raises(ResumeError, match="input_sha"):
+        eigensolver("L", Matrix.from_global(a2, TileElementSize(nb, nb)),
+                    resume=True)
+
+
+def test_resume_emits_checkpoint_and_resume_records(tmp_path):
+    from dlaf_tpu.eigensolver.eigensolver import eigensolver
+
+    path = str(tmp_path / "art.jsonl")
+    rng = np.random.default_rng(2)
+    n, nb = 24, 8
+    x = rng.standard_normal((n, n))
+    a = (x + x.T) / 2
+    C.initialize(C.Configuration(log="off", metrics_path=path,
+                                 resume_dir=str(tmp_path / "rd")))
+    eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)))
+    eigensolver("L", Matrix.from_global(a, TileElementSize(nb, nb)),
+                resume=True)
+    recs = [r for r in _records(path) if r.get("type") == "resilience"]
+    checkpoints = [r for r in recs if r["event"] == "checkpoint"]
+    resumes = [r for r in recs if r["event"] == "resume"]
+    assert len(checkpoints) == 5             # one per stage
+    assert len(resumes) == 5                 # full skip on resume
+    assert not obs.validate_records(obs.read_records(path),
+                                    require_resilience=True)
+
+
+# ---------------------------------------------------------------------------
+# Schema + validator obligation
+# ---------------------------------------------------------------------------
+
+def test_resilience_record_schema_rejections():
+    base = {"v": 1, "ts": 1.0, "type": "resilience"}
+    ok = [dict(base, site="s", event="retry", attempt=0, delay_s=0.1),
+          dict(base, site="s", event="resume", attrs={"stage": "b2t"})]
+    assert not obs.validate_records(ok)
+    bad = [
+        dict(base, event="retry", attempt=0, delay_s=0.1),    # no site
+        dict(base, site="s", event="explode"),                # bad event
+        dict(base, site="s", event="retry", delay_s=0.1),     # no attempt
+        dict(base, site="s", event="retry", attempt=0),       # no delay
+        dict(base, site="s", event="retry", attempt=0,
+             delay_s=float("nan")),                           # nan delay
+        dict(base, site="s", event="shed", attrs="notdict"),  # bad attrs
+    ]
+    for rec in bad:
+        assert obs.validate_records([rec]), rec
+
+
+def test_require_resilience_obligation_legs():
+    base = {"v": 1, "ts": 1.0}
+    retry = dict(base, type="resilience", site="s", event="retry",
+                 attempt=0, delay_s=0.0)
+    # no proof at all
+    errors = obs.validate_records([], require_resilience=True)
+    assert any("no resilience retry/resume" in e for e in errors)
+    # retry proof satisfies
+    assert not obs.validate_records([retry], require_resilience=True)
+    # a breaker left open in the LAST snapshot rejects
+    def snap(value):
+        return dict(base, type="metrics", metrics=[
+            {"name": "dlaf_circuit_state", "kind": "gauge",
+             "labels": {"site": "serve.x"}, "value": value}])
+    errors = obs.validate_records([retry, snap(2.0)],
+                                  require_resilience=True)
+    assert any("left open" in e for e in errors)
+    # ...but a LATER snapshot showing recovery passes (last state wins)
+    assert not obs.validate_records([retry, snap(2.0), snap(0.0)],
+                                    require_resilience=True)
+
+
+def test_validator_cli_require_resilience_flag(tmp_path):
+    from dlaf_tpu.obs import validate as vcli
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps({"v": 1, "ts": 1.0, "type": "resilience",
+                                "site": "s", "event": "retry",
+                                "attempt": 0, "delay_s": 0.0}) + "\n")
+    assert vcli.main([str(good), "--require-resilience"]) == 0
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"v": 1, "ts": 1.0, "type": "log",
+                                 "level": "info", "logger": "x",
+                                 "msg": "hi"}) + "\n")
+    assert vcli.main([str(empty), "--require-resilience"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# profile_summary serve section
+# ---------------------------------------------------------------------------
+
+def test_profile_summary_prints_serve_section(tmp_path, capsys):
+    import sys
+
+    path = _metrics_on(tmp_path)
+    q = Queue(ProgramService(), batch=64, deadline_s=1e9, buckets=(16,),
+              clock=FakeClock(), max_depth=2, shed=True)
+    q.submit(Request(op="cholesky", a=_hpd(8, 0)))
+    q.submit(Request(op="cholesky", a=_hpd(8, 1)))
+    with pytest.raises(OverloadError):
+        q.submit(Request(op="cholesky", a=_hpd(8, 2)))
+    q.flush()
+    obs.flush()
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import profile_summary
+
+    profile_summary.summarize_jsonl(path, 25)
+    out = capsys.readouterr().out
+    assert "serve / resilience" in out
+    assert "dlaf_serve_shed_total" in out
+    assert "resilience events" in out and "shed=1" in out

@@ -49,7 +49,8 @@ def serve_reset():
     obs._reset_for_tests()
     obs.telemetry._reset_for_tests()
     serve_programs._reset_for_tests()
-    C.finalize()
+    health.circuit.reset()            # a tripped dispatch breaker must
+    C.finalize()                      # not fail-fast later tests' buckets
     C.initialize()
 
 
