@@ -264,10 +264,20 @@ EOF
     # — the artifact must carry >= 1 batched dispatch, all-hit cache
     # (post-warmup contract), finite per-request latency, per-request
     # accuracy records, and zero post-warmup retraces (--require-serve)
+    # ISSUE 13 additions to the same run: the live exporter is scraped
+    # MID-STREAM (/metrics parses, counters monotone across two scrapes,
+    # exemplar trace IDs live; /healthz parses and must agree with the
+    # artifact's dispatch records), the flight recorder is ARMED and the
+    # clean stream must produce NO flight artifact, and one request's
+    # trace ID is saved for the aggregate --trace waterfall check below
     SERVE_DIR=$(mktemp -d)
     SERVE_ART="$SERVE_DIR/serve_metrics.jsonl"
+    SERVE_PORT=${DLAF_CI_METRICS_PORT:-$((18000 + RANDOM % 2000))}
     DLAF_METRICS_PATH="$SERVE_ART" DLAF_PROGRAM_TELEMETRY=1 \
       DLAF_ACCURACY=1 DLAF_SERVE_BUCKETS=32,64 DLAF_SERVE_BATCH=4 \
+      DLAF_METRICS_PORT="$SERVE_PORT" DLAF_FLIGHT_RECORDER=64 \
+      SERVE_TRACE_OUT="$SERVE_DIR/trace_id.txt" \
+      SERVE_HEALTHZ_OUT="$SERVE_DIR/healthz.json" \
       python - <<'EOF'
 import numpy as np
 import dlaf_tpu.config as C
@@ -296,7 +306,34 @@ for _ in range(4):
     reqs.append(Request(op="eigh", a=(x + x.T) / 2))
 q = Queue()
 q.warmup(reqs)
-tickets = [q.submit(r) for r in reqs]
+import json as _json
+import os
+import urllib.request
+
+port = int(os.environ["DLAF_METRICS_PORT"])
+
+
+def scrape(route, accept=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{route}")
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read().decode()
+
+
+def counters(text):
+    out = {}
+    for ln in text.splitlines():
+        name, _, val = ln.rpartition(" ")
+        if name and ("_total" in name or "_count" in name) \
+                and not name.startswith("#"):
+            out[name] = float(val)
+    return out
+
+
+tickets = [q.submit(r) for r in reqs[:8]]
+m1 = scrape("/metrics")            # MID-stream scrape (live process)
+tickets += [q.submit(r) for r in reqs[8:]]
 q.flush()
 assert all(t.done for t in tickets)
 for t in tickets:
@@ -318,9 +355,152 @@ assert st["misses"] == 0 and st["hit_rate"] == 1.0, st
 print(f"serve smoke ok: {q.requests} requests over {q.dispatches} "
       f"dispatches, {st['warmups']} warmed programs, hit rate "
       f"{st['hit_rate']:.2f}")
+# live scrape checks (ISSUE 13): both scrapes parse, counters monotone,
+# the classic rendering stays exemplar-free (the 0.0.4 grammar has no
+# exemplar clause), the OpenMetrics rendering carries exemplar trace
+# IDs + the # EOF terminator, healthz saved for the artifact-agreement
+# check in the driver
+m2 = scrape("/metrics")
+c1, c2 = counters(m1), counters(m2)
+assert c1 and set(c1) <= set(c2), "second scrape lost counter series"
+assert all(c2[k] >= v for k, v in c1.items()), \
+    "counters not monotone across scrapes"
+assert " # {" not in m2, "classic /metrics leaked an exemplar clause"
+om = scrape("/metrics", accept="application/openmetrics-text;"
+            "version=1.0.0,text/plain;version=0.0.4")
+assert " # {trace_id=" in om, "no exemplar trace IDs on OpenMetrics scrape"
+assert om.endswith("# EOF\n"), "OpenMetrics scrape lacks the terminator"
+hz = _json.loads(scrape("/healthz"))
+assert hz["status"] == "ok" and hz["queues"], hz
+with open(os.environ["SERVE_HEALTHZ_OUT"], "w") as f:
+    f.write(_json.dumps(hz))
 obs.flush()
+# end-to-end trace join (ISSUE 13 acceptance): ONE trace_id on the
+# request's serve record, its dispatch (membership), its span records,
+# and its accuracy record
+from dlaf_tpu.obs.context import trace_matches
+
+recs = obs.read_records(os.environ["DLAF_METRICS_PATH"])
+tid = tickets[0].trace_id
+mine = [r for r in recs if trace_matches(r, tid)]
+types = {r["type"] for r in mine}
+assert {"serve", "span", "accuracy"} <= types, types
+events = {r.get("event") for r in mine if r["type"] == "serve"}
+assert events == {"request", "dispatch"}, events
+with open(os.environ["SERVE_TRACE_OUT"], "w") as f:
+    f.write(tid)
+print("live scrape ok: counters monotone, exemplars live, trace "
+      f"{tid} joins {len(mine)} records")
 EOF
     python -m dlaf_tpu.obs.validate "$SERVE_ART" --require-serve
+    # must-NOT-trip leg: a clean stream with the recorder armed writes
+    # no incident artifact — its existence IS the incident signal
+    if [ -e "$SERVE_ART.flight.jsonl" ]; then
+      echo "clean serve run produced a flight artifact" >&2; exit 1
+    fi
+    echo "clean serve run produced no flight artifact (must-not-trip ok)"
+    echo "== smoke: trace waterfall (obs.aggregate --trace) =="
+    SERVE_TRACE_ID=$(cat "$SERVE_DIR/trace_id.txt")
+    python -m dlaf_tpu.obs.aggregate "$SERVE_ART" \
+        --trace "$SERVE_TRACE_ID" > "$SERVE_DIR/trace_report.txt"
+    for stage in "queue wait" compose program fetch unpad; do
+      if ! grep -q "$stage" "$SERVE_DIR/trace_report.txt"; then
+        echo "aggregate --trace waterfall missing stage '$stage'" >&2
+        cat "$SERVE_DIR/trace_report.txt" >&2; exit 1
+      fi
+    done
+    python -m dlaf_tpu.obs.aggregate "$SERVE_ART" --top-slow 3 \
+        > "$SERVE_DIR/top_slow.txt"
+    grep -q "slowest requests" "$SERVE_DIR/top_slow.txt"
+    echo "aggregate --trace waterfall + --top-slow ok"
+    # the mid-stream /healthz must agree with the artifact: queue
+    # drained, dispatch count == the artifact's dispatch records,
+    # breaker states are the documented names
+    python - "$SERVE_ART" "$SERVE_DIR/healthz.json" <<'EOF'
+import json
+import sys
+
+art, hz_path = sys.argv[1], sys.argv[2]
+hz = json.load(open(hz_path))
+recs = [json.loads(ln) for ln in open(art)]
+disp = [r for r in recs if r.get("type") == "serve"
+        and r.get("event") == "dispatch"]
+q = hz["queues"][0]
+assert q["pending"] == 0, q
+assert q["dispatches"] == len(disp), (q["dispatches"], len(disp))
+assert q["buckets"], "healthz carries no per-bucket table"
+for site, b in q["buckets"].items():
+    assert b["breaker"] in (None, "closed", "half_open", "open"), (site, b)
+print(f"healthz/artifact agreement ok: {q['dispatches']} dispatches == "
+      f"{len(disp)} artifact dispatch records, depth 0")
+EOF
+    echo "== smoke: flight-recorder must-trip drill (ISSUE 13) =="
+    # leg A: a TRANSIENT fault retries and recovers — the retry record
+    # must carry the members' trace IDs (the resilience leg of the
+    # trace-join acceptance) and must NOT trip the recorder. leg B:
+    # SUSTAINED fail_dispatch opens the bucket breaker — the flight
+    # artifact must exist, hold the pre-trigger dispatch records, and
+    # pass --require-flight
+    FLIGHT_ART="$SERVE_DIR/flight_drill.jsonl"
+    DLAF_METRICS_PATH="$FLIGHT_ART" DLAF_FLIGHT_RECORDER=64 \
+      DLAF_CIRCUIT_THRESHOLD=2 DLAF_SERVE_RETRY_ATTEMPTS=2 \
+      DLAF_SERVE_RETRY_BACKOFF_MS=0 python - <<'EOF'
+import os
+
+import numpy as np
+
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.health import inject
+from dlaf_tpu.obs.context import trace_matches
+from dlaf_tpu.serve import Queue, Request
+
+C.initialize()
+rng = np.random.default_rng(3)
+
+
+def hpd(n):
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+q = Queue(buckets=(32,), batch=2, deadline_s=1e9)
+q.warmup([Request(op="cholesky", a=hpd(24))])
+with inject.fail_dispatch(count=1):
+    tickets = [q.submit(Request(op="cholesky", a=hpd(24)))
+               for _ in range(2)]
+for t in tickets:
+    t.result()                     # the retry recovered the batch
+obs.flush()
+recs = obs.read_records(os.environ["DLAF_METRICS_PATH"])
+tid = tickets[0].trace_id
+mine = [r for r in recs if trace_matches(r, tid)]
+assert any(r.get("type") == "resilience" and r.get("event") == "retry"
+           for r in mine), "retry record missing the batch trace stamp"
+flight_path = os.environ["DLAF_METRICS_PATH"] + ".flight.jsonl"
+assert not os.path.exists(flight_path), \
+    "a recovered transient fault must not trip the flight recorder"
+with inject.fail_dispatch(count=100):
+    for i in range(3):
+        try:
+            q.submit(Request(op="cholesky", a=hpd(24)))
+        except Exception:
+            pass
+assert os.path.exists(flight_path), \
+    "breaker open did not trip the flight recorder"
+print("flight drill ok: retry carries the trace, breaker-open dump "
+      "landed")
+obs.flush()
+EOF
+    if ! grep -q '"reason": "breaker_open"' "$FLIGHT_ART.flight.jsonl"; then
+      echo "flight dump header does not name breaker_open" >&2; exit 1
+    fi
+    if ! grep -q '"type": "serve"' "$FLIGHT_ART.flight.jsonl"; then
+      echo "flight dump holds no pre-trigger dispatch records" >&2; exit 1
+    fi
+    python -m dlaf_tpu.obs.validate "$FLIGHT_ART.flight.jsonl" \
+        --require-flight
+    echo "flight must-trip drill passed (--require-flight)"
     echo "== smoke: serve evict/miss must-trip drill =="
     # an evicted bucket hit by the next in-bucket request, and an
     # out-of-bucket shape, must BOTH recompile and bump the miss

@@ -508,6 +508,42 @@ class Configuration:
     #: ``serve.ProgramService.pin`` exempts a program from eviction.
     #: 0 (default) = unbounded.
     serve_cache_bytes: int = 0
+    #: Live metrics/health endpoint port (``DLAF_METRICS_PORT``, ISSUE 13,
+    #: docs/observability.md live operations): when > 0, dlaf_tpu.obs
+    #: starts a stdlib-http daemon thread on 127.0.0.1 serving ``GET
+    #: /metrics`` (Prometheus text exposition of the LIVE registry, with
+    #: exemplar trace IDs on latency histogram buckets) and ``GET
+    #: /healthz`` (JSON: serve-queue depth/shed/breaker states, worst
+    #: live accuracy bound_ratio, rank/pid/uptime). Arming the port also
+    #: turns the metrics registry on even without DLAF_METRICS_PATH
+    #: (scrape-only deployments). 0 (default): zero threads, zero
+    #: sockets.
+    metrics_port: int = 0
+    #: Rolling SLO latency objective, milliseconds (``DLAF_SLO_P99_MS``):
+    #: every latency recorded through obs.observe_latency (the serve
+    #: queue per request; health.policy.with_policy per successful call)
+    #: that exceeds this objective increments the
+    #: ``dlaf_slo_breach_total{op}`` burn counter. 0 (default) = no
+    #: objective, nothing counted. The windowed
+    #: ``dlaf_serve_latency_window{op,bucket,q}`` percentile gauges are
+    #: maintained regardless.
+    slo_p99_ms: float = 0.0
+    #: Rolling SLO window length, seconds (``DLAF_SLO_WINDOW_S``): the
+    #: span of the sliding-window quantile estimator behind the
+    #: ``dlaf_serve_latency_window`` gauges — a ring of fixed-size epoch
+    #: buckets (bounded memory, deterministic under an injected clock;
+    #: dlaf_tpu.obs.metrics.SlidingWindow).
+    slo_window_s: float = 60.0
+    #: Flight-recorder ring depth (``DLAF_FLIGHT_RECORDER``): keep the
+    #: last N JSONL records in memory (all types, pre-serialization) and
+    #: dump them atomically to ``<metrics_path>.flight.jsonl`` on
+    #: incident triggers — breaker open, overload shed, recovery
+    #: exhaustion, accuracy budget breach, /healthz failure
+    #: (dlaf_tpu.obs.flight; validated by ``python -m dlaf_tpu.obs.
+    #: validate --require-flight``). Requires DLAF_METRICS_PATH (the ring
+    #: captures the sink's record stream). 0 (default) = off; a clean
+    #: run must produce NO flight artifact.
+    flight_recorder: int = 0
     #: Program telemetry (``DLAF_PROGRAM_TELEMETRY``): the algorithm entry
     #: points and the library's cached-program sites record per-site
     #: compile walls (``dlaf_compile_seconds{site}``), trace counts
@@ -626,6 +662,18 @@ def _validate(cfg: Configuration) -> None:
     if not cfg.serve_retry_backoff_ms >= 0:
         raise ValueError(f"serve_retry_backoff_ms="
                          f"{cfg.serve_retry_backoff_ms}: must be >= 0")
+    if not 0 <= cfg.metrics_port <= 65535:
+        raise ValueError(f"metrics_port={cfg.metrics_port}: must be in "
+                         "[0, 65535] (0 = live exporter off)")
+    if not cfg.slo_p99_ms >= 0:
+        raise ValueError(f"slo_p99_ms={cfg.slo_p99_ms}: must be >= 0 "
+                         "(0 = no latency objective)")
+    if not cfg.slo_window_s > 0:
+        raise ValueError(f"slo_window_s={cfg.slo_window_s}: must be > 0 "
+                         "(the rolling quantile window length)")
+    if cfg.flight_recorder < 0:
+        raise ValueError(f"flight_recorder={cfg.flight_recorder}: must be "
+                         ">= 0 (0 = flight recorder off; N = ring depth)")
     if cfg.circuit_threshold < 1:
         raise ValueError(f"circuit_threshold={cfg.circuit_threshold}: must "
                          "be >= 1 (consecutive failures before opening)")
@@ -721,7 +769,9 @@ def initialize(user: Optional[Configuration] = None,
 
     obs.configure(log_level=cfg.log, metrics_path=cfg.metrics_path,
                   trace_dir=cfg.trace_dir or cfg.profile_dir,
-                  program_telemetry=cfg.program_telemetry)
+                  program_telemetry=cfg.program_telemetry,
+                  metrics_port=cfg.metrics_port,
+                  flight_recorder=cfg.flight_recorder)
     if cfg.print_config:
         print(cfg)
     _active = cfg

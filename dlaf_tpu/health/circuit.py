@@ -90,7 +90,13 @@ class CircuitBreaker:
             return self._state
 
     def _set(self, state: str) -> None:
-        """Transition (lock held): gauge + resilience record."""
+        """Transition (lock held): gauge + resilience record. The
+        flight-recorder dump a transition TO open owes
+        (docs/observability.md trigger catalog) is fired by the caller
+        AFTER the lock is released — the dump is file I/O (write +
+        fsync + replace), and holding the breaker lock through it would
+        stall every dispatch thread and the /healthz scrape at exactly
+        the moment of an incident storm."""
         if state == self._state:
             return
         self._state = state
@@ -132,17 +138,29 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         """A call failed: a half-open probe failure re-opens (cooldown
         restarts); the threshold-th consecutive closed-state failure
-        opens."""
+        opens. An opening trips the flight recorder (reason
+        ``breaker_open``) — AFTER the lock is released (see
+        :meth:`_set`) and after the transition record landed in the
+        ring, so the dump includes the opening itself."""
+        opened = False
         with self._lock:
             self._consecutive += 1
             if self._state == "half_open":
                 self._probe_live = False
                 self._opened_at = self.clock()
                 self._set("open")
+                opened = True
             elif self._state == "closed" \
                     and self._consecutive >= self.threshold:
                 self._opened_at = self.clock()
                 self._set("open")
+                opened = True
+            consecutive = self._consecutive
+        if opened:
+            from ..obs import flight
+
+            flight.trigger("breaker_open", site=self.site,
+                           consecutive=consecutive)
 
     def reset(self) -> None:
         """Force-close (tests / injection reset-safety)."""
@@ -182,6 +200,15 @@ def peek(site: str) -> Optional[str]:
     with _REG_LOCK:
         br = _BREAKERS.get(site)
     return br.state() if br is not None else None
+
+
+def states() -> dict:
+    """``{site: state_name}`` for every registered breaker — the live
+    ``/healthz`` endpoint's breaker table (dlaf_tpu/obs/exporter.py),
+    sorted by site so the JSON is deterministic."""
+    with _REG_LOCK:
+        live = sorted(_BREAKERS.items())
+    return {site: br.state() for site, br in live}
 
 
 def reset(prefix: Optional[str] = None) -> int:

@@ -265,6 +265,11 @@ def with_policy(site: str, fn: Callable, *args,
                                         attempt=a.index)
         if breaker is not None:
             breaker.record_success()
+        # rolling-window SLO tracking (ISSUE 13): every policy-guarded
+        # success feeds the same windowed-percentile machinery the serve
+        # queue uses, with op = the policy site — observe_latency no-ops
+        # when metrics are off
+        obs.observe_latency(site, elapsed)
         return result
     assert last is not None  # attempts() only exhausts on marked failures
     raise last

@@ -173,6 +173,11 @@ def robust_cholesky(uplo: str, mat, *, max_attempts: int = 4,
                 f"cholesky info={info} (first failing global column) at "
                 f"attempt {attempt}; retrying with diagonal shift "
                 f"{alpha:.3e}", n=n, uplo=uplo, attempt=attempt)
+    # exhaustion is an incident: capture the flight ring (the attempts'
+    # retry records are already in it) before raising
+    from ..obs import flight
+    flight.trigger("factorization_exhausted", algo="cholesky",
+                   attempts=max_attempts, failing_column=int(infos[-1]))
     raise FactorizationError(failing_column=infos[-1],
                              attempts=max_attempts,
                              shifts=tuple(shifts), infos=tuple(infos))
@@ -308,6 +313,10 @@ def robust_cholesky_batched(uplo: str, a, *, nb: Optional[int] = None,
                 f"subset with diagonal shift {alpha:.3e}", n=n, uplo=uplo,
                 attempt=attempt, lanes=len(failed))
     bad = [int(full_info[i]) for i in failed]
+    from ..obs import flight
+    flight.trigger("factorization_exhausted", algo="cholesky_batched",
+                   attempts=max_attempts, failing_column=bad[0],
+                   lanes=len(bad))
     raise FactorizationError(failing_column=bad[0], attempts=max_attempts,
                              shifts=tuple(shifts), infos=tuple(bad))
 

@@ -32,15 +32,22 @@ import time
 from typing import Optional
 
 from . import accuracy as accuracy
+from . import exporter as exporter
+from . import flight as _flight
 from . import logging as _logging
 from . import metrics as _metrics
 from . import sinks as _sinks
+from . import slo as _slo
 from . import telemetry as telemetry
 from . import trace as _trace
 from ._state import LOG_LEVELS, STATE, current_rank
+from .context import (current_trace, new_span_id, new_trace_id,
+                      single_trace_id, trace_context, trace_matches)
+from .flight import FlightRecorder
 from .logging import Logger, get_logger
-from .metrics import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, Counter,
-                      Gauge, Histogram, Registry, prometheus_text)
+from .metrics import (NOOP_COUNTER, NOOP_GAUGE, NOOP_HISTOGRAM, NOOP_WINDOW,
+                      Counter, Gauge, Histogram, Registry, SlidingWindow,
+                      prometheus_text, quantile)
 from .sinks import (SCHEMA_VERSION, JsonlSink,
                     accuracy_record_to_history_line, append_history_line,
                     expand_rank_template, read_history_records, read_records,
@@ -57,15 +64,21 @@ __all__ = [
     "validate_records", "read_records", "Span", "Counter", "Gauge",
     "Histogram", "Registry", "Logger", "JsonlSink", "SCHEMA_VERSION",
     "NOOP_SPAN", "NOOP_CTX", "NOOP_COUNTER", "NOOP_GAUGE", "NOOP_HISTOGRAM",
+    "NOOP_WINDOW",
     "LOG_LEVELS", "start_profiler", "stop_profiler", "telemetry",
     "set_rank", "current_rank", "expand_rank_template",
     "append_history_line", "read_history_records", "validate_history_records",
     "accuracy", "accuracy_record_to_history_line",
+    # ISSUE 13: live operational telemetry
+    "trace_context", "current_trace", "new_trace_id", "new_span_id",
+    "single_trace_id", "trace_matches", "observe_latency", "quantile",
+    "SlidingWindow", "FlightRecorder", "exporter",
 ]
 
 
 def configure(log_level: str = "info", metrics_path: str = "",
-              trace_dir: str = "", program_telemetry: bool = False) -> None:
+              trace_dir: str = "", program_telemetry: bool = False,
+              metrics_port: int = 0, flight_recorder: int = 0) -> None:
     """(Re)configure the layer — called by ``config.initialize()`` with the
     resolved knobs, or lazily from the env by the first logging call in a
     process that never initializes the runtime.
@@ -85,6 +98,17 @@ def configure(log_level: str = "info", metrics_path: str = "",
     walls, retrace counters, and HBM gauges from the library's cached
     program sites. Off (default), every telemetry call site is a
     zero-cost passthrough.
+
+    ``metrics_port`` (``DLAF_METRICS_PORT``, ISSUE 13) starts the live
+    ``/metrics`` + ``/healthz`` exporter (:mod:`dlaf_tpu.obs.exporter`)
+    as a daemon thread on 127.0.0.1 — AND turns the registry on even
+    without a sink, so a scrape-only deployment records. 0 (default):
+    no thread, no socket. ``flight_recorder``
+    (``DLAF_FLIGHT_RECORDER``) arms a bounded in-memory ring of the
+    last N sink records, dumped atomically to
+    ``<metrics_path>.flight.jsonl`` on incident triggers
+    (:mod:`dlaf_tpu.obs.flight`); it needs a sink (the ring captures
+    the sink's record stream) and warns once when armed without one.
     """
     level = str(log_level or "info").strip().lower()
     if level not in LOG_LEVELS:
@@ -100,12 +124,39 @@ def configure(log_level: str = "info", metrics_path: str = "",
     if metrics_path and STATE.sink is None:
         STATE.sink = _sinks.JsonlSink(metrics_path)
     STATE.trace_dir = trace_dir or ""
-    STATE.metrics_on = STATE.sink is not None
+    port = int(metrics_port or 0)
+    if port < 0:
+        raise ValueError(f"DLAF_METRICS_PORT={metrics_port!r}: must be "
+                         ">= 0 (0 = exporter off)")
+    STATE.metrics_on = STATE.sink is not None or port > 0
     STATE.annotate = bool(trace_dir)
     STATE.telemetry_on = bool(program_telemetry)
     if STATE.registry is None and (STATE.metrics_on or STATE.annotate
                                    or STATE.telemetry_on):
         STATE.registry = _metrics.Registry()
+    # live exporter lifecycle: restart on a port change, stop on 0
+    if port != STATE.exporter_port:
+        exporter.stop()
+        STATE.exporter_port = 0
+        if port > 0:
+            exporter.start(port)
+            STATE.exporter_port = port
+    # flight recorder: a ring of the knob's size over the sink stream
+    cap = int(flight_recorder or 0)
+    if cap < 0:
+        raise ValueError(f"DLAF_FLIGHT_RECORDER={flight_recorder!r}: must "
+                         "be >= 0 (0 = recorder off; N = ring depth)")
+    if cap > 0 and STATE.sink is not None:
+        if STATE.flight is None or STATE.flight.capacity != cap:
+            STATE.flight = _flight.FlightRecorder(cap)
+    else:
+        if cap > 0:
+            get_logger("obs").warning_once(
+                ("flight_no_sink",),
+                "DLAF_FLIGHT_RECORDER is set but DLAF_METRICS_PATH is "
+                "not: the flight ring captures the sink's record stream, "
+                "so the recorder stays unarmed")
+        STATE.flight = None
     if (STATE.metrics_on or STATE.annotate or STATE.telemetry_on) \
             and not STATE.atexit_registered:
         STATE.atexit_registered = True
@@ -123,12 +174,15 @@ def set_rank(rank: int) -> None:
 
 
 def _shutdown() -> None:
-    """Process exit: flush a final metrics snapshot and stop the profiler
-    so artifacts are complete even when drivers forget to call flush()."""
+    """Process exit: flush a final metrics snapshot, stop the profiler,
+    and shut the live exporter down so artifacts are complete even when
+    drivers forget to call flush()."""
     try:
         emit_metrics_snapshot()
     finally:
         _trace.stop_profiler()
+        exporter.stop()
+        STATE.exporter_port = 0
         if STATE.sink is not None:
             STATE.sink.close()
 
@@ -195,10 +249,29 @@ def flush() -> None:
 
 
 def prometheus_snapshot_text() -> str:
-    """Prometheus text exposition of the live registry."""
-    if STATE.registry is None:
+    """Prometheus text exposition of the live registry — and the
+    documented zero-allocation no-op ("") when :func:`metrics_active` is
+    false, matching the discipline of every other obs entry point: with
+    metrics off there is nothing worth snapshotting (a registry may
+    still exist from an annotate/telemetry-only configuration, but its
+    exposition is not a metrics product). Pinned by
+    tests/test_live_telemetry.py (ISSUE 13 satellite)."""
+    if not STATE.metrics_on or STATE.registry is None:
         return ""
     return prometheus_text(STATE.registry.snapshot())
+
+
+def observe_latency(op: str, seconds: float, bucket: str = "") -> None:
+    """Feed one end-to-end latency into the rolling-window SLO tracker
+    (:mod:`dlaf_tpu.obs.slo`): the ``dlaf_serve_latency_seconds{op,
+    bucket}`` histogram (+ exemplar trace ID when called under a
+    request-scoped :func:`trace_context`), the
+    ``dlaf_serve_latency_window{op,bucket,q}`` gauges, and the
+    ``dlaf_slo_breach_total{op}`` burn counter against
+    ``DLAF_SLO_P99_MS``. No-op when metrics are off."""
+    if not STATE.metrics_on:
+        return
+    _slo.observe(str(op), float(seconds), bucket=str(bucket))
 
 
 def _reset_for_tests() -> None:
@@ -211,6 +284,7 @@ def _reset_for_tests() -> None:
         pass
     if STATE.sink is not None:
         STATE.sink.close()
+    exporter.stop()
     STATE.sink = None
     STATE.metrics_on = False
     STATE.annotate = False
@@ -221,5 +295,8 @@ def _reset_for_tests() -> None:
     STATE.log_level_num = LOG_LEVELS["info"]
     STATE.telemetry_on = False
     STATE.rank = None
+    STATE.flight = None
+    STATE.exporter_port = 0
+    _slo.set_clock(None)
     telemetry._reset_for_tests()
     _logging.reset_once()

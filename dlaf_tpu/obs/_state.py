@@ -20,7 +20,7 @@ class _ObsState:
     __slots__ = ("configured", "log_level", "log_level_num", "metrics_on",
                  "annotate", "trace_dir", "sink", "registry",
                  "profiler_started", "atexit_registered", "telemetry_on",
-                 "rank")
+                 "rank", "flight", "exporter_port")
 
     def __init__(self):
         self.configured = False
@@ -35,6 +35,8 @@ class _ObsState:
         self.atexit_registered = False
         self.telemetry_on = False        # DLAF_PROGRAM_TELEMETRY knob
         self.rank = None                 # type: Optional[int]  # process rank
+        self.flight = None               # type: Optional[object]  # recorder
+        self.exporter_port = 0           # DLAF_METRICS_PORT in effect (0=off)
 
 
 STATE = _ObsState()
@@ -61,12 +63,33 @@ def ensure_env_defaults() -> None:
               f"{tuple(LOG_LEVELS)}; using 'info'", file=sys.stderr,
               flush=True)
         level = "info"
+    def _int_env(name):
+        raw = os.environ.get(name, "").strip()
+        try:
+            val = int(raw) if raw else 0
+        except ValueError:
+            val = -1
+        if val < 0:
+            import sys
+
+            # same stance as the DLAF_LOG fallback above: a malformed
+            # (or negative — configure() rejects those too) env var on
+            # this lazy path warns instead of crashing a bare log call;
+            # config.initialize() still rejects it loudly
+            print(f"dlaf_tpu[warning] obs: {name}={raw!r} is not a "
+                  "non-negative int; using 0 (off)", file=sys.stderr,
+                  flush=True)
+            return 0
+        return val
+
     configure(log_level=level,
               metrics_path=os.environ.get("DLAF_METRICS_PATH", ""),
               trace_dir=os.environ.get("DLAF_TRACE_DIR", ""),
               program_telemetry=os.environ.get(
                   "DLAF_PROGRAM_TELEMETRY", "").strip().lower()
-              in ("1", "true", "yes", "on"))
+              in ("1", "true", "yes", "on"),
+              metrics_port=_int_env("DLAF_METRICS_PORT"),
+              flight_recorder=_int_env("DLAF_FLIGHT_RECORDER"))
 
 
 def current_rank():

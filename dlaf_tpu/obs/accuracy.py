@@ -762,6 +762,7 @@ def emit(site: str, metric: str, value, *, n: int, nb: int, dtype,
         rec["eps_eff"] = eps
     if record:
         from . import counter, emit_event, gauge, metrics_active
+        from . import flight as _flight
 
         emit_event("accuracy", **rec)
         if metrics_active():
@@ -771,6 +772,15 @@ def emit(site: str, metric: str, value, *, n: int, nb: int, dtype,
             if not finite:
                 counter("dlaf_accuracy_nonfinite_total", site=site,
                         metric=metric).inc()
+        if (ratio is not None and ratio > 1.0) or not finite:
+            # a blown analytic budget (or a corrupted estimate — worse)
+            # IS an incident: capture the flight ring AFTER this record
+            # landed in it, so the dump includes the breaching record
+            # itself (docs/observability.md trigger catalog)
+            _flight.trigger("accuracy_breach", site=site, metric=metric,
+                            bound_ratio=(float(ratio)
+                                         if ratio is not None else None),
+                            nonfinite=not finite)
     return AccuracyResult(site=site, metric=metric, value=v, finite=finite,
                           tol=tol, bound_ratio=ratio, eps_eff=eps,
                           eps_label=label)

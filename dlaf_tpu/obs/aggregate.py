@@ -1,7 +1,8 @@
 """Merge per-rank observability artifacts into one timeline.
 
     python -m dlaf_tpu.obs.aggregate rank0.jsonl rank1.jsonl ... \\
-        [-o merged.jsonl] [--chrome trace.json] [--top N] [--align]
+        [-o merged.jsonl] [--chrome trace.json] [--top N] [--align] \\
+        [--trace <id>] [--top-slow N]
 
 Multi-host runs write one ``DLAF_METRICS_PATH`` artifact per rank (the
 ``%r`` template — docs/observability.md); this tool merges them and
@@ -38,6 +39,17 @@ the >10 ms spans these artifacts carry; an unsynchronized pod is not).
 before analysis/export — inter-host offset drops out, at the cost of
 losing true cross-rank start ordering (the ``-o`` merged artifact always
 keeps the raw timestamps).
+
+``--trace <id>`` joins ONE request's whole causal chain (ISSUE 13): its
+``serve`` request record, the dispatch that served it (via the shared
+``span_id``), and every other record stamped with the trace ID —
+rendered as the per-request waterfall (queue wait → dispatch compose →
+program → fetch → unpad) plus the trace's record inventory.
+``--top-slow N`` lists the N worst end-to-end requests with their trace
+IDs, the triage entry point into ``--trace``. Both report-only modes
+suppress the merge tables. ``scripts/profile_summary.py`` shares the
+request-join code here too (:func:`request_rows`,
+:func:`format_request_table`) — single owner, not a fork.
 
 ``scripts/profile_summary.py`` shares the skew-table code here (not a
 fork) for its JSONL mode.
@@ -231,6 +243,116 @@ def format_accuracy_table(rows, top_n: int = 25) -> list:
     return lines
 
 
+#: Waterfall stage order: queue wait from the request record, then the
+#: dispatch record's ``stages`` object (serve/queue.py emits them).
+WATERFALL_STAGES = (("queue wait", None), ("compose", "compose_s"),
+                    ("program", "program_s"), ("fetch", "fetch_s"),
+                    ("unpad", "unpad_s"))
+
+
+def request_rows(records) -> list:
+    """Per-request rows joined across the trace convention (ISSUE 13):
+    each ``serve`` request record, with the stage timings of the
+    dispatch record sharing its ``span_id``. Sorted worst end-to-end
+    latency first — the ``--top-slow`` order."""
+    dispatches = {}
+    for r in records:
+        if r.get("type") == "serve" and r.get("event") == "dispatch" \
+                and isinstance(r.get("span_id"), str):
+            dispatches[r["span_id"]] = r
+    rows = []
+    for r in records:
+        if r.get("type") != "serve" or r.get("event") != "request":
+            continue
+        d = dispatches.get(r.get("span_id"))
+        rows.append({
+            "trace_id": r.get("trace_id"),
+            "span_id": r.get("span_id"),
+            "rank": r.get("rank", 0),
+            "op": r.get("op", "?"),
+            "n": r.get("n"),
+            "bucket_n": r.get("bucket_n"),
+            "dtype": r.get("dtype", "?"),
+            "queue_s": r.get("queue_s", 0.0) or 0.0,
+            "total_s": r.get("total_s", 0.0) or 0.0,
+            "stages": (d or {}).get("stages"),
+            "dispatch_s": (d or {}).get("dispatch_s"),
+            "lanes": (d or {}).get("lanes"),
+        })
+    rows.sort(key=lambda row: -row["total_s"])
+    return rows
+
+
+def _stage_values(row) -> list:
+    """``[(label, seconds)]`` for one request row's waterfall."""
+    out = [("queue wait", row["queue_s"])]
+    for label, key in WATERFALL_STAGES[1:]:
+        v = (row.get("stages") or {}).get(key)
+        if isinstance(v, (int, float)):
+            out.append((label, float(v)))
+    return out
+
+
+def format_request_table(rows, top_n: int = 5) -> list:
+    """Printable lines for the slowest-requests table (shared with
+    ``scripts/profile_summary.py`` — single owner, not a fork): one line
+    per request, total + stage breakdown + trace ID."""
+    lines = []
+    for row in rows[:top_n]:
+        stages = " | ".join(f"{label} {v * 1e3:.2f}"
+                            for label, v in _stage_values(row))
+        tid = row["trace_id"] if isinstance(row["trace_id"], str) \
+            else "-"
+        lines.append(f"{row['total_s'] * 1e3:10.2f} ms  {row['op']:<9s}"
+                     f" n={row['n']}/{row['bucket_n']}  ({stages})"
+                     f"  trace {tid}")
+    return lines
+
+
+def format_waterfall(row, width: int = 40) -> list:
+    """The per-request waterfall: one bar-chart line per stage, scaled
+    to the request's end-to-end wall."""
+    total = max(row["total_s"], 1e-12)
+    lines = [f"request: op={row['op']} n={row['n']} "
+             f"bucket={row['bucket_n']} dtype={row['dtype']} "
+             f"rank={row['rank']} lanes={row.get('lanes')}  "
+             f"total {row['total_s'] * 1e3:.2f} ms"]
+    for label, v in _stage_values(row):
+        bar = "#" * max(int(round(width * v / total)), 1 if v > 0 else 0)
+        lines.append(f"  {label:<12s} {v * 1e3:10.3f} ms  {bar}")
+    if row.get("stages") is None:
+        lines.append("  (no dispatch stage record joined — span_id "
+                     "missing or dispatch record not in this artifact)")
+    return lines
+
+
+def trace_report(records, trace_id: str) -> list:
+    """Printable report for ONE trace ID: the request waterfall(s) plus
+    an inventory of every record stamped with the ID (request-scoped
+    string match or batch-scope list membership). Empty list = the ID
+    appears nowhere."""
+    from .context import trace_matches
+
+    matched = [r for r in records
+               if isinstance(r, dict) and trace_matches(r, trace_id)]
+    if not matched:
+        return []
+    lines = [f"== trace {trace_id}: {len(matched)} records =="]
+    rows = [row for row in request_rows(matched)
+            if row["trace_id"] == trace_id]
+    for row in rows:
+        lines.extend(format_waterfall(row))
+    lines.append("records on this trace:")
+    for r in matched:
+        rtype = r.get("type", "?")
+        what = r.get("name") or r.get("site") or r.get("op") or ""
+        event = r.get("event") or r.get("metric") or ""
+        scope = "batch" if isinstance(r.get("trace_id"), list) else "request"
+        lines.append(f"  {rtype:<14s} {what:<24s} {event:<12s} "
+                     f"[{scope} scope, rank {r.get('rank', 0)}]")
+    return lines
+
+
 def collective_imbalance(records) -> list:
     """Cross-rank imbalance of the collective counters: for each
     (counter name, kind, axis) in each rank's LAST metrics snapshot,
@@ -406,6 +528,8 @@ def main(argv=None) -> int:
     out_path = chrome_path = None
     top_n = 25
     align = False
+    trace_id = None
+    top_slow = None
     paths = []
     i = 0
     while i < len(argv):
@@ -423,6 +547,16 @@ def main(argv=None) -> int:
             except ValueError:
                 print(__doc__, file=sys.stderr)
                 return 2
+        elif a == "--trace":
+            i += 1
+            trace_id = argv[i] if i < len(argv) else None
+        elif a == "--top-slow":
+            i += 1
+            try:
+                top_slow = int(argv[i]) if i < len(argv) else None
+            except ValueError:
+                print(__doc__, file=sys.stderr)
+                return 2
         elif a == "--align":
             align = True
         elif a.startswith("-"):
@@ -432,7 +566,10 @@ def main(argv=None) -> int:
             paths.append(a)
         i += 1
     if not paths or (out_path is None and "-o" in argv) \
-            or (chrome_path is None and "--chrome" in argv):
+            or (chrome_path is None and "--chrome" in argv) \
+            or (trace_id is None and "--trace" in argv) \
+            or (top_slow is None and "--top-slow" in argv) \
+            or (top_slow is not None and top_slow < 1):
         print(__doc__, file=sys.stderr)
         return 2
     try:
@@ -443,6 +580,27 @@ def main(argv=None) -> int:
     if not records:
         print("aggregate: no records in any artifact", file=sys.stderr)
         return 1
+    if trace_id is not None:
+        # report-only mode: one request's causal chain (ISSUE 13)
+        lines = trace_report(records, trace_id)
+        if not lines:
+            print(f"aggregate: trace {trace_id!r} appears in no record",
+                  file=sys.stderr)
+            return 1
+        for line in lines:
+            print(line)
+        return 0
+    if top_slow is not None:
+        rows = request_rows(records)
+        if not rows:
+            print("aggregate: no serve request records to rank",
+                  file=sys.stderr)
+            return 1
+        print(f"== top {min(top_slow, len(rows))} slowest requests "
+              f"(of {len(rows)}) ==")
+        for line in format_request_table(rows, top_slow):
+            print(f"  {line}")
+        return 0
     ranks = sorted({r.get("rank", 0) for r in records})
     print(f"== merged {len(records)} records from {len(paths)} artifact(s), "
           f"ranks {ranks}{' (per-rank aligned timelines)' if align else ''}"

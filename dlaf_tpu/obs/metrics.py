@@ -23,11 +23,122 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Optional
 
 #: Default histogram upper bounds: powers of two from 1 us to ~17 min,
 #: in seconds — span durations from tile ops to whole-pipeline runs.
 DEFAULT_BUCKETS = tuple(2.0 ** e for e in range(-20, 11))
+
+
+def _quantile_sorted(vals, q: float) -> float:
+    """Linear-interpolated q-quantile of an ALREADY-SORTED non-empty
+    list (:func:`quantile` has the contract; :func:`quantiles` shares
+    the sort across several q)."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile: q={q} must be in [0, 1]")
+    pos = (len(vals) - 1) * float(q)
+    lo = int(math.floor(pos))
+    hi = int(math.ceil(pos))
+    a, b = vals[lo], vals[hi]
+    t = pos - lo
+    # numpy's _lerp: the t >= 0.5 branch anchors on b so the two ends
+    # are exact and the result is monotone — mirrored here so the
+    # equality pin holds to the bit, not just approximately
+    return b - (b - a) * (1.0 - t) if t >= 0.5 else a + (b - a) * t
+
+
+def quantile(values, q: float) -> float:
+    """The q-quantile (q in [0, 1]) of ``values`` with numpy's default
+    linear interpolation — bit-identical to ``np.quantile(values, q)``
+    on the same sample, which is the pin that lets bench.py's
+    serve/overload arms and the rolling SLO window report THE SAME p99
+    for the same latencies (ISSUE 13 satellite: one quantile
+    implementation, not three hand-sorted ones). NaN for an empty
+    sample."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return float("nan")
+    return _quantile_sorted(vals, q)
+
+
+def quantiles(values, qs) -> list:
+    """Several quantiles of the same sample with ONE sort (the
+    per-observation SLO gauge refresh asks for p50/p95/p99 together —
+    three independent :func:`quantile` calls would sort the window
+    three times). NaN-filled for an empty sample."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return [float("nan")] * len(qs)
+    return [_quantile_sorted(vals, q) for q in qs]
+
+
+class SlidingWindow:
+    """Rolling-window sample store for latency quantiles (ISSUE 13):
+    a ring of ``epochs`` fixed-capacity epoch buckets, each covering
+    ``window_s / epochs`` seconds of the injectable ``clock``. A sample
+    lands in the current epoch's bucket; an epoch older than the window
+    is overwritten when its ring slot comes around again and excluded
+    from :meth:`samples` meanwhile — memory is bounded at
+    ``epochs * cap`` floats regardless of traffic, and behavior is a
+    pure function of the (clock, observe) sequence, so tests drive it
+    deterministically with a fake clock. Overflow beyond ``cap`` samples
+    per epoch is dropped and counted (:attr:`dropped`) — visibly, never
+    silently reweighted."""
+
+    __slots__ = ("window_s", "epochs", "cap", "clock", "dropped",
+                 "_epoch_len", "_ring", "_stamps", "_lock")
+
+    def __init__(self, window_s: float = 60.0, epochs: int = 6,
+                 cap: int = 256, clock=time.monotonic, lock=None):
+        if not window_s > 0 or epochs < 1 or cap < 1:
+            raise ValueError("SlidingWindow: window_s > 0, epochs >= 1, "
+                             f"cap >= 1 required (got {window_s}, {epochs},"
+                             f" {cap})")
+        self.window_s = float(window_s)
+        self.epochs = int(epochs)
+        self.cap = int(cap)
+        self.clock = clock
+        self.dropped = 0
+        self._epoch_len = self.window_s / self.epochs
+        self._ring = [[] for _ in range(self.epochs)]
+        self._stamps = [None] * self.epochs
+        self._lock = lock or threading.Lock()
+
+    def _epoch(self) -> int:
+        return int(self.clock() // self._epoch_len)
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            e = self._epoch()
+            slot = e % self.epochs
+            if self._stamps[slot] != e:
+                self._ring[slot] = []       # the slot's old epoch expired
+                self._stamps[slot] = e
+            if len(self._ring[slot]) < self.cap:
+                self._ring[slot].append(v)
+            else:
+                self.dropped += 1
+
+    def samples(self) -> list:
+        """All samples still inside the window (live epochs only)."""
+        with self._lock:
+            e = self._epoch()
+            out = []
+            for slot in range(self.epochs):
+                stamp = self._stamps[slot]
+                if stamp is not None and 0 <= e - stamp < self.epochs:
+                    out.extend(self._ring[slot])
+            return out
+
+    def count(self) -> int:
+        return len(self.samples())
+
+    def quantile(self, q: float) -> float:
+        """Windowed q-quantile (numpy-linear, :func:`quantile`); NaN when
+        the window is empty."""
+        return quantile(self.samples(), q)
 
 
 class Counter:
@@ -73,7 +184,7 @@ class Gauge:
 
 class Histogram:
     __slots__ = ("name", "labels", "bounds", "bucket_counts", "count",
-                 "sum", "min", "max", "lock")
+                 "sum", "min", "max", "lock", "window", "exemplars")
 
     def __init__(self, name: str, labels: dict, bounds=DEFAULT_BUCKETS,
                  lock=None):
@@ -86,9 +197,34 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self.lock = lock or threading.Lock()
+        self.window = None       # optional SlidingWindow (windowed())
+        self.exemplars = {}      # bucket index -> [trace_id, value]
+
+    def windowed(self, window_s: Optional[float] = None,
+                 epochs: int = 6, cap: int = 256,
+                 clock=time.monotonic) -> SlidingWindow:
+        """The histogram's attached rolling-window quantile estimator
+        (created on first call; later calls return the SAME window and
+        ignore the sizing arguments — one window per series). Every
+        subsequent :meth:`observe` feeds it alongside the cumulative
+        buckets; the window has its OWN lock (it is also read from
+        scrape threads) and bounded memory (class docstring)."""
+        with self.lock:
+            if self.window is None:
+                self.window = SlidingWindow(
+                    window_s if window_s is not None else 60.0,
+                    epochs=epochs, cap=cap, clock=clock)
+            return self.window
 
     def observe(self, v) -> None:
         v = float(v)
+        # exemplar: attribute this observation to the active REQUEST
+        # trace when there is exactly one (batch-scope contexts carry a
+        # list and are never exemplars) — resolved before taking the
+        # lock, one ContextVar read when no context is live
+        from .context import single_trace_id
+
+        tid = single_trace_id()
         with self.lock:
             # count/sum/buckets move together, or a concurrent snapshot
             # breaks the Prometheus invariant bucket{le="+Inf"} == count
@@ -98,11 +234,18 @@ class Histogram:
                 self.min = v
             if v > self.max:
                 self.max = v
+            slot = len(self.bounds)
             for i, b in enumerate(self.bounds):
                 if v <= b:
-                    self.bucket_counts[i] += 1
-                    return
-            self.bucket_counts[-1] += 1
+                    slot = i
+                    break
+            self.bucket_counts[slot] += 1
+            if tid is not None:
+                self.exemplars[slot] = [tid, v]
+        if self.window is not None:
+            # outside the registry lock: the window owns its own lock
+            # (a shared non-reentrant lock would deadlock here)
+            self.window.observe(v)
 
     def cumulative_buckets(self):
         """Prometheus-convention cumulative ``[le, count]`` pairs, the
@@ -115,11 +258,19 @@ class Histogram:
         return out
 
     def snapshot(self) -> dict:
-        return {"name": self.name, "kind": "histogram", "labels": self.labels,
+        snap = {"name": self.name, "kind": "histogram",
+                "labels": self.labels,
                 "count": self.count, "sum": self.sum,
                 "min": self.min if self.count else 0.0,
                 "max": self.max if self.count else 0.0,
                 "buckets": self.cumulative_buckets()}
+        if self.exemplars:
+            # keyed by bucket INDEX (matching the cumulative list's
+            # positions, +Inf last) so exposition can attach each
+            # exemplar to its bucket line
+            snap["exemplars"] = {i: list(ex)
+                                 for i, ex in self.exemplars.items()}
+        return snap
 
 
 class _NoopCounter:
@@ -136,11 +287,30 @@ class _NoopGauge:
         pass
 
 
+class _NoopWindow:
+    __slots__ = ()
+
+    def observe(self, v) -> None:
+        pass
+
+    def samples(self) -> list:
+        return []
+
+    def count(self) -> int:
+        return 0
+
+    def quantile(self, q) -> float:
+        return float("nan")
+
+
 class _NoopHistogram:
     __slots__ = ()
 
     def observe(self, v) -> None:
         pass
+
+    def windowed(self, *args, **kwargs):
+        return NOOP_WINDOW
 
 
 #: Singletons the facade returns when observability is off: no state, no
@@ -148,6 +318,7 @@ class _NoopHistogram:
 NOOP_COUNTER = _NoopCounter()
 NOOP_GAUGE = _NoopGauge()
 NOOP_HISTOGRAM = _NoopHistogram()
+NOOP_WINDOW = _NoopWindow()
 
 
 def _labels_key(labels: dict):
@@ -214,9 +385,17 @@ def _prom_num(v) -> str:
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
-def prometheus_text(snapshot: list) -> str:
+def prometheus_text(snapshot: list, exemplars: bool = False) -> str:
     """Prometheus text exposition (format 0.0.4) of a registry snapshot
-    (the list :func:`Registry.snapshot` returns)."""
+    (the list :func:`Registry.snapshot` returns).
+
+    ``exemplars=True`` additionally appends OpenMetrics-style exemplars
+    to histogram bucket lines that carry one —
+    ``name_bucket{le="0.25"} 7 # {trace_id="3f2a..."} 0.21`` — joining a
+    latency bucket to ONE request's trace ID (docs/observability.md live
+    operations). Off by default: the classic 0.0.4 grammar has no
+    exemplar clause, so artifacts and the ``--prom`` CLI stay exactly as
+    before; the live ``/metrics`` endpoint opts in."""
     by_name: dict = {}
     for m in snapshot:
         by_name.setdefault((m["name"], m["kind"]), []).append(m)
@@ -231,10 +410,17 @@ def prometheus_text(snapshot: list) -> str:
         for m in entries:
             labels = m.get("labels", {})
             if kind == "histogram":
-                for le, cnt in m["buckets"]:
+                ex = m.get("exemplars") or {} if exemplars else {}
+                for i, (le, cnt) in enumerate(m["buckets"]):
                     lb = dict(labels)
                     lb["le"] = le if isinstance(le, str) else _prom_num(le)
-                    lines.append(f"{name}_bucket{_prom_labels(lb)} {cnt}")
+                    line = f"{name}_bucket{_prom_labels(lb)} {cnt}"
+                    hit = ex.get(i, ex.get(str(i)))
+                    if hit:
+                        tid, v = hit
+                        line += (' # {trace_id="%s"} %s'
+                                 % (tid, _prom_num(float(v))))
+                    lines.append(line)
                 lines.append(f"{name}_sum{_prom_labels(labels)} "
                              f"{_prom_num(m['sum'])}")
                 lines.append(f"{name}_count{_prom_labels(labels)} "

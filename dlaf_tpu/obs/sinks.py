@@ -80,11 +80,30 @@ Schema (version 1). Every record carries ``v`` (int schema version),
     program traced twice = an evicted/cold bucket recompiled
     mid-stream).
 
+``flight_trigger``
+    Header record of a flight-recorder dump (:mod:`dlaf_tpu.obs.flight`,
+    the ``DLAF_FLIGHT_RECORDER`` knob): ``reason`` one of
+    :data:`FLIGHT_REASONS`, ``dump_seq`` int >= 1, ``records`` int >= 0
+    (ring depth at the dump), ``attrs`` object. It appears only in the
+    standalone ``<metrics_path>.flight.jsonl`` incident artifact — the
+    ``--require-flight`` CI obligation: >= 1 ``flight_trigger`` record
+    AND >= 1 ordinary record after it (an incident dump with no
+    pre-trigger context captured nothing worth gating on).
+
 Every record additionally carries an optional ``rank`` (int >= 0,
 ``jax.process_index()``) — stamped by the sink once the rank is known, so
 multi-host artifacts merge per rank (``python -m dlaf_tpu.obs.aggregate``;
 ``DLAF_METRICS_PATH`` accepts a ``%r`` per-rank template so ranks never
-interleave one file).
+interleave one file) — and optional trace correlation (ISSUE 13,
+:mod:`dlaf_tpu.obs.context`): ``trace_id`` (non-empty str for
+request-scoped records, non-empty list of non-empty strs for
+batch-scoped ones — a dispatch, its retries, its compiles) and
+``span_id`` (non-empty str, one per batch dispatch), both stamped by the
+sink from the active ``obs.trace_context``. ``serve`` dispatch records
+may carry a ``stages`` object of finite non-negative stage walls
+(``compose_s``/``program_s``/``fetch_s``/``unpad_s``) — joined to member
+requests via ``span_id`` by ``obs.aggregate --trace`` (the per-request
+waterfall).
 
 :func:`validate_file` is the single schema owner consumed by tests and the
 CI gate (``python -m dlaf_tpu.obs.validate``): it rejects unparsable lines,
@@ -107,12 +126,18 @@ from typing import Optional
 SCHEMA_VERSION = 1
 
 KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
-               "accuracy", "serve", "resilience")
+               "accuracy", "serve", "resilience", "flight_trigger")
 
 #: The resilience record's event vocabulary (schema above).
 RESILIENCE_EVENTS = ("retry", "give_up", "deadline", "circuit_open",
                      "circuit_half_open", "circuit_close", "shed",
                      "expired", "checkpoint", "preempt", "resume")
+
+#: The flight recorder's trigger vocabulary (docs/observability.md live
+#: operations; trigger sites in :mod:`dlaf_tpu.obs.flight`).
+FLIGHT_REASONS = ("breaker_open", "overload_shed",
+                  "factorization_exhausted", "accuracy_breach",
+                  "healthz_failure")
 
 
 def expand_rank_template(path: str) -> str:
@@ -154,6 +179,18 @@ class JsonlSink:
             rank = current_rank()
             if rank is not None:
                 record["rank"] = rank
+        # request-scoped trace correlation (ISSUE 13): the active
+        # obs.trace_context's trace_id/span_id land on EVERY record type
+        # written under it — one ContextVar read when no context is live
+        from .context import record_stamp
+
+        record_stamp(record)
+        from ._state import STATE
+
+        if STATE.flight is not None:
+            # flight ring capture, pre-serialization and pre-file-write:
+            # the moments before an incident survive a lost sink file
+            STATE.flight.capture(record)
         line = json.dumps(record, default=str)
         with self._lock:
             if self._f is None:
@@ -312,6 +349,16 @@ def _validate_serve(r: dict, where: str, errors: list) -> None:
         if not _finite(r.get("dispatch_s")) or r.get("dispatch_s", -1) < 0:
             errors.append(f"{where}: serve dispatch_s "
                           "missing/non-finite/negative")
+        stages = r.get("stages")
+        if stages is not None:
+            if not isinstance(stages, dict):
+                errors.append(f"{where}: serve dispatch stages must be an "
+                              "object")
+            else:
+                for key, v in stages.items():
+                    if not _finite(v) or v < 0:
+                        errors.append(f"{where}: serve dispatch stages"
+                                      f"[{key!r}] non-finite/negative")
     else:
         if not isinstance(r.get("n"), int) or isinstance(r.get("n"), bool) \
                 or r.get("n", 0) < 1:
@@ -349,6 +396,40 @@ def _validate_resilience(r: dict, where: str, errors: list) -> None:
         errors.append(f"{where}: resilience attrs must be an object")
 
 
+def _validate_flight_trigger(r: dict, where: str, errors: list) -> None:
+    if r.get("reason") not in FLIGHT_REASONS:
+        errors.append(f"{where}: flight_trigger reason must be one of "
+                      f"{FLIGHT_REASONS}, got {r.get('reason')!r}")
+    for key in ("dump_seq", "records"):
+        if not isinstance(r.get(key), int) or isinstance(r.get(key), bool) \
+                or r.get(key, -1) < 0:
+            errors.append(f"{where}: flight_trigger {key} must be a "
+                          "non-negative int")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: flight_trigger attrs must be an object")
+
+
+def _validate_trace_stamp(r: dict, where: str, errors: list) -> None:
+    """Optional trace correlation fields, any record type: ``trace_id``
+    a non-empty str (request scope) or non-empty list of non-empty strs
+    (batch scope); ``span_id`` a non-empty str."""
+    tid = r.get("trace_id")
+    if tid is not None:
+        if isinstance(tid, str):
+            if not tid:
+                errors.append(f"{where}: trace_id must be non-empty")
+        elif isinstance(tid, list):
+            if not tid or any(not isinstance(t, str) or not t for t in tid):
+                errors.append(f"{where}: trace_id list must be non-empty "
+                              "with non-empty string members")
+        else:
+            errors.append(f"{where}: trace_id must be a string or a list "
+                          f"of strings, got {type(tid).__name__}")
+    sid = r.get("span_id")
+    if sid is not None and (not isinstance(sid, str) or not sid):
+        errors.append(f"{where}: span_id must be a non-empty string")
+
+
 def _validate_metrics(r: dict, where: str, errors: list) -> None:
     entries = r.get("metrics")
     if not isinstance(entries, list):
@@ -376,7 +457,8 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_fallbacks=False, require_comm_overlap=False,
                      require_dc_batch=False, require_bt_overlap=False,
                      require_telemetry=False, require_accuracy=False,
-                     require_serve=False, require_resilience=False) -> list:
+                     require_serve=False, require_resilience=False,
+                     require_flight=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -417,7 +499,11 @@ def validate_records(records, require_spans=False, require_gflops=False,
     actually ran (event ``retry`` or ``resume``), and NO
     ``dlaf_circuit_state`` gauge still at the open value (2) in the last
     metrics snapshot — a run that ended with a breaker tripped failed,
-    whatever else it recorded."""
+    whatever else it recorded — and (``require_flight``) the
+    flight-recorder incident obligation (docs/observability.md): >= 1
+    ``flight_trigger`` record with a known reason AND >= 1 ordinary
+    (pre-trigger) record, so an incident dump that captured no context
+    fails the drill."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = n_accuracy = 0
@@ -425,6 +511,7 @@ def validate_records(records, require_spans=False, require_gflops=False,
     n_serve_batched = n_serve_miss = n_serve_requests = 0
     n_serve_accuracy = 0
     n_resilience_proof = 0
+    n_flight_triggers = n_flight_context = 0
     circuit_state = {}                # site -> latest gauge value seen
     serve_retrace_sites = {}          # serve.* site -> trace evidence count
     overlap_axes, byte_axes = set(), set()
@@ -447,7 +534,14 @@ def validate_records(records, require_spans=False, require_gflops=False,
                             or r["rank"] < 0):
             errors.append(f"{where}: rank must be a non-negative int, "
                           f"got {r['rank']!r}")
-        if rtype == "program":
+        _validate_trace_stamp(r, where, errors)
+        if rtype != "flight_trigger":
+            n_flight_context += 1
+        if rtype == "flight_trigger":
+            _validate_flight_trigger(r, where, errors)
+            if r.get("reason") in FLIGHT_REASONS:
+                n_flight_triggers += 1
+        elif rtype == "program":
             _validate_program(r, where, errors)
             if r.get("event") == "compile" and _finite(r.get("compile_s")):
                 n_compile_obs += 1
@@ -606,6 +700,13 @@ def validate_records(records, require_spans=False, require_gflops=False,
         if open_sites:
             errors.append("circuit breaker(s) left open at artifact end "
                           f"(dlaf_circuit_state >= 2): {open_sites}")
+    if require_flight:
+        if n_flight_triggers == 0:
+            errors.append("artifact contains no flight_trigger record "
+                          "with a known reason (no incident dump)")
+        if n_flight_context == 0:
+            errors.append("flight artifact carries no pre-trigger context "
+                          "records (the ring captured nothing)")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "

@@ -54,6 +54,11 @@ Flags:
                             value (2) in the last metrics snapshot — a
                             run that ended with a tripped breaker must
                             fail the gate, not scrape as healthy
+    --require-flight        validate the file as a flight-recorder
+                            incident dump (docs/observability.md live
+                            operations): >= 1 flight_trigger record with
+                            a known reason AND >= 1 ordinary pre-trigger
+                            record captured by the ring
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
@@ -91,7 +96,7 @@ def main(argv=None) -> int:
              "--require-comm-overlap", "--require-dc-batch",
              "--require-bt-overlap", "--require-telemetry",
              "--require-accuracy", "--require-serve",
-             "--require-resilience", "--history",
+             "--require-resilience", "--require-flight", "--history",
              "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
@@ -127,7 +132,8 @@ def main(argv=None) -> int:
         require_telemetry="--require-telemetry" in flags,
         require_accuracy="--require-accuracy" in flags,
         require_serve="--require-serve" in flags,
-        require_resilience="--require-resilience" in flags)
+        require_resilience="--require-resilience" in flags,
+        require_flight="--require-flight" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -138,12 +144,14 @@ def main(argv=None) -> int:
     n_acc = sum(r.get("type") == "accuracy" for r in records)
     n_serve = sum(r.get("type") == "serve" for r in records)
     n_res = sum(r.get("type") == "resilience" for r in records)
+    n_flight = sum(r.get("type") == "flight_trigger" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
     extra += f", {n_acc} accuracy records" if n_acc else ""
     extra += f", {n_serve} serve records" if n_serve else ""
     extra += f", {n_res} resilience records" if n_res else ""
+    extra += f", {n_flight} flight triggers" if n_flight else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

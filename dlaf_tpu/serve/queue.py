@@ -37,6 +37,21 @@ request lands as a ``serve`` JSONL record so the validator's
 ``--require-serve`` covers the serving path end to end
 (docs/observability.md).
 
+Trace correlation (ISSUE 13, docs/observability.md live operations):
+``submit`` stamps one ``trace_id`` per request (``Ticket.trace_id``)
+and each batch dispatch draws one ``span_id``; the dispatch runs under
+a batch-scope ``obs.trace_context`` (member-ID list + span_id) so the
+dispatch record, the policy engine's retry/breaker records, and any
+program compile it triggers are all joinable from any member ID, while
+the per-request records (request, span, accuracy, SLO latency
+exemplar) re-enter request scope with the single ID. The dispatch
+record's ``stages`` object (compose/program/fetch/unpad walls) plus
+the request's ``queue_s`` is the per-request waterfall
+``obs.aggregate --trace <id>`` renders. Request completions feed
+``obs.observe_latency`` (the rolling-window SLO gauges + breach
+counter), the queue registers itself on the live ``/healthz`` endpoint
+at construction, and an admission shed trips the flight recorder.
+
 Resilience (PR 12, docs/robustness.md):
 
 * **Admission control** (``DLAF_SERVE_MAX_DEPTH`` / ``DLAF_SERVE_SHED``):
@@ -165,6 +180,11 @@ class Ticket:
         self.info: Optional[int] = None
         self.queue_s: Optional[float] = None
         self.total_s: Optional[float] = None
+        # request-scoped trace correlation (ISSUE 13): one ID per
+        # request, stamped by obs.trace_context onto every record the
+        # request's causal chain emits — `obs.aggregate --trace <id>`
+        # joins them back together
+        self.trace_id = obs.new_trace_id()
         self._result = None
 
     def result(self):
@@ -368,6 +388,13 @@ class Queue:
         self.requests = 0
         self._in_flight = 0               # dispatches currently executing
         self._counts: dict = {}           # _BucketKey -> {shed, expired}
+        # expose this queue on the live /healthz endpoint (weakref, no
+        # unregister protocol) — LAST, after every field stats() reads
+        # exists: a scrape thread may call stats() the instant the queue
+        # is visible, and a half-constructed queue answering /healthz
+        # with an AttributeError would fabricate a healthz_failure
+        # flight dump on a perfectly clean run
+        obs.exporter.register_queue(self)
 
     # -- submission ------------------------------------------------------
 
@@ -419,6 +446,14 @@ class Queue:
                                attrs={"op": key.op, "bucket_n": key.n,
                                       "depth": self.pending(),
                                       "max_depth": self.max_depth})
+                # a shed burst is an incident: dump the flight ring
+                # (the shed record above is already in it); the
+                # recorder's per-reason cooldown means the FIRST shed
+                # of a burst dumps and the next thousand do not
+                from ..obs import flight
+                flight.trigger("overload_shed", op=key.op,
+                               bucket_n=key.n, depth=self.pending(),
+                               max_depth=self.max_depth)
                 raise OverloadError(self.pending(), self.max_depth,
                                     op=key.op, bucket_n=key.n)
             fullest = max((k for k, v in self._pending.items() if v),
@@ -594,12 +629,13 @@ class Queue:
                 if obs.metrics_active():
                     obs.counter("dlaf_deadline_exceeded_total",
                                 site="serve.queue").inc()
-                obs.emit_event("resilience", site="serve.queue",
-                               event="expired",
-                               attrs={"rid": req.rid, "op": key.op,
-                                      "bucket_n": key.n,
-                                      "waited_s": float(waited),
-                                      "deadline_s": float(req.deadline_s)})
+                with obs.trace_context(trace_id=ticket.trace_id):
+                    obs.emit_event(
+                        "resilience", site="serve.queue", event="expired",
+                        attrs={"rid": req.rid, "op": key.op,
+                               "bucket_n": key.n,
+                               "waited_s": float(waited),
+                               "deadline_s": float(req.deadline_s)})
             else:
                 live.append((req, ticket))
         return live
@@ -615,6 +651,19 @@ class Queue:
         tickets = [t for _, t in lanes]
         spec = self._spec(key)
         resident = spec in self.service.specs()
+        # batch-scope trace context (ISSUE 13): the dispatch's span_id
+        # plus the MEMBER trace-ID list stamp every record emitted below
+        # — the dispatch record, the policy engine's retry/breaker
+        # records, any program compile the batch triggers — so one
+        # request ID finds its whole dispatch by membership
+        span_id = obs.new_span_id()
+        member_ids = [t.trace_id for t in tickets]
+        with obs.trace_context(trace_id=member_ids, span_id=span_id):
+            return self._dispatch_traced(key, reqs, tickets, spec,
+                                         resident, span_id)
+
+    def _dispatch_traced(self, key: _BucketKey, reqs: list, tickets: list,
+                         spec, resident: bool, span_id: str) -> bool:
         t0 = self.clock()
         # assemble the padded batch (host: request shapes are serve-small)
         a_batch = np.stack(
@@ -630,6 +679,7 @@ class Queue:
                              + [np.dtype(key.dtype).type(1.0)]
                              * (self.batch - len(reqs)))
             args += [b_batch, alpha]
+        t_compose = self.clock()
         # dispatch + compile run under the shared policy engine behind
         # the bucket's circuit breaker: a transient failure (e.g. an
         # inject.fail_dispatch drill, a flaky tunnel) retries before any
@@ -650,6 +700,7 @@ class Queue:
                       dtype=key.dtype, cache="hit" if resident else "miss"):
             out = with_policy(spec.site, _attempt, policy=policy,
                               breaker=breaker, clock=self.clock)
+        t_prog = self.clock()
         dev_outs, infos = _split_outputs(key.op, out)
         # ONE device->host fetch per dispatch, then zero-cost numpy views
         # per ticket: per-lane device slicing would cost a dispatch per
@@ -659,6 +710,16 @@ class Queue:
         lane_outs = (tuple(np.asarray(o) for o in dev_outs)
                      if isinstance(dev_outs, tuple) else np.asarray(dev_outs))
         t1 = self.clock()
+        infos_np = np.asarray(infos) if infos is not None else None
+        # unpad every lane BEFORE the dispatch record so the record's
+        # stages object covers the whole waterfall the requests ride
+        for i, (req, ticket) in enumerate(zip(reqs, tickets)):
+            ticket._result = _unpad(req, key, _lane(key.op, lane_outs, i))
+            ticket.info = int(infos_np[i]) if infos_np is not None else None
+            ticket.queue_s = max(t0 - ticket.submitted, 0.0)
+            ticket.total_s = max(t1 - ticket.submitted, 0.0)
+            ticket.done = True
+        t_unpad = self.clock()
         self.dispatches += 1
         if obs.metrics_active():
             obs.counter("dlaf_serve_dispatch_total", op=key.op).inc()
@@ -668,40 +729,48 @@ class Queue:
                        bucket_n=key.n, nrhs=key.nrhs, dtype=key.dtype,
                        lanes=len(reqs), batch=self.batch,
                        cache="hit" if resident else "miss",
-                       dispatch_s=float(t1 - t0))
-        infos_np = np.asarray(infos) if infos is not None else None
+                       dispatch_s=float(t1 - t0),
+                       stages={"compose_s": float(t_compose - t0),
+                               "program_s": float(t_prog - t_compose),
+                               "fetch_s": float(t1 - t_prog),
+                               "unpad_s": float(t_unpad - t1)})
         residuals = self._residuals(key, reqs, args, dev_outs)
         for i, (req, ticket) in enumerate(zip(reqs, tickets)):
-            ticket._result = _unpad(req, key, _lane(key.op, lane_outs, i))
-            ticket.info = int(infos_np[i]) if infos_np is not None else None
-            ticket.queue_s = max(t0 - ticket.submitted, 0.0)
-            ticket.total_s = max(t1 - ticket.submitted, 0.0)
-            ticket.done = True
             n_req = int(np.asarray(req.a).shape[0])
             attrs = {"rid": req.rid,
                      **({"info": ticket.info}
                         if ticket.info is not None else {})}
-            obs.emit_event("serve", event="request", op=key.op, n=n_req,
-                           bucket_n=key.n, dtype=key.dtype,
-                           queue_s=float(ticket.queue_s),
-                           total_s=float(ticket.total_s), attrs=attrs)
-            # per-request span record (unfenced-wall convention does not
-            # apply: total_s ends at the dispatch's host materialization,
-            # a real fence) — the request-granular audit trail next to
-            # the typed serve record
-            obs.emit_event("span", name="serve.request",
-                           dur_s=float(ticket.total_s), depth=0,
-                           parent=None,
-                           attrs={"op": key.op, "n": n_req,
-                                  "bucket_n": key.n, **attrs})
-            if residuals is not None:
-                metric, c = _ACCURACY[key.op]
-                obs.accuracy.emit(
-                    "serve", metric, residuals[i], n=n_req,
-                    nb=_default_nb(key.n), c=c, dtype=np.dtype(key.dtype),
-                    of=_lane_array(dev_outs),
-                    attrs={"op": key.op, "rid": req.rid,
-                           "bucket_n": key.n})
+            # request-scope trace context: these records carry the ONE
+            # member trace ID (overriding the surrounding batch scope)
+            # while keeping the dispatch's span_id as the join key
+            with obs.trace_context(trace_id=ticket.trace_id,
+                                   span_id=span_id):
+                obs.emit_event("serve", event="request", op=key.op,
+                               n=n_req, bucket_n=key.n, dtype=key.dtype,
+                               queue_s=float(ticket.queue_s),
+                               total_s=float(ticket.total_s), attrs=attrs)
+                # per-request span record (unfenced-wall convention does
+                # not apply: total_s ends at the dispatch's host
+                # materialization, a real fence) — the request-granular
+                # audit trail next to the typed serve record
+                obs.emit_event("span", name="serve.request",
+                               dur_s=float(ticket.total_s), depth=0,
+                               parent=None,
+                               attrs={"op": key.op, "n": n_req,
+                                      "bucket_n": key.n, **attrs})
+                # rolling-window SLO tracking: the histogram records the
+                # exemplar trace ID from this request-scoped context
+                obs.observe_latency(f"serve.{key.op}", ticket.total_s,
+                                    bucket=str(key.n))
+                if residuals is not None:
+                    metric, c = _ACCURACY[key.op]
+                    obs.accuracy.emit(
+                        "serve", metric, residuals[i], n=n_req,
+                        nb=_default_nb(key.n), c=c,
+                        dtype=np.dtype(key.dtype),
+                        of=_lane_array(dev_outs),
+                        attrs={"op": key.op, "rid": req.rid,
+                               "bucket_n": key.n})
         return True
 
     def _residuals(self, key, reqs, args, lane_outs):

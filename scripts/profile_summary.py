@@ -127,14 +127,41 @@ def summarize_jsonl(path: str, top_n: int) -> None:
             print(f"  {len(disp)} dispatches ({hits} cache hits), "
                   f"{len(reqs)} requests")
         if reqs:
-            lat = sorted(r.get("total_s", 0.0) for r in reqs)
-            p99 = lat[min(int(0.99 * len(lat)), len(lat) - 1)]
+            # shared quantile computation (obs.metrics.quantile — the
+            # same numpy-linear estimator behind the SLO window gauges
+            # and bench.py's arms), not another hand-rolled p99
+            from dlaf_tpu.obs.metrics import quantile
+
+            lat = [r.get("total_s", 0.0) for r in reqs]
             print(f"  request latency: mean {sum(lat) / len(lat) * 1e3:.2f}"
-                  f" ms  p99 {p99 * 1e3:.2f} ms")
+                  f" ms  p99 {quantile(lat, 0.99) * 1e3:.2f} ms")
         if resil:
             events = collections.Counter(r.get("event", "?") for r in resil)
             print("  resilience events: "
                   + ", ".join(f"{k}={v}" for k, v in sorted(events.items())))
+        if reqs:
+            # requests section (ISSUE 13): slowest trace IDs with their
+            # stage breakdown + per-op percentiles — the join code is
+            # obs.aggregate's (request_rows/format_request_table),
+            # single owner, not a fork
+            from dlaf_tpu.obs.aggregate import (format_request_table,
+                                                request_rows)
+            from dlaf_tpu.obs.metrics import quantile
+
+            print("\n== requests (slowest first; obs.aggregate "
+                  "--trace <id> for the waterfall) ==")
+            for line in format_request_table(request_rows(records),
+                                             top_n=5):
+                print(f"  {line}")
+            by_op = collections.defaultdict(list)
+            for r in reqs:
+                by_op[r.get("op", "?")].append(r.get("total_s", 0.0))
+            for op in sorted(by_op):
+                lat = by_op[op]
+                qs = "  ".join(
+                    f"p{int(q * 100)} {quantile(lat, q) * 1e3:.2f} ms"
+                    for q in (0.5, 0.95, 0.99))
+                print(f"  {op:<9s} ({len(lat)} reqs): {qs}")
         # queue depth / shed / expired / breaker state from the last
         # snapshot (the gauges Queue.stats() exports — single owner of
         # the semantics, this is just the offline view)

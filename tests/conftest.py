@@ -107,6 +107,9 @@ _QUICK_TESTS = {
     ("test_accuracy.py", "test_gate_legs"),
     ("test_analysis.py", "test_drills_trip_their_rules"),
     ("test_analysis.py", "test_lint_repo_is_clean"),
+    ("test_live_telemetry.py", "test_serve_trace_join_end_to_end"),
+    ("test_live_telemetry.py",
+     "test_metrics_scrape_monotone_across_two_scrapes"),
 }
 
 

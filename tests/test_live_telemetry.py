@@ -1,0 +1,799 @@
+"""Tests for ISSUE 13: live operational telemetry.
+
+Covers: the shared numpy-linear quantile (pinned bit-identical to
+``np.percentile`` — the computation bench.py's serve/overload arms now
+share with the SLO window), the SlidingWindow epoch ring (deterministic
+expiry under a fake clock, bounded memory with counted drops), the
+``obs.observe_latency`` SLO path (windowed ``q``-labelled gauges in
+deterministic order, the ``dlaf_slo_breach_total`` burn counter against
+``DLAF_SLO_P99_MS``), exemplar trace IDs on histogram buckets and their
+text-format grammar, request-scoped trace correlation end to end
+through the serve queue (one trace_id on request / dispatch / span /
+accuracy / retry-resilience records, ``span_id`` as the dispatch join
+key, ``obs.aggregate --trace`` waterfall + ``--top-slow``), the live
+``/metrics`` + ``/healthz`` exporter (monotone counters across two
+mid-stream scrapes, ``Queue.stats()`` JSON round-trip incl. breaker
+state names, lifecycle, 404/500 + healthz-failure flight trigger), the
+flight recorder (bounded ring, atomic dump, per-reason cooldown, every
+trigger site, the must-NOT-trip clean run, ``--require-flight``), the
+``prometheus_snapshot_text`` no-op pin, and the new config knobs
+(docs/observability.md live operations).
+"""
+
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.config as C
+from dlaf_tpu import health, obs
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.health import circuit, inject
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.obs import exporter, flight, slo
+from dlaf_tpu.obs.metrics import SlidingWindow, prometheus_text, quantile
+from dlaf_tpu.serve import Queue, Request
+from dlaf_tpu.serve import programs as serve_programs
+
+
+@pytest.fixture(autouse=True)
+def live_reset():
+    """Every test leaves default config, no metrics, no exporter thread,
+    no breakers, and an empty default program service behind."""
+    yield
+    for key in ("DLAF_METRICS_PATH", "DLAF_METRICS_PORT",
+                "DLAF_FLIGHT_RECORDER", "DLAF_SLO_P99_MS",
+                "DLAF_SLO_WINDOW_S", "DLAF_ACCURACY"):
+        os.environ.pop(key, None)
+    obs._reset_for_tests()
+    circuit.reset()
+    serve_programs._reset_for_tests()
+    C.finalize()
+    C.initialize()
+
+
+def _metrics_on(tmp_path, **cfg):
+    path = str(tmp_path / "live.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, log="off", **cfg))
+    return path
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _hpd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+def _serve_stream(n_reqs=4, batch=2, n=12, bucket=16, seed=0):
+    """A warm queue + a stream of completed cholesky tickets."""
+    q = Queue(buckets=(bucket,), batch=batch, deadline_s=1e9)
+    q.warmup([Request(op="cholesky", a=_hpd(n, seed))])
+    tickets = [q.submit(Request(op="cholesky", a=_hpd(n, seed + i)))
+               for i in range(n_reqs)]
+    q.flush()
+    for t in tickets:
+        t.result()
+    return q, tickets
+
+
+# ---------------------------------------------------------------------------
+# quantile: the one shared estimator (satellite)
+# ---------------------------------------------------------------------------
+
+def test_quantile_matches_numpy_percentile():
+    """Pinned BIT-identical to np.percentile's linear interpolation on
+    the same sample — the contract that makes the SLO gauges, the
+    aggregate tables, and bench.py's serve/overload p99 report the same
+    number for the same latencies."""
+    rng = np.random.default_rng(7)
+    for size in (1, 2, 3, 7, 64, 100):
+        vals = rng.exponential(size=size).tolist()
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0, 0.123):
+            assert quantile(vals, q) == float(np.quantile(vals, q))
+        # and through percentile's own q*100/100 round-trip at the
+        # percentiles the legacy bench code used
+        for pct in (50, 95, 99):
+            assert quantile(vals, pct / 100) == \
+                float(np.percentile(vals, pct))
+
+
+def test_quantile_empty_and_bad_q():
+    assert math.isnan(quantile([], 0.5))
+    with pytest.raises(ValueError):
+        quantile([1.0], 1.5)
+    with pytest.raises(ValueError):
+        quantile([1.0], -0.1)
+
+
+def test_quantiles_one_sort_matches_quantile():
+    """metrics.quantiles (one sort for the whole gauge refresh) is
+    element-wise identical to independent quantile() calls."""
+    from dlaf_tpu.obs.metrics import quantiles
+
+    vals = [0.3, 0.1, 0.9, 0.2, 0.7]
+    qs = [0.5, 0.95, 0.99]
+    assert quantiles(vals, qs) == [quantile(vals, q) for q in qs]
+    assert all(math.isnan(v) for v in quantiles([], qs))
+
+
+def test_bench_p99_matches_legacy_computation():
+    """The ISSUE-13 satellite pin: the quantile bench.py now routes its
+    serve/overload p99 through equals the np.percentile(lat, 99) those
+    arms hand-computed before, on a fixed sample."""
+    lat = [0.01, 0.5, 0.03, 0.2, 0.11, 0.07, 0.004, 0.9, 0.3, 0.06]
+    assert quantile(lat, 0.99) == float(np.percentile(lat, 99))
+
+
+# ---------------------------------------------------------------------------
+# SlidingWindow: the epoch ring
+# ---------------------------------------------------------------------------
+
+def test_sliding_window_deterministic_expiry():
+    clock = FakeClock()
+    w = SlidingWindow(window_s=6.0, epochs=3, clock=clock)
+    w.observe(1.0)
+    clock.t = 1.0
+    w.observe(2.0)
+    assert sorted(w.samples()) == [1.0, 2.0]
+    # advance one epoch (2 s): both still inside the 6 s window
+    clock.t = 2.5
+    w.observe(3.0)
+    assert sorted(w.samples()) == [1.0, 2.0, 3.0]
+    # advance past the window: epoch-0 samples expire, epoch-1's live
+    clock.t = 6.1
+    assert sorted(w.samples()) == [3.0]
+    clock.t = 100.0
+    assert w.samples() == []
+    assert math.isnan(w.quantile(0.5))     # empty window: NaN, never 0
+
+
+def test_sliding_window_bounded_memory_drops_counted():
+    clock = FakeClock()
+    w = SlidingWindow(window_s=10.0, epochs=2, cap=4, clock=clock)
+    for i in range(10):
+        w.observe(float(i))
+    assert w.count() == 4          # bounded at cap per epoch
+    assert w.dropped == 6          # overflow visible, never silent
+    with pytest.raises(ValueError):
+        SlidingWindow(window_s=0.0)
+
+
+def test_histogram_windowed_is_singleton_and_fed():
+    reg = obs.Registry()           # a bare registry, no sink needed
+    h = reg.histogram("lat", op="x")
+    clock = FakeClock()
+    w = h.windowed(window_s=60.0, clock=clock)
+    assert h.windowed(window_s=999.0) is w     # one window per series
+    h.observe(0.25)
+    h.observe(0.5)
+    assert sorted(w.samples()) == [0.25, 0.5]
+    assert w.quantile(1.0) == 0.5
+
+
+# ---------------------------------------------------------------------------
+# observe_latency: the SLO path
+# ---------------------------------------------------------------------------
+
+def test_observe_latency_gauges_and_breach_counter(tmp_path):
+    _metrics_on(tmp_path, slo_p99_ms=100.0)
+    for v in (0.01, 0.02, 0.05, 0.2, 0.3):       # 2 of 5 over 100 ms
+        obs.observe_latency("serve.cholesky", v, bucket="64")
+    snap = {(m["name"], tuple(sorted(m.get("labels", {}).items()))): m
+            for m in obs.registry().snapshot()}
+    breach = snap[("dlaf_slo_breach_total",
+                   (("op", "serve.cholesky"),))]
+    assert breach["value"] == 2
+    for q in ("0.5", "0.95", "0.99"):
+        g = snap[("dlaf_serve_latency_window",
+                  (("bucket", "64"), ("op", "serve.cholesky"), ("q", q)))]
+        assert g["value"] == quantile([0.01, 0.02, 0.05, 0.2, 0.3],
+                                      float(q))
+    # the cumulative histogram moved too
+    h = snap[("dlaf_serve_latency_seconds",
+              (("bucket", "64"), ("op", "serve.cholesky")))]
+    assert h["count"] == 5
+
+
+def test_observe_latency_no_objective_no_breach(tmp_path):
+    _metrics_on(tmp_path)                         # slo_p99_ms = 0 (off)
+    obs.observe_latency("op", 1e9)
+    names = {m["name"] for m in obs.registry().snapshot()}
+    assert "dlaf_slo_breach_total" not in names
+    assert "dlaf_serve_latency_window" in names
+
+
+def test_observe_latency_noop_when_metrics_off():
+    C.initialize()
+    assert not obs.metrics_active()
+    obs.observe_latency("op", 0.5)                # must not blow up
+    assert obs.prometheus_snapshot_text() == ""
+
+
+def test_window_gauge_q_labels_sorted_deterministically(tmp_path):
+    """The q label values sort lexicographically ascending in the
+    exposition, and two snapshots render identically (ISSUE 13 test
+    obligation)."""
+    _metrics_on(tmp_path)
+    obs.observe_latency("a", 0.1, bucket="8")
+    text = obs.prometheus_snapshot_text()
+    qs = re.findall(r'dlaf_serve_latency_window\{[^}]*q="([^"]+)"\}', text)
+    assert qs == ["0.5", "0.95", "0.99"]
+    assert obs.prometheus_snapshot_text() == text
+
+
+def test_with_policy_success_feeds_window(tmp_path):
+    _metrics_on(tmp_path)
+    from dlaf_tpu.health.policy import with_policy
+
+    assert with_policy("mysite", lambda: 41) == 41
+    snap = obs.registry().snapshot()
+    gauges = [m for m in snap if m["name"] == "dlaf_serve_latency_window"
+              and m["labels"].get("op") == "mysite"]
+    assert len(gauges) == 3        # one per quantile
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+def test_exemplar_captured_only_in_request_scope(tmp_path):
+    _metrics_on(tmp_path)
+    h = obs.histogram("lat")
+    with obs.trace_context(trace_id="aabbccdd00112233"):
+        h.observe(0.1)
+    with obs.trace_context(trace_id=["t1", "t2"], span_id="s1"):
+        h.observe(0.2)             # batch scope: never an exemplar
+    h.observe(0.3)                 # no context: no exemplar
+    snap = [m for m in obs.registry().snapshot() if m["name"] == "lat"][0]
+    exes = {tid for tid, _ in snap["exemplars"].values()}
+    assert exes == {"aabbccdd00112233"}
+
+
+def test_exemplar_text_grammar(tmp_path):
+    """Exemplar lines parse under the text-format grammar — base sample
+    first, then ``# {trace_id="..."} value`` — and the default
+    exposition (exemplars off) never emits them."""
+    _metrics_on(tmp_path)
+    with obs.trace_context(trace_id="feedface01234567"):
+        obs.histogram("lat", op="x").observe(0.1)
+    snap = obs.registry().snapshot()
+    text = prometheus_text(snap, exemplars=True)
+    ex_lines = [ln for ln in text.splitlines() if " # {" in ln]
+    assert ex_lines
+    gram = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*_bucket\{[^}]*le="[^"]+"[^}]*\} '
+        r'\d+ # \{trace_id="[0-9a-f]{1,32}"\} [0-9.eE+-]+$')
+    for ln in ex_lines:
+        assert gram.match(ln), ln
+        # stripping the exemplar clause restores the classic grammar
+        base = ln.split(" # ")[0]
+        assert re.match(r'^\S+\{[^}]*\} \d+$', base)
+    # the classic exposition (artifacts, --prom) carries no exemplars
+    assert " # {" not in prometheus_text(snap)
+    assert " # {" not in obs.prometheus_snapshot_text()
+
+
+# ---------------------------------------------------------------------------
+# trace context + sink stamping
+# ---------------------------------------------------------------------------
+
+def test_trace_context_stamps_every_record_type(tmp_path):
+    path = _metrics_on(tmp_path)
+    with obs.trace_context(trace_id="deadbeef00000001", span_id="span01"):
+        obs.emit_event("resilience", site="s", event="retry", attempt=0,
+                       delay_s=0.0, attrs={})
+        with obs.span("work"):
+            pass
+        obs.emit_event("log", level="info", logger="t", msg="m")
+    obs.emit_event("resilience", site="s", event="retry", attempt=0,
+                   delay_s=0.0, attrs={})
+    obs.flush()
+    records = obs.read_records(path)
+    inside = [r for r in records if r.get("trace_id") is not None]
+    assert {r["type"] for r in inside} >= {"resilience", "span", "log"}
+    for r in inside:
+        assert r["trace_id"] == "deadbeef00000001"
+        assert r["span_id"] == "span01"
+    outside = [r for r in records if r["type"] == "resilience"
+               and "trace_id" not in r]
+    assert outside                 # the post-context record is unstamped
+    assert not obs.validate_records(records)
+
+
+def test_trace_context_nesting_and_batch_scope():
+    from dlaf_tpu.obs.context import current_trace, trace_matches
+
+    assert current_trace() == (None, None)
+    with obs.trace_context(trace_id=["a", "b"], span_id="s1"):
+        assert current_trace() == (("a", "b"), "s1")
+        with obs.trace_context(trace_id="a"):       # request scope wins
+            assert current_trace() == ("a", "s1")   # span inherited
+        assert current_trace() == (("a", "b"), "s1")
+    assert current_trace() == (None, None)
+    assert trace_matches({"trace_id": "a"}, "a")
+    assert trace_matches({"trace_id": ["a", "b"]}, "b")
+    assert not trace_matches({"trace_id": ["a", "b"]}, "c")
+    assert not trace_matches({}, "a")
+
+
+def test_serve_trace_join_end_to_end(tmp_path):
+    """THE acceptance pin: one trace_id appears on the request's serve
+    record, the dispatch record (by membership), the span records, and
+    its accuracy record; span_id joins request to dispatch."""
+    os.environ["DLAF_ACCURACY"] = "1"
+    path = _metrics_on(tmp_path, accuracy="1")
+    q, tickets = _serve_stream(n_reqs=4, batch=2)
+    obs.flush()
+    records = obs.read_records(path)
+    assert not obs.validate_records(records, require_serve=True)
+    tid = tickets[0].trace_id
+    from dlaf_tpu.obs.context import trace_matches
+
+    mine = [r for r in records if trace_matches(r, tid)]
+    types = {r["type"] for r in mine}
+    assert {"serve", "span", "accuracy"} <= types
+    events = {r.get("event") for r in mine if r["type"] == "serve"}
+    assert events == {"request", "dispatch"}
+    req = [r for r in mine if r["type"] == "serve"
+           and r.get("event") == "request"][0]
+    disp = [r for r in mine if r["type"] == "serve"
+            and r.get("event") == "dispatch"][0]
+    # request-scoped records carry the single ID; the dispatch carries
+    # the member list; both share the dispatch's span_id
+    assert req["trace_id"] == tid
+    assert isinstance(disp["trace_id"], list) and tid in disp["trace_id"]
+    assert req["span_id"] == disp["span_id"]
+    # the dispatch's stages object is the waterfall's raw material
+    assert set(disp["stages"]) == {"compose_s", "program_s", "fetch_s",
+                                   "unpad_s"}
+    assert all(v >= 0 for v in disp["stages"].values())
+    # every ticket got a distinct trace ID
+    assert len({t.trace_id for t in tickets}) == len(tickets)
+
+
+def test_retry_records_carry_batch_trace(tmp_path):
+    path = _metrics_on(tmp_path, serve_retry_attempts=2)
+    q = Queue(buckets=(16,), batch=2, deadline_s=1e9,
+              retry_attempts=2, retry_backoff_s=0.0)
+    with inject.fail_dispatch(count=1):
+        tickets = [q.submit(Request(op="cholesky", a=_hpd(12, i)))
+                   for i in range(2)]
+    for t in tickets:
+        t.result()                 # retry recovered the dispatch
+    obs.flush()
+    records = obs.read_records(path)
+    retries = [r for r in records if r.get("type") == "resilience"
+               and r.get("event") == "retry"]
+    assert retries
+    member_ids = sorted(t.trace_id for t in tickets)
+    for r in retries:
+        assert sorted(r["trace_id"]) == member_ids      # batch scope
+        assert isinstance(r["span_id"], str)
+
+
+def test_aggregate_trace_and_top_slow_cli(tmp_path):
+    os.environ["DLAF_ACCURACY"] = "1"
+    path = _metrics_on(tmp_path, accuracy="1")
+    q, tickets = _serve_stream(n_reqs=4, batch=2)
+    obs.flush()
+    obs._reset_for_tests()
+    tid = tickets[0].trace_id
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--trace", tid], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert f"trace {tid}" in r.stdout
+    for stage in ("queue wait", "compose", "program", "fetch", "unpad"):
+        assert stage in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--top-slow", "3"], capture_output=True, text=True)
+    assert r2.returncode == 0
+    assert "slowest requests" in r2.stdout
+    assert len(re.findall(r"trace [0-9a-f]{16}", r2.stdout)) == 3
+    # unknown trace: loud, exit 1; bad N: usage, exit 2
+    assert subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--trace", "nosuchtrace"], capture_output=True).returncode == 1
+    assert subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--top-slow", "0"], capture_output=True).returncode == 2
+
+
+def test_profile_summary_requests_section(tmp_path):
+    os.environ["DLAF_ACCURACY"] = "1"
+    path = _metrics_on(tmp_path, accuracy="1")
+    q, tickets = _serve_stream(n_reqs=4, batch=2)
+    obs.flush()
+    obs._reset_for_tests()
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "profile_summary.py"), path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "== requests" in r.stdout
+    assert tickets[0].trace_id in r.stdout or "trace " in r.stdout
+    assert re.search(r"cholesky\s+\(4 reqs\): p50 .* p95 .* p99", r.stdout)
+
+
+# ---------------------------------------------------------------------------
+# live exporter
+# ---------------------------------------------------------------------------
+
+#: The Accept value Prometheus sends when exemplar scraping is on.
+OPENMETRICS_ACCEPT = "application/openmetrics-text;version=1.0.0," \
+                     "text/plain;version=0.0.4"
+
+
+def _get(port, route, accept=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{route}")
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _counters(text):
+    out = {}
+    for ln in text.splitlines():
+        if ln.startswith("#") or " " not in ln:
+            continue
+        name, val = ln.rsplit(" ", 1)
+        if "_total{" in name or name.endswith("_total") \
+                or "_count{" in name or name.endswith("_count"):
+            out[name] = float(val)
+    return out
+
+
+def test_metrics_scrape_monotone_across_two_scrapes(tmp_path):
+    """Scraping a LIVE serving process mid-stream: both scrapes parse,
+    and every counter is monotone non-decreasing between them."""
+    _metrics_on(tmp_path)
+    port = exporter.start(0)
+    q, _ = _serve_stream(n_reqs=2, batch=2, seed=0)
+    _, scrape1 = _get(port, "/metrics")
+    for i in range(2):
+        t = q.submit(Request(op="cholesky", a=_hpd(12, 50 + i)))
+    q.flush()
+    _, scrape2 = _get(port, "/metrics")
+    c1, c2 = _counters(scrape1), _counters(scrape2)
+    assert c1 and set(c1) <= set(c2)
+    for k, v in c1.items():
+        assert c2[k] >= v, k
+    assert c2['dlaf_serve_requests_total{op="cholesky"}'] == 4.0
+    # content negotiation (real Prometheus behavior): the classic 0.0.4
+    # rendering has NO exemplar clause — its grammar cannot express one
+    # and a classic scraper would fail the whole scrape on it — while
+    # an OpenMetrics Accept gets exemplars + the # EOF terminator
+    assert " # {" not in scrape2
+    _, om = _get(port, "/metrics", accept=OPENMETRICS_ACCEPT)
+    assert " # {trace_id=" in om
+    assert om.endswith("# EOF\n")
+    assert 'dlaf_serve_requests_total{op="cholesky"} 4.0' in om
+
+
+def test_healthz_roundtrips_queue_stats(tmp_path):
+    _metrics_on(tmp_path)
+    port = exporter.start(0)
+    q, _ = _serve_stream(n_reqs=2, batch=2)
+    status, body = _get(port, "/healthz")
+    payload = json.loads(body)
+    assert status == 200 and payload["status"] == "ok"
+    # the queue's stats() round-trips faithfully through the JSON,
+    # including the breaker state NAMES
+    stats = json.loads(json.dumps(q.stats()))
+    assert payload["queues"] == [stats]
+    site, bucket = next(iter(stats["buckets"].items()))
+    assert bucket["breaker"] == "closed"
+    assert payload["breakers"][site] == "closed"
+    assert payload["rank"] in (None, 0)
+    assert payload["pid"] == os.getpid()
+    assert payload["uptime_s"] >= 0
+
+
+def test_exporter_lifecycle_and_404():
+    C.initialize()
+    assert exporter.port() == 0            # knob unset: no socket
+    port = exporter.start(0)
+    assert exporter.port() == port > 0
+    status = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).status
+    assert status == 200
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/nope")
+    assert ei.value.code == 404
+    exporter.stop()
+    assert exporter.port() == 0
+    with pytest.raises(Exception):
+        _get(port, "/metrics")
+
+
+def test_exporter_via_config_knob(tmp_path):
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    free_port = s.getsockname()[1]
+    s.close()
+    _metrics_on(tmp_path, metrics_port=free_port)
+    assert exporter.port() == free_port
+    status, _ = _get(free_port, "/metrics")
+    assert status == 200
+    # reconfiguring the knob to 0 stops the server
+    C.initialize(C.Configuration(log="off"))
+    assert exporter.port() == 0
+
+
+def test_metrics_port_arms_registry_without_sink():
+    """A scrape-only deployment (port set, no metrics path) still
+    records: metrics_active() is on and the scrape shows counters."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    free_port = s.getsockname()[1]
+    s.close()
+    C.initialize(C.Configuration(metrics_port=free_port, log="off"))
+    assert obs.metrics_active()
+    obs.counter("scrape_only_total").inc()
+    _, text = _get(free_port, "/metrics")
+    assert "scrape_only_total 1" in text
+
+
+def test_healthz_failure_trips_flight(tmp_path):
+    path = _metrics_on(tmp_path, flight_recorder=32)
+    port = exporter.start(0)
+    q, _ = _serve_stream(n_reqs=2, batch=2)
+    q.stats = lambda: 1 / 0                # break the payload build
+    flight_path = path + ".flight.jsonl"
+    assert not os.path.exists(flight_path)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(port, "/healthz")
+    assert ei.value.code == 500
+    assert os.path.exists(flight_path)
+    header = obs.read_records(flight_path)[0]
+    assert header["type"] == "flight_trigger"
+    assert header["reason"] == "healthz_failure"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_bounded_and_dump(tmp_path):
+    clock = FakeClock()
+    dump = str(tmp_path / "dump.flight.jsonl")
+    rec = obs.FlightRecorder(capacity=5, path=dump, clock=clock)
+    for i in range(12):
+        rec.capture({"v": 1, "type": "log", "ts": float(i),
+                     "level": "info", "logger": "t", "msg": str(i),
+                     "i": i})
+    out = rec.trigger("overload_shed", depth=9)
+    assert out == dump
+    records = obs.read_records(dump)
+    header, body = records[0], records[1:]
+    assert header["type"] == "flight_trigger"
+    assert header["reason"] == "overload_shed"
+    assert header["records"] == 5 and header["dump_seq"] == 1
+    assert header["attrs"] == {"depth": 9}
+    assert [r["i"] for r in body] == [7, 8, 9, 10, 11]   # the LAST 5
+    # the dump artifact itself passes --require-flight
+    assert not obs.validate_records(records, require_flight=True)
+
+
+def test_flight_cooldown_per_reason(tmp_path):
+    clock = FakeClock()
+    dump = str(tmp_path / "dump.flight.jsonl")
+    rec = obs.FlightRecorder(capacity=4, path=dump, cooldown_s=60.0,
+                             clock=clock)
+    rec.capture({"v": 1, "type": "log", "ts": 0.0, "level": "info",
+                 "logger": "t", "msg": "m"})
+    assert rec.trigger("overload_shed") == dump
+    clock.t = 10.0
+    assert rec.trigger("overload_shed") is None        # cooled down
+    assert rec.trigger("breaker_open") == dump         # new reason lands
+    assert rec.dump_seq == 2
+    clock.t = 70.1
+    assert rec.trigger("overload_shed") == dump        # cooldown elapsed
+    assert obs.read_records(dump)[0]["dump_seq"] == 3
+
+
+def test_flight_trigger_unarmed_is_noop(tmp_path):
+    path = _metrics_on(tmp_path)                       # no knob
+    assert flight.trigger("breaker_open") is None
+    assert not os.path.exists(path + ".flight.jsonl")
+
+
+def test_flight_requires_sink_warns(tmp_path):
+    C.initialize(C.Configuration(flight_recorder=16))
+    from dlaf_tpu.obs._state import STATE
+
+    assert STATE.flight is None                        # unarmed, warned
+
+
+def test_clean_serve_run_writes_no_flight_artifact(tmp_path):
+    """The must-NOT-trip leg: an armed recorder on a clean stream dumps
+    nothing — the artifact's existence IS the incident signal."""
+    path = _metrics_on(tmp_path, flight_recorder=64)
+    _serve_stream(n_reqs=4, batch=2)
+    obs.flush()
+    assert not os.path.exists(path + ".flight.jsonl")
+
+
+def test_breaker_open_trips_flight_with_context(tmp_path):
+    """Sustained dispatch failure -> breaker opens -> the dump exists,
+    passes --require-flight, and holds the PRE-trigger serve/resilience
+    records (the CI drill's contract)."""
+    path = _metrics_on(tmp_path, flight_recorder=64, circuit_threshold=2)
+    q = Queue(buckets=(16,), batch=1, deadline_s=1e9,
+              retry_attempts=1, retry_backoff_s=0.0)
+    q.submit(Request(op="cholesky", a=_hpd(12))).result()   # warm + clean
+    flight_path = path + ".flight.jsonl"
+    assert not os.path.exists(flight_path)
+    with inject.fail_dispatch(count=100):
+        for i in range(3):
+            try:
+                q.submit(Request(op="cholesky", a=_hpd(12, i)))
+            except Exception:
+                pass
+    assert os.path.exists(flight_path)
+    records = obs.read_records(flight_path)
+    assert not obs.validate_records(records, require_flight=True)
+    header = records[0]
+    assert header["reason"] == "breaker_open"
+    body_types = {r["type"] for r in records[1:]}
+    assert "serve" in body_types          # the pre-trigger dispatches
+    opens = [r for r in records[1:] if r.get("type") == "resilience"
+             and r.get("event") == "circuit_open"]
+    assert opens                          # the opening itself is in-ring
+
+
+def test_overload_shed_trips_flight_once_per_burst(tmp_path):
+    path = _metrics_on(tmp_path, flight_recorder=64)
+    clock = FakeClock()
+    q = Queue(buckets=(16,), batch=64, deadline_s=1e9, max_depth=2,
+              shed=True, clock=clock)
+    q.submit(Request(op="cholesky", a=_hpd(12, 0)))
+    q.submit(Request(op="cholesky", a=_hpd(12, 1)))
+    flight_path = path + ".flight.jsonl"
+    n_shed = 0
+    for i in range(5):                    # a shed burst
+        with pytest.raises(health.OverloadError):
+            q.submit(Request(op="cholesky", a=_hpd(12, 2 + i)))
+        n_shed += 1
+    assert os.path.exists(flight_path)
+    header = obs.read_records(flight_path)[0]
+    assert header["reason"] == "overload_shed"
+    # per-reason cooldown: the burst dumped ONCE (fake clock never moved)
+    assert header["dump_seq"] == 1
+    shed_records = [r for r in obs.read_records(flight_path)[1:]
+                    if r.get("event") == "shed"]
+    assert shed_records               # the first shed is in its own dump
+
+
+def test_factorization_exhausted_trips_flight(tmp_path):
+    path = _metrics_on(tmp_path, flight_recorder=32)
+    a = _hpd(8)
+    a[2, 1] = a[1, 2] = np.nan            # unrecoverable by shifting
+    m = Matrix.from_global(a, TileElementSize(4, 4))
+    with pytest.raises(health.FactorizationError):
+        health.robust_cholesky("L", m, max_attempts=2)
+    flight_path = path + ".flight.jsonl"
+    assert os.path.exists(flight_path)
+    header = obs.read_records(flight_path)[0]
+    assert header["reason"] == "factorization_exhausted"
+    assert header["attrs"]["attempts"] == 2
+
+
+def test_accuracy_breach_trips_flight(tmp_path):
+    path = _metrics_on(tmp_path, flight_recorder=32, accuracy="1")
+    from dlaf_tpu.obs import accuracy as acc
+
+    # bound_ratio > 1: value far above c * n * eps
+    acc.emit("test", "cholesky_residual", 1.0, n=8, nb=4,
+             dtype=np.float64, c=60.0)
+    flight_path = path + ".flight.jsonl"
+    assert os.path.exists(flight_path)
+    records = obs.read_records(flight_path)
+    assert records[0]["reason"] == "accuracy_breach"
+    # the breaching accuracy record itself is inside the dump
+    assert any(r.get("type") == "accuracy" for r in records[1:])
+
+
+# ---------------------------------------------------------------------------
+# schema / validator
+# ---------------------------------------------------------------------------
+
+def _base(rtype, **kw):
+    return {"v": 1, "type": rtype, "ts": 0.0, **kw}
+
+
+def test_trace_stamp_schema_validation():
+    ok = _base("log", level="info", logger="x", msg="m")
+    assert not obs.validate_records([dict(ok, trace_id="abc")])
+    assert not obs.validate_records([dict(ok, trace_id=["a", "b"],
+                                          span_id="s")])
+    for bad in ({"trace_id": ""}, {"trace_id": []}, {"trace_id": ["a", ""]},
+                {"trace_id": 7}, {"span_id": ""}, {"span_id": 3}):
+        errs = obs.validate_records([dict(ok, **bad)])
+        assert errs, bad
+
+
+def test_dispatch_stages_schema_validation():
+    disp = _base("serve", event="dispatch", op="cholesky", bucket_n=16,
+                 nrhs=0, dtype="float64", lanes=2, batch=2, cache="hit",
+                 dispatch_s=0.1)
+    assert not obs.validate_records([dict(disp)])
+    good = dict(disp, stages={"compose_s": 0.0, "program_s": 0.09,
+                              "fetch_s": 0.01, "unpad_s": 0.0})
+    assert not obs.validate_records([good])
+    assert obs.validate_records([dict(disp, stages="nope")])
+    assert obs.validate_records(
+        [dict(disp, stages={"compose_s": -1.0})])
+    assert obs.validate_records(
+        [dict(disp, stages={"compose_s": float("nan")})])
+
+
+def test_require_flight_obligations():
+    trig = _base("flight_trigger", reason="breaker_open", dump_seq=1,
+                 records=1, attrs={})
+    ctx = _base("log", level="info", logger="x", msg="m")
+    assert not obs.validate_records([trig, ctx], require_flight=True)
+    # no trigger record: fails
+    assert obs.validate_records([ctx], require_flight=True)
+    # trigger but no captured context: fails (the ring was empty)
+    assert obs.validate_records([trig], require_flight=True)
+    # unknown reason: schema error
+    assert obs.validate_records(
+        [dict(trig, reason="bad_reason"), ctx])
+    # malformed dump_seq
+    assert obs.validate_records([dict(trig, dump_seq="x"), ctx])
+    # the flag is wired through the CLI (unreadable path = INVALID, 1)
+    r = subprocess.run([sys.executable, "-m", "dlaf_tpu.obs.validate",
+                        "--require-flight", "/nonexistent.jsonl"],
+                       capture_output=True)
+    assert r.returncode == 1
+
+
+def test_prometheus_snapshot_text_noop_when_inactive(tmp_path):
+    """The documented zero-work no-op pin (ISSUE 13 satellite): a
+    registry may exist from an annotate-only configuration, but with
+    metrics_active() false the exposition is ''."""
+    C.initialize(C.Configuration(trace_dir=str(tmp_path / "tr"),
+                                 log="off"))
+    from dlaf_tpu.obs._state import STATE
+
+    assert STATE.registry is not None      # annotate mode has a registry
+    assert not obs.metrics_active()
+    assert obs.prometheus_snapshot_text() == ""
+
+
+def test_config_knob_validation():
+    for bad in (dict(metrics_port=-1), dict(metrics_port=70000),
+                dict(slo_p99_ms=-1.0), dict(slo_window_s=0.0),
+                dict(flight_recorder=-2)):
+        with pytest.raises(ValueError):
+            C.initialize(C.Configuration(**bad))
+        C.finalize()
+    # env layer round-trip
+    os.environ["DLAF_SLO_P99_MS"] = "250"
+    os.environ["DLAF_FLIGHT_RECORDER"] = "128"
+    C.finalize()
+    cfg = C.initialize()
+    assert cfg.slo_p99_ms == 250.0
+    assert cfg.flight_recorder == 128
