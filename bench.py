@@ -519,6 +519,46 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
     print(json.dumps(line), flush=True)
 
 
+def _emit_devtrace(variant: str) -> None:
+    """Traced bench run (DLAF_TRACE_DIR armed + a metrics sink): stop
+    the process trace so the profiler artifact lands, attribute the
+    device timeline to this arm's spans (dlaf_tpu.obs.devtrace, ISSUE
+    14), and append the devtrace/measured_overlap records to the SAME
+    artifact — so a traced bench arm's artifact passes
+    ``--require-devtrace`` and feeds ``scripts/perf_diff.py`` with
+    measured per-phase device walls next to its bench_result. No-op on
+    untraced runs; never fails the measurement (the number already
+    landed)."""
+    from dlaf_tpu import obs
+    from dlaf_tpu.obs._state import STATE
+
+    trace_root = STATE.trace_dir
+    if not STATE.profiler_started or STATE.sink is None or not trace_root:
+        return
+    # NOTHING here may fail the child: the bench_result already flushed,
+    # and the parent drops a nonzero-rc child's landed measurement — so
+    # the whole post-measurement path (profiler stop, trace parse,
+    # attribution, the sink writes themselves) degrades to a log line
+    try:
+        obs.stop_profiler()        # flush the profiler artifact to disk
+        from dlaf_tpu.obs import devtrace
+
+        path = devtrace.newest_trace(trace_root)
+        records = obs.read_records(STATE.sink.path)
+        report = devtrace.attribute(devtrace.load_trace(path), records)
+        for rec in devtrace.records_from_report(report, path):
+            obs.emit_event(rec.pop("type"), **rec)
+        obs.flush()
+    except SystemExit as e:        # newest_trace's empty-dir signal
+        log(f"[{variant}] devtrace attribution skipped: {e}")
+        return
+    except Exception as e:
+        log(f"[{variant}] devtrace attribution skipped: {e!r}")
+        return
+    log(f"[{variant}] devtrace: coverage {report['coverage'] * 100:.1f}%, "
+        f"{len(report['overlap'])} measured_overlap record(s)")
+
+
 def run_variant() -> None:
     """Child: measure ONE trailing variant (env DLAF_BENCH_VARIANT), print
     one JSON line {variant, platform, dtype, n, nb, gflops, t, ts, source,
@@ -543,6 +583,7 @@ def run_variant() -> None:
     if base.split("+")[0] in STAGE_BASES:
         parts = base.split("+")
         _run_stage_variant(variant, parts[0], set(parts[1:]))
+        _emit_devtrace(variant)
         return
     os.environ.setdefault("DLAF_CHOLESKY_LOOKAHEAD", la or "0")
     # "ozaki_concat"/"ozaki_dots" = the ozaki trailing with the group form
@@ -651,6 +692,7 @@ def run_variant() -> None:
 
     obs.emit_event("bench_result", payload=line)
     obs.flush()
+    _emit_devtrace(variant)
     print(json.dumps(line), flush=True)
 
 
@@ -871,8 +913,21 @@ def sweep(platform: str) -> None:
             else:
                 log(f"[{variant}] child rc={proc.returncode}, no result")
         except subprocess.TimeoutExpired:
-            log(f"[{variant}] timed out after {VARIANT_TIMEOUT_S}s; killed "
-                "(measurements from other variants are unaffected)")
+            # the measurement may already have landed: the child flushes
+            # its bench_result to the line-buffered artifact BEFORE the
+            # post-measurement work (accuracy probe, devtrace
+            # attribution of a large trace) that can eat the rest of the
+            # budget — a timeout there must not discard a landed number
+            line = read_bench_result(art)
+            if line is not None:
+                results.append(line)
+                log(f"[{variant}] timed out after {VARIANT_TIMEOUT_S}s "
+                    "AFTER its measurement landed; result recovered from "
+                    "the artifact")
+            else:
+                log(f"[{variant}] timed out after {VARIANT_TIMEOUT_S}s; "
+                    "killed (measurements from other variants are "
+                    "unaffected)")
         except Exception as e:
             log(f"[{variant}] failed: {e!r}")
     if not results:

@@ -24,6 +24,26 @@ export JAX_PLATFORMS=cpu
 
 case "$TIER" in
   smoke)
+    # post-mortem evidence (ISSUE 14 satellite): every leg registers its
+    # scratch dirs here; on ANY smoke failure the trap copies them into
+    # one repo-local smoke_artifacts/ dir (gitignored) instead of
+    # leaving the devtrace/flight/merged-JSONL evidence scattered in
+    # per-leg mktemp dirs under /tmp
+    SMOKE_KEEP=()
+    archive_smoke_artifacts() {
+      rc=$?
+      if [ "$rc" -ne 0 ] && [ "${#SMOKE_KEEP[@]}" -gt 0 ]; then
+        dest="smoke_artifacts"
+        rm -rf "$dest"; mkdir -p "$dest"
+        for p in "${SMOKE_KEEP[@]}"; do
+          if [ -e "$p" ]; then cp -r "$p" "$dest/" || true; fi
+        done
+        echo "smoke FAILED (rc=$rc): evidence archived in $dest/" >&2
+        ls "$dest" >&2
+      fi
+      exit "$rc"
+    }
+    trap archive_smoke_artifacts EXIT
     python -m pytest tests/ -q -m quick
     echo "== smoke: miniapp_cholesky observability artifact =="
     # distributed run on a 2x2 virtual-CPU grid so the artifact carries
@@ -42,11 +62,16 @@ case "$TIER" in
     # docs/accuracy.md): every timed run probes its factor in-graph and
     # the merged artifact must carry the accuracy records
     # (--require-accuracy) that scripts/accuracy_gate.py gates below
+    # device-timeline attribution rides the same run (ISSUE 14): the
+    # trace dir arms the jax.profiler Chrome trace that obs.devtrace
+    # attributes below — per-phase device walls, measured overlap,
+    # coverage — gated by --require-devtrace
     OBS_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$OBS_DIR")
     OBS_ART="$OBS_DIR/miniapp_cholesky.r%r.jsonl"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
       DLAF_METRICS_PATH="$OBS_ART" DLAF_PROGRAM_TELEMETRY=1 \
-      DLAF_ACCURACY=1 \
+      DLAF_ACCURACY=1 DLAF_TRACE_DIR="$OBS_DIR/trace" \
       DLAF_CHOLESKY_LOOKAHEAD=1 DLAF_COMM_LOOKAHEAD=1 \
       python -m dlaf_tpu.miniapp.miniapp_cholesky -m 256 -b 64 \
         --grid-rows 2 --grid-cols 2 --nruns 2
@@ -74,6 +99,72 @@ for i, p in enumerate(sorted(glob.glob(f"{d}/miniapp_cholesky.r*.jsonl"))):
 assert ranks and span_pids >= ranks, (ranks, span_pids)
 print(f"chrome trace ok: {len(evs)} events, span ranks {sorted(span_pids)}")
 EOF
+    echo "== smoke: device-timeline attribution (obs.devtrace, ISSUE 14) =="
+    # the traced 2x2 run's profiler artifact, attributed end-to-end: the
+    # enriched artifact must carry >= 1 finite measured_overlap record
+    # with positive collective time AND coverage >= the documented floor
+    # (sinks.DEVTRACE_COVERAGE_FLOOR) — --require-devtrace gates both
+    python -m dlaf_tpu.obs.devtrace "$OBS_DIR/trace" \
+      "$OBS_DIR/merged.jsonl" -o "$OBS_DIR/devtrace.jsonl" \
+      | tee "$OBS_DIR/devtrace_report.txt"
+    grep -q "MXU-overlapped" "$OBS_DIR/devtrace_report.txt"
+    python -m dlaf_tpu.obs.validate "$OBS_DIR/devtrace.jsonl" \
+      --require-devtrace
+    # profile_summary's trace mode shares the parser (single owner) and
+    # must print the per-phase attribution section for the same join
+    python scripts/profile_summary.py "$OBS_DIR/trace" 10 \
+      --jsonl "$OBS_DIR/merged.jsonl" > "$OBS_DIR/profile_summary.txt"
+    grep -q "device-time attribution" "$OBS_DIR/profile_summary.txt"
+    grep -q "coverage" "$OBS_DIR/profile_summary.txt"
+    echo "== smoke: perf_diff must-trip drill (regression explainer) =="
+    # identity diff must pass; an injected slowdown on the cholesky
+    # phase must exit SPECIFICALLY 1 with the phase NAMED in a
+    # REGRESSION line — the gate-to-diagnosis contract bench_gate's
+    # verdict points at
+    python scripts/perf_diff.py "$OBS_DIR/devtrace.jsonl" \
+      "$OBS_DIR/devtrace.jsonl"
+    drill_rc=0
+    python scripts/perf_diff.py "$OBS_DIR/devtrace.jsonl" \
+      "$OBS_DIR/devtrace.jsonl" --inject-slowdown cholesky=0.5 \
+      > "$OBS_DIR/perf_diff_drill.log" 2>&1 || drill_rc=$?
+    if [ "$drill_rc" -ne 1 ] \
+        || ! grep -q "REGRESSION.*cholesky" "$OBS_DIR/perf_diff_drill.log"; then
+      echo "perf_diff drill did not name the injected phase" \
+           "(rc=$drill_rc, wanted rc=1 + REGRESSION naming cholesky)" >&2
+      cat "$OBS_DIR/perf_diff_drill.log" >&2; exit 1
+    fi
+    echo "perf_diff correctly named the injected regressing phase"
+    # zero-attribution rejection drill: a trace stripped of its
+    # collectives attributes NO collective time — the devtrace artifact
+    # it produces must be REJECTED by --require-devtrace
+    python - "$OBS_DIR" <<'EOF'
+import json, sys
+from dlaf_tpu.obs import devtrace
+from dlaf_tpu.obs.aggregate import merge_artifacts
+d = sys.argv[1]
+events = [e for e in devtrace.load_trace(f"{d}/trace")
+          if devtrace.classify_op(e.get("name", ""))[0] != "collective"]
+records = merge_artifacts([f"{d}/merged.jsonl"])
+report = devtrace.attribute(events, records)
+assert not report["overlap"], "stripped trace still attributed collectives"
+with open(f"{d}/devtrace_nocoll.jsonl", "w") as f:
+    for r in devtrace.records_from_report(report, "stripped.json.gz"):
+        f.write(json.dumps(r) + "\n")
+print("zero-collective artifact written")
+EOF
+    if python -m dlaf_tpu.obs.validate "$OBS_DIR/devtrace_nocoll.jsonl" \
+        --require-devtrace > /dev/null 2>&1; then
+      echo "--require-devtrace FAILED to reject the zero-attribution" \
+           "artifact" >&2; exit 1
+    fi
+    echo "--require-devtrace correctly rejected the zero-attribution artifact"
+    echo "== smoke: measured-MFU replay (mfu_table --measured fixture) =="
+    # the committed devtrace fixture must replay hermetically into the
+    # measured(dev) column (CPU-labeled, BASELINE.md acceptance)
+    python scripts/mfu_table.py --no-ici --measured \
+      > "$OBS_DIR/mfu_measured.txt"
+    grep -q "measured(dev) GF/s" "$OBS_DIR/mfu_measured.txt"
+    grep -Eq "cpu [0-9]+/[0-9]+" "$OBS_DIR/mfu_measured.txt"
     echo "== smoke: bench-regression gate (replay + injection drill) =="
     # clean replay of the committed history must pass; a 20% synthetic
     # slowdown must trip the gate (exit nonzero) — proving the gate
@@ -112,7 +203,9 @@ EOF
     # robust_cholesky.attempt spans), and an injected native-load failure
     # must degrade to numpy (leaving a dlaf_fallback_total counter); the
     # validator fails the tier unless the artifact records BOTH
-    HEALTH_ART=$(mktemp -d)/health_metrics.jsonl
+    HEALTH_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$HEALTH_DIR")
+    HEALTH_ART="$HEALTH_DIR/health_metrics.jsonl"
     DLAF_METRICS_PATH="$HEALTH_ART" python - <<'EOF'
 import numpy as np
 import dlaf_tpu.config as C
@@ -149,7 +242,9 @@ EOF
     # the artifact must carry the trace-time
     # dlaf_panel_kernel_total{impl="fused"} counters AND a finite
     # accuracy record next to them
-    PANEL_ART=$(mktemp -d)/panel_metrics.jsonl
+    PANEL_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$PANEL_DIR")
+    PANEL_ART="$PANEL_DIR/panel_metrics.jsonl"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
       DLAF_METRICS_PATH="$PANEL_ART" DLAF_PANEL_IMPL=fused DLAF_ACCURACY=1 \
       python - <<'EOF'
@@ -271,6 +366,7 @@ EOF
     # clean stream must produce NO flight artifact, and one request's
     # trace ID is saved for the aggregate --trace waterfall check below
     SERVE_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$SERVE_DIR")
     SERVE_ART="$SERVE_DIR/serve_metrics.jsonl"
     SERVE_PORT=${DLAF_CI_METRICS_PORT:-$((18000 + RANDOM % 2000))}
     DLAF_METRICS_PATH="$SERVE_ART" DLAF_PROGRAM_TELEMETRY=1 \
@@ -594,6 +690,7 @@ EOF
     # reference BITWISE; the shared artifact must then validate under
     # --require-resilience (resume records present, no breaker open)
     RESUME_TMP=$(mktemp -d)
+    SMOKE_KEEP+=("$RESUME_TMP")
     RESIL_ART="$RESUME_TMP/resilience.jsonl"
     python - "$RESUME_TMP" <<'EOF'
 import sys
@@ -671,6 +768,7 @@ EOF
     # artifact must be REJECTED by --require-resilience (breaker left
     # open), proving the gate has teeth
     RETRY_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$RETRY_DIR")
     DLAF_METRICS_PATH="$RETRY_DIR/retry.jsonl" python - <<'EOF'
 import numpy as np
 import dlaf_tpu.config as C
@@ -787,7 +885,9 @@ EOF
     # counters (dlaf_comm_overlapped_total{algo=bt_*}) — the audit trail
     # that the batched/pipelined programs were actually built
     # (docs/eigensolver_perf.md)
-    EIG_ART=$(mktemp -d)/eigensolver_metrics.jsonl
+    EIG_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$EIG_DIR")
+    EIG_ART="$EIG_DIR/eigensolver_metrics.jsonl"
     XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
       DLAF_METRICS_PATH="$EIG_ART" \
       DLAF_DC_LEVEL_BATCH=1 DLAF_BT_LOOKAHEAD=1 DLAF_DIST_STEP_MODE=unrolled \

@@ -534,6 +534,16 @@ class Configuration:
     #: buckets (bounded memory, deterministic under an injected clock;
     #: dlaf_tpu.obs.metrics.SlidingWindow).
     slo_window_s: float = 60.0
+    #: SLO breach-burst flight trigger threshold (``DLAF_SLO_BURST``,
+    #: ISSUE 14): when at least this many ``dlaf_slo_breach_total``
+    #: breaches land inside ONE rolling SLO window (``slo_window_s``,
+    #: per op), the flight recorder dumps its ring with reason
+    #: ``slo_breach_burst`` — once per recorder cooldown, so a sustained
+    #: latency storm leaves ONE incident artifact holding the moments
+    #: before the burst instead of a thousand re-dumps. Needs
+    #: ``DLAF_FLIGHT_RECORDER`` armed (and ``DLAF_SLO_P99_MS`` set —
+    #: no objective, no breaches). 0 disables the trigger.
+    slo_burst: int = 5
     #: Flight-recorder ring depth (``DLAF_FLIGHT_RECORDER``): keep the
     #: last N JSONL records in memory (all types, pre-serialization) and
     #: dump them atomically to ``<metrics_path>.flight.jsonl`` on
@@ -671,6 +681,9 @@ def _validate(cfg: Configuration) -> None:
     if not cfg.slo_window_s > 0:
         raise ValueError(f"slo_window_s={cfg.slo_window_s}: must be > 0 "
                          "(the rolling quantile window length)")
+    if cfg.slo_burst < 0:
+        raise ValueError(f"slo_burst={cfg.slo_burst}: must be >= 0 "
+                         "(0 = breach-burst flight trigger off)")
     if cfg.flight_recorder < 0:
         raise ValueError(f"flight_recorder={cfg.flight_recorder}: must be "
                          ">= 0 (0 = flight recorder off; N = ring depth)")

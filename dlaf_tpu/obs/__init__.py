@@ -73,7 +73,21 @@ __all__ = [
     "trace_context", "current_trace", "new_trace_id", "new_span_id",
     "single_trace_id", "trace_matches", "observe_latency", "quantile",
     "SlidingWindow", "FlightRecorder", "exporter",
+    # ISSUE 14: device-timeline attribution
+    "devtrace",
 ]
+
+
+def __getattr__(name: str):
+    # lazy submodule: ``obs.devtrace`` is an offline analysis engine
+    # (ISSUE 14) never needed on the record-emitting hot path, and an
+    # eager import here would trip runpy's found-in-sys.modules warning
+    # on every ``python -m dlaf_tpu.obs.devtrace`` invocation
+    if name == "devtrace":
+        import importlib
+
+        return importlib.import_module(".devtrace", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def configure(log_level: str = "info", metrics_path: str = "",

@@ -353,6 +353,28 @@ def trace_report(records, trace_id: str) -> list:
     return lines
 
 
+def devtrace_rows(records) -> list:
+    """Printable lines for any ``devtrace``/``measured_overlap`` records
+    riding in the merged artifact (:mod:`dlaf_tpu.obs.devtrace` writes
+    them; the full report lives in that CLI — this is the merge view)."""
+    lines = []
+    for r in records:
+        if r.get("type") == "devtrace":
+            lines.append(
+                f"trace {r.get('trace', '?')}: device busy "
+                f"{(r.get('device_busy_s') or 0.0) * 1e3:.2f} ms, "
+                f"coverage {(r.get('coverage') or 0.0) * 100:.1f}% "
+                f"(join={r.get('join', '?')}, rank {r.get('rank', 0)})")
+    for r in records:
+        if r.get("type") == "measured_overlap":
+            lines.append(
+                f"  {r.get('algo', '?')}/{r.get('axis', '?')}: "
+                f"{(r.get('overlap_frac') or 0.0) * 100:.1f}% of "
+                f"{(r.get('collective_s') or 0.0) * 1e3:.2f} ms "
+                "collective time MXU-overlapped")
+    return lines
+
+
 def collective_imbalance(records) -> list:
     """Cross-rank imbalance of the collective counters: for each
     (counter name, kind, axis) in each rank's LAST metrics snapshot,
@@ -632,6 +654,12 @@ def main(argv=None) -> int:
                 else f"{row['ratio']:.3f}"
             print(f"  {row['name']}{{kind={row['kind']},axis={row['axis']}}}"
                   f": {per}  max/min={ratio}")
+
+    dt = devtrace_rows(view)
+    if dt:
+        print("\n== device-timeline attribution (obs.devtrace) ==")
+        for line in dt:
+            print(f"  {line}")
 
     ov = overlap_report(view)
     if ov["rank_wall_s"]:

@@ -1,0 +1,629 @@
+"""Device-timeline attribution from profiler traces (ISSUE 14).
+
+Every overlap claim so far is structural (jaxpr pins: the hoisted
+collective programs were *emitted*) and every MFU number is modeled
+(``scripts/mfu_table.py`` rooflines) or wall-clock-derived. This module
+reads the artifact a ``DLAF_TRACE_DIR`` run already lands — the
+``plugins/profile/<ts>/*.trace.json.gz`` Chrome trace the span tracer
+writes via ``create_perfetto_trace`` — and turns it into *measured*
+per-phase device facts:
+
+* **op classification** — every device-track interval is classified from
+  its XLA op name (:func:`classify_op`): MXU work (``dot``/``conv``/
+  solver ops and the fusions that contain one), collectives by kind
+  (``all-reduce``, ``all-gather``, ``all-to-all``, ``collective-permute``,
+  ``reduce-scatter``, ...), data movement (copies, transposes, slices),
+  host callbacks (``custom-call``/infeed/outfeed), and residual
+  elementwise compute. Device events are recognized by their
+  ``hlo_op``/``hlo_module`` args (XLA:CPU thunk events) or by a
+  ``/device:`` process name (TPU traces); profiler-infrastructure
+  events (``ThunkExecutor::...``) are never ops.
+* **phase join** — device intervals are attributed to algorithm phases
+  through the host-span windows: the ``jax.profiler.TraceAnnotation``
+  mirrors of the JSONL span records live on the host threads of the SAME
+  trace clock, so the join needs no cross-clock arithmetic. The merged
+  ``DLAF_METRICS_PATH`` artifact supplies the span-name *vocabulary*
+  (host threads also carry thousands of jax-internal events — ``dce``,
+  ``cholesky_expander`` — that must not become phases), the flop models,
+  and the knob attrs. When a trace carries no annotation mirrors
+  (third-party traces), the fallback join rebases the JSONL spans with
+  :func:`dlaf_tpu.obs.aggregate.rebase_per_rank` (the ``--align``
+  machinery) and the device events to the trace origin, matching windows
+  on the rebased clocks.
+* **measured overlap** — per attributed phase (``algo``): the fraction
+  of collective device time that coincides with MXU-busy time in the
+  same overlap domain (one device = one trace process on TPU, one
+  executor thread on XLA:CPU — CPU thunks run serially, so CPU CI pins
+  report *structure*: finite fractions, coverage, schema). The ``axis``
+  field is ``"all"``: a Chrome trace carries no replica-group metadata,
+  so the per-mesh-axis split of the ``dlaf_comm_overlapped_total``
+  trace-time counters is not recoverable here (documented in
+  docs/observability.md).
+* **measured MFU** — entry-span flop models joined to the phase's
+  attributed device-busy wall (union across tracks): the denominator of
+  ``scripts/mfu_table.py --measured``, device time instead of host wall.
+
+Two JSONL record types land in the schema (:mod:`dlaf_tpu.obs.sinks`):
+one ``devtrace`` summary (per-phase busy walls, attribution coverage)
+and one ``measured_overlap`` record per (algo, axis) with positive
+attributed collective time. ``python -m dlaf_tpu.obs.validate
+--require-devtrace`` gates on them: >= 1 finite ``measured_overlap``
+record with positive collective time, coverage >=
+:data:`~dlaf_tpu.obs.sinks.DEVTRACE_COVERAGE_FLOOR`, no NaN walls — an
+artifact whose trace attributed ZERO collectives must be rejected, not
+scraped as "overlap measured".
+
+CLI::
+
+    python -m dlaf_tpu.obs.devtrace <trace.json[.gz] | profile_dir> \\
+        merged.r0.jsonl [more.jsonl ...] [-o enriched.jsonl] \\
+        [--json report.json] [--distill small.trace.json.gz] [--top N]
+
+Prints the attribution report; ``-o`` writes the input records plus the
+new ``devtrace``/``measured_overlap`` records (the enriched artifact
+``scripts/perf_diff.py`` diffs); ``--distill`` writes a reduced trace
+(metadata + device ops + span-window host events only) — the committed
+fixture convention under ``tests/fixtures/devtrace/``, small enough for
+git, replayable without hardware. ``scripts/profile_summary.py``'s
+trace mode routes through this module (:func:`newest_trace`,
+:func:`track_tables`) — single parser owner, not a fork.
+
+Exit status: 0 = report produced; 1 = unreadable trace/artifact or a
+trace with no device op events (an empty attribution must fail loudly);
+2 = usage.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+#: Collective op-name prefixes -> kind label (XLA HLO spelling; checked
+#: before every other category so ``all-gather`` never classifies as a
+#: data-movement ``gather``).
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "all-to-all",
+                    "reduce-scatter", "collective-permute",
+                    "collective-broadcast", "send", "recv")
+
+#: Name tokens that mark MXU work (dots, convolutions, the solver ops,
+#: and any fusion whose name embeds one — XLA names fusions after their
+#: constituent ops, e.g. ``bitcast_dot_fusion.1``).
+MXU_TOKENS = ("dot", "conv", "cholesky", "triangular-solve", "einsum")
+
+#: Name tokens for data movement (copies/layout changes). ``slice``
+#: covers ``dynamic-slice`` and ``dynamic-update-slice``.
+COPY_TOKENS = ("copy", "transpose", "bitcast", "slice", "concatenate",
+               "gather", "scatter", "broadcast", "reshape", "pad")
+
+#: Name tokens for host round trips.
+HOST_TOKENS = ("custom-call", "infeed", "outfeed", "host-")
+
+#: Classification categories, display order.
+CATEGORIES = ("mxu", "collective", "copy", "host_callback", "compute")
+
+
+def classify_op(name: str):
+    """``(category, kind)`` for one XLA op name — ``kind`` is the
+    collective kind for collectives, None otherwise. Returns ``(None,
+    None)`` for profiler-infrastructure events (``::``-qualified C++
+    names, spaced descriptions) that are not ops."""
+    if not name or "::" in name or " " in name:
+        return None, None
+    base = name.split(".")[0]
+    for kind in COLLECTIVE_KINDS:
+        if base.startswith(kind) or f"_{kind}" in base:
+            return "collective", kind
+    for tok in HOST_TOKENS:
+        if tok in base:
+            return "host_callback", None
+    for tok in MXU_TOKENS:
+        if tok in base:
+            return "mxu", None
+    for tok in COPY_TOKENS:
+        if tok in base:
+            return "copy", None
+    return "compute", None
+
+
+def newest_trace(root: str) -> str:
+    """Newest ``*.trace.json.gz`` under ``root`` (the
+    ``plugins/profile/<ts>/`` discovery convention of a
+    ``DLAF_TRACE_DIR`` run). Prefers the Chrome trace over the perfetto
+    one at equal recency (both carry the events; the Chrome one names
+    processes in metadata events). Single owner — the
+    ``scripts/profile_summary.py`` copy now lives here."""
+    cands = sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json.gz"),
+                  recursive=True) +
+        glob.glob(os.path.join(root, "**", "perfetto_trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime)
+    if not cands:
+        raise SystemExit(f"no *.trace.json.gz under {root}")
+    chrome = [c for c in cands if not c.endswith("perfetto_trace.json.gz")]
+    return (chrome or cands)[-1]
+
+
+def load_trace(path: str) -> list:
+    """Trace events from a Chrome trace file (gzipped or plain JSON; a
+    directory is resolved through :func:`newest_trace`)."""
+    if os.path.isdir(path):
+        path = newest_trace(path)
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def _meta_maps(events):
+    """(process names by pid, thread names by (pid, tid))."""
+    procs, threads = {}, {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            procs[e.get("pid")] = (e.get("args") or {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            threads[(e.get("pid"), e.get("tid"))] = \
+                (e.get("args") or {}).get("name", "")
+    return procs, threads
+
+
+def _is_device_event(e, procs) -> bool:
+    """A device-op interval: carries the XLA ``hlo_op``/``hlo_module``
+    args (XLA:CPU thunk events) or lives on a ``/device:`` process
+    (TPU traces)."""
+    args = e.get("args") or {}
+    if "hlo_op" in args or "hlo_module" in args:
+        return True
+    return str(procs.get(e.get("pid"), "")).startswith("/device:")
+
+
+def device_events(events) -> list:
+    """Classified device intervals: ``(start_us, end_us, category, kind,
+    name, domain)`` for every complete (``ph == "X"``) device-op event.
+    ``domain`` is the overlap domain — the process for ``/device:``
+    tracks (a TPU device's streams overlap each other), the single
+    executor thread on a host-process trace (XLA:CPU runs one virtual
+    device per thread, serially)."""
+    procs, _ = _meta_maps(events)
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or not _is_device_event(e, procs):
+            continue
+        cat, kind = classify_op(e.get("name", ""))
+        if cat is None:
+            continue
+        start = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0) or 0.0)
+        pid = e.get("pid")
+        domain = pid if str(procs.get(pid, "")).startswith("/device:") \
+            else (pid, e.get("tid"))
+        out.append((start, start + dur, cat, kind, e.get("name", "?"),
+                    domain))
+    return out
+
+
+def host_span_events(events, span_names) -> list:
+    """``(start_us, end_us, name)`` for host-thread events whose names
+    are in the JSONL span vocabulary — the TraceAnnotation mirrors that
+    become phase windows. Host threads carry thousands of jax-internal
+    events (``dce``, ``cholesky_expander``); only the vocabulary match
+    keeps them out of the phase set."""
+    procs, _ = _meta_maps(events)
+    names = set(span_names)
+    out = []
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") not in names \
+                or _is_device_event(e, procs):
+            continue
+        start = float(e.get("ts", 0.0))
+        out.append((start, start + float(e.get("dur", 0.0) or 0.0),
+                    e.get("name")))
+    return out
+
+
+def _union(intervals):
+    """Union length-preserving merge of ``[(lo, hi)]`` (sorted input not
+    required)."""
+    merged = []
+    for lo, hi in sorted(intervals):
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def _intersect_len(a_sorted_union, b_sorted_union) -> float:
+    out, i, j = 0.0, 0, 0
+    a, b = a_sorted_union, b_sorted_union
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _fallback_windows(records, devs) -> list:
+    """Phase windows when the trace carries no annotation mirrors:
+    JSONL spans rebased per rank (the ``--align`` machinery of
+    :mod:`dlaf_tpu.obs.aggregate`) onto the device-event origin —
+    inter-clock offset drops out, honest to within dispatch skew."""
+    from .aggregate import rebase_per_rank
+
+    if not devs:
+        return []
+    t0 = min(lo for lo, *_ in devs)
+    out = []
+    for r in rebase_per_rank(records):
+        if r.get("type") != "span":
+            continue
+        end = (r.get("ts") or 0.0) * 1e6 + t0
+        dur = (r.get("dur_s") or 0.0) * 1e6
+        out.append((end - dur, end, r.get("name", "?")))
+    return out
+
+
+def attribute(events, records) -> dict:
+    """The attribution report joining one trace to one merged artifact.
+
+    Returns::
+
+        {"device_busy_s", "attributed_s", "coverage", "events",
+         "domains", "join",                       # "annotation"|"rebase"
+         "categories": {cat: seconds},            # whole-trace totals
+         "phases": {name: {"busy_s",              # sum over tracks
+                           "wall_s",              # union across tracks
+                           "categories": {cat: s},
+                           "flops", "measured_gflops"}},  # when modeled
+         "overlap": [{"algo", "axis", "collective_s", "overlapped_s",
+                      "overlap_frac", "mxu_busy_s",
+                      "kinds": {kind: s}}, ...],
+         "knobs": {attr: [values]}}
+
+    ``coverage`` = attributed device busy / total device busy — the
+    floor ``--require-devtrace`` enforces. Raises ValueError when the
+    trace carries no device op events (an empty attribution must fail
+    loudly, not report 100 % of nothing)."""
+    devs = device_events(events)
+    if not devs or not any(hi > lo for lo, hi, *_ in devs):
+        # zero-duration-only traces would divide coverage by zero below;
+        # both shapes mean the same thing — nothing to attribute
+        raise ValueError("trace contains no device op events with "
+                         "duration (hlo_op-tagged or /device:-track "
+                         "intervals)")
+    spans = [r for r in records if isinstance(r, dict)
+             and r.get("type") == "span"]
+    span_names = {s.get("name", "?") for s in spans}
+    windows = host_span_events(events, span_names)
+    join = "annotation"
+    if not windows:
+        windows = _fallback_windows(records, devs)
+        join = "rebase"
+    # innermost-wins attribution by sweep: device events visited in
+    # midpoint order, windows activated by start and expired lazily, so
+    # the join costs O((E + W) log E + E * nesting depth) instead of the
+    # O(E x W) per-event scan (a raw miniapp trace is ~1e5-1e6 events)
+    win_sorted = sorted(windows)
+    order = sorted(range(len(devs)),
+                   key=lambda i: devs[i][0] + devs[i][1])
+    phase_by_event = [None] * len(devs)
+    active: list = []
+    wi = 0
+    for i in order:
+        mid = (devs[i][0] + devs[i][1]) / 2.0
+        while wi < len(win_sorted) and win_sorted[wi][0] <= mid:
+            active.append(win_sorted[wi])
+            wi += 1
+        if any(whi < mid for _, whi, _ in active):
+            active = [w for w in active if w[1] >= mid]
+        best = None
+        for wlo, whi, wname in active:
+            if wlo <= mid <= whi and (
+                    best is None or whi - wlo < best[1] - best[0]):
+                best = (wlo, whi, wname)
+        if best is not None:
+            phase_by_event[i] = best[2]
+
+    total_busy = 0.0
+    attributed = 0.0
+    cat_totals = collections.Counter()
+    phases: dict = {}
+    mxu_by_domain: dict = {}
+    coll_by_phase: dict = {}
+    for i, (lo, hi, cat, kind, name, domain) in enumerate(devs):
+        dur = (hi - lo) / 1e6
+        total_busy += dur
+        cat_totals[cat] += dur
+        if cat == "mxu":
+            mxu_by_domain.setdefault(domain, []).append((lo, hi))
+        phase = phase_by_event[i]
+        if phase is None:
+            continue
+        attributed += dur
+        cell = phases.setdefault(phase, {"busy_s": 0.0, "_ivs": [],
+                                         "categories":
+                                             collections.Counter()})
+        cell["busy_s"] += dur
+        cell["_ivs"].append((lo, hi))
+        cell["categories"][cat] += dur
+        if cat == "collective":
+            coll_by_phase.setdefault(phase, []).append(
+                (lo, hi, kind, domain))
+    for cell in phases.values():
+        cell["wall_s"] = sum(hi - lo for lo, hi in
+                             _union(cell.pop("_ivs"))) / 1e6
+        cell["categories"] = dict(cell["categories"])
+    # measured MFU: flop-modeled span names -> device busy wall
+    flops_by_name = collections.Counter()
+    for s in spans:
+        f = s.get("flops")
+        if isinstance(f, (int, float)) and not isinstance(f, bool) \
+                and s.get("name") in phases:
+            flops_by_name[s["name"]] += float(f)
+    for name, f in flops_by_name.items():
+        cell = phases[name]
+        cell["flops"] = f
+        if cell["wall_s"] > 0:
+            cell["measured_gflops"] = f / cell["wall_s"] / 1e9
+    # measured overlap per attributed phase: collective time coinciding
+    # with MXU-busy time in the same overlap domain
+    mxu_union = {d: _union(iv) for d, iv in mxu_by_domain.items()}
+    overlap = []
+    for phase, colls in sorted(coll_by_phase.items()):
+        coll_s = sum(hi - lo for lo, hi, _, _ in colls) / 1e6
+        if coll_s <= 0:
+            continue
+        overlapped = 0.0
+        kinds = collections.Counter()
+        for lo, hi, kind, domain in colls:
+            kinds[kind] += (hi - lo) / 1e6
+            overlapped += _intersect_len([(lo, hi)],
+                                         mxu_union.get(domain, []))
+        overlapped_s = min(overlapped / 1e6, coll_s)
+        overlap.append({
+            "algo": phase, "axis": "all",
+            "collective_s": coll_s, "overlapped_s": overlapped_s,
+            "overlap_frac": overlapped_s / coll_s,
+            # phase-scoped like every sibling field (the MXU time
+            # attributed to THIS phase), not the trace-global union —
+            # overlapped_s / mxu_busy_s must be a meaningful ratio
+            "mxu_busy_s": phases[phase]["categories"].get("mxu", 0.0),
+            "kinds": dict(kinds)})
+    from .aggregate import KNOB_ATTRS
+
+    knobs: dict = {}
+    for s in spans:
+        for k in KNOB_ATTRS:
+            if k in (s.get("attrs") or {}):
+                knobs.setdefault(k, set()).add(s["attrs"][k])
+    return {
+        "device_busy_s": total_busy,
+        "attributed_s": attributed,
+        "coverage": attributed / total_busy,
+        "events": len(devs),
+        "domains": len({d for *_, d in devs}),
+        "join": join,
+        "categories": dict(cat_totals),
+        "phases": phases,
+        "overlap": overlap,
+        "knobs": {k: sorted(v) for k, v in knobs.items()},
+    }
+
+
+def records_from_report(report: dict, trace: str) -> list:
+    """The JSONL records the report lands as (schema:
+    :mod:`dlaf_tpu.obs.sinks`): one ``devtrace`` summary plus one
+    ``measured_overlap`` record per (algo, axis) with positive
+    attributed collective time — a zero-collective attribution emits NO
+    overlap record, which is exactly what ``--require-devtrace``
+    rejects."""
+    from .sinks import SCHEMA_VERSION
+
+    ts = time.time()
+    phases = {}
+    for name, cell in report["phases"].items():
+        out = {"busy_s": cell["busy_s"], "wall_s": cell["wall_s"],
+               "categories": cell["categories"]}
+        for key in ("flops", "measured_gflops"):
+            if key in cell:
+                out[key] = cell[key]
+        phases[name] = out
+    recs = [{
+        "v": SCHEMA_VERSION, "type": "devtrace", "ts": ts,
+        "trace": os.path.basename(trace),
+        "device_busy_s": report["device_busy_s"],
+        "attributed_s": report["attributed_s"],
+        "coverage": report["coverage"],
+        "join": report["join"],
+        "phases": phases,
+        "attrs": {"events": report["events"],
+                  "domains": report["domains"],
+                  "knobs": report["knobs"]},
+    }]
+    for row in report["overlap"]:
+        recs.append({
+            "v": SCHEMA_VERSION, "type": "measured_overlap", "ts": ts,
+            "algo": row["algo"], "axis": row["axis"],
+            "collective_s": row["collective_s"],
+            "overlapped_s": row["overlapped_s"],
+            "overlap_frac": row["overlap_frac"],
+            "mxu_busy_s": row["mxu_busy_s"],
+            "kinds": row["kinds"],
+            "attrs": {"trace": os.path.basename(trace)},
+        })
+    return recs
+
+
+def format_report(report: dict, top_n: int = 25) -> list:
+    """Printable lines for one attribution report."""
+    lines = [
+        f"device busy {report['device_busy_s'] * 1e3:.2f} ms over "
+        f"{report['events']} op events, {report['domains']} domain(s); "
+        f"attributed {report['attributed_s'] * 1e3:.2f} ms "
+        f"(coverage {report['coverage'] * 100:.1f}%, "
+        f"join={report['join']})"]
+    cats = " ".join(f"{c}={report['categories'].get(c, 0.0) * 1e3:.2f}ms"
+                    for c in CATEGORIES if c in report["categories"])
+    lines.append(f"by category: {cats}")
+    ranked = sorted(report["phases"].items(),
+                    key=lambda kv: -kv[1]["busy_s"])[:top_n]
+    for name, cell in ranked:
+        cats = " ".join(f"{c}={cell['categories'].get(c, 0.0) * 1e3:.2f}"
+                        for c in CATEGORIES if c in cell["categories"])
+        mfu = (f"  measured {cell['measured_gflops']:.2f} GF/s (device)"
+               if "measured_gflops" in cell else "")
+        lines.append(f"  {cell['busy_s'] * 1e3:10.2f} ms busy  "
+                     f"wall {cell['wall_s'] * 1e3:10.2f} ms  "
+                     f"{name}  [{cats}]{mfu}")
+    for row in report["overlap"]:
+        kinds = " ".join(f"{k}={v * 1e3:.2f}ms"
+                         for k, v in sorted(row["kinds"].items()))
+        lines.append(
+            f"  overlap {row['algo']}/{row['axis']}: "
+            f"{row['overlap_frac'] * 100:.1f}% of "
+            f"{row['collective_s'] * 1e3:.2f} ms collective time "
+            f"MXU-overlapped ({kinds})")
+    if report["knobs"]:
+        lines.append("  knob attrs seen: "
+                     + " ".join(f"{k}={v}" for k, v in
+                                sorted(report["knobs"].items())))
+    return lines
+
+
+def track_tables(events) -> list:
+    """Per-track totals for the ``scripts/profile_summary.py`` trace
+    mode (output contract owner moved here): ``[(track, total_ms,
+    [(name, ms), ...])]`` sorted by total, complete events only."""
+    procs, _ = _meta_maps(events)
+    by_track = collections.defaultdict(collections.Counter)
+    track_total = collections.Counter()
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        track = procs.get(pid, f"pid{pid}")
+        dur = float(e.get("dur", 0) or 0) / 1e3    # us -> ms
+        by_track[track][e.get("name", "?")] += dur
+        track_total[track] += dur
+    return [(track, total, by_track[track].most_common())
+            for track, total in track_total.most_common()]
+
+
+def distill(events, records) -> list:
+    """The reduced trace for a committed fixture: metadata events,
+    device op events, and the span-vocabulary host windows — everything
+    :func:`attribute` consumes, nothing else (a raw miniapp trace
+    carries ~700k jax-internal host events; the distilled one is
+    git-sized). The distilled file replays bitwise through the same
+    engine."""
+    procs, _ = _meta_maps(events)
+    span_names = {r.get("name", "?") for r in records
+                  if isinstance(r, dict) and r.get("type") == "span"}
+    keep = []
+    for e in events:
+        if e.get("ph") == "M":
+            keep.append(e)
+        elif e.get("ph") == "X" and (
+                _is_device_event(e, procs)
+                or e.get("name") in span_names):
+            keep.append(e)
+    return keep
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = json_path = distill_path = None
+    top_n = 25
+    paths = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "-o":
+            i += 1
+            out_path = argv[i] if i < len(argv) else None
+        elif a == "--json":
+            i += 1
+            json_path = argv[i] if i < len(argv) else None
+        elif a == "--distill":
+            i += 1
+            distill_path = argv[i] if i < len(argv) else None
+        elif a == "--top":
+            i += 1
+            try:
+                top_n = int(argv[i]) if i < len(argv) else top_n
+            except ValueError:
+                print(__doc__, file=sys.stderr)
+                return 2
+        elif a.startswith("-"):
+            print(__doc__, file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+    if len(paths) < 2 \
+            or (out_path is None and "-o" in argv) \
+            or (json_path is None and "--json" in argv) \
+            or (distill_path is None and "--distill" in argv):
+        print(__doc__, file=sys.stderr)
+        return 2
+    trace_path, jsonl_paths = paths[0], paths[1:]
+    from .aggregate import merge_artifacts
+
+    try:
+        if os.path.isdir(trace_path):
+            trace_path = newest_trace(trace_path)
+        events = load_trace(trace_path)
+        records = merge_artifacts(jsonl_paths)
+        report = attribute(events, records)
+    except (OSError, ValueError) as e:
+        print(f"devtrace: {e}", file=sys.stderr)
+        return 1
+    # artifacts land BEFORE the human-facing report: a downstream
+    # consumer piping the report through `head` closes stdout early
+    # (SIGPIPE), and that must never cost the enriched artifact
+    recs = records_from_report(report, trace_path)
+    if out_path:
+        with open(out_path, "w") as f:
+            for r in records + recs:
+                f.write(json.dumps(r, default=str) + "\n")
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(report, f, indent=1, default=str)
+    if distill_path:
+        kept = distill(events, records)
+        opener = gzip.open if distill_path.endswith(".gz") else open
+        with opener(distill_path, "wt") as f:
+            json.dump({"traceEvents": kept}, f)
+    print(f"trace: {trace_path}")
+    for line in format_report(report, top_n):
+        print(line)
+    if not report["overlap"]:
+        print("devtrace: WARNING — zero attributed collective device "
+              "time; no measured_overlap record emitted "
+              "(--require-devtrace will reject this artifact)",
+              file=sys.stderr)
+    if out_path:
+        print(f"enriched artifact: {out_path} (+{len(recs)} devtrace "
+              "records)")
+    if json_path:
+        print(f"report json: {json_path}")
+    if distill_path:
+        print(f"distilled trace: {distill_path} ({len(kept)} of "
+              f"{len(events)} events kept)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,10 +21,15 @@ never opens a public socket). Two routes:
   (bucket depth/shed/expired + breaker state names, exactly the
   structure the method returns — pinned round-trip-faithful), every
   registered circuit breaker's state, the worst live
-  ``dlaf_accuracy_ratio`` gauge, process rank / pid / uptime. A payload
-  build failure answers 500 AND trips the flight recorder
-  (``healthz_failure``): the moments before a health endpoint broke are
-  exactly what the ring is for.
+  ``dlaf_accuracy_ratio`` gauge, the rolling SLO window state (ISSUE 14
+  satellite: one entry per (op, bucket) with the
+  ``dlaf_serve_latency_window`` p50/p95/p99 gauge values — the SAME
+  numbers the gauges scrape, pinned round-trip-faithful like the queue
+  stats — plus the ``dlaf_slo_breach_total`` burn counters, so a
+  scrape-only deployment with no JSONL sink still sees SLO state), and
+  process rank / pid / uptime. A payload build failure answers 500 AND
+  trips the flight recorder (``healthz_failure``): the moments before a
+  health endpoint broke are exactly what the ring is for.
 
 Queues register themselves at construction (weakrefs — a dropped queue
 disappears from ``/healthz`` with no unregister protocol). Lifecycle is
@@ -94,17 +99,34 @@ def healthz_payload() -> dict:
     every non-finite number is mapped to None — a NaN must not produce
     the invalid-JSON token that breaks every scraper parsing it."""
     from ..health import circuit
+    from .slo import QUANTILES, WINDOW_GAUGE, BREACH_COUNTER
+
+    def safe(v):
+        return float(v) if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) and math.isfinite(v) else None
 
     worst = None
+    slo_rows: dict = {}
+    breaches: dict = {}
     reg = STATE.registry
     if reg is not None:
+        # the gauge's q label spellings (slo.QUANTILES) -> payload keys
+        q_keys = {label: "p" + str(round(float(label) * 100))
+                  for _, label in QUANTILES}
         for m in reg.snapshot():
-            if m.get("name") != "dlaf_accuracy_ratio":
-                continue
-            v = m.get("value")
-            if isinstance(v, (int, float)) and math.isfinite(v) \
-                    and (worst is None or v > worst):
-                worst = float(v)
+            name = m.get("name")
+            labels = m.get("labels") or {}
+            if name == "dlaf_accuracy_ratio":
+                v = safe(m.get("value"))
+                if v is not None and (worst is None or v > worst):
+                    worst = v
+            elif name == WINDOW_GAUGE and labels.get("q") in q_keys:
+                key = (labels.get("op", ""), labels.get("bucket", ""))
+                cell = slo_rows.setdefault(
+                    key, {"op": key[0], "bucket": key[1]})
+                cell[q_keys[labels["q"]]] = safe(m.get("value"))
+            elif name == BREACH_COUNTER:
+                breaches[labels.get("op", "")] = safe(m.get("value"))
     return {
         "status": "ok",
         "rank": current_rank(),
@@ -114,6 +136,8 @@ def healthz_payload() -> dict:
         "queues": [q.stats() for q in live_queues()],
         "breakers": circuit.states(),
         "accuracy": {"worst_bound_ratio": worst},
+        "slo": {"windows": [slo_rows[k] for k in sorted(slo_rows)],
+                "breaches": breaches},
     }
 
 

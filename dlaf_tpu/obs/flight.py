@@ -23,7 +23,9 @@ schema owner) and their call sites:
 * ``accuracy_breach`` — an accuracy record lands with
   ``bound_ratio > 1`` or a non-finite estimate (obs/accuracy.py);
 * ``healthz_failure`` — the live ``/healthz`` endpoint fails to build
-  its payload (obs/exporter.py).
+  its payload (obs/exporter.py);
+* ``slo_breach_burst`` — >= ``DLAF_SLO_BURST`` over-objective latencies
+  inside one rolling SLO window for one op (obs/slo.py, ISSUE 14).
 
 Per-reason cooldown (default 60 s, injectable clock): the FIRST shed of
 a burst dumps; the next thousand do not re-dump the same ring. Dumps

@@ -90,6 +90,34 @@ Schema (version 1). Every record carries ``v`` (int schema version),
     AND >= 1 ordinary record after it (an incident dump with no
     pre-trigger context captured nothing worth gating on).
 
+``devtrace``
+    Device-timeline attribution summary (:mod:`dlaf_tpu.obs.devtrace`,
+    ISSUE 14; docs/observability.md device-time attribution): ``trace``
+    non-empty str (the profiler artifact's basename), finite
+    ``device_busy_s``/``attributed_s`` >= 0, ``coverage`` finite in
+    [0, 1] (attributed / total device busy), ``join``
+    "annotation" | "rebase" (how phases were matched), ``phases`` object
+    of per-phase cells — finite ``busy_s``/``wall_s`` >= 0 (a NaN wall
+    is a schema error: the "no NaN walls" leg of ``--require-devtrace``),
+    ``categories`` object of finite seconds, optional finite ``flops``/
+    ``measured_gflops`` (the measured-MFU join) — and ``attrs`` object.
+
+``measured_overlap``
+    Measured comm/compute overlap for one (``algo``, ``axis``) — the
+    device-timeline counterpart of the structural
+    ``dlaf_comm_overlapped_total`` trace-time counters: non-empty
+    ``algo``/``axis`` strs (``axis`` is ``"all"`` when the trace carries
+    no replica-group metadata — Chrome traces do not), finite
+    ``collective_s``/``overlapped_s``/``mxu_busy_s`` >= 0 with
+    ``overlapped_s <= collective_s`` (every field phase-scoped:
+    ``mxu_busy_s`` is the MXU time attributed to THIS algo, so
+    ``overlapped_s / mxu_busy_s`` is a meaningful ratio),
+    ``overlap_frac`` finite in [0, 1],
+    ``kinds`` object of finite per-collective-kind seconds, ``attrs``
+    object. Emitted only for phases with POSITIVE attributed collective
+    time, so an artifact whose trace attributed zero collectives carries
+    no such record and fails ``--require-devtrace``.
+
 Every record additionally carries an optional ``rank`` (int >= 0,
 ``jax.process_index()``) — stamped by the sink once the rank is known, so
 multi-host artifacts merge per rank (``python -m dlaf_tpu.obs.aggregate``;
@@ -126,7 +154,15 @@ from typing import Optional
 SCHEMA_VERSION = 1
 
 KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
-               "accuracy", "serve", "resilience", "flight_trigger")
+               "accuracy", "serve", "resilience", "flight_trigger",
+               "devtrace", "measured_overlap")
+
+#: Documented attribution-coverage floor of ``--require-devtrace``
+#: (docs/observability.md device-time attribution): a devtrace record
+#: must attribute at least this fraction of total device busy time to
+#: algorithm phases — below it, the per-phase walls describe a minority
+#: of the timeline and must not gate (or pass) anything.
+DEVTRACE_COVERAGE_FLOOR = 0.5
 
 #: The resilience record's event vocabulary (schema above).
 RESILIENCE_EVENTS = ("retry", "give_up", "deadline", "circuit_open",
@@ -137,7 +173,7 @@ RESILIENCE_EVENTS = ("retry", "give_up", "deadline", "circuit_open",
 #: operations; trigger sites in :mod:`dlaf_tpu.obs.flight`).
 FLIGHT_REASONS = ("breaker_open", "overload_shed",
                   "factorization_exhausted", "accuracy_breach",
-                  "healthz_failure")
+                  "healthz_failure", "slo_breach_burst")
 
 
 def expand_rank_template(path: str) -> str:
@@ -396,6 +432,83 @@ def _validate_resilience(r: dict, where: str, errors: list) -> None:
         errors.append(f"{where}: resilience attrs must be an object")
 
 
+def _validate_devtrace(r: dict, where: str, errors: list) -> None:
+    if not isinstance(r.get("trace"), str) or not r.get("trace"):
+        errors.append(f"{where}: devtrace record without a trace name")
+    for key in ("device_busy_s", "attributed_s"):
+        if not _finite(r.get(key)) or r.get(key, -1) < 0:
+            errors.append(f"{where}: devtrace {key} "
+                          "missing/non-finite/negative")
+    cov = r.get("coverage")
+    if not _finite(cov) or not 0.0 <= cov <= 1.0:
+        errors.append(f"{where}: devtrace coverage must be finite in "
+                      f"[0, 1], got {cov!r}")
+    if r.get("join") not in ("annotation", "rebase"):
+        errors.append(f"{where}: devtrace join must be "
+                      f"annotation|rebase, got {r.get('join')!r}")
+    phases = r.get("phases")
+    if not isinstance(phases, dict):
+        errors.append(f"{where}: devtrace phases must be an object")
+    else:
+        for name, cell in phases.items():
+            w = f"{where} phase[{name!r}]"
+            if not isinstance(cell, dict):
+                errors.append(f"{w}: must be an object")
+                continue
+            # the "no NaN walls" leg: every per-phase wall is finite
+            for key in ("busy_s", "wall_s"):
+                if not _finite(cell.get(key)) or cell.get(key, -1) < 0:
+                    errors.append(f"{w}: {key} "
+                                  "missing/non-finite/negative")
+            cats = cell.get("categories")
+            if not isinstance(cats, dict):
+                errors.append(f"{w}: categories must be an object")
+            else:
+                for cat, v in cats.items():
+                    if not _finite(v) or v < 0:
+                        errors.append(f"{w}: categories[{cat!r}] "
+                                      "non-finite/negative")
+            for key in ("flops", "measured_gflops"):
+                if key in cell and (not _finite(cell[key])
+                                    or cell[key] < 0):
+                    errors.append(f"{w}: {key} non-finite/negative")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: devtrace attrs must be an object")
+
+
+def _validate_measured_overlap(r: dict, where: str, errors: list) -> None:
+    for key in ("algo", "axis"):
+        if not isinstance(r.get(key), str) or not r.get(key):
+            errors.append(f"{where}: measured_overlap record without "
+                          f"a {key}")
+    for key in ("collective_s", "overlapped_s", "mxu_busy_s"):
+        if not _finite(r.get(key)) or r.get(key, -1) < 0:
+            errors.append(f"{where}: measured_overlap {key} "
+                          "missing/non-finite/negative")
+    if _finite(r.get("collective_s")) and _finite(r.get("overlapped_s")) \
+            and r["overlapped_s"] > r["collective_s"]:
+        errors.append(f"{where}: measured_overlap overlapped_s > "
+                      "collective_s (overlap cannot exceed the "
+                      "collective time it overlaps)")
+    frac = r.get("overlap_frac")
+    if not _finite(frac) or not 0.0 <= frac <= 1.0:
+        errors.append(f"{where}: measured_overlap overlap_frac must be "
+                      f"finite in [0, 1], got {frac!r}")
+    kinds = r.get("kinds")
+    if kinds is not None:
+        if not isinstance(kinds, dict):
+            errors.append(f"{where}: measured_overlap kinds must be an "
+                          "object")
+        else:
+            for kind, v in kinds.items():
+                if not _finite(v) or v < 0:
+                    errors.append(f"{where}: measured_overlap kinds"
+                                  f"[{kind!r}] non-finite/negative")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: measured_overlap attrs must be an "
+                      "object")
+
+
 def _validate_flight_trigger(r: dict, where: str, errors: list) -> None:
     if r.get("reason") not in FLIGHT_REASONS:
         errors.append(f"{where}: flight_trigger reason must be one of "
@@ -458,7 +571,7 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_dc_batch=False, require_bt_overlap=False,
                      require_telemetry=False, require_accuracy=False,
                      require_serve=False, require_resilience=False,
-                     require_flight=False) -> list:
+                     require_flight=False, require_devtrace=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -503,7 +616,14 @@ def validate_records(records, require_spans=False, require_gflops=False,
     flight-recorder incident obligation (docs/observability.md): >= 1
     ``flight_trigger`` record with a known reason AND >= 1 ordinary
     (pre-trigger) record, so an incident dump that captured no context
-    fails the drill."""
+    fails the drill — and (``require_devtrace``) the device-timeline
+    attribution obligation (ISSUE 14, docs/observability.md): >= 1
+    ``measured_overlap`` record with finite ``overlap_frac`` and
+    POSITIVE attributed collective time (a trace that attributed zero
+    collectives measured nothing about comm/compute overlap), and >= 1
+    ``devtrace`` record with attribution coverage >=
+    :data:`DEVTRACE_COVERAGE_FLOOR` (the schema validation above
+    already rejects NaN phase walls unconditionally)."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = n_accuracy = 0
@@ -512,6 +632,8 @@ def validate_records(records, require_spans=False, require_gflops=False,
     n_serve_accuracy = 0
     n_resilience_proof = 0
     n_flight_triggers = n_flight_context = 0
+    n_overlap_proof = n_devtrace_covered = 0
+    devtrace_coverages = []
     circuit_state = {}                # site -> latest gauge value seen
     serve_retrace_sites = {}          # serve.* site -> trace evidence count
     overlap_axes, byte_axes = set(), set()
@@ -541,6 +663,18 @@ def validate_records(records, require_spans=False, require_gflops=False,
             _validate_flight_trigger(r, where, errors)
             if r.get("reason") in FLIGHT_REASONS:
                 n_flight_triggers += 1
+        elif rtype == "devtrace":
+            _validate_devtrace(r, where, errors)
+            if _finite(r.get("coverage")):
+                devtrace_coverages.append(float(r["coverage"]))
+                if r["coverage"] >= DEVTRACE_COVERAGE_FLOOR:
+                    n_devtrace_covered += 1
+        elif rtype == "measured_overlap":
+            _validate_measured_overlap(r, where, errors)
+            if _finite(r.get("overlap_frac")) \
+                    and _finite(r.get("collective_s")) \
+                    and r["collective_s"] > 0:
+                n_overlap_proof += 1
         elif rtype == "program":
             _validate_program(r, where, errors)
             if r.get("event") == "compile" and _finite(r.get("compile_s")):
@@ -707,6 +841,18 @@ def validate_records(records, require_spans=False, require_gflops=False,
         if n_flight_context == 0:
             errors.append("flight artifact carries no pre-trigger context "
                           "records (the ring captured nothing)")
+    if require_devtrace:
+        if n_overlap_proof == 0:
+            errors.append("artifact contains no measured_overlap record "
+                          "with finite overlap_frac and positive "
+                          "attributed collective time (the device "
+                          "timeline attributed no collectives)")
+        if n_devtrace_covered == 0:
+            got = (f" (got {['%.3f' % c for c in devtrace_coverages]})"
+                   if devtrace_coverages else "")
+            errors.append("artifact contains no devtrace record with "
+                          "attribution coverage >= "
+                          f"{DEVTRACE_COVERAGE_FLOOR}{got}")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "

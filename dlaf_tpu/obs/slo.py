@@ -17,7 +17,14 @@ feed one end-to-end latency for ``(op, bucket)`` and the module
   Per-observation burn counting, not a windowed-p99 comparison: every
   over-objective request burns budget the moment it completes, so the
   counter is deterministic and monotone — alerting math (burn rate over
-  window) belongs to the scraper.
+  window) belongs to the scraper;
+* trips the flight recorder with reason ``slo_breach_burst`` when at
+  least ``DLAF_SLO_BURST`` breaches (default 5; 0 = off) land inside
+  one SLO window for one op (ISSUE 14 satellite): the recorder's
+  per-reason cooldown turns a sustained latency storm into ONE incident
+  artifact holding the pre-burst ring instead of a re-dump per breach.
+  Breach stamps ride the same injectable clock as the windows, so the
+  drill is deterministic under a fake clock.
 
 The window length comes from ``DLAF_SLO_WINDOW_S``; both serve-queue
 request completions and :func:`dlaf_tpu.health.policy.with_policy`
@@ -49,13 +56,41 @@ BREACH_COUNTER = "dlaf_slo_breach_total"
 #: clock; one module clock so every (op, bucket) window agrees on "now").
 _clock = time.monotonic
 
+#: Per-op breach timestamps inside the current SLO window (the
+#: ``slo_breach_burst`` trigger state; pruned per observation, cleared
+#: by :func:`set_clock`).
+_breaches: dict = {}
+
 
 def set_clock(clock=None) -> None:
     """Swap the window clock (tests); None restores ``time.monotonic``.
     Only windows created AFTER the swap use it — call before the first
-    observation of the series under test."""
+    observation of the series under test. Clears the breach-burst
+    stamps (they are meaningless across a clock swap)."""
     global _clock
     _clock = clock if clock is not None else time.monotonic
+    _breaches.clear()
+
+
+def _note_breach(op: str, cfg) -> None:
+    """One over-objective observation: prune stamps older than the SLO
+    window, and when the op's in-window breach count reaches
+    ``slo_burst``, dump the flight ring (the recorder's per-reason
+    cooldown dedups a storm into one artifact)."""
+    burst = int(getattr(cfg, "slo_burst", 0) or 0)
+    if burst <= 0:
+        return
+    window = max(float(cfg.slo_window_s), 1e-9)
+    now = _clock()
+    stamps = _breaches.setdefault(op, [])
+    stamps.append(now)
+    while stamps and now - stamps[0] > window:
+        stamps.pop(0)
+    if len(stamps) >= burst:
+        from . import flight
+
+        flight.trigger("slo_breach_burst", op=op, breaches=len(stamps),
+                       window_s=window, burst=burst)
 
 
 def observe(op: str, seconds: float, bucket: str = "") -> None:
@@ -79,3 +114,4 @@ def observe(op: str, seconds: float, bucket: str = "") -> None:
     slo_ms = float(cfg.slo_p99_ms)
     if slo_ms > 0 and seconds * 1e3 > slo_ms:
         reg.counter(BREACH_COUNTER, op=op).inc()
+        _note_breach(op, cfg)

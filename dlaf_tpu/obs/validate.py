@@ -59,6 +59,15 @@ Flags:
                             operations): >= 1 flight_trigger record with
                             a known reason AND >= 1 ordinary pre-trigger
                             record captured by the ring
+    --require-devtrace      fail unless the artifact carries the
+                            device-timeline attribution trail (ISSUE 14,
+                            docs/observability.md): >= 1 measured_overlap
+                            record with finite overlap_frac and POSITIVE
+                            attributed collective device time, and >= 1
+                            devtrace record with attribution coverage >=
+                            the documented floor
+                            (sinks.DEVTRACE_COVERAGE_FLOOR); NaN phase
+                            walls are schema errors regardless
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
@@ -96,7 +105,8 @@ def main(argv=None) -> int:
              "--require-comm-overlap", "--require-dc-batch",
              "--require-bt-overlap", "--require-telemetry",
              "--require-accuracy", "--require-serve",
-             "--require-resilience", "--require-flight", "--history",
+             "--require-resilience", "--require-flight",
+             "--require-devtrace", "--history",
              "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
@@ -133,7 +143,8 @@ def main(argv=None) -> int:
         require_accuracy="--require-accuracy" in flags,
         require_serve="--require-serve" in flags,
         require_resilience="--require-resilience" in flags,
-        require_flight="--require-flight" in flags)
+        require_flight="--require-flight" in flags,
+        require_devtrace="--require-devtrace" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -145,6 +156,8 @@ def main(argv=None) -> int:
     n_serve = sum(r.get("type") == "serve" for r in records)
     n_res = sum(r.get("type") == "resilience" for r in records)
     n_flight = sum(r.get("type") == "flight_trigger" for r in records)
+    n_devtrace = sum(r.get("type") in ("devtrace", "measured_overlap")
+                     for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
@@ -152,6 +165,7 @@ def main(argv=None) -> int:
     extra += f", {n_serve} serve records" if n_serve else ""
     extra += f", {n_res} resilience records" if n_res else ""
     extra += f", {n_flight} flight triggers" if n_flight else ""
+    extra += f", {n_devtrace} devtrace records" if n_devtrace else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

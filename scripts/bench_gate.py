@@ -254,6 +254,13 @@ def main(argv=None) -> int:
     if regressions:
         print(f"bench_gate: {regressions} regressed key(s)",
               file=sys.stderr)
+        # the explainer is one command away (ISSUE 14): diff the fresh
+        # obs artifact against a known-good merged artifact — per-phase
+        # device walls, compile seconds, retraces, comm bytes, overlap
+        # fractions, accuracy — and the ranked report names the phase
+        fresh_art = args.fresh[0] if args.fresh else "<fresh.jsonl>"
+        print("bench_gate: diagnose with: python scripts/perf_diff.py "
+              f"<baseline_merged.jsonl> {fresh_art}", file=sys.stderr)
         return 1
     print("bench_gate: no regressions")
     return 0

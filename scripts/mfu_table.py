@@ -49,12 +49,31 @@ their single-chip rehearsal number with a note, or "pending".
   carry a number before live silicon does.  Link bandwidth is the public
   per-chip ICI aggregate / 4 links.
 
+* **measured MFU (device)** — the ISSUE-14 measured path: entry-span
+  flop models joined to the phase's attributed device-busy wall from a
+  profiler trace (``dlaf_tpu.obs.devtrace``), replayed hermetically from
+  the committed fixture under ``tests/fixtures/devtrace/`` (a distilled
+  ``DLAF_TRACE_DIR`` Chrome trace + its merged JSONL). The denominator
+  is measured device time, not host wall and not a model — but the
+  committed fixture ran in the CPU CI container, so its numbers are
+  labeled with their platform/shape and are NOT comparable to the TPU
+  roofline ceilings; a TPU-captured fixture drops in with no code
+  change.
+
 Usage:
     python scripts/mfu_table.py            # print the markdown table
     python scripts/mfu_table.py --write    # splice into BASELINE.md
                                            # between the mfu-table markers
     python scripts/mfu_table.py --no-ici   # skip the traced ICI column
                                            # (fast; prints em-dashes)
+    python scripts/mfu_table.py --measured # fill the measured(dev)
+                                           # column from the committed
+                                           # devtrace fixture
+    python scripts/mfu_table.py --reuse-ici  # reuse the ICI cells
+                                           # already in BASELINE.md
+                                           # instead of re-tracing
+                                           # (hermetic regeneration)
+    python scripts/mfu_table.py --fixture DIR  # override the fixture dir
 """
 
 from __future__ import annotations
@@ -320,6 +339,88 @@ def ici_ceiling(family: str, n: int, nb: int, grid: str, chip: str):
     return _FLOPS_MODEL[family](n) / t / 1e9
 
 
+#: devtrace fixture for the measured-MFU column (``--measured``): a
+#: distilled Chrome trace + merged JSONL, committed so the replay needs
+#: no hardware and no live run (docs/observability.md device-time
+#: attribution).
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "devtrace")
+
+#: entry-span phase name -> table family (the devtrace phase join keys
+#: measured device GF/s by span name; the table rows key by family).
+ENTRY_PHASE_FAMILIES = {
+    "cholesky": "cholesky", "triangular_solve": "trsm",
+    "gen_to_std": "hegst", "reduction_to_band": "red2band",
+    "tridiag_solver": "tridiag", "bt_band_to_tridiag": "bt_b2t",
+    "bt_reduction_to_band": "bt_r2b", "eigensolver": "eigensolver",
+    "gen_eigensolver": "eigensolver",
+}
+
+
+def measured_device(fixture_dir: str = FIXTURE_DIR):
+    """{family: "GF/s (platform n/nb grid)"} from the committed devtrace
+    fixture — the device-busy-denominated measured numbers, labeled with
+    where they ran so a CPU-container fixture can never masquerade as a
+    TPU datum. Empty dict when the fixture is absent/unreadable (the
+    column prints em-dashes)."""
+    sys.path.insert(0, REPO)
+    from dlaf_tpu.obs import devtrace
+    from dlaf_tpu.obs.aggregate import merge_artifacts
+
+    import glob as _glob
+
+    trace = os.path.join(fixture_dir, "trace.json.gz")
+    jsonls = sorted(_glob.glob(os.path.join(fixture_dir, "*.jsonl")))
+    if not os.path.exists(trace) or not jsonls:
+        return {}
+    try:
+        records = merge_artifacts(jsonls)
+        report = devtrace.attribute(devtrace.load_trace(trace), records)
+    except (OSError, ValueError) as e:
+        print(f"mfu_table: devtrace fixture unreadable: {e}",
+              file=sys.stderr)
+        return {}
+    platform = "cpu"
+    for r in records:
+        if r.get("type") == "accuracy" and r.get("platform"):
+            platform = r["platform"]
+            break
+    attrs_by_name = {}
+    for r in records:
+        if r.get("type") == "span" and r.get("name"):
+            attrs_by_name.setdefault(r["name"], r.get("attrs") or {})
+    out = {}
+    for phase, cell in report["phases"].items():
+        family = ENTRY_PHASE_FAMILIES.get(phase)
+        if family is None or "measured_gflops" not in cell:
+            continue
+        a = attrs_by_name.get(phase, {})
+        label = (f"{cell['measured_gflops']:.2f} ({platform} "
+                 f"{a.get('n', '?')}/{a.get('nb', '?')} "
+                 f"{a.get('grid', '1x1')})")
+        out[family] = label
+    return out
+
+
+def parse_existing_ici(path: str = BASELINE_MD) -> dict:
+    """{config label: ICI cell} parsed from the committed table — the
+    ``--reuse-ici`` source, so a measured-column regeneration does not
+    re-run the (minutes-long) trace subprocesses and stays hermetic."""
+    try:
+        with open(path) as f:
+            doc = f.read()
+    except OSError:
+        return {}
+    if BEGIN not in doc or END not in doc:
+        return {}
+    out = {}
+    for line in doc[doc.index(BEGIN):doc.index(END)].splitlines():
+        cells = [c.strip() for c in line.split("|")]
+        # | config | route | compute | HBM | ICI | ... (leading '')
+        if len(cells) >= 6 and cells[1].startswith("#"):
+            out[cells[1]] = cells[5]
+    return out
+
+
 #: measured-entry classifier: history `variant` labels per workload family
 _FAMILIES = {
     "cholesky": ("chol_", "ozaki", "scan", "xla", "loop", "biggemm",
@@ -400,13 +501,23 @@ CONFIGS = [
 _MEAS_AT = {"#4 red2band d 16384/512 4x4": (8192, 512)}
 
 
-def build_rows(with_ici=True):
+def build_rows(with_ici=True, reuse_ici=None, dev=None):
     rows = []
+    dev = dev or {}
     for label, family, n, nb, grid, chip, note in CONFIGS:
         comp = oz_compute_ceiling(chip)
         hbm = (chol_hbm_ceiling(chip, n, nb)
                if family in ("cholesky", "trsm", "hegst") else None)
-        ici = ici_ceiling(family, n, nb, grid, chip) if with_ici else None
+        if reuse_ici is not None:
+            cell = reuse_ici.get(label, "—")
+            try:
+                ici = float(cell)
+            except ValueError:
+                ici = None
+        elif with_ici:
+            ici = ici_ceiling(family, n, nb, grid, chip)
+        else:
+            ici = None
         panel = panel_ceiling(family, n, nb)
         candidates = [comp] + [x for x in (hbm, ici, panel)
                                if x is not None]
@@ -421,11 +532,12 @@ def build_rows(with_ici=True):
                      f"{comp:.0f}", f"{hbm:.0f}" if hbm else "—",
                      f"{ici:.0f}" if ici else "—",
                      f"{panel:.0f}" if panel else "—", bound,
-                     f"{got:.1f}" if got else "pending", mfu, note))
+                     f"{got:.1f}" if got else "pending",
+                     dev.get(family, "—"), mfu, note))
     return rows
 
 
-def render(with_ici=True) -> str:
+def render(with_ici=True, reuse_ici=None, dev=None) -> str:
     head = (f"{BEGIN}\n"
             "## MFU / roofline table (scripts/mfu_table.py — regenerate "
             "with `--write`)\n\n"
@@ -456,13 +568,23 @@ def render(with_ici=True) -> str:
             "(`bound=panel`), the fused Pallas panel kernels "
             "(`panel_impl`, docs/pallas_panel.md) are the lever, modeled "
             "~6x higher at 2 dispatches/step (A/B via the bench "
-            "`fpanel`/`fpanel+fp1` arms).\n\n"
+            "`fpanel`/`fpanel+fp1` arms). "
+            "`measured(dev)` is the ISSUE-14 device-timeline path "
+            "(`dlaf_tpu.obs.devtrace` + `--measured`): entry-span flop "
+            "models over the phase's attributed DEVICE-busy wall from a "
+            "profiler trace — measured time, not a model — replayed "
+            "hermetically from the committed "
+            "`tests/fixtures/devtrace/` fixture and labeled with the "
+            "platform/shape it ran (the CI fixture is a CPU-container "
+            "2x2 run: its GF/s validate the measurement path, not the "
+            "TPU ceilings; a TPU-captured fixture drops in unchanged — "
+            "docs/observability.md device-time attribution).\n\n"
             "| config | route | compute ceil GF/s | HBM ceil GF/s "
             "| ICI ceil GF/s | panel ceil GF/s | bound | measured GF/s "
-            "| MFU | note |\n"
-            "|---|---|---|---|---|---|---|---|---|---|\n")
+            "| measured(dev) GF/s | MFU | note |\n"
+            "|---|---|---|---|---|---|---|---|---|---|---|\n")
     body = "".join("| " + " | ".join(r) + " |\n"
-                   for r in build_rows(with_ici))
+                   for r in build_rows(with_ici, reuse_ici, dev))
     return head + body + END
 
 
@@ -471,7 +593,16 @@ def main() -> None:
         _trace_ici_child(json.loads(sys.argv[sys.argv.index("--trace-ici")
                                              + 1]))
         return
-    text = render(with_ici="--no-ici" not in sys.argv)
+    fixture = FIXTURE_DIR
+    if "--fixture" in sys.argv:
+        i = sys.argv.index("--fixture") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("mfu_table: --fixture needs a directory")
+        fixture = sys.argv[i]
+    dev = measured_device(fixture) if "--measured" in sys.argv else None
+    reuse = parse_existing_ici() if "--reuse-ici" in sys.argv else None
+    text = render(with_ici="--no-ici" not in sys.argv,
+                  reuse_ici=reuse, dev=dev)
     if "--write" not in sys.argv:
         print(text)
         return

@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Perf-regression explainer: diff two merged obs artifacts (ISSUE 14).
+
+    python scripts/perf_diff.py BASELINE.jsonl FRESH.jsonl \\
+        [--threshold 0.10] [--top N] [--inject-slowdown PHASE=F | F]
+
+``scripts/bench_gate.py`` says *that* a key regressed; this tool says
+*where*. Both inputs are merged ``DLAF_METRICS_PATH`` artifacts
+(``obs.aggregate -o``), ideally enriched with the device-timeline
+records (``python -m dlaf_tpu.obs.devtrace ... -o``). Per artifact it
+extracts:
+
+* **per-phase device wall** — ``devtrace`` records' per-phase ``wall_s``
+  (the measured device busy union, not host wall);
+* **host span wall** per span name (``dur_s`` sums — the coarse view
+  when no devtrace records ride along);
+* **compile seconds** per site (``program`` compile records);
+* **retrace counts** per site (``program`` retrace records +
+  ``dlaf_retrace_total`` counters, last snapshot);
+* **comm bytes** per (kind, axis)
+  (``dlaf_comm_collective_bytes_total``, last snapshot per rank,
+  summed);
+* **measured overlap fraction** per (algo, axis) (``measured_overlap``
+  records, collective-time-weighted mean);
+* **worst accuracy bound_ratio** (``accuracy`` records).
+
+The report is RANKED what-changed: every change sorted by severity
+(relative change weighted by absolute magnitude), worst first; changes
+in the bad direction beyond ``--threshold`` are REGRESSION lines naming
+the phase/site/key. ``--inject-slowdown cholesky=0.5`` scales the FRESH
+artifact's matching device-phase walls (and its host span walls) by
+1.5x before diffing — the CI must-trip drill: the injected phase must
+top the ranking and exit 1.
+
+Exit status: 0 = no regression beyond threshold; 1 = >= 1 regression
+(each named); 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dlaf_tpu.obs.sinks import read_records
+
+
+def _finite(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool) \
+        and math.isfinite(x)
+
+
+def extract(records) -> dict:
+    """The comparable facts of one merged artifact (module docstring)."""
+    facts = {
+        "phase_wall": {},       # phase -> device wall s (devtrace)
+        "host_wall": {},        # span name -> sum dur_s
+        "compile_s": {},        # site -> sum compile s
+        "retraces": {},         # site -> count
+        "comm_bytes": {},       # (kind, axis) -> bytes
+        "overlap": {},          # (algo, axis) -> weighted overlap frac
+        "worst_bound_ratio": None,
+        "coverage": None,       # worst devtrace coverage
+    }
+    overlap_acc: dict = {}
+    last_snap: dict = {}
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        rtype = r.get("type")
+        if rtype == "devtrace":
+            for phase, cell in (r.get("phases") or {}).items():
+                w = cell.get("wall_s")
+                if _finite(w):
+                    facts["phase_wall"][phase] = \
+                        facts["phase_wall"].get(phase, 0.0) + w
+            cov = r.get("coverage")
+            if _finite(cov):
+                facts["coverage"] = cov if facts["coverage"] is None \
+                    else min(facts["coverage"], cov)
+        elif rtype == "measured_overlap":
+            key = (r.get("algo", "?"), r.get("axis", "?"))
+            if _finite(r.get("overlap_frac")) \
+                    and _finite(r.get("collective_s")):
+                acc = overlap_acc.setdefault(key, [0.0, 0.0])
+                acc[0] += r["overlap_frac"] * r["collective_s"]
+                acc[1] += r["collective_s"]
+        elif rtype == "span":
+            if _finite(r.get("dur_s")):
+                name = r.get("name", "?")
+                facts["host_wall"][name] = \
+                    facts["host_wall"].get(name, 0.0) + r["dur_s"]
+        elif rtype == "program":
+            site = r.get("site", "?")
+            if r.get("event") == "compile" and _finite(r.get("compile_s")):
+                facts["compile_s"][site] = \
+                    facts["compile_s"].get(site, 0.0) + r["compile_s"]
+            elif r.get("event") == "retrace":
+                facts["retraces"][site] = facts["retraces"].get(site, 0) + 1
+        elif rtype == "accuracy":
+            br = r.get("bound_ratio")
+            if r.get("nonfinite") is True:
+                facts["worst_bound_ratio"] = float("inf")
+            elif _finite(br):
+                cur = facts["worst_bound_ratio"]
+                if cur is None or br > cur:
+                    facts["worst_bound_ratio"] = br
+        elif rtype == "metrics":
+            last_snap[r.get("rank", 0)] = r
+    for key, (num, den) in overlap_acc.items():
+        facts["overlap"][key] = num / den if den > 0 else 0.0
+    retrace_counters: dict = {}
+    for snap in last_snap.values():
+        for m in snap.get("metrics") or []:
+            if not isinstance(m, dict) or not _finite(m.get("value")):
+                continue
+            labels = m.get("labels") or {}
+            if m.get("name") == "dlaf_comm_collective_bytes_total":
+                key = (labels.get("kind", "?"), labels.get("axis", "?"))
+                facts["comm_bytes"][key] = \
+                    facts["comm_bytes"].get(key, 0.0) + m["value"]
+            elif m.get("name") == "dlaf_retrace_total":
+                site = labels.get("site", "?")
+                retrace_counters[site] = retrace_counters.get(site, 0.0) \
+                    + m["value"]
+    for site, v in retrace_counters.items():
+        # the counter's first trace = 1; keep whichever evidence is
+        # larger so record-trail and counter-trail artifacts compare
+        facts["retraces"][site] = max(facts["retraces"].get(site, 0),
+                                      int(v))
+    return facts
+
+
+def _rel(old: float, new: float) -> float:
+    if old == 0.0:
+        return math.inf if new > 0 else 0.0
+    return (new - old) / abs(old)
+
+
+def diff(a: dict, b: dict, threshold: float) -> list:
+    """Ranked findings ``[(severity, is_regression, line), ...]`` worst
+    first. Direction conventions: walls/compile/retraces/bytes/
+    bound_ratio UP is bad; overlap fraction DOWN is bad."""
+    findings = []
+
+    def add(kind, label, old, new, *, unit="ms", scale=1e3, bad_up=True,
+            fmt="{:.2f}", min_abs=0.0):
+        if old is None and new is None:
+            return
+        if old is None or new is None:
+            # a metric family present on only ONE side is instrumentation
+            # skew (a baseline predating the devtrace/accuracy records, a
+            # newly named span), not a measured perf change: report it
+            # informationally, never as a REGRESSION — the exit-code
+            # contract must not trip on a better-instrumented fresh run
+            side = "only in fresh" if old is None else "only in baseline"
+            v = float(new if old is None else old)
+            findings.append((0.0, False, False,
+                             f"{kind:<14s} {label}: "
+                             + fmt.format(v * scale)
+                             + f" {unit} ({side}; not comparable)"))
+            return
+        old_v, new_v = float(old), float(new)
+        delta = new_v - old_v
+        if abs(delta) * scale < min_abs:
+            return
+        rel = _rel(old_v, new_v)
+        worse = delta > 0 if bad_up else delta < 0
+        is_reg = worse and (abs(rel) > threshold or math.isinf(rel))
+        # severity: relative change, damped by absolute size so a
+        # 0.01 ms phase tripling never outranks a 100 ms phase +30%
+        sev = min(abs(rel), 10.0) * abs(delta) * scale
+        arrow = "+" if delta >= 0 else ""
+        rel_s = "new" if math.isinf(rel) else f"{arrow}{rel * 100:.1f}%"
+        line = (f"{kind:<14s} {label}: "
+                + fmt.format(old_v * scale) + f" -> "
+                + fmt.format(new_v * scale) + f" {unit} ({rel_s})")
+        findings.append((sev, is_reg, worse, line))
+
+    for phase in sorted(set(a["phase_wall"]) | set(b["phase_wall"])):
+        add("device-phase", phase, a["phase_wall"].get(phase),
+            b["phase_wall"].get(phase), min_abs=0.01)
+    for name in sorted(set(a["host_wall"]) | set(b["host_wall"])):
+        add("host-span", name, a["host_wall"].get(name),
+            b["host_wall"].get(name), min_abs=0.01)
+    for site in sorted(set(a["compile_s"]) | set(b["compile_s"])):
+        add("compile", site, a["compile_s"].get(site),
+            b["compile_s"].get(site), unit="s", scale=1.0,
+            min_abs=0.01)
+    for site in sorted(set(a["retraces"]) | set(b["retraces"])):
+        add("retraces", site, a["retraces"].get(site),
+            b["retraces"].get(site), unit="traces", scale=1.0,
+            fmt="{:.0f}")
+    for key in sorted(set(a["comm_bytes"]) | set(b["comm_bytes"])):
+        add("comm-bytes", f"{key[0]}/{key[1]}", a["comm_bytes"].get(key),
+            b["comm_bytes"].get(key), unit="MiB", scale=1.0 / 2**20,
+            min_abs=0.01)
+    for key in sorted(set(a["overlap"]) | set(b["overlap"])):
+        add("overlap-frac", f"{key[0]}/{key[1]}", a["overlap"].get(key),
+            b["overlap"].get(key), unit="%", scale=100.0, bad_up=False,
+            fmt="{:.1f}")
+    add("bound-ratio", "worst accuracy", a["worst_bound_ratio"],
+        b["worst_bound_ratio"], unit="", scale=1.0, fmt="{:.3g}")
+    findings.sort(key=lambda f: -f[0])
+    return findings
+
+
+def parse_inject(spec: str):
+    """``PHASE=FACTOR`` or bare ``FACTOR`` -> (phase or None, factor)."""
+    if "=" in spec:
+        phase, _, factor = spec.partition("=")
+        return phase, float(factor)
+    return None, float(spec)
+
+
+def inject_slowdown(facts: dict, phase, factor: float) -> None:
+    """Scale the fresh artifact's device-phase walls (and host span
+    walls, so artifacts without devtrace records still drill) by
+    ``1 + factor`` — matching ``phase`` only, or every phase when
+    None."""
+    for table in ("phase_wall", "host_wall"):
+        for name in facts[table]:
+            if phase is None or name == phase:
+                facts[table][name] *= 1.0 + factor
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="perf-regression explainer (see module docstring)")
+    ap.add_argument("baseline")
+    ap.add_argument("fresh")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative-change threshold for a REGRESSION "
+                         "verdict (default 0.10)")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--inject-slowdown", default="", metavar="PHASE=F",
+                    help="scale the fresh artifact's matching phase "
+                         "walls by 1+F before diffing (the CI "
+                         "must-trip drill)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    if not 0.0 <= args.threshold < 10.0 or args.top < 1:
+        print("perf_diff: bad --threshold/--top", file=sys.stderr)
+        return 2
+    try:
+        a = extract(read_records(args.baseline))
+        b = extract(read_records(args.fresh))
+    except (OSError, ValueError) as e:
+        print(f"perf_diff: {e}", file=sys.stderr)
+        return 1
+    if not (a["phase_wall"] or a["host_wall"]) \
+            or not (b["phase_wall"] or b["host_wall"]):
+        print("perf_diff: an artifact carries neither devtrace phases "
+              "nor span records — nothing to attribute", file=sys.stderr)
+        return 1
+    mode = ""
+    if args.inject_slowdown:
+        try:
+            phase, factor = parse_inject(args.inject_slowdown)
+        except ValueError:
+            print(f"perf_diff: bad --inject-slowdown "
+                  f"{args.inject_slowdown!r}", file=sys.stderr)
+            return 2
+        inject_slowdown(b, phase, factor)
+        mode = (f" [+{factor:.0%} injected slowdown on "
+                f"{phase or 'every phase'}]")
+    print(f"perf_diff: {args.baseline} -> {args.fresh}{mode}")
+    if a["coverage"] is not None or b["coverage"] is not None:
+        fmt = lambda c: "-" if c is None else f"{c * 100:.1f}%"  # noqa: E731
+        print(f"  devtrace coverage: {fmt(a['coverage'])} -> "
+              f"{fmt(b['coverage'])}")
+    findings = diff(a, b, args.threshold)
+    regressions = []
+    shown = 0
+    for sev, is_reg, worse, line in findings:
+        verdict = "REGRESSION" if is_reg else \
+            ("  worse   " if worse else "  ok      ")
+        if is_reg:
+            regressions.append(line)
+        if shown < args.top or is_reg:
+            print(f"  {verdict} {line}")
+            shown += 1
+    if not findings:
+        print("  (no measurable differences)")
+    if regressions:
+        print(f"perf_diff: {len(regressions)} regression(s); worst: "
+              f"{regressions[0]}", file=sys.stderr)
+        return 1
+    print("perf_diff: no regression beyond "
+          f"{args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

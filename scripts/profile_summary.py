@@ -15,30 +15,29 @@ Two input shapes, auto-detected:
   host threads), the top-N ops by total duration. This is the instrument
   for deciding WHERE config #1's 0.2 s actually goes — per-op tunnel
   probes sit on the ~140 ms RTT floor and cannot (BASELINE.md round 4).
+  The trace parsing is :mod:`dlaf_tpu.obs.devtrace`'s (ISSUE 14) —
+  single owner, not a fork — and ``--jsonl merged.jsonl`` additionally
+  prints the per-phase device-time attribution section (op classes per
+  algorithm phase, measured overlap, coverage) for the trace joined to
+  that artifact.
 
-Usage: python scripts/profile_summary.py <profile_dir | metrics.jsonl> [top_n]
+Usage: python scripts/profile_summary.py <profile_dir | metrics.jsonl> \\
+           [top_n] [--jsonl merged.jsonl ...]
 """
 import collections
-import glob
-import gzip
-import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
 
 def newest_trace(root: str) -> str:
-    cands = sorted(
-        glob.glob(os.path.join(root, "**", "*.trace.json.gz"),
-                  recursive=True) +
-        glob.glob(os.path.join(root, "**", "perfetto_trace.json.gz"),
-                  recursive=True),
-        key=os.path.getmtime)
-    if not cands:
-        raise SystemExit(f"no *.trace.json.gz under {root}")
-    # prefer the chrome trace over the perfetto one at equal recency (both
-    # carry the events; the chrome one names processes in metadata events)
-    chrome = [c for c in cands if not c.endswith("perfetto_trace.json.gz")]
-    return (chrome or cands)[-1]
+    """Kept as the documented entry point; the implementation moved to
+    :func:`dlaf_tpu.obs.devtrace.newest_trace` (single parser owner)."""
+    from dlaf_tpu.obs.devtrace import newest_trace as _newest
+
+    return _newest(root)
 
 
 def summarize_jsonl(path: str, top_n: int) -> None:
@@ -196,39 +195,48 @@ def summarize_jsonl(path: str, top_n: int) -> None:
 
 
 def main():
-    root = sys.argv[1]
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
-    if os.path.isfile(root):
+    argv = sys.argv[1:]
+    jsonls = []
+    while "--jsonl" in argv:
+        i = argv.index("--jsonl")
+        if i + 1 >= len(argv):
+            raise SystemExit(__doc__)
+        jsonls.append(argv[i + 1])
+        del argv[i:i + 2]
+    if not argv:
+        raise SystemExit(__doc__)
+    root = argv[0]
+    top_n = int(argv[1]) if len(argv) > 1 else 25
+    if os.path.isfile(root) and not root.endswith((".json", ".json.gz")):
         summarize_jsonl(root, top_n)
         return
-    path = newest_trace(root)
+    # trace mode: the parsing/classification is obs.devtrace's (single
+    # owner, not a fork); this CLI keeps the per-track output contract
+    from dlaf_tpu.obs import devtrace
+
+    path = root if os.path.isfile(root) else newest_trace(root)
     print(f"trace: {path}")
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    events = data["traceEvents"] if isinstance(data, dict) else data
+    events = devtrace.load_trace(path)
 
-    proc_names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            proc_names[e.get("pid")] = e.get("args", {}).get("name", "")
-
-    # complete events only (ph == "X": have a duration)
-    by_track = collections.defaultdict(collections.Counter)
-    track_total = collections.Counter()
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        pid = e.get("pid")
-        track = proc_names.get(pid, f"pid{pid}")
-        dur = e.get("dur", 0) / 1e3  # us -> ms
-        by_track[track][e.get("name", "?")] += dur
-        track_total[track] += dur
-
-    for track, total in track_total.most_common():
+    for track, total, rows in devtrace.track_tables(events):
         print(f"\n== {track}: {total:.1f} ms total (sum of events) ==")
-        for name, dur in by_track[track].most_common(top_n):
+        for name, dur in rows[:top_n]:
             print(f"  {dur:10.2f} ms  {100 * dur / max(total, 1e-9):5.1f}%"
                   f"  {name[:100]}")
+
+    if jsonls:
+        # per-phase attribution (ISSUE 14): device op classes joined to
+        # the artifact's span windows — report code is devtrace's
+        from dlaf_tpu.obs.aggregate import merge_artifacts
+
+        print("\n== device-time attribution (obs.devtrace) ==")
+        try:
+            report = devtrace.attribute(events, merge_artifacts(jsonls))
+        except ValueError as e:
+            print(f"  (unavailable: {e})")
+            return
+        for line in devtrace.format_report(report, top_n):
+            print(f"  {line}")
 
 
 if __name__ == "__main__":

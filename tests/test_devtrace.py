@@ -1,0 +1,412 @@
+"""Device-timeline attribution tests (ISSUE 14, dlaf_tpu.obs.devtrace).
+
+Covers the op classifier, the phase join (annotation + rebase fallback),
+the measured-overlap computation on a synthetic TPU-shaped trace (where
+collectives genuinely overlap MXU work across streams of one device),
+the devtrace/measured_overlap record schema + the ``--require-devtrace``
+accept/reject legs (zero-attributed-collectives must be REJECTED), the
+hermetic replay of the committed ``tests/fixtures/devtrace/`` fixture
+(the ``mfu_table.py --measured`` source), the CLI, and the
+``scripts/perf_diff.py`` explainer with its must-trip injected-slowdown
+drill. The overlap ORDERING assertion (``comm_lookahead=1`` >= ``=0``)
+is TPU-gated like PR 2/4's A/B arms — XLA:CPU executes thunks serially,
+so CPU CI pins report *structure* (finite fractions, coverage, schema),
+never the ordering.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from dlaf_tpu.obs import devtrace
+from dlaf_tpu.obs.aggregate import merge_artifacts
+from dlaf_tpu.obs.sinks import (DEVTRACE_COVERAGE_FLOOR, validate_records)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURE = os.path.join(HERE, "fixtures", "devtrace")
+FIXTURE_TRACE = os.path.join(FIXTURE, "trace.json.gz")
+FIXTURE_JSONL = os.path.join(FIXTURE, "merged.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# op classification
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name,cat,kind", [
+    ("dot.24", "mxu", None),
+    ("bitcast_dot_fusion.1", "mxu", None),
+    ("convolution.2", "mxu", None),
+    ("all-reduce.11", "collective", "all-reduce"),
+    ("all-gather.5", "collective", "all-gather"),
+    ("reduce-scatter", "collective", "reduce-scatter"),
+    ("collective-permute.3", "collective", "collective-permute"),
+    ("gather.7", "copy", None),              # NOT all-gather
+    ("copy_dynamic-update-slice_fusion", "copy", None),
+    ("transpose.1", "copy", None),
+    ("custom-call.2", "host_callback", None),
+    ("add.174", "compute", None),
+    ("while.1", "compute", None),
+    ("partition-id", "compute", None),
+])
+def test_classify_op(name, cat, kind):
+    assert devtrace.classify_op(name) == (cat, kind)
+
+
+def test_classify_op_rejects_infra_events():
+    for name in ("ThunkExecutor::Execute", "TfrtCpuExecutable::ExecuteHelper",
+                 "ThunkExecutor::Execute (wait for completion)", ""):
+        assert devtrace.classify_op(name) == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# synthetic traces: phase join + overlap semantics
+# ---------------------------------------------------------------------------
+
+def _span_record(name, ts, dur_s, flops=None, **attrs):
+    r = {"v": 1, "type": "span", "ts": ts, "name": name, "dur_s": dur_s,
+         "depth": 0, "parent": None, "attrs": attrs, "rank": 0}
+    if flops is not None:
+        r["flops"] = flops
+    return r
+
+
+def _synth_tpu_trace():
+    """One /device: process with two streams: an all-reduce on stream 1
+    overlapping a dot on stream 2 for half its duration, plus a host
+    thread carrying the span-annotation window around everything."""
+    return [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "python"}},
+        # host window [0, 1000] us named like the JSONL span
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 0.0, "dur": 1000.0,
+         "name": "cholesky"},
+        # stream 1: collective [100, 300]
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 100.0, "dur": 200.0,
+         "name": "all-reduce.1"},
+        # stream 2: dot [200, 500] -> overlap with the collective = 100us
+        {"ph": "X", "pid": 1, "tid": 2, "ts": 200.0, "dur": 300.0,
+         "name": "dot.1"},
+        # stream 1: copy outside the window -> unattributed
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 2000.0, "dur": 100.0,
+         "name": "copy.1"},
+    ]
+
+
+def test_synthetic_overlap_and_coverage():
+    records = [_span_record("cholesky", 10.0, 1.0, flops=2e9,
+                            comm_lookahead=1)]
+    report = devtrace.attribute(_synth_tpu_trace(), records)
+    # 600us of ops, 500 attributed (the trailing copy is outside)
+    assert report["device_busy_s"] == pytest.approx(600e-6)
+    assert report["attributed_s"] == pytest.approx(500e-6)
+    assert report["coverage"] == pytest.approx(5.0 / 6.0)
+    assert report["join"] == "annotation"
+    (row,) = report["overlap"]
+    # /device: process -> the overlap domain is the whole process, so
+    # the dot's [200, 300] slice overlaps the collective
+    assert row["algo"] == "cholesky" and row["axis"] == "all"
+    assert row["collective_s"] == pytest.approx(200e-6)
+    assert row["overlapped_s"] == pytest.approx(100e-6)
+    assert row["overlap_frac"] == pytest.approx(0.5)
+    assert row["kinds"] == {"all-reduce": pytest.approx(200e-6)}
+    # mxu_busy_s is PHASE-scoped like every sibling field (the review
+    # fix): the cholesky phase attributed 300us of MXU work
+    assert row["mxu_busy_s"] == pytest.approx(300e-6)
+    cell = report["phases"]["cholesky"]
+    assert cell["categories"]["mxu"] == pytest.approx(300e-6)
+    # measured MFU: flops / device-busy wall (union [100, 500] = 400us)
+    assert cell["wall_s"] == pytest.approx(400e-6)
+    assert cell["measured_gflops"] == pytest.approx(2e9 / 400e-6 / 1e9)
+    assert report["knobs"] == {"comm_lookahead": [1]}
+
+
+def test_cpu_thread_domains_do_not_cross_overlap():
+    """On a host-process trace (XLA:CPU), each executor thread is its
+    own device: a dot on thread B must NOT count as overlapping a
+    collective on thread A."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 7, "tid": 5, "ts": 0.0, "dur": 1000.0,
+         "name": "cholesky"},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 100.0, "dur": 200.0,
+         "name": "all-reduce.1", "args": {"hlo_op": "all-reduce.1"}},
+        {"ph": "X", "pid": 7, "tid": 2, "ts": 100.0, "dur": 200.0,
+         "name": "dot.1", "args": {"hlo_op": "dot.1"}},
+    ]
+    report = devtrace.attribute(events, [_span_record("cholesky", 1.0, 1.0)])
+    (row,) = report["overlap"]
+    assert row["overlap_frac"] == 0.0 and row["collective_s"] > 0
+
+
+def test_innermost_window_wins():
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "python"}},
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 0.0, "dur": 1000.0,
+         "name": "outer"},
+        {"ph": "X", "pid": 9, "tid": 1, "ts": 100.0, "dur": 300.0,
+         "name": "inner"},
+        {"ph": "X", "pid": 2, "tid": 1, "ts": 200.0, "dur": 50.0,
+         "name": "dot.1", "args": {"hlo_op": "dot.1"}},
+        {"ph": "X", "pid": 2, "tid": 1, "ts": 600.0, "dur": 50.0,
+         "name": "dot.2", "args": {"hlo_op": "dot.2"}},
+    ]
+    records = [_span_record("outer", 1.0, 1.0),
+               _span_record("inner", 1.0, 0.5)]
+    report = devtrace.attribute(events, records)
+    assert report["phases"]["inner"]["busy_s"] == pytest.approx(50e-6)
+    assert report["phases"]["outer"]["busy_s"] == pytest.approx(50e-6)
+
+
+def test_rebase_fallback_join():
+    """A trace without annotation mirrors still joins: the JSONL spans
+    are rebased (aggregate's --align machinery) onto the device-event
+    origin."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 2, "tid": 1, "ts": 1000.0, "dur": 100.0,
+         "name": "dot.1", "args": {"hlo_op": "dot.1"}},
+    ]
+    # span of 1s whose rebased window is [0 us, 1e6 us] from the device
+    # origin (ts is stamped at span EXIT, dur_s before it)
+    records = [_span_record("cholesky", 1.0, 1.0)]
+    report = devtrace.attribute(events, records)
+    assert report["join"] == "rebase"
+    assert report["coverage"] == pytest.approx(1.0)
+    assert "cholesky" in report["phases"]
+
+
+def test_empty_trace_fails_loudly():
+    with pytest.raises(ValueError, match="no device op events"):
+        devtrace.attribute([{"ph": "M", "name": "process_name", "pid": 1,
+                             "args": {"name": "python"}}], [])
+    # zero-duration-only device events are equally unattributable — a
+    # loud ValueError, never a coverage division by zero
+    with pytest.raises(ValueError, match="no device op events"):
+        devtrace.attribute(
+            [{"ph": "X", "pid": 1, "tid": 1, "ts": 5.0, "dur": 0.0,
+              "name": "dot.1", "args": {"hlo_op": "dot.1"}}], [])
+
+
+# ---------------------------------------------------------------------------
+# records + validator obligations
+# ---------------------------------------------------------------------------
+
+def test_records_validate_and_require_devtrace_accepts():
+    records = [_span_record("cholesky", 10.0, 1.0, flops=2e9)]
+    report = devtrace.attribute(_synth_tpu_trace(), records)
+    recs = devtrace.records_from_report(report, "t.json.gz")
+    assert not validate_records(recs)
+    assert not validate_records(recs, require_devtrace=True)
+    types = [r["type"] for r in recs]
+    assert types.count("devtrace") == 1
+    assert types.count("measured_overlap") == 1
+
+
+def test_require_devtrace_rejects_zero_attributed_collectives():
+    """A trace whose attribution found NO collective time emits no
+    measured_overlap record — and the artifact must be REJECTED."""
+    events = [e for e in _synth_tpu_trace()
+              if not e.get("name", "").startswith("all-reduce")]
+    report = devtrace.attribute(events, [_span_record("cholesky", 1.0, 1.0)])
+    assert report["overlap"] == []
+    recs = devtrace.records_from_report(report, "t.json.gz")
+    errors = validate_records(recs, require_devtrace=True)
+    assert any("no measured_overlap" in e for e in errors)
+    # but the records themselves are schema-valid
+    assert not validate_records(recs)
+
+
+def test_require_devtrace_rejects_low_coverage_and_nan_walls():
+    records = [_span_record("cholesky", 10.0, 1.0)]
+    report = devtrace.attribute(_synth_tpu_trace(), records)
+    recs = devtrace.records_from_report(report, "t.json.gz")
+    (dt,) = [r for r in recs if r["type"] == "devtrace"]
+    dt["coverage"] = DEVTRACE_COVERAGE_FLOOR - 0.01
+    errors = validate_records(recs, require_devtrace=True)
+    assert any("coverage" in e for e in errors)
+    dt["coverage"] = 0.9
+    dt["phases"]["cholesky"]["wall_s"] = float("nan")
+    errors = validate_records(recs)            # schema-level, no require
+    assert any("wall_s" in e for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# the committed fixture: hermetic replay (mfu_table --measured source)
+# ---------------------------------------------------------------------------
+
+def test_fixture_replays_hermetically():
+    records = merge_artifacts([FIXTURE_JSONL])
+    report = devtrace.attribute(devtrace.load_trace(FIXTURE_TRACE), records)
+    assert report["join"] == "annotation"
+    assert report["coverage"] >= DEVTRACE_COVERAGE_FLOOR
+    assert report["overlap"], "fixture must carry attributed collectives"
+    for row in report["overlap"]:
+        assert math.isfinite(row["overlap_frac"])
+        assert 0.0 <= row["overlap_frac"] <= 1.0
+    assert "cholesky" in report["phases"]
+    assert report["phases"]["cholesky"]["measured_gflops"] > 0
+    recs = devtrace.records_from_report(report, FIXTURE_TRACE)
+    assert not validate_records(recs, require_devtrace=True)
+
+
+def test_fixture_distill_is_idempotent():
+    records = merge_artifacts([FIXTURE_JSONL])
+    events = devtrace.load_trace(FIXTURE_TRACE)
+    again = devtrace.distill(events, records)
+    assert devtrace.attribute(again, records) == \
+        devtrace.attribute(events, records)
+
+
+def test_mfu_table_measured_column_from_fixture():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import mfu_table
+
+    dev = mfu_table.measured_device(FIXTURE)
+    assert "cholesky" in dev
+    assert "cpu" in dev["cholesky"]            # platform-labeled, always
+    text = mfu_table.render(with_ici=False, dev=dev)
+    assert "measured(dev) GF/s" in text
+    assert dev["cholesky"] in text
+
+
+# ---------------------------------------------------------------------------
+# CLI + perf_diff explainer
+# ---------------------------------------------------------------------------
+
+def test_devtrace_cli_enriches_and_validates(tmp_path):
+    out = str(tmp_path / "enriched.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.devtrace", FIXTURE_TRACE,
+         FIXTURE_JSONL, "-o", out], capture_output=True, text=True,
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "coverage" in r.stdout and "MXU-overlapped" in r.stdout
+    v = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.validate", out,
+         "--require-devtrace"], capture_output=True, text=True, cwd=REPO)
+    assert v.returncode == 0, v.stderr
+    # usage: no artifact path -> 2; unreadable trace -> 1
+    assert subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.devtrace", FIXTURE_TRACE],
+        capture_output=True, cwd=REPO).returncode == 2
+    assert subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.devtrace",
+         str(tmp_path / "nope.json.gz"), FIXTURE_JSONL],
+        capture_output=True, cwd=REPO).returncode == 1
+
+
+@pytest.fixture()
+def enriched(tmp_path):
+    records = merge_artifacts([FIXTURE_JSONL])
+    report = devtrace.attribute(devtrace.load_trace(FIXTURE_TRACE), records)
+    recs = devtrace.records_from_report(report, FIXTURE_TRACE)
+    path = str(tmp_path / "enriched.jsonl")
+    with open(path, "w") as f:
+        for r in records + recs:
+            f.write(json.dumps(r, default=str) + "\n")
+    return path
+
+
+def test_perf_diff_identity_passes(enriched):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_diff.py"),
+         enriched, enriched], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no regression" in r.stdout
+
+
+def test_perf_diff_inject_slowdown_names_the_phase(enriched):
+    """The CI must-trip drill: an injected slowdown on one phase must
+    produce exit 1 with that phase named in a REGRESSION line."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_diff.py"),
+         enriched, enriched, "--inject-slowdown", "cholesky=0.5"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    reg_lines = [ln for ln in r.stdout.splitlines() if "REGRESSION" in ln]
+    assert reg_lines and any("cholesky" in ln for ln in reg_lines)
+    assert "regression(s); worst:" in r.stderr
+
+
+def test_perf_diff_one_sided_family_is_not_a_regression(tmp_path, enriched):
+    """A metric family present on only one side (a baseline predating
+    the accuracy/devtrace instrumentation, a newly named span) is
+    instrumentation skew: reported informationally, NEVER exit 1."""
+    records = [json.loads(ln) for ln in open(enriched)]
+    baseline = str(tmp_path / "old_baseline.jsonl")
+    with open(baseline, "w") as f:
+        for r in records:
+            if r.get("type") != "accuracy":
+                f.write(json.dumps(r) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_diff.py"),
+         baseline, enriched], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "only in fresh; not comparable" in r.stdout
+
+
+def test_perf_diff_rejects_empty_artifacts(tmp_path):
+    empty = str(tmp_path / "empty.jsonl")
+    with open(empty, "w") as f:
+        f.write(json.dumps({"v": 1, "type": "log", "ts": 1.0,
+                            "level": "info", "logger": "x", "msg": "y",
+                            "fields": {}}) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_diff.py"),
+         empty, empty], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    assert "nothing to attribute" in r.stderr
+
+
+def test_bench_gate_regression_names_perf_diff(tmp_path):
+    """A tripped bench gate must print the exact perf_diff invocation
+    (ISSUE 14: one command from verdict to diagnosis)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_gate.py"),
+         "--replay", "--inject-slowdown", "0.2"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    assert "scripts/perf_diff.py" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# TPU-gated: measured overlap ordering (the A/B the counters only imply)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="XLA:CPU executes thunks serially — the "
+                           "measured overlap ordering only exists on a "
+                           "device that actually overlaps ICI with MXU "
+                           "work (PR 2/4 A/B discipline)")
+def test_comm_lookahead_measured_overlap_ordering(tmp_path):
+    """comm_lookahead=1 must measure >= the =0 arm's overlap fraction."""
+    fracs = {}
+    for la in (0, 1):
+        env = dict(os.environ,
+                   DLAF_METRICS_PATH=str(tmp_path / f"la{la}.r%r.jsonl"),
+                   DLAF_TRACE_DIR=str(tmp_path / f"trace{la}"),
+                   DLAF_CHOLESKY_LOOKAHEAD="1",
+                   DLAF_COMM_LOOKAHEAD=str(la))
+        subprocess.run(
+            [sys.executable, "-m", "dlaf_tpu.miniapp.miniapp_cholesky",
+             "-m", "1024", "-b", "256", "--grid-rows", "2",
+             "--grid-cols", "2", "--nruns", "2"],
+            check=True, env=env, cwd=REPO)
+        records = merge_artifacts(
+            sorted(str(p) for p in tmp_path.glob(f"la{la}.r*.jsonl")))
+        report = devtrace.attribute(
+            devtrace.load_trace(str(tmp_path / f"trace{la}")), records)
+        fracs[la] = max((r["overlap_frac"] for r in report["overlap"]),
+                        default=0.0)
+    assert fracs[1] >= fracs[0]

@@ -797,3 +797,164 @@ def test_config_knob_validation():
     cfg = C.initialize()
     assert cfg.slo_p99_ms == 250.0
     assert cfg.flight_recorder == 128
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellites: healthz SLO windows, slo_breach_burst, degraded
+# aggregate --trace inputs
+# ---------------------------------------------------------------------------
+
+def test_healthz_slo_windows_roundtrip(tmp_path):
+    """/healthz carries the rolling SLO window quantiles per (op,
+    bucket) — the SAME values the dlaf_serve_latency_window gauges
+    scrape (round-trip pinned like the queue stats), plus the breach
+    burn counters — so a scrape-only deployment sees SLO state."""
+    _metrics_on(tmp_path, slo_p99_ms=100.0)
+    port = exporter.start(0)
+    lat = [0.01, 0.02, 0.05, 0.2, 0.3]
+    for v in lat:
+        obs.observe_latency("serve.cholesky", v, bucket="64")
+    obs.observe_latency("serve.eigh", 0.5, bucket="32")
+    _, body = _get(port, "/healthz")
+    payload = json.loads(body)
+    rows = {(w["op"], w["bucket"]): w for w in payload["slo"]["windows"]}
+    assert set(rows) == {("serve.cholesky", "64"), ("serve.eigh", "32")}
+    gauges = {(m["labels"]["op"], m["labels"]["bucket"],
+               m["labels"]["q"]): m["value"]
+              for m in obs.registry().snapshot()
+              if m["name"] == "dlaf_serve_latency_window"}
+    for (op, bucket), row in rows.items():
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            assert row[key] == gauges[(op, bucket, q)]
+    assert rows[("serve.cholesky", "64")]["p99"] == \
+        quantile(lat, 0.99)
+    assert payload["slo"]["breaches"] == {"serve.cholesky": 2.0,
+                                          "serve.eigh": 1.0}
+
+
+def test_healthz_slo_empty_without_observations(tmp_path):
+    _metrics_on(tmp_path)
+    port = exporter.start(0)
+    _, body = _get(port, "/healthz")
+    payload = json.loads(body)
+    assert payload["slo"] == {"windows": [], "breaches": {}}
+
+
+def test_slo_breach_burst_trips_flight(tmp_path):
+    """The must-trip drill: DLAF_SLO_BURST breaches inside one SLO
+    window dump the ring once (reason slo_breach_burst, a known
+    FLIGHT_REASONS member), and the artifact passes --require-flight."""
+    clock = FakeClock(1000.0)
+    slo.set_clock(clock)
+    path = _metrics_on(tmp_path, slo_p99_ms=10.0, slo_window_s=60.0,
+                       slo_burst=3, flight_recorder=32)
+    # pre-trigger context for the ring (the validator rejects an
+    # incident dump that captured nothing)
+    with obs.span("pre_incident_work", n=1):
+        pass
+    flight_path = path + ".flight.jsonl"
+    for i in range(2):
+        obs.observe_latency("cholesky", 0.5)
+        clock.t += 1.0
+    assert not os.path.exists(flight_path), "tripped below the burst"
+    obs.observe_latency("cholesky", 0.5)
+    assert os.path.exists(flight_path), "burst did not trip"
+    records = obs.read_records(flight_path)
+    header = records[0]
+    assert header["type"] == "flight_trigger"
+    assert header["reason"] == "slo_breach_burst"
+    assert header["attrs"]["op"] == "cholesky"
+    assert header["attrs"]["breaches"] == 3
+    from dlaf_tpu.obs.sinks import validate_records
+
+    assert not validate_records(records, require_flight=True)
+    # cooldown: the storm continues but the same reason does not re-dump
+    seq = header["dump_seq"]
+    for _ in range(5):
+        obs.observe_latency("cholesky", 0.5)
+    assert obs.read_records(flight_path)[0]["dump_seq"] == seq
+
+
+def test_slo_breach_burst_window_prunes(tmp_path):
+    """Breaches spread wider than one SLO window must NOT trip: the
+    stamp pruning keeps only in-window breaches."""
+    clock = FakeClock(1000.0)
+    slo.set_clock(clock)
+    path = _metrics_on(tmp_path, slo_p99_ms=10.0, slo_window_s=5.0,
+                       slo_burst=3, flight_recorder=32)
+    flight_path = path + ".flight.jsonl"
+    for _ in range(6):                      # 6 breaches, 6 s apart
+        obs.observe_latency("cholesky", 0.5)
+        clock.t += 6.0
+    assert not os.path.exists(flight_path)
+    # burst=0 disables the trigger entirely
+    obs._reset_for_tests()
+    slo.set_clock(clock)
+    path = _metrics_on(tmp_path / "b0", slo_p99_ms=10.0, slo_burst=0,
+                       flight_recorder=32)
+    for _ in range(10):
+        obs.observe_latency("cholesky", 0.5)
+    assert not os.path.exists(str(tmp_path / "b0" / "live.jsonl")
+                              + ".flight.jsonl")
+
+
+def _degraded_trace_artifact(tmp_path):
+    """Hand-written records for the aggregate --trace degraded paths:
+    a request whose dispatch record is MISSING (no stages to join), and
+    a batch-scope-only trace (list trace_id, no request record)."""
+    records = [
+        {"v": 1, "type": "serve", "ts": 10.0, "event": "request",
+         "op": "cholesky", "n": 24, "bucket_n": 32, "dtype": "float64",
+         "queue_s": 0.01, "total_s": 0.05, "attrs": {},
+         "trace_id": "aaaa000011112222", "span_id": "bbbb000011112222",
+         "rank": 0},
+        {"v": 1, "type": "resilience", "ts": 11.0, "site": "serve.x",
+         "event": "retry", "attempt": 1, "delay_s": 0.0, "attrs": {},
+         "trace_id": ["cccc000011112222", "dddd000011112222"],
+         "span_id": "eeee000011112222", "rank": 0},
+    ]
+    path = str(tmp_path / "degraded.jsonl")
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def test_aggregate_trace_dispatch_missing_stages(tmp_path):
+    """A request record with no joinable dispatch still renders its
+    waterfall — with the explicit no-stages note, not a crash."""
+    path = _degraded_trace_artifact(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--trace", "aaaa000011112222"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "queue wait" in r.stdout
+    assert "no dispatch stage record joined" in r.stdout
+
+
+def test_aggregate_trace_batch_scope_only(tmp_path):
+    """A trace ID that appears only in batch-scope lists (no request
+    record) renders the record inventory without a waterfall."""
+    path = _degraded_trace_artifact(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--trace", "cccc000011112222"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "[batch scope, rank 0]" in r.stdout
+    assert "queue wait" not in r.stdout     # no request => no waterfall
+    assert "resilience" in r.stdout
+
+
+def test_aggregate_trace_unknown_id_and_usage_exit_codes(tmp_path):
+    """The exit-code contract: an unknown trace ID is loud exit 1; a
+    --trace flag with no value is a usage error, exit 2."""
+    path = _degraded_trace_artifact(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--trace", "ffff000011112222"], capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "appears in no record" in r.stderr
+    r2 = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.aggregate", path,
+         "--trace"], capture_output=True, text=True)
+    assert r2.returncode == 2
