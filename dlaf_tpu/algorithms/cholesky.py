@@ -100,11 +100,16 @@ def _count_step_modes(algo: str, overlapped: int, serialized: int) -> None:
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing",
                                              "lookahead", "with_info",
                                              "panel_fused",
-                                             "panel_interpret"),
+                                             "panel_interpret", "route"),
                    donate_argnums=0)
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
                     lookahead: bool = False, with_info: bool = False,
-                    panel_fused: bool = False, panel_interpret: bool = False):
+                    panel_fused: bool = False, panel_interpret: bool = False,
+                    route: tuple = ()):
+    # ``route`` is the active autotune route's cache-key component
+    # (docs/autotune.md): the builders read route-sensitive knobs at
+    # trace time (_oz_slices / trsm_panel route), so a route change must
+    # be a different compiled program, never a stale-trace reuse
     n = a.shape[0]
     # "ozaki": route the flops-dominant trailing update through int8 MXU
     # passes (tile_ops.ozaki) — f64 and complex128 (4-real-product form);
@@ -333,12 +338,12 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
                                              "use_mixed", "lookahead",
                                              "with_info", "panel_fused",
-                                             "panel_interpret"),
+                                             "panel_interpret", "route"),
                    donate_argnums=0)
 def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                          use_mixed: bool = False, lookahead: bool = False,
                          with_info: bool = False, panel_fused: bool = False,
-                         panel_interpret: bool = False):
+                         panel_interpret: bool = False, route: tuple = ()):
     """``lax.scan`` formulation of the local factorization: ONE compiled
     step body, looped ``nt`` times with uniform full-size shapes.
 
@@ -1469,9 +1474,13 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
                           use_oz_pallas=False, scan=False, donate=False,
                           lookahead=False, comm_la=False, with_info=False,
-                          panel_fused=False):
+                          panel_fused=False, route=()):
     # dtype stays in the cache key: storage dtype changes retrace the jit
-    # anyway, but distinct keys keep program caches per element type
+    # anyway, but distinct keys keep program caches per element type.
+    # ``route`` (the active autotune route, docs/autotune.md) is a pure
+    # cache-key member: the builders read the routed knobs (_oz_slices /
+    # trsm_panel) at trace time, so a route change must land in a
+    # DIFFERENT compiled program — never an in-place retrace
     donate_kw = donate_argnums_kw(donate, 0)
     if scan:
         # comm_la is not a scan cache key: the pipelined scan body already
@@ -1504,6 +1513,41 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
 
 def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
              with_info: bool = False):
+    """Factorize the Hermitian positive-definite ``mat`` in the ``uplo``
+    triangle: L L^H (uplo='L') or U^H U (uplo='U').
+
+    Under ``DLAF_AUTOTUNE`` (docs/autotune.md) the call first consults
+    the autotune route table for this (n-bucket, nb, dtype, platform)
+    site — the selected precision route rides the builder cache keys, so
+    a learned route change dispatches a different compiled program
+    without retracing the old one — and, when ``mat`` survives the call
+    (``donate=False``), feeds the factor's cheap Hutchinson residual
+    probe back into the table (escalate on breach / relax after K
+    comfortable probes). Donated inputs skip the probe: there is nothing
+    left to compare against.
+
+    See :func:`_cholesky` for the factorization semantics proper
+    (info contract, donation, builder routing).
+    """
+    from .. import autotune
+
+    steer = autotune.steering_for_matrix("cholesky", mat)
+    if steer is None:
+        return _cholesky(uplo, mat, donate=donate, with_info=with_info)
+    with steer.applied():
+        out = _cholesky(uplo, mat, donate=donate, with_info=with_info,
+                        route=steer.route.key())
+    if not donate and steer.probe_due:
+        res = out[0] if with_info else out
+        steer.observe(
+            obs.accuracy.cholesky_residual(uplo, mat, res),
+            c=60.0, of=res.storage, attrs={"entry": "cholesky",
+                                           "uplo": uplo})
+    return out
+
+
+def _cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
+              with_info: bool = False, route: tuple = ()):
     """Factorize the Hermitian positive-definite ``mat`` in the ``uplo``
     triangle: L L^H (uplo='L') or U^H U (uplo='U').
 
@@ -1575,6 +1619,7 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
         trailing=trailing, lookahead=int(lookahead),
         comm_lookahead=int(comm_la),
         panel_impl="fused" if panel_fused else "xla",
+        **({"autotune_route": dict(route)} if route else {}),
         grid=f"{grid_shape[0]}x{grid_shape[1]}"))
     # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
     # resolution local and distributed, single owner in tile_ops.blas);
@@ -1596,14 +1641,16 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                     uplo=uplo, nb=mat.block_size.row, use_mxu=use_mxu,
                     use_mixed=use_mixed, lookahead=lookahead,
                     with_info=with_info, panel_fused=panel_fused,
-                    panel_interpret=panel_fused and panel_interp)
+                    panel_interpret=panel_fused and panel_interp,
+                    route=route)
             else:
                 out = obs.telemetry.call(
                     "cholesky.local", _cholesky_local, a, uplo=uplo,
                     nb=mat.block_size.row, trailing=trailing,
                     lookahead=lookahead, with_info=with_info,
                     panel_fused=panel_fused,
-                    panel_interpret=panel_fused and panel_interp)
+                    panel_interpret=panel_fused and panel_interp,
+                    route=route)
             info = None
             if with_info:
                 out, info = out
@@ -1616,7 +1663,10 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
     from ..health.registry import route_available
     from ..tile_ops.pallas_ozaki import MASKED_MB_MAX
 
-    want_oz_pallas = use_mxu and cfg.ozaki_impl == "pallas"
+    from ..config import _route_override
+
+    oz_impl = _route_override("ozaki_impl") or cfg.ozaki_impl
+    want_oz_pallas = use_mxu and oz_impl == "pallas"
     use_oz_pallas = (want_oz_pallas and dt == np.dtype(np.float64)
                      and mat.block_size.row <= MASKED_MB_MAX)
     if use_oz_pallas and not route_available("pallas", "ozaki_pallas"):
@@ -1651,7 +1701,7 @@ def cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                                # hoist (and cache key) is unrolled-only
                                comm_la=comm_la and not scan_mode,
                                with_info=with_info,
-                               panel_fused=panel_fused)
+                               panel_fused=panel_fused, route=route)
     with entry_span, quiet_donation():
         if with_info:
             storage, info = obs.telemetry.call("cholesky.dist", fn,

@@ -1,0 +1,57 @@
+"""dlaf_tpu.autotune — accuracy-steered precision route selection
+(ISSUE 15, docs/autotune.md).
+
+The closed loop over PR 8's numerical-quality signal: the static
+precision knobs that dominate TPU f64-emulation cost
+(``f64_gemm_slices`` / ``f64_trsm`` / ``panel_impl`` / ``ozaki_impl``)
+become an adaptive policy layer chosen per ``(op, n-bucket, nb, dtype,
+platform)`` from the MEASURED ``bound_ratio`` trajectory — the LP-GEMM /
+TVM-generator observation (arXiv:2604.04599, arXiv:2310.20347) that the
+gemm route should be selected per layout/shape, not globally.
+
+Three parts, behind the layered ``DLAF_AUTOTUNE`` knob ("0"/"1"/"auto";
+auto = 1 on TPU):
+
+* :mod:`.routes` — :class:`Route` overrides + the escalation ladders +
+  the active-route context the knob-resolution single owners consult;
+* :mod:`.table` — the :class:`RouteTable` keyed by site, the PURE
+  decision core :func:`~dlaf_tpu.autotune.table.decide` (escalate on
+  breach, relax after K comfortable probes, documented hysteresis), and
+  schema-validated atomic JSON persistence (``DLAF_AUTOTUNE_TABLE``,
+  warm-start like the bench/accuracy histories);
+* :mod:`.controller` — the per-entry :func:`steering` handle (route out,
+  probe in), the ``autotune`` record/metric emission, and the
+  escalation-exhaustion incident path (flight recorder +
+  ``DLAF_STRICT``).
+
+Cost contract: with the knob off, every entry pays one config read and
+no probe; the factor outputs are bitwise identical knob on/off at the
+start rung (the ladders' start routes ARE the platform defaults —
+tests/test_autotune.py pins the passthrough).
+"""
+
+from __future__ import annotations
+
+from .controller import (Steering, applied, enabled, get_table,
+                         ingest_result, observe_ratio,
+                         route_metric_values, steering,
+                         steering_for_matrix)
+from .routes import (LADDER_F32, LADDER_F64, Ladder, Route, active,
+                     ladder_for, override)
+from .table import (HISTORY_CAP, REASONS, TABLE_VERSION, Decision, Entry,
+                    RouteTable, SiteKey, bucket_n, decide, site_key)
+
+__all__ = [
+    "Route", "Ladder", "LADDER_F64", "LADDER_F32", "ladder_for",
+    "active", "override", "applied",
+    "RouteTable", "SiteKey", "Entry", "Decision", "decide", "site_key",
+    "bucket_n", "REASONS", "TABLE_VERSION", "HISTORY_CAP",
+    "enabled", "steering", "steering_for_matrix", "Steering",
+    "observe_ratio", "ingest_result", "get_table", "route_metric_values",
+]
+
+
+def _reset_for_tests() -> None:
+    from . import controller
+
+    controller._reset_for_tests()

@@ -1,0 +1,318 @@
+"""The closed-loop controller: probe -> table -> route (docs/autotune.md).
+
+Wiring (ISSUE 15 tentpole (b)): an algorithm entry asks
+:func:`steering` for its site's current route BEFORE building/dispatching
+(the route rides the entry's program-cache keys and the
+:mod:`dlaf_tpu.autotune.routes` context), runs the factorization, then —
+when the input survived (not donated) — feeds PR 8's cheap Hutchinson
+probe of the result back through :meth:`Steering.observe`. No new device
+code: the probe IS :mod:`dlaf_tpu.obs.accuracy`'s estimator family, and
+the ``bound_ratio`` normalization IS :func:`dlaf_tpu.obs.accuracy.emit`'s
+(computed with ``record=False`` — the probe lands in the ``autotune``
+decision record, while ordinary ``accuracy`` records remain the
+``DLAF_ACCURACY`` knob's business).
+
+Every decision (including holds) lands as one ``autotune`` JSONL record
+(site, op, rungs, old/new route, probe, reason — obs/sinks.py owns the
+schema) plus ``dlaf_autotune_route{op,knob}`` gauges and the
+``dlaf_autotune_decisions_total{op,reason}`` /
+``dlaf_autotune_escalations_total{op}`` counters. Escalation exhaustion
+(a breach at the ladder top) additionally trips the flight recorder
+(reason ``autotune_exhausted``) and raises
+:class:`~dlaf_tpu.health.errors.AutotuneExhaustedError` under
+``DLAF_STRICT`` — at the top of the ladder there is no safer route, so
+strict deployments must fail loudly rather than keep serving numbers the
+probes say are out of budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from . import routes as _routes
+from . import table as _table
+from .routes import Ladder, Route, applied, ladder_for
+from .table import Decision, RouteTable, SiteKey, site_key
+
+__all__ = ["enabled", "steering", "steering_for_matrix", "Steering",
+           "observe_ratio", "ingest_result", "applied", "get_table",
+           "route_metric_values"]
+
+
+def enabled() -> bool:
+    """The layered ``DLAF_AUTOTUNE`` knob, "auto" resolved per platform:
+    1 on TPU (the "fast by default, never silently wrong" production
+    default), 0 elsewhere."""
+    from ..config import get_configuration, resolve_platform_auto
+
+    return resolve_platform_auto(
+        get_configuration().autotune, knob="autotune", tpu_choice="1",
+        other_choice="0",
+        detail="accuracy-steered precision routes pay off exactly where "
+               "the f64-emulation knobs bind (the mxu/mixed/pallas "
+               "routes); elsewhere the ladder is behavior-inert and the "
+               "probe devices-work buys nothing") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Process table
+# ---------------------------------------------------------------------------
+
+_TABLE: Optional[RouteTable] = None
+_TABLE_PATH: Optional[str] = None
+_TABLE_LOCK = threading.Lock()
+
+
+def get_table() -> RouteTable:
+    """The process route table, re-bound (and warm-started) whenever the
+    ``DLAF_AUTOTUNE_TABLE`` knob changes. A configured path that exists
+    loads eagerly — and a malformed/stale/mismatched table raises HERE,
+    at first use, naming the field (never a silent cold start over a
+    table the operator committed)."""
+    global _TABLE, _TABLE_PATH
+    from ..config import get_configuration
+
+    path = str(get_configuration().autotune_table or "")
+    with _TABLE_LOCK:
+        if _TABLE is None or path != _TABLE_PATH:
+            tab = RouteTable(path)
+            if path:
+                import os
+
+                if os.path.exists(path):
+                    tab.load(path)
+            _TABLE = tab
+            _TABLE_PATH = path
+        return _TABLE
+
+
+def _reset_for_tests() -> None:
+    global _TABLE, _TABLE_PATH
+    with _TABLE_LOCK:
+        _TABLE = None
+        _TABLE_PATH = None
+
+
+# ---------------------------------------------------------------------------
+# Steering handle
+# ---------------------------------------------------------------------------
+
+#: Gauge value encodings for the non-numeric route knobs
+#: (``dlaf_autotune_route{op,knob}``): higher = more conservative.
+_KNOB_VALUES = {
+    "f64_trsm": {"mixed": 0.0, "native": 1.0},
+    "panel_impl": {"fused": 0.0, "xla": 1.0},
+    "ozaki_impl": {"pallas": 0.0, "jnp": 1.0},
+}
+
+
+def route_metric_values(route: Route) -> dict:
+    """knob -> numeric gauge value for a route's overrides (plus nothing
+    for inherited fields — the gauge only reports what the autotuner is
+    actually pinning)."""
+    out = {}
+    for knob, value in route.as_dict().items():
+        if knob == "f64_gemm_slices":
+            out[knob] = float(value)
+        else:
+            out[knob] = _KNOB_VALUES[knob][value]
+    return out
+
+
+@dataclasses.dataclass
+class Steering:
+    """One entry's steering handle: the key, ladder, and the route in
+    effect for this call (:func:`steering`)."""
+
+    key: SiteKey
+    ladder: Ladder
+    route: Route
+    site: str
+    #: the ACTUAL problem dimension (not the bucket ceiling): the probe's
+    #: analytic tolerance must match what the DLAF_ACCURACY records use
+    #: for the same result — normalizing with the power-of-two bucket
+    #: would loosen the breach budget by up to 2x mid-bucket
+    n: int = 0
+    #: probe-cadence verdict (``DLAF_AUTOTUNE_PROBE_EVERY``): entries
+    #: skip the residual probe when False (the route still applies)
+    probe_due: bool = True
+
+    def applied(self):
+        """Context manager applying :attr:`route` (sugar over
+        :func:`dlaf_tpu.autotune.routes.applied`)."""
+        return _routes.applied(self.route)
+
+    def observe(self, value, *, c: float, of=None,
+                attrs: Optional[dict] = None) -> Decision:
+        """Feed one raw probe estimate (the accuracy estimator's
+        residual) back into the table; normalization to ``bound_ratio``
+        rides :func:`dlaf_tpu.obs.accuracy.emit` with ``record=False``
+        (module docstring). Returns the decision (emitting the
+        ``autotune`` record + metrics; strict-raising on exhaustion)."""
+        from ..obs import accuracy
+
+        res = accuracy.emit(self.site, "autotune_probe", value,
+                            n=self.n or self.key.n_bucket,
+                            nb=self.key.nb,
+                            dtype=self.key.dtype, c=c, of=of,
+                            record=False)
+        ratio = res.bound_ratio if res.finite and res.bound_ratio \
+            is not None else float("inf")
+        return observe_ratio(self.key, self.ladder, ratio,
+                             probe_value=(res.value if res.finite
+                                          else None),
+                             attrs=attrs)
+
+
+def steering(op: str, *, n: int, nb: int, dtype,
+             platform: Optional[str] = None,
+             tick: bool = False) -> Optional[Steering]:
+    """The steering handle for one entry call, or None when the loop is
+    closed for it: knob off, an untuned dtype (no ladder), or an empty
+    problem. ``platform`` defaults to the process backend. ``tick=True``
+    counts the call against the site's probe cadence
+    (``DLAF_AUTOTUNE_PROBE_EVERY``) and sets :attr:`Steering.probe_due`
+    accordingly — the algorithm entries tick; identity-only consults
+    (the serve queue's spec labels) do not."""
+    if int(n) < 1 or not enabled():
+        return None
+    ladder = ladder_for(dtype)
+    if ladder is None:
+        return None
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    key = site_key(op, n=n, nb=nb, dtype=dtype, platform=platform)
+    table = get_table()
+    route = table.route_for(key, ladder)
+    due = True
+    if tick:
+        from ..config import get_configuration
+
+        due = table.tick(key, ladder,
+                         get_configuration().autotune_probe_every)
+    return Steering(key=key, ladder=ladder, route=route, site=key.label,
+                    n=int(n), probe_due=due)
+
+
+def steering_for_matrix(op: str, mat) -> Optional[Steering]:
+    """:func:`steering` for a :class:`~dlaf_tpu.matrix.matrix.Matrix`
+    entry argument — platform judged from the matrix's own mesh when
+    distributed (the entry-span convention), else the process backend."""
+    if mat.size.is_empty():
+        return None
+    if mat.grid is not None and mat.grid.num_devices > 1:
+        platform = next(iter(mat.grid.mesh.devices.flat)).platform
+    else:
+        platform = None
+    return steering(op, n=mat.size.row, nb=mat.block_size.row,
+                    dtype=mat.dtype, platform=platform, tick=True)
+
+
+def ingest_result(op: str, result, *, n: int, nb: int, dtype,
+                  platform: Optional[str] = None,
+                  attrs: Optional[dict] = None) -> Optional[Decision]:
+    """Feed an ALREADY-computed residual into the table: the donated-
+    entry path. Timed miniapp runs donate their input (the N=16384
+    HBM story), so the entry itself has nothing left to probe — but the
+    miniapp's ``--check-result`` / ``DLAF_ACCURACY`` probes compute the
+    same residual against the kept reference copy; this ingests their
+    :class:`~dlaf_tpu.obs.accuracy.AccuracyResult` when the loop is
+    armed. Informational results (no budget -> no ``bound_ratio``) and
+    untuned dtypes are ignored. Returns the decision, or None."""
+    if not enabled():
+        return None
+    ladder = ladder_for(dtype)
+    if ladder is None:
+        return None
+    if result.tol is None:
+        return None
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    key = site_key(op, n=n, nb=nb, dtype=dtype, platform=platform)
+    ratio = result.bound_ratio if result.finite \
+        and result.bound_ratio is not None else float("inf")
+    return observe_ratio(key, ladder, ratio,
+                         probe_value=(result.value if result.finite
+                                      else None),
+                         attrs=dict(attrs or {}, source="ingest"))
+
+
+def observe_ratio(key: SiteKey, ladder: Ladder, ratio: float, *,
+                  probe_value: Optional[float] = None,
+                  attrs: Optional[dict] = None) -> Decision:
+    """Feed one normalized ``bound_ratio`` probe for ``key`` into the
+    table and publish the decision (record + gauges + counters + flight/
+    strict handling). The serve queue calls this directly with its
+    per-bucket residual ratios; entries go through
+    :meth:`Steering.observe`."""
+    from .. import obs
+    from ..config import get_configuration
+
+    cfg = get_configuration()
+    table = get_table()
+    decision = table.observe(
+        key, ladder, ratio, margin=float(cfg.autotune_margin),
+        relax_after=int(cfg.autotune_relax_after),
+        budget=int(cfg.autotune_budget))
+    # both routes derived from THE decision's rungs (not a separate
+    # pre-observe table read): under concurrent feeds a second lock
+    # round-trip could pair one decision's rung_old with another's route
+    route_old = ladder.rungs[decision.rung_old]
+    route_new = ladder.rungs[decision.rung_new]
+    rec = {"site": key.label, "op": key.op, "n_bucket": key.n_bucket,
+           "nb": key.nb, "dtype": key.dtype, "platform": key.platform,
+           "reason": decision.reason, "rung_old": decision.rung_old,
+           "rung_new": decision.rung_new,
+           "route_old": route_old.as_dict(),
+           "route_new": route_new.as_dict(),
+           "probe": None if decision.nonfinite else float(decision.probe),
+           "attrs": dict(attrs or {})}
+    if decision.nonfinite:
+        rec["nonfinite"] = True
+    if probe_value is not None:
+        rec["attrs"].setdefault("value", float(probe_value))
+    obs.emit_event("autotune", **rec)
+    if obs.metrics_active():
+        obs.gauge("dlaf_autotune_route", op=key.op, knob="rung").set(
+            float(decision.rung_new))
+        for knob, val in route_metric_values(route_new).items():
+            obs.gauge("dlaf_autotune_route", op=key.op, knob=knob).set(val)
+        obs.counter("dlaf_autotune_decisions_total", op=key.op,
+                    reason=decision.reason).inc()
+        if decision.reason == "escalate":
+            obs.counter("dlaf_autotune_escalations_total", op=key.op).inc()
+    if decision.reason == "exhausted":
+        from ..health.registry import strict_mode
+        from ..obs import flight
+
+        if obs.metrics_active():
+            obs.counter("dlaf_autotune_exhausted_total", op=key.op).inc()
+        # the open-state incident: the ladder top could not hold the
+        # budget — dump the ring (the exhausted record above is in it)
+        flight.trigger("autotune_exhausted", site=key.label,
+                       rung=decision.rung_new,
+                       ladder=ladder.name,
+                       bound_ratio=(None if decision.nonfinite
+                                    else float(decision.probe)))
+        obs.get_logger("autotune").warning_once(
+            ("autotune_exhausted", key.label),
+            f"autotune ladder exhausted at {key.label}: probe "
+            f"bound_ratio {decision.probe!r} breached the budget at the "
+            f"TOP rung ({decision.rung_new}) of the {ladder.name} "
+            "ladder — no safer route exists; DLAF_STRICT=1 raises",
+            site=key.label, rung=decision.rung_new)
+        if strict_mode():
+            from ..health.errors import AutotuneExhaustedError
+
+            raise AutotuneExhaustedError(
+                key.label, rung=decision.rung_new,
+                ladder=ladder.name,
+                bound_ratio=(float("inf") if decision.nonfinite
+                             else float(decision.probe)))
+    return decision

@@ -1,0 +1,207 @@
+"""Precision routes and their escalation ladders (docs/autotune.md).
+
+A :class:`Route` is one point in the precision/speed trade the autotuner
+steers: a set of OVERRIDES over the resolved config knobs that dominate
+TPU f64-emulation cost — the Ozaki slice count (``f64_gemm_slices``),
+the panel-solve refinement route (``f64_trsm``), the panel factorization
+kernels (``panel_impl``), and the Ozaki slice-reduction implementation
+(``ozaki_impl``). A field left ``None`` inherits the ordinary config
+resolution, so the EMPTY route is exactly the platform default — and the
+``DLAF_AUTOTUNE=0`` bitwise-passthrough contract falls out of the same
+property (tests/test_autotune.py).
+
+A *ladder* is an ordered tuple of routes from fastest/least-conservative
+(rung 0) to safest/most-conservative (top rung), with a ``start`` rung
+per ladder. Ladder discipline (docs/autotune.md):
+
+* every rung's overrides only BIND where the underlying route is active
+  (the slice count is only read on the mxu gemm path; ``ozaki_impl=
+  "pallas"`` only applies inside the mxu route; ``f64_trsm="native"``
+  and ``panel_impl="xla"`` coincide with the off-TPU defaults) — so on
+  CPU every rung of both ladders is behavior-inert and the decision
+  machinery can be drilled without perturbing numerics, while on TPU the
+  rungs move real silicon routes;
+* the ``start`` rung matches the platform default route, so a fresh
+  table changes nothing until probes justify a move.
+
+The ACTIVE route is carried in a contextvar (:func:`applied`) that the
+knob-resolution single owners consult (``tile_ops.blas._oz_slices`` /
+``trsm_panel_uses_mixed``, ``tile_ops.pallas_panel.panel_uses_fused``,
+the cholesky entry's ``ozaki_impl`` gate). Because those reads happen at
+trace time, every program cache on a route-sensitive path carries
+``Route.key()`` as a static cache-key component — a route change is a
+CACHE KEY change, dispatched through a different compiled program, never
+an in-place retrace (the PR 7/11 keyed-cache discipline; the zero-
+steady-state-retrace pin in tests/test_autotune.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+#: Fields a route may override, in serialization order. Each is the name
+#: of the config knob it shadows.
+ROUTE_FIELDS = ("f64_gemm_slices", "f64_trsm", "panel_impl", "ozaki_impl")
+
+
+@dataclasses.dataclass(frozen=True)
+class Route:
+    """One precision route: overrides over the resolved config knobs
+    (None = inherit the ordinary resolution)."""
+
+    f64_gemm_slices: Optional[int] = None
+    f64_trsm: Optional[str] = None        # "mixed" | "native"
+    panel_impl: Optional[str] = None      # "fused" | "xla"
+    ozaki_impl: Optional[str] = None      # "jnp" | "pallas"
+
+    def key(self) -> tuple:
+        """Hashable cache-key component for the program caches: a route
+        change MUST change every affected program's cache key (module
+        docstring). The empty route keys as ``()`` so route-free callers
+        keep their existing cache identities."""
+        items = tuple((f, getattr(self, f)) for f in ROUTE_FIELDS
+                      if getattr(self, f) is not None)
+        return items
+
+    def tag(self) -> str:
+        """Compact human/metric label, e.g. ``s5.ozpallas`` (``default``
+        for the empty route) — bounded cardinality: one per ladder rung."""
+        parts = []
+        if self.f64_gemm_slices is not None:
+            parts.append(f"s{self.f64_gemm_slices}")
+        if self.f64_trsm is not None:
+            parts.append(f"trsm_{self.f64_trsm}")
+        if self.panel_impl is not None:
+            parts.append(f"panel_{self.panel_impl}")
+        if self.ozaki_impl is not None:
+            parts.append(f"oz{self.ozaki_impl}")
+        return ".".join(parts) or "default"
+
+    def as_dict(self) -> dict:
+        """The non-None overrides (JSONL ``autotune`` record payload)."""
+        return {f: getattr(self, f) for f in ROUTE_FIELDS
+                if getattr(self, f) is not None}
+
+
+@dataclasses.dataclass(frozen=True)
+class Ladder:
+    """An escalation ladder: rungs fast -> safe, plus the start rung
+    (the platform-default route) and a stable identity string that the
+    persisted table refuses to warm-start across (a rung learned against
+    one ladder must not index into a different one)."""
+
+    name: str
+    rungs: Tuple[Route, ...]
+    start: int
+
+    def __post_init__(self):
+        assert 0 <= self.start < len(self.rungs), \
+            f"ladder {self.name}: start {self.start} outside rungs"
+
+    @property
+    def ident(self) -> str:
+        """Version-stable identity: name + rung count + every rung tag.
+        Any ladder edit changes it, which makes previously persisted
+        entries for it STALE (table.load refuses loudly)."""
+        return f"{self.name}:{len(self.rungs)}:" + \
+            ",".join(r.tag() for r in self.rungs)
+
+
+#: f64/complex128 ladder: the Ozaki slice count s=5..8 (arXiv:2604.04599's
+#: per-shape gemm-route selection), with the fused Pallas slice kernels
+#: (``ozaki_impl="pallas"``, ~48-bit double-f32 fold — the fastest, least
+#: conservative reduction) as the bottom rung and the native-f64 panel
+#: solves (``f64_trsm="native"``) as the safety top. Rung 3 (s=7, the
+#: TPU auto default) is the start. Every override only binds inside the
+#: mxu gemm route, so the whole ladder is inert where f64_gemm resolves
+#: "native" (CPU) — see the module docstring's ladder discipline.
+LADDER_F64 = Ladder(
+    name="f64",
+    rungs=(
+        Route(f64_gemm_slices=5, ozaki_impl="pallas"),
+        Route(f64_gemm_slices=5),
+        Route(f64_gemm_slices=6),
+        Route(f64_gemm_slices=7),
+        Route(f64_gemm_slices=8),
+        Route(f64_gemm_slices=8, f64_trsm="native"),
+    ),
+    start=3,
+)
+
+#: f32/bf16 ladder: the fused Pallas panel kernels (the TPU default,
+#: rung 0 = empty route) vs the generic XLA panel chain as the
+#: conservative escape (docs/pallas_panel.md documents the two impls as
+#: ulp-distinct at equal analytic budget; the generic route is the
+#: reference arbiter when a probe breaches).
+LADDER_F32 = Ladder(
+    name="f32",
+    rungs=(
+        Route(),
+        Route(panel_impl="xla"),
+    ),
+    start=0,
+)
+
+_LADDERS = {
+    np.dtype(np.float64): LADDER_F64,
+    np.dtype(np.complex128): LADDER_F64,
+    np.dtype(np.float32): LADDER_F32,
+    # bf16 shares the f32 panel treatment (pallas_panel._SUPPORTED)
+}
+
+
+def ladder_for(dtype) -> Optional[Ladder]:
+    """The ladder tuning this dtype's routes, or None (dtype untuned —
+    the autotuner leaves it entirely alone)."""
+    try:
+        dt = np.dtype(dtype)
+    except TypeError:
+        return None
+    if dt == np.dtype(np.float32):
+        return _LADDERS[dt]
+    if str(dt) == "bfloat16":
+        return LADDER_F32
+    return _LADDERS.get(dt)
+
+
+# ---------------------------------------------------------------------------
+# Active-route context
+# ---------------------------------------------------------------------------
+
+_ACTIVE: contextvars.ContextVar = contextvars.ContextVar(
+    "dlaf_autotune_route", default=None)
+
+
+def active() -> Optional[Route]:
+    """The route applied by the innermost :func:`applied` context (None =
+    no override, ordinary knob resolution)."""
+    return _ACTIVE.get()
+
+
+def override(field: str):
+    """The active route's override for ``field`` (None = inherit) — the
+    one consult the knob-resolution single owners make."""
+    route = _ACTIVE.get()
+    return None if route is None else getattr(route, field)
+
+
+@contextlib.contextmanager
+def applied(route: Optional[Route]):
+    """Apply ``route``'s overrides for the duration (None = no-op).
+    Entries hold this open across their builder-cache lookup AND the
+    first call, because the overrides are read at trace time — and every
+    such cache keys on ``Route.key()``, so a stale trace cannot be
+    reused under a different route (module docstring)."""
+    if route is None:
+        yield
+        return
+    token = _ACTIVE.set(route)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)

@@ -424,6 +424,55 @@ class Configuration:
     #: verifies regardless of the knob — the knob only picks the
     #: estimator mode ("0" checks with the "1" probe).
     accuracy: str = "0"
+    #: Accuracy-steered precision autotuning (``DLAF_AUTOTUNE``, ISSUE 15,
+    #: docs/autotune.md): "1" closes the loop on the accuracy signal —
+    #: the precision routes that dominate TPU f64-emulation cost
+    #: (``f64_gemm_slices`` / ``f64_trsm`` / ``panel_impl`` /
+    #: ``ozaki_impl``) are chosen per (op, n-bucket, nb, dtype, platform)
+    #: from a route table fed by PR 8's cheap Hutchinson probe after each
+    #: factorization: escalate one ladder rung immediately on a
+    #: ``bound_ratio`` breach, relax one rung after
+    #: ``autotune_relax_after`` consecutive comfortable probes
+    #: (dlaf_tpu.autotune; decisions are pure functions of
+    #: (table, probe), so drills replay exactly). "0" (the bitwise
+    #: passthrough: ladders start at the platform-default route, and off
+    #: nothing is probed or overridden). "auto" (default): 1 on TPU —
+    #: exactly where the emulation routes bind — and 0 elsewhere. Probe
+    #: cost: one O(n^2 k) device estimate per non-donated entry call;
+    #: donated inputs skip the probe (nothing to compare against).
+    autotune: str = "auto"
+    #: Route-table persistence path (``DLAF_AUTOTUNE_TABLE``,
+    #: docs/autotune.md): when non-empty, the autotuner warm-starts from
+    #: this schema-validated JSON table (malformed/stale/version-mismatch
+    #: refuses loudly, naming the field) and re-serializes it ATOMICALLY
+    #: after every decision, so learned routes survive restarts — the
+    #: committed ``.autotune_table.json`` is the repo's warm-start
+    #: convention (copy it aside before pointing a mutating run at it,
+    #: like ``.bench_history.jsonl``). Empty (default): in-memory only.
+    autotune_table: str = ""
+    #: Relax-comfort threshold (``DLAF_AUTOTUNE_MARGIN``): a probe with
+    #: ``bound_ratio <= margin`` counts toward relaxing one rung; ratios
+    #: in (margin, 1] hold the route (and reset the comfortable streak —
+    #: the documented hysteresis band, docs/autotune.md).
+    autotune_margin: float = 0.25
+    #: Consecutive comfortable probes required before the route relaxes
+    #: one rung toward the fast end (``DLAF_AUTOTUNE_RELAX_AFTER``) —
+    #: escalation on a breach is always immediate.
+    autotune_relax_after: int = 3
+    #: Probe cadence (``DLAF_AUTOTUNE_PROBE_EVERY``): the algorithm
+    #: entries run the Hutchinson probe on every K-th call per table
+    #: entry (the first call always probes). The probe is O(n^2 k)
+    #: against the factorization's O(n^3) — negligible at production
+    #: sizes, measurable at toy ones — so latency-sensitive deployments
+    #: amortize it here. Un-probed calls still apply the learned route;
+    #: the serve queue's per-dispatch residuals (already gated on
+    #: ``DLAF_ACCURACY``) ignore this cadence.
+    autotune_probe_every: int = 1
+    #: Per-site relax budget per process run (``DLAF_AUTOTUNE_BUDGET``):
+    #: at most this many relax route changes per table entry, bounding
+    #: route churn (each change is a new compiled program). Escalations
+    #: are NEVER budget-limited — safety moves always run. 0 = unbounded.
+    autotune_budget: int = 16
     #: Bucket ceilings of the serving layer (``DLAF_SERVE_BUCKETS``,
     #: docs/serving.md): a comma-separated ascending list of matrix sizes
     #: (e.g. "32,64,128") that :class:`dlaf_tpu.serve.Queue` rounds
@@ -633,6 +682,7 @@ _VALID_CHOICES = {
     "bcast_impl": ("psum", "tree"),
     "log": ("debug", "info", "warning", "error", "off"),
     "accuracy": ("0", "1", "full"),
+    "autotune": ("0", "1", "auto"),
 }
 
 
@@ -693,6 +743,21 @@ def _validate(cfg: Configuration) -> None:
     if not cfg.circuit_cooldown_s >= 0:
         raise ValueError(f"circuit_cooldown_s={cfg.circuit_cooldown_s}: "
                          "must be >= 0 (open -> half-open probe delay)")
+    if not 0 < cfg.autotune_margin <= 1:
+        raise ValueError(f"autotune_margin={cfg.autotune_margin}: must be "
+                         "in (0, 1] (the relax-comfort bound_ratio "
+                         "threshold; 1 would erase the hysteresis band)")
+    if cfg.autotune_relax_after < 1:
+        raise ValueError(f"autotune_relax_after={cfg.autotune_relax_after}:"
+                         " must be >= 1 (consecutive comfortable probes "
+                         "before a relax)")
+    if cfg.autotune_probe_every < 1:
+        raise ValueError(f"autotune_probe_every="
+                         f"{cfg.autotune_probe_every}: must be >= 1 "
+                         "(probe every K-th entry call per site)")
+    if cfg.autotune_budget < 0:
+        raise ValueError(f"autotune_budget={cfg.autotune_budget}: must be "
+                         ">= 0 (0 = unbounded per-site relax budget)")
     parse_serve_buckets(cfg.serve_buckets)   # raises on a malformed list
     # cholesky_trailing is validated against VALID_TRAILING at the use site
     # (algorithms/cholesky.py) to keep the list next to the implementations
@@ -839,9 +904,24 @@ def resolved_f64_gemm() -> str:
                "2026-08-01 v5e session")
 
 
+def _route_override(field: str):
+    """The active autotune route's override for ``field`` (None =
+    inherit the ordinary resolution) — docs/autotune.md. Consulted by
+    the knob resolvers whose decisions the autotuner steers; every
+    program cache on such a path carries the route in its cache key
+    (dlaf_tpu.autotune.routes module docstring)."""
+    from .autotune.routes import override
+
+    return override(field)
+
+
 def resolved_f64_trsm() -> str:
     """``f64_trsm`` with "auto" resolved: mixed on TPU, native elsewhere
-    (see the knob docstring for the measurement basis)."""
+    (see the knob docstring for the measurement basis). An active
+    autotune route (docs/autotune.md) overrides the resolution."""
+    routed = _route_override("f64_trsm")
+    if routed is not None:
+        return routed
     return resolve_platform_auto(
         get_configuration().f64_trsm, knob="f64_trsm",
         tpu_choice="mixed", other_choice="native",
@@ -853,7 +933,11 @@ def resolved_panel_impl() -> str:
     """``panel_impl`` with "auto" resolved: fused on TPU, xla elsewhere
     (platform leg only — the dtype/block-size leg lives in
     ``tile_ops.pallas_panel.panel_uses_fused``, the route's single
-    owner)."""
+    owner). An active autotune route (docs/autotune.md) overrides the
+    resolution."""
+    routed = _route_override("panel_impl")
+    if routed is not None:
+        return routed
     return resolve_platform_auto(
         get_configuration().panel_impl, knob="panel_impl",
         tpu_choice="fused", other_choice="xla",

@@ -317,8 +317,9 @@ def bt_band_to_tridiag(tri: TridiagResult, evecs):
 
 
 @register_program_cache
-@functools.partial(jax.jit, static_argnames=("nb", "la"))
-def _bt_r2b_local(a_v, taus, e, *, nb: int, la: bool = False):
+@functools.partial(jax.jit, static_argnames=("nb", "la", "route"))
+def _bt_r2b_local(a_v, taus, e, *, nb: int, la: bool = False,
+                  route: tuple = ()):
     """C <- (I - V T V^H) C per reflector block, reverse order.
 
     ``la`` (``bt_lookahead=1``, docs/eigensolver_perf.md): the next
@@ -540,7 +541,11 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band, la: bool = False):
 
 @register_program_cache
 @functools.lru_cache(maxsize=32)
-def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band, scan=False, la=False):
+def _dist_bt_r2b_cached(dist_a, dist_c, mesh, band, scan=False, la=False,
+                        route=()):
+    # ``route``: the eigensolver's active autotune route as a pure
+    # cache-key member (docs/autotune.md) — the bulk trmm/gemm
+    # application reads _oz_slices at trace time on the mxu path
     build = _build_dist_bt_r2b_scan if scan else _build_dist_bt_r2b
     return jax.jit(build(dist_a, dist_c, mesh, band, la=la))
 
@@ -558,7 +563,7 @@ def _bt_r2b_entry_span(red: BandReduction, n: int, m: int, la: bool,
         band=red.band, dtype=dt.name, bt_lookahead=int(la), grid=grid))
 
 
-def bt_reduction_to_band(red: BandReduction, evecs):
+def bt_reduction_to_band(red: BandReduction, evecs, *, route: tuple = ()):
     """Eigenvectors of the ORIGINAL matrix from eigenvectors of the band
     matrix: apply the panel reflector blocks in reverse order.
 
@@ -594,7 +599,7 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         fn = _dist_bt_r2b_cached(a.dist, evecs.dist, a.grid.mesh, red.band,
                                  scan=resolve_step_mode(max(
                                      -(-a.size.row // red.band) - 1, 1))
-                                 == "scan", la=la)
+                                 == "scan", la=la, route=route)
         with _bt_r2b_entry_span(
                 red, a.size.row, evecs.size.col, la,
                 f"{a.dist.grid_size.row}x{a.dist.grid_size.col}"):
@@ -618,7 +623,7 @@ def bt_reduction_to_band(red: BandReduction, evecs):
         out = obs.telemetry.call("bt_reduction_to_band.local",
                                  _bt_r2b_local, a_v,
                                  memory.as_device(red.taus), e, nb=red.band,
-                                 la=la)
+                                 la=la, route=route)
     if ret_matrix:
         return Matrix(evecs.dist, global_to_tiles(out, evecs.dist), evecs.grid)
     return out

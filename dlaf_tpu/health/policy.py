@@ -270,6 +270,14 @@ def with_policy(site: str, fn: Callable, *args,
         # queue uses, with op = the policy site — observe_latency no-ops
         # when metrics are off
         obs.observe_latency(site, elapsed)
+        # a recovered retry must not leak its failure: the caught
+        # exception's traceback references THIS frame (the classic tb
+        # reference cycle), so returning with `last` still bound keeps
+        # every object in the guarded call chain — a serve Queue, its
+        # batch arrays — alive until the next cyclic GC pass (observed:
+        # /healthz listing a long-dead queue whose dispatch once
+        # retried through an injected fault)
+        last = None
         return result
     assert last is not None  # attempts() only exhausts on marked failures
     raise last

@@ -25,7 +25,10 @@ schema owner) and their call sites:
 * ``healthz_failure`` — the live ``/healthz`` endpoint fails to build
   its payload (obs/exporter.py);
 * ``slo_breach_burst`` — >= ``DLAF_SLO_BURST`` over-objective latencies
-  inside one rolling SLO window for one op (obs/slo.py, ISSUE 14).
+  inside one rolling SLO window for one op (obs/slo.py, ISSUE 14);
+* ``autotune_exhausted`` — an accuracy probe breached the budget at the
+  TOP rung of a precision ladder: no safer route exists
+  (autotune/controller.py, ISSUE 15; docs/autotune.md).
 
 Per-reason cooldown (default 60 s, injectable clock): the FIRST shed of
 a burst dumps; the next thousand do not re-dump the same ring. Dumps

@@ -59,6 +59,15 @@ Flags:
                             operations): >= 1 flight_trigger record with
                             a known reason AND >= 1 ordinary pre-trigger
                             record captured by the ring
+    --require-autotune      fail unless the artifact carries the
+                            closed-loop precision-steering trail
+                            (DLAF_AUTOTUNE, docs/autotune.md): >= 1
+                            autotune record with reason escalate|relax
+                            (the loop actually moved a route), and no
+                            site whose LAST decision is 'exhausted' —
+                            an artifact ending with the ladder pinned
+                            at its top under a breach is an open
+                            incident and must be REJECTED
     --require-devtrace      fail unless the artifact carries the
                             device-timeline attribution trail (ISSUE 14,
                             docs/observability.md): >= 1 measured_overlap
@@ -106,7 +115,7 @@ def main(argv=None) -> int:
              "--require-bt-overlap", "--require-telemetry",
              "--require-accuracy", "--require-serve",
              "--require-resilience", "--require-flight",
-             "--require-devtrace", "--history",
+             "--require-devtrace", "--require-autotune", "--history",
              "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
@@ -144,7 +153,8 @@ def main(argv=None) -> int:
         require_serve="--require-serve" in flags,
         require_resilience="--require-resilience" in flags,
         require_flight="--require-flight" in flags,
-        require_devtrace="--require-devtrace" in flags)
+        require_devtrace="--require-devtrace" in flags,
+        require_autotune="--require-autotune" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -158,6 +168,7 @@ def main(argv=None) -> int:
     n_flight = sum(r.get("type") == "flight_trigger" for r in records)
     n_devtrace = sum(r.get("type") in ("devtrace", "measured_overlap")
                      for r in records)
+    n_autotune = sum(r.get("type") == "autotune" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
@@ -166,6 +177,7 @@ def main(argv=None) -> int:
     extra += f", {n_res} resilience records" if n_res else ""
     extra += f", {n_flight} flight triggers" if n_flight else ""
     extra += f", {n_devtrace} devtrace records" if n_devtrace else ""
+    extra += f", {n_autotune} autotune decisions" if n_autotune else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

@@ -41,6 +41,13 @@ analytic-budget leg, this gates a brand-new serve measurement before
 any history accumulates, and a committed serve history line keeps the
 floor enforced in every ``--replay``.
 
+``workload="autotune"`` lines (bench.py's accuracy-steered precision
+arm, ISSUE 15, docs/autotune.md) face the analogous history-free leg:
+their learned-table vs pinned-worst-case-route ``speedup`` field must
+be >= ``--min-autotune-speedup`` (default 0.5 — parity minus
+probe-per-call overhead on platforms where the ladder is inert; on TPU
+the learned routes sit well above 1).
+
 Exit status: 0 = no regression; 1 = regression (or invalid history /
 no usable fresh measurements); 2 = usage error.
 """
@@ -107,10 +114,42 @@ def baselines(history, best_k: int) -> dict:
 
 DEFAULT_MIN_SERVE_SPEEDUP = 3.0
 
+#: History-free floor on the autotune arm's learned-table vs pinned-
+#: worst-case-route speedup (ISSUE 15): the learned routes must never
+#: cost more than this fraction of the conservative route's throughput.
+#: On CPU every ladder rung is behavior-inert, so the honest expectation
+#: is parity minus probe overhead — and at the arm's toy sizes the
+#: O(n^2 k) probe is a real fraction of the O(n^3) factor (measured
+#: ~0.7-0.8x at n=192-512 with probe-per-call; DLAF_AUTOTUNE_PROBE_EVERY
+#: amortizes it in production). 0.5 trips a pathological steering loop
+#: without tripping probe arithmetic; on TPU the learned routes are the
+#: whole point and sit well above 1.
+DEFAULT_MIN_AUTOTUNE_SPEEDUP = 0.5
+
+
+def _best_speedup_per_key(fresh, workload: str) -> dict:
+    """Best finite ``speedup`` field per key among ``workload`` lines —
+    the bench protocol is best-of, so one slow pass must not trip a key
+    whose best pass cleared the bar."""
+    best: dict = {}
+    for line in fresh:
+        if line.get("workload") != workload:
+            continue
+        s = line.get("speedup")
+        if not isinstance(s, (int, float)) or isinstance(s, bool) \
+                or not math.isfinite(s):
+            continue
+        key = measurement_key(line)
+        if key not in best or s > best[key]:
+            best[key] = float(s)
+    return best
+
 
 def run_gate(history, fresh, *, tolerance: float, min_history: int,
              best_k: int, log=print,
-             min_serve_speedup: float = DEFAULT_MIN_SERVE_SPEEDUP) -> int:
+             min_serve_speedup: float = DEFAULT_MIN_SERVE_SPEEDUP,
+             min_autotune_speedup: float
+             = DEFAULT_MIN_AUTOTUNE_SPEEDUP) -> int:
     """Compare fresh bests against history baselines; returns the number
     of regressed keys. Keys without fresh measurements are skipped (the
     gate judges what this run measured, not what it skipped — bench.py's
@@ -150,20 +189,8 @@ def run_gate(history, fresh, *, tolerance: float, min_history: int,
         else:
             log(f"OK         {fmt_key(key)}: {new:.2f} >= {floor:.2f} GF/s "
                 f"(baseline {bl:.2f}, {n_hist} entries)")
-    # serve-speedup floor: judge the BEST fresh speedup per key (the
-    # bench protocol is best-of, and one slow pass must not trip a key
-    # whose best pass cleared the bar)
-    best_speedup: dict = {}
-    for line in fresh:
-        if line.get("workload") != "serve":
-            continue
-        s = line.get("speedup")
-        if not isinstance(s, (int, float)) or isinstance(s, bool) \
-                or not math.isfinite(s):
-            continue
-        key = measurement_key(line)
-        if key not in best_speedup or s > best_speedup[key]:
-            best_speedup[key] = float(s)
+    # serve-speedup floor: judge the BEST fresh speedup per key
+    best_speedup = _best_speedup_per_key(fresh, "serve")
     for key in sorted(best_speedup, key=fmt_key):
         s = best_speedup[key]
         if s < min_serve_speedup:
@@ -174,6 +201,20 @@ def run_gate(history, fresh, *, tolerance: float, min_history: int,
         else:
             log(f"OK         {fmt_key(key)}: batched-vs-singles speedup "
                 f"{s:.2f}x >= {min_serve_speedup:.1f}x")
+    # autotune-speedup floor (ISSUE 15, docs/autotune.md): the learned
+    # route table vs the pinned worst-case route (s=8 + native trsm) —
+    # history-free like the serve leg, so a first-round autotune
+    # measurement already gates
+    for key, s in sorted(_best_speedup_per_key(fresh, "autotune").items(),
+                         key=lambda kv: fmt_key(kv[0])):
+        if s < min_autotune_speedup:
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: learned-vs-pinned-worst "
+                f"speedup {s:.2f}x < {min_autotune_speedup:.2f}x "
+                "(ISSUE-15 autotune floor; history-free leg)")
+        else:
+            log(f"OK         {fmt_key(key)}: learned-vs-pinned-worst "
+                f"speedup {s:.2f}x >= {min_autotune_speedup:.2f}x")
     return regressions
 
 
@@ -201,6 +242,11 @@ def main(argv=None) -> int:
                     default=DEFAULT_MIN_SERVE_SPEEDUP,
                     help="history-free floor on the serve arm's batched-"
                          "vs-singles speedup field (ISSUE 11: >= 3x)")
+    ap.add_argument("--min-autotune-speedup", type=float,
+                    default=DEFAULT_MIN_AUTOTUNE_SPEEDUP,
+                    help="history-free floor on the autotune arm's "
+                         "learned-table vs pinned-worst-case-route "
+                         "speedup field (ISSUE 15; docs/autotune.md)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -250,7 +296,8 @@ def main(argv=None) -> int:
     regressions = run_gate(history, fresh, tolerance=args.tolerance,
                            min_history=args.min_history,
                            best_k=args.best_k,
-                           min_serve_speedup=args.min_serve_speedup)
+                           min_serve_speedup=args.min_serve_speedup,
+                           min_autotune_speedup=args.min_autotune_speedup)
     if regressions:
         print(f"bench_gate: {regressions} regressed key(s)",
               file=sys.stderr)

@@ -233,6 +233,111 @@ class TestPallasFused:
             config.initialize()
 
 
+class TestFusedKernelExactness:
+    """The BASELINE.md round-2 pending interpret-mode parity pins
+    (ISSUE 15 satellite): the rewritten predicated-square-grid fused
+    slice kernels' numerical contract checked EXACTLY, not just within
+    tolerance — the per-shift int32 group sums are exact integers, and
+    the double-f32 fold is a deterministic f32 op sequence, so the
+    kernels can be pinned against an independent numpy replay of that
+    sequence bit for bit. (Hardware re-probe stays pending on the
+    tunnel, docs/ROUND4.md; these pins make a future silicon run a
+    drop-in check instead of a debug session.)"""
+
+    S = 6
+
+    def _slices(self, m, k, n, seed=41):
+        from dlaf_tpu.tile_ops import ozaki as oz
+
+        rng = np.random.default_rng(seed)
+        a = rng.standard_normal((m, k))
+        b = rng.standard_normal((k, n))
+        sa = np.asarray(oz._scale(jnp.asarray(a), axis=-1))
+        sb = np.asarray(oz._scale(jnp.asarray(b), axis=-2))
+        ia = jnp.stack(oz._peel_slices(jnp.asarray(a / sa * 0.5), self.S))
+        ib = jnp.stack(oz._peel_slices(jnp.asarray(b / sb * 0.5), self.S))
+        return ia, ib
+
+    @staticmethod
+    def _fold_reference(ia, ib):
+        """Numpy replay of pallas_ozaki._fold_body: exact int64 group
+        sums, the exact int32 -> double-f32 split, and the two-sum fold
+        — the kernels must reproduce this BIT FOR BIT."""
+        from dlaf_tpu.tile_ops.ozaki import SLICE_BITS
+
+        s = ia.shape[0]
+        ia64 = np.asarray(ia, np.int64)
+        ib64 = np.asarray(ib, np.int64)
+        hi = np.zeros((ia.shape[1], ib.shape[2]), np.float32)
+        lo = np.zeros_like(hi)
+        for d in range(s):
+            p = np.zeros_like(hi, dtype=np.int64)
+            for t in range(d + 1):
+                p = p + ia64[t] @ ib64[d - t]
+            phi = p.astype(np.float32)
+            plo = (p - phi.astype(np.int64)).astype(np.float32)
+            scale = np.float32(2.0 ** (-SLICE_BITS * (d + 2)))
+            # Knuth two-sum in f32, exactly as the kernel spells it
+            b32 = phi * scale
+            ssum = hi + b32
+            bb = ssum - hi
+            err = (hi - (ssum - bb)) + (b32 - bb)
+            hi = ssum
+            lo = lo + (err + plo * scale)
+        return hi, lo
+
+    def test_fused_product_matches_exact_fold_replay(self):
+        from dlaf_tpu.tile_ops.pallas_ozaki import fused_slice_product
+
+        ia, ib = self._slices(40, 64, 24)
+        hi, lo = fused_slice_product(ia, ib, block_m=16, block_n=16,
+                                     interpret=True)
+        rhi, rlo = self._fold_reference(ia, ib)
+        assert np.array_equal(np.asarray(hi), rhi)
+        assert np.array_equal(np.asarray(lo), rlo)
+
+    def test_fused_dot_routes_bit_identical(self):
+        """int8 vs bf16 slice dots (the ozaki_dot A/B, integer-exact by
+        the k*2^12 <= 2^24 bound): identical hi AND lo planes."""
+        from dlaf_tpu.tile_ops.pallas_ozaki import fused_slice_product
+
+        ia, ib = self._slices(32, 48, 32)
+        h8, l8 = fused_slice_product(ia, ib, block_m=16, block_n=16,
+                                     interpret=True, dot="int8")
+        hb, lb = fused_slice_product(ia, ib, block_m=16, block_n=16,
+                                     interpret=True, dot="bf16")
+        assert np.array_equal(np.asarray(h8), np.asarray(hb))
+        assert np.array_equal(np.asarray(l8), np.asarray(lb))
+
+    def test_fused_syrk_matches_product_on_lower_tiles(self):
+        """The predicated syrk (strict-upper tiles skipped) equals the
+        general product of the same slices on every lower tile, bit for
+        bit, and is exactly zero above the block diagonal."""
+        from dlaf_tpu.tile_ops.pallas_ozaki import (fused_slice_product,
+                                                    fused_slice_syrk)
+
+        ia, _ = self._slices(48, 32, 8)
+        block = 16
+        hs, ls = fused_slice_syrk(ia, block=block, interpret=True)
+        hp, lp = fused_slice_product(ia, jnp.swapaxes(ia, 1, 2),
+                                     block_m=block, block_n=block,
+                                     interpret=True)
+        m = ia.shape[1]
+        nt = m // block
+        for r in range(nt):
+            for c in range(nt):
+                sl = (slice(r * block, (r + 1) * block),
+                      slice(c * block, (c + 1) * block))
+                if c <= r:
+                    assert np.array_equal(np.asarray(hs[sl]),
+                                          np.asarray(hp[sl])), (r, c)
+                    assert np.array_equal(np.asarray(ls[sl]),
+                                          np.asarray(lp[sl])), (r, c)
+                else:
+                    assert np.all(np.asarray(hs[sl]) == 0.0)
+                    assert np.all(np.asarray(ls[sl]) == 0.0)
+
+
 class TestContract:
     """blas.contract: the einsum->slice-product factorization must equal
     jnp.einsum for every pattern the algorithms use, real and complex."""
