@@ -165,6 +165,64 @@ EOF
       > "$OBS_DIR/mfu_measured.txt"
     grep -q "measured(dev) GF/s" "$OBS_DIR/mfu_measured.txt"
     grep -Eq "cpu [0-9]+/[0-9]+" "$OBS_DIR/mfu_measured.txt"
+    # the measured bound column must also fill from the critpath fixture
+    grep -q "measured bound" "$OBS_DIR/mfu_measured.txt"
+    echo "== smoke: critical-path attribution (obs.critpath, ISSUE 16) =="
+    # the telemetry-armed traced run above carries schedule records:
+    # reconstruct the live per-step timeline and gate the artifact with
+    # --require-critpath (>= 1 multi-step critpath record at or above
+    # the coverage floor + >= 1 whatif projection)
+    python -m dlaf_tpu.obs.critpath "$OBS_DIR/trace" \
+      "$OBS_DIR/merged.jsonl" -o "$OBS_DIR/critpath.jsonl" \
+      | tee "$OBS_DIR/critpath_report.txt"
+    grep -q "critical path" "$OBS_DIR/critpath_report.txt"
+    grep -q "what-if" "$OBS_DIR/critpath_report.txt"
+    python -m dlaf_tpu.obs.validate "$OBS_DIR/critpath.jsonl" \
+      --require-critpath
+    # hermetic fixture replay: the committed tests/fixtures/critpath/
+    # fixture must reproduce per-step bound classification AND a NONZERO
+    # measured step-boundary gap (the fixture's documented 2 ms
+    # synthetic injection — scripts/refresh_devtrace_fixture.py)
+    python -m dlaf_tpu.obs.critpath tests/fixtures/critpath/trace.json.gz \
+      tests/fixtures/critpath/merged.jsonl \
+      -o "$OBS_DIR/critpath_fixture.jsonl" > /dev/null
+    python -m dlaf_tpu.obs.validate "$OBS_DIR/critpath_fixture.jsonl" \
+      --require-critpath
+    python - "$OBS_DIR" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(f"{sys.argv[1]}/critpath_fixture.jsonl")]
+cps = [r for r in recs if r["type"] == "critpath" and r["algo"] == "cholesky"]
+assert cps, "fixture replay produced no cholesky critpath record"
+steps = [s for r in cps for s in r["steps"] if not s.get("empty")]
+bounds = {s["bound"] for s in steps}
+gaps = [s.get("gap_after_s", 0.0) for s in steps]
+assert bounds, "no per-step bound classification"
+assert max(gaps) > 0.0, f"fixture carries no step-boundary gap: {gaps}"
+print(f"fixture replay ok: bounds {sorted(bounds)}, "
+      f"max step-boundary gap {max(gaps) * 1e3:.3f} ms")
+EOF
+    echo "== smoke: gap-injection must-trip drill (critpath explainer) =="
+    # inject a 5 ms stall before cholesky.step003 at the TRACE level and
+    # diff against the clean fixture replay: perf_diff must exit
+    # SPECIFICALLY 1 with a REGRESSION line naming the injected step's
+    # gap — the step-level gate-to-diagnosis contract
+    python -m dlaf_tpu.obs.critpath tests/fixtures/critpath/trace.json.gz \
+      tests/fixtures/critpath/merged.jsonl \
+      --inject-gap cholesky.step003=5.0 \
+      -o "$OBS_DIR/critpath_injected.jsonl" > /dev/null
+    drill_rc=0
+    python scripts/perf_diff.py "$OBS_DIR/critpath_fixture.jsonl" \
+      "$OBS_DIR/critpath_injected.jsonl" \
+      > "$OBS_DIR/critpath_drill.log" 2>&1 || drill_rc=$?
+    if [ "$drill_rc" -ne 1 ] \
+        || ! grep -q "REGRESSION.*cholesky\.step003 gap" \
+             "$OBS_DIR/critpath_drill.log"; then
+      echo "gap-injection drill did not name the injected step" \
+           "(rc=$drill_rc, wanted rc=1 + REGRESSION naming" \
+           "cholesky.step003 gap)" >&2
+      cat "$OBS_DIR/critpath_drill.log" >&2; exit 1
+    fi
+    echo "perf_diff correctly named the injected step-boundary gap"
     echo "== smoke: bench-regression gate (replay + injection drill) =="
     # clean replay of the committed history must pass; a 20% synthetic
     # slowdown must trip the gate (exit nonzero) — proving the gate

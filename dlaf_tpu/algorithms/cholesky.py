@@ -992,9 +992,13 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         la = None
         ch_next = None
         for k in range(nt):
-            # phase name on the compiled program's op metadata (device
-            # timeline) + per-step tile-slot accounting; all trace-time
-            with obs.named_span(f"cholesky.k{k:03d}"):
+            # uniform per-step phase scopes (`cholesky.step<k>.<phase>`,
+            # docs/observability.md critical-path attribution): the names
+            # land on the compiled program's op metadata, so the critpath
+            # joiner can put every device interval on its (step, phase).
+            # Names carry no repeat index — identical across runs, so
+            # histograms never fork. Counters are all trace-time.
+            with obs.named_span(f"cholesky.step{k:03d}"):
                 if obs.metrics_active():
                     obs.counter("dlaf_algo_tile_ops_total",
                                 algo="cholesky_dist", op="potrf").inc()
@@ -1009,23 +1013,36 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                     # comm look-ahead (docs/comm_overlap.md): step k+1's
                     # panel chain — its bcast2d/bcast/all_gather included
                     # — is emitted between step k's strip and step k's
-                    # bulk product, reading only the carried strip values
-                    ch = ch_next if ch_next is not None \
-                        else panel_chain(lt, k, la)
-                    lt, la = step_pre(lt, k, ch)
+                    # bulk product, reading only the carried strip values.
+                    # The hoisted chain is scoped as step k+1's PANEL even
+                    # though it executes inside step k's window — that is
+                    # the overlap the critpath report must see.
+                    if ch_next is not None:
+                        ch = ch_next
+                    else:
+                        with obs.named_span(f"cholesky.step{k:03d}.panel"):
+                            ch = panel_chain(lt, k, la)
+                    with obs.named_span(f"cholesky.step{k:03d}.strip"):
+                        lt, la = step_pre(lt, k, ch)
                     ch_next = None
                     if k + 1 < nt and la is not None:
-                        ch_next = panel_chain(None, k + 1, la)
+                        with obs.named_span(
+                                f"cholesky.step{k + 1:03d}.panel"):
+                            ch_next = panel_chain(None, k + 1, la)
                         n_row, n_col = chain_comm_counts(k + 1)
                         cc.record_overlapped("cholesky_dist", ROW_AXIS,
                                              n_row)
                         cc.record_overlapped("cholesky_dist", COL_AXIS,
                                              n_col)
-                    lt = step_bulk(lt, k, ch, la is not None)
+                    with obs.named_span(f"cholesky.step{k:03d}.bulk"):
+                        lt = step_bulk(lt, k, ch, la is not None)
                 else:
-                    ch = panel_chain(lt, k, la)
-                    lt, la = step_pre(lt, k, ch)
-                    lt = step_bulk(lt, k, ch, la is not None)
+                    with obs.named_span(f"cholesky.step{k:03d}.panel"):
+                        ch = panel_chain(lt, k, la)
+                    with obs.named_span(f"cholesky.step{k:03d}.strip"):
+                        lt, la = step_pre(lt, k, ch)
+                    with obs.named_span(f"cholesky.step{k:03d}.bulk"):
+                        lt = step_bulk(lt, k, ch, la is not None)
         if with_info:
             return lt, _dist_factor_info(lt, dist)
         return lt
@@ -1450,13 +1467,21 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                     pvc = jnp.zeros((ltc_s, mb, mb), lt.dtype)
                 else:
                     pvr, pvc = pvr[-ltr_s:], pvc[-ltc_s:]
+                # scan bodies carry the index-free `cholesky.scanstep`
+                # scope: ONE traced body serves every iteration, so
+                # per-step critpath reconstruction uses occurrence order
+                # (docs/observability.md, one-traced-body limitation)
                 (sub, pvr, pvc), _ = jax.lax.scan(
-                    make_step_la(lu_r0, lu_c0, ltr_s, ltc_s),
+                    obs.scoped_step(
+                        "cholesky.scanstep",
+                        make_step_la(lu_r0, lu_c0, ltr_s, ltc_s)),
                     (sub, pvr, pvc), jnp.arange(k0_seg, k0_seg + seg_len))
             else:
                 _count_step_modes("cholesky_dist_scan", 0, seg_len)
                 sub, _ = jax.lax.scan(
-                    make_step(lu_r0, lu_c0, ltr_s, ltc_s), sub,
+                    obs.scoped_step(
+                        "cholesky.scanstep",
+                        make_step(lu_r0, lu_c0, ltr_s, ltc_s)), sub,
                     jnp.arange(k0_seg, k0_seg + seg_len))
             lt = lt.at[lu_r0:, lu_c0:].set(sub)
         if with_info:

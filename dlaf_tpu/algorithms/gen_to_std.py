@@ -688,24 +688,37 @@ def _build_dist_hegst(dist, mesh, uplo: str, use_mxu=False, cplx=False,
         rc = (cc.this_rank(COL_AXIS) - sc) % Qc
         la = None
         ch_next = None
+        # uniform per-step phase scopes (`hegst.step<k>.<phase>`, shared
+        # convention with cholesky — docs/observability.md critical-path
+        # attribution); the comm_la-hoisted chain is scoped as step k+1's
+        # PANEL even though it executes inside step k's window
         for k in range(nt):
             if comm_la:
                 # step k+1's panel chain (collectives included) emitted
                 # between step k's strip and step k's bulk her2k
-                ch = ch_next if ch_next is not None \
-                    else chain(lt, ll, k, la, rr, rc)
-                lt, la = step_pre(lt, k, ch, rr, rc)
+                if ch_next is not None:
+                    ch = ch_next
+                else:
+                    with obs.named_span(f"hegst.step{k:03d}.panel"):
+                        ch = chain(lt, ll, k, la, rr, rc)
+                with obs.named_span(f"hegst.step{k:03d}.strip"):
+                    lt, la = step_pre(lt, k, ch, rr, rc)
                 ch_next = None
                 if k + 1 < nt and la is not None:
-                    ch_next = chain(None, ll, k + 1, la, rr, rc)
+                    with obs.named_span(f"hegst.step{k + 1:03d}.panel"):
+                        ch_next = chain(None, ll, k + 1, la, rr, rc)
                     n_row, n_col = chain_comm_counts(k + 1)
                     cc.record_overlapped("hegst_dist", ROW_AXIS, n_row)
                     cc.record_overlapped("hegst_dist", COL_AXIS, n_col)
-                lt = step_bulk(lt, k, ch, la is not None, rr, rc)
+                with obs.named_span(f"hegst.step{k:03d}.bulk"):
+                    lt = step_bulk(lt, k, ch, la is not None, rr, rc)
             else:
-                ch = chain(lt, ll, k, la, rr, rc)
-                lt, la = step_pre(lt, k, ch, rr, rc)
-                lt = step_bulk(lt, k, ch, la is not None, rr, rc)
+                with obs.named_span(f"hegst.step{k:03d}.panel"):
+                    ch = chain(lt, ll, k, la, rr, rc)
+                with obs.named_span(f"hegst.step{k:03d}.strip"):
+                    lt, la = step_pre(lt, k, ch, rr, rc)
+                with obs.named_span(f"hegst.step{k:03d}.bulk"):
+                    lt = step_bulk(lt, k, ch, la is not None, rr, rc)
         return lt
 
     return shard_map(transform, mesh=mesh,

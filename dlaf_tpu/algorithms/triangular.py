@@ -134,21 +134,28 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
         else:
             forward = not eff_lower
         order = range(nt) if forward else range(nt - 1, -1, -1)
+        # uniform per-step phase scopes (`trsm.step<k>.<phase>`, shared
+        # convention with cholesky — docs/observability.md critical-path
+        # attribution). Backward sweeps keep the GLOBAL step index k in
+        # the name; the critpath joiner orders steps by time, not index.
         for k in order:
-            akk = bcast_diag(ctx_a, lta, k)
-            if k == nt - 1:  # short edge tile: keep the solve nonsingular
-                akk = pad_diag_identity(akk, min(mb, n - k * mb))
+            with obs.named_span(f"trsm.step{k:03d}.panel"):
+                akk = bcast_diag(ctx_a, lta, k)
+                if k == nt - 1:  # short edge tile: keep the solve nonsingular
+                    akk = pad_diag_identity(akk, min(mb, n - k * mb))
             if side == "L":
-                # solve op(Akk) Xk = Bk for tile row k of B (all local cols)
-                bk = row_panel(ctx_b, ltb, k, 0)
-                # pivot-diag solve on the panel_impl route (fused Pallas
-                # strip kernel or the XLA chain; docs/pallas_panel.md)
-                xk = ppan.panel_solve("L", uplo, op, diag, akk, bk,
-                                      fused=panel_fused,
-                                      interpret=panel_interpret)
-                own = ctx_b.rank_r == ctx_b.owner_r(k)
-                row = ctx_b.kr(k)
-                ltb = ltb.at[row].set(jnp.where(own, xk, ltb[row]))
+                with obs.named_span(f"trsm.step{k:03d}.panel"):
+                    # solve op(Akk) Xk = Bk for tile row k of B (all
+                    # local cols) — pivot-diag solve on the panel_impl
+                    # route (fused Pallas strip kernel or the XLA chain;
+                    # docs/pallas_panel.md)
+                    bk = row_panel(ctx_b, ltb, k, 0)
+                    xk = ppan.panel_solve("L", uplo, op, diag, akk, bk,
+                                          fused=panel_fused,
+                                          interpret=panel_interpret)
+                    own = ctx_b.rank_r == ctx_b.owner_r(k)
+                    row = ctx_b.kr(k)
+                    ltb = ltb.at[row].set(jnp.where(own, xk, ltb[row]))
                 # remaining rows i: B[i,:] -= E[i,k] @ Xk
                 if forward:
                     lu = ctx_b.row_start(k + 1)
@@ -159,26 +166,29 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                 count = sl.stop - sl.start if sl.stop is not None else 0
                 if count <= 0:
                     continue
-                g = ctx_b.g_rows(lu, count)
-                rem = (g > k) if forward else (g < k)
-                rem = rem & (g < nt)
-                if op == "N":
-                    e = col_panel(ctx_a, lta, k, lu)[:count]  # A[i,k] my rows
-                else:
-                    rk = row_panel(ctx_a, lta, k, 0)      # A[k,j] my cols
-                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
-                e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
-                upd = tb.contract("rab,cbd->rcad", e, xk)
-                ltb = ltb.at[sl].add(-upd)
+                with obs.named_span(f"trsm.step{k:03d}.bulk"):
+                    g = ctx_b.g_rows(lu, count)
+                    rem = (g > k) if forward else (g < k)
+                    rem = rem & (g < nt)
+                    if op == "N":
+                        e = col_panel(ctx_a, lta, k, lu)[:count]  # A[i,k] my rows
+                    else:
+                        rk = row_panel(ctx_a, lta, k, 0)      # A[k,j] my cols
+                        e = _tile_op(transpose_row_to_cols(ctx_a, rk, 0, g), op)
+                    e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                    upd = tb.contract("rab,cbd->rcad", e, xk)
+                    ltb = ltb.at[sl].add(-upd)
             else:
-                # solve Xk op(Akk) = Bk for tile col k of B (all local rows)
-                bk = col_panel(ctx_b, ltb, k, 0)
-                xk = ppan.panel_solve("R", uplo, op, diag, akk, bk,
-                                      fused=panel_fused,
-                                      interpret=panel_interpret)
-                own = ctx_b.rank_c == ctx_b.owner_c(k)
-                col = ctx_b.kc(k)
-                ltb = ltb.at[:, col].set(jnp.where(own, xk, ltb[:, col]))
+                with obs.named_span(f"trsm.step{k:03d}.panel"):
+                    # solve Xk op(Akk) = Bk for tile col k of B (all
+                    # local rows)
+                    bk = col_panel(ctx_b, ltb, k, 0)
+                    xk = ppan.panel_solve("R", uplo, op, diag, akk, bk,
+                                          fused=panel_fused,
+                                          interpret=panel_interpret)
+                    own = ctx_b.rank_c == ctx_b.owner_c(k)
+                    col = ctx_b.kc(k)
+                    ltb = ltb.at[:, col].set(jnp.where(own, xk, ltb[:, col]))
                 if forward:
                     lu = ctx_b.col_start(k + 1)
                     sl = slice(lu, ctx_b.ltc)
@@ -188,17 +198,18 @@ def _build_dist_solve(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                 count = sl.stop - sl.start
                 if count <= 0:
                     continue
-                g = ctx_b.g_cols(lu, count)
-                rem = (g > k) if forward else (g < k)
-                rem = rem & (g < nt)
-                if op == "N":
-                    e = row_panel(ctx_a, lta, k, 0)[lu: lu + count]  # A[k,j]
-                else:
-                    ck = col_panel(ctx_a, lta, k, 0)      # A[i,k] my rows
-                    e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
-                e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
-                upd = tb.contract("rab,cbd->rcad", xk, e)
-                ltb = ltb.at[:, sl].add(-upd)
+                with obs.named_span(f"trsm.step{k:03d}.bulk"):
+                    g = ctx_b.g_cols(lu, count)
+                    rem = (g > k) if forward else (g < k)
+                    rem = rem & (g < nt)
+                    if op == "N":
+                        e = row_panel(ctx_a, lta, k, 0)[lu: lu + count]  # A[k,j]
+                    else:
+                        ck = col_panel(ctx_a, lta, k, 0)      # A[i,k] my rows
+                        e = _tile_op(transpose_col_to_rows(ctx_a, ck, 0, g), op)
+                    e = jnp.where(rem[:, None, None], e, jnp.zeros_like(e))
+                    upd = tb.contract("rab,cbd->rcad", xk, e)
+                    ltb = ltb.at[:, sl].add(-upd)
         return ltb
 
     def run(lta, ltb, alpha):
@@ -486,12 +497,18 @@ def _build_dist_solve_scan(dist_a, dist_b, mesh, side, uplo, op, diag, dtype,
                 else:
                     pe = pe[lu0 - prev_lu0: lu0 - prev_lu0 + cnt]
                 prev_lu0 = lu0
+                # index-free scope: one traced body for all iterations —
+                # critpath reconstructs per-step timing by occurrence
+                # order (docs/observability.md one-traced-body note)
                 (sub, pe, pxk), _ = jax.lax.scan(
-                    make_step_la(lu0, cnt, lq0, cnt_q), (sub, pe, pxk),
-                    jnp.arange(i0, i0 + seg_len))
+                    obs.scoped_step("trsm.scanstep",
+                                    make_step_la(lu0, cnt, lq0, cnt_q)),
+                    (sub, pe, pxk), jnp.arange(i0, i0 + seg_len))
             else:
-                sub, _ = jax.lax.scan(make_step(lu0, cnt, lq0, cnt_q), sub,
-                                      jnp.arange(i0, i0 + seg_len))
+                sub, _ = jax.lax.scan(
+                    obs.scoped_step("trsm.scanstep",
+                                    make_step(lu0, cnt, lq0, cnt_q)), sub,
+                    jnp.arange(i0, i0 + seg_len))
             if side == "L":
                 ltb = ltb.at[lu0:lu0 + cnt].set(sub)
             else:
@@ -546,26 +563,29 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                 cnt = sl.stop - sl.start
                 if cnt <= 0:
                     continue
-                bk = row_panel(ctx_b, ltb, k, 0)          # B[k,:] my cols
-                g = ctx_b.g_rows(lu, cnt)
-                if op == "N":
-                    e = col_panel(ctx_a, lta, k, lu)[:cnt]  # A[i,k]
-                else:
-                    # transpose-exchange windowed to the reachable tiles
-                    # (g >= k ascending / g <= k descending)
-                    if ascending:
-                        lq = uniform_slot_start(k, ctx_a.Q)
-                        rk = row_panel(ctx_a, lta, k, lq)
+                with obs.named_span(f"trmm.step{k:03d}.panel"):
+                    bk = row_panel(ctx_b, ltb, k, 0)      # B[k,:] my cols
+                    g = ctx_b.g_rows(lu, cnt)
+                    if op == "N":
+                        e = col_panel(ctx_a, lta, k, lu)[:cnt]  # A[i,k]
                     else:
-                        lq = 0
-                        rk = row_panel(ctx_a, lta, k, 0)[
-                            :min(ctx_a.ltc,
-                                 uniform_slot_start(k, ctx_a.Q) + 1)]
-                    e = _tile_op(transpose_row_to_cols(ctx_a, rk, lq, g), op)
-                strict = (g > k) if eff_lower else (g < k)
-                e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
-                upd = tb.contract("rab,cbd->rcad", e, bk)
-                out = out.at[sl].add(upd)
+                        # transpose-exchange windowed to the reachable tiles
+                        # (g >= k ascending / g <= k descending)
+                        if ascending:
+                            lq = uniform_slot_start(k, ctx_a.Q)
+                            rk = row_panel(ctx_a, lta, k, lq)
+                        else:
+                            lq = 0
+                            rk = row_panel(ctx_a, lta, k, 0)[
+                                :min(ctx_a.ltc,
+                                     uniform_slot_start(k, ctx_a.Q) + 1)]
+                        e = _tile_op(transpose_row_to_cols(ctx_a, rk, lq, g),
+                                     op)
+                    strict = (g > k) if eff_lower else (g < k)
+                    e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
+                with obs.named_span(f"trmm.step{k:03d}.bulk"):
+                    upd = tb.contract("rab,cbd->rcad", e, bk)
+                    out = out.at[sl].add(upd)
             else:
                 if ascending:
                     lu = ctx_b.col_start(k)
@@ -575,24 +595,27 @@ def _build_dist_mult(dist_a, dist_b, mesh, side, uplo, op, diag, dtype):
                 cnt = sl.stop - sl.start
                 if cnt <= 0:
                     continue
-                bk = col_panel(ctx_b, ltb, k, 0)          # B[:,k] my rows
-                g = ctx_b.g_cols(lu, cnt)
-                if op == "N":
-                    e = row_panel(ctx_a, lta, k, lu)[:cnt]  # A[k,j]
-                else:
-                    if ascending:
-                        lq = uniform_slot_start(k, ctx_a.P)
-                        ck = col_panel(ctx_a, lta, k, lq)
+                with obs.named_span(f"trmm.step{k:03d}.panel"):
+                    bk = col_panel(ctx_b, ltb, k, 0)      # B[:,k] my rows
+                    g = ctx_b.g_cols(lu, cnt)
+                    if op == "N":
+                        e = row_panel(ctx_a, lta, k, lu)[:cnt]  # A[k,j]
                     else:
-                        lq = 0
-                        ck = col_panel(ctx_a, lta, k, 0)[
-                            :min(ctx_a.ltr,
-                                 uniform_slot_start(k, ctx_a.P) + 1)]
-                    e = _tile_op(transpose_col_to_rows(ctx_a, ck, lq, g), op)
-                strict = (g > k) if not eff_lower else (g < k)
-                e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
-                upd = tb.contract("rab,cbd->rcad", bk, e)
-                out = out.at[:, sl].add(upd)
+                        if ascending:
+                            lq = uniform_slot_start(k, ctx_a.P)
+                            ck = col_panel(ctx_a, lta, k, lq)
+                        else:
+                            lq = 0
+                            ck = col_panel(ctx_a, lta, k, 0)[
+                                :min(ctx_a.ltr,
+                                     uniform_slot_start(k, ctx_a.P) + 1)]
+                        e = _tile_op(transpose_col_to_rows(ctx_a, ck, lq, g),
+                                     op)
+                    strict = (g > k) if not eff_lower else (g < k)
+                    e = _mask_tri_panel(e, g, k, nt, strict, uplo, op, diag)
+                with obs.named_span(f"trmm.step{k:03d}.bulk"):
+                    upd = tb.contract("rab,cbd->rcad", bk, e)
+                    out = out.at[:, sl].add(upd)
         return out
 
     def run(lta, ltb, alpha):

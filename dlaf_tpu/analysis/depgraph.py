@@ -24,6 +24,7 @@ sub-jaxpr and reports the control-flow path it took to reach each eqn.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Callable, Iterator, Sequence, Tuple, Union
 
 import jax
@@ -353,3 +354,104 @@ def dropped_outputs(scan_eqn) -> list:
     num_carry = scan_eqn.params["num_carry"]
     return [i for i, v in enumerate(scan_eqn.outvars[num_carry:])
             if isinstance(v, jax_core.DropVar)]
+
+
+# ---------------------------------------------------------------------------
+# Per-step scope structure (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+#: The per-step ``named_scope`` convention every pipelined builder
+#: annotates with (``<algo>.step<k>.<phase>``, obs.named_span) and the
+#: index-free scan form (``<algo>.scanstep[.<phase>]``, obs.scoped_step).
+#: Kept textually identical to obs.critpath's HLO-side patterns — the
+#: jaxpr name stack and the compiled op_name metadata carry the same
+#: scopes, so the static structure here and the measured timeline there
+#: join on the same keys.
+STEP_SCOPE_RE = re.compile(
+    r"([A-Za-z0-9_]+)\.step(\d+)(?:\.(panel|strip|bulk))?")
+SCAN_SCOPE_RE = re.compile(
+    r"([A-Za-z0-9_]+)\.scanstep(?:\.(panel|strip|bulk))?")
+
+
+def step_scope_of(eqn) -> Tuple[str, int, str] | None:
+    """``(algo, step, phase)`` of an eqn's innermost step scope, from its
+    traced name stack — or ``None`` for unscoped eqns.  Scan-body scopes
+    carry no index and report step ``-1``; phase defaults to ``other``
+    (the scope names only the step)."""
+    stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+    hits = list(STEP_SCOPE_RE.finditer(stack))
+    if hits:
+        h = hits[-1]  # innermost scope wins (comm-lookahead hoisting)
+        return (h.group(1), int(h.group(2)), h.group(3) or "other")
+    hits = list(SCAN_SCOPE_RE.finditer(stack))
+    if hits:
+        h = hits[-1]
+        return (h.group(1), -1, h.group(2) or "other")
+    return None
+
+
+def step_groups(eqns: Sequence) -> dict:
+    """Group a flat eqn list by step scope: ``{(algo, step, phase):
+    [eqn, ...]}`` in emission order.  Unscoped eqns are omitted."""
+    out: dict = {}
+    for e in eqns:
+        key = step_scope_of(e)
+        if key is not None:
+            out.setdefault(key, []).append(e)
+    return out
+
+
+def step_edges(eqns: Sequence) -> set:
+    """Inter-group dependency edges of the step structure.
+
+    ``(src, dst)`` is present when some eqn in group ``dst`` transitively
+    depends — through producers within ``eqns`` — on an eqn in group
+    ``src``.  This is the static step DAG the critpath joiner's
+    critical-path model walks with measured walls; tests pin the
+    lookahead property on it (panel k+1 must NOT depend on bulk k).
+    """
+    groups = step_groups(eqns)
+    owner = {id(e): key for key, evs in groups.items() for e in evs}
+    edges: set = set()
+    for key, evs in groups.items():
+        seeds = [v for e in evs for v in e.invars]
+        for d in closure(eqns, seeds):
+            src = owner.get(id(d))
+            if src is not None and src != key:
+                edges.add((src, key))
+    return edges
+
+
+def step_structure(eqns_or_jaxpr) -> dict:
+    """Export the static per-step phase structure of a traced program:
+    ``{"groups": {key: n_eqns}, "edges": [...], "algos": {algo:
+    {"steps": K, "scan": bool}}}`` with keys rendered as
+    ``"<algo>.step<k>.<phase>"`` strings (scan: ``"<algo>.scanstep.
+    <phase>"``) — the depgraph-side mirror of obs.critpath's measured
+    schedule, JSON-ready for tooling."""
+    if hasattr(eqns_or_jaxpr, "eqns"):
+        eqns = list(eqns_or_jaxpr.eqns)
+    elif hasattr(eqns_or_jaxpr, "jaxpr"):
+        eqns = list(eqns_or_jaxpr.jaxpr.eqns)
+    else:
+        eqns = list(eqns_or_jaxpr)
+    groups = step_groups(eqns)
+    edges = step_edges(eqns)
+
+    def render(key) -> str:
+        algo, step, phase = key
+        stem = f"{algo}.scanstep" if step < 0 else f"{algo}.step{step:03d}"
+        return f"{stem}.{phase}"
+
+    algos: dict = {}
+    for algo, step, _phase in groups:
+        a = algos.setdefault(algo, {"steps": 0, "scan": False})
+        if step < 0:
+            a["scan"] = True
+        else:
+            a["steps"] = max(a["steps"], step + 1)
+    return {
+        "groups": {render(k): len(v) for k, v in sorted(groups.items())},
+        "edges": sorted((render(a), render(b)) for a, b in edges),
+        "algos": algos,
+    }

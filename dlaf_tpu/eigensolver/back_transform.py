@@ -46,6 +46,7 @@ from jax import lax
 from .._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..tile_ops import blas as tb
 from ..config import register_program_cache
 from ..comm import collectives as cc
@@ -424,27 +425,38 @@ def _build_dist_bt_r2b(dist_a, dist_c, mesh, band, la: bool = False):
             upd = tb.contract("rab,cbd->rcad", v_my, w2)
             return lt_c.at[luc:].add(-upd)
 
+        # uniform per-step phase scopes (`bt_r2b.step<p>.<phase>`,
+        # docs/observability.md critical-path attribution): panel = the
+        # reflector gather + larft chain, bulk = the W2/apply update. The
+        # reverse sweep keeps the GLOBAL panel index p in the name; under
+        # lookahead panel p's chain is emitted (and scoped) ahead of the
+        # pending panel's bulk — the overlap the critpath report must see.
         ps = range(npan - 1, -1, -1)
         if la:
-            pend = None
+            pend = pend_p = None
             for p in ps:
-                ch = chain(p)      # emitted ahead of pend's bulk update
+                with obs.named_span(f"bt_r2b.step{p:03d}.panel"):
+                    ch = chain(p)  # emitted ahead of pend's bulk update
                 if ch is None:
                     continue
                 if pend is not None:
                     # this chain's collectives overlap the pending bulk
                     cc.record_overlapped("bt_r2b_dist", ROW_AXIS, 1)
                     cc.record_overlapped("bt_r2b_dist", COL_AXIS, 1)
-                    lt_c = update(pend, lt_c)
-                pend = ch
+                    with obs.named_span(f"bt_r2b.step{pend_p:03d}.bulk"):
+                        lt_c = update(pend, lt_c)
+                pend, pend_p = ch, p
             if pend is not None:
-                lt_c = update(pend, lt_c)
+                with obs.named_span(f"bt_r2b.step{pend_p:03d}.bulk"):
+                    lt_c = update(pend, lt_c)
             return lt_c
         for p in ps:
-            ch = chain(p)
+            with obs.named_span(f"bt_r2b.step{p:03d}.panel"):
+                ch = chain(p)
             if ch is None:
                 continue
-            lt_c = update(ch, lt_c)
+            with obs.named_span(f"bt_r2b.step{p:03d}.bulk"):
+                lt_c = update(ch, lt_c)
         return lt_c
 
     return shard_map(run, mesh=mesh,
@@ -528,8 +540,12 @@ def _build_dist_bt_r2b_scan(dist_a, dist_c, mesh, band, la: bool = False):
 
         for (lu_off, lc_off), i0, seg_len in telescope_windows(npan, window):
             sub_c = lt_c[lu_off:]
+            # index-free scope: one traced body per telescope segment —
+            # critpath reconstructs per-step timing by occurrence order
             sub_c, _ = jax.lax.scan(
-                make_step(lu_off, lc_off, ctx_c.ltr - lu_off), sub_c,
+                obs.scoped_step(
+                    "bt_r2b.scanstep",
+                    make_step(lu_off, lc_off, ctx_c.ltr - lu_off)), sub_c,
                 jnp.arange(i0, i0 + seg_len))
             lt_c = lt_c.at[lu_off:].set(sub_c)
         return lt_c

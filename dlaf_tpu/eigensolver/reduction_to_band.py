@@ -426,24 +426,35 @@ def _build_dist_red2band(dist, mesh, dtype, band, comm_la=False):
             -jnp.where(notstrip[None, :, None, :], upd, 0))
 
     def prog(lt):
+        # uniform per-step phase scopes (`red2band.step<p>.<phase>`,
+        # docs/observability.md critical-path attribution): panel =
+        # factor_panel's gather+QR chain, strip = the W/M/X chain and the
+        # eager next-column strip, bulk = the rank-2 trailing product.
+        # The comm_la-hoisted factor_panel(p+1) is scoped as step p+1's
+        # panel even though it executes inside step p's window.
         taus_out = jnp.zeros((max(npan, 0), b), dtype=lt.dtype)
         if not comm_la:
             for p in range(npan):
-                lt, taus_out, pq = factor_panel(lt, taus_out, p)
+                with obs.named_span(f"red2band.step{p:03d}.panel"):
+                    lt, taus_out, pq = factor_panel(lt, taus_out, p)
                 if pq is None:
                     continue
-                lt, ops = trailing_ops(lt, p, *pq, strip_next=False)
+                with obs.named_span(f"red2band.step{p:03d}.strip"):
+                    lt, ops = trailing_ops(lt, p, *pq, strip_next=False)
                 if ops is not None:
-                    lt = apply_bulk(lt, ops)
+                    with obs.named_span(f"red2band.step{p:03d}.bulk"):
+                        lt = apply_bulk(lt, ops)
             return lt, taus_out
         pq = None
         for p in range(npan):
             if pq is None:
-                lt, taus_out, pq = factor_panel(lt, taus_out, p)
+                with obs.named_span(f"red2band.step{p:03d}.panel"):
+                    lt, taus_out, pq = factor_panel(lt, taus_out, p)
             if pq is None:
                 continue
             strip_next = p + 1 < npan
-            lt, ops = trailing_ops(lt, p, *pq, strip_next=strip_next)
+            with obs.named_span(f"red2band.step{p:03d}.strip"):
+                lt, ops = trailing_ops(lt, p, *pq, strip_next=strip_next)
             pq = None
             if ops is None:
                 continue
@@ -451,11 +462,13 @@ def _build_dist_red2band(dist, mesh, dtype, band, comm_la=False):
                 # panel p+1's gather (column broadcast + tile-row
                 # all_gather), QR and write-back — emitted BEFORE panel
                 # p's bulk rank-2 product
-                lt, taus_out, pq = factor_panel(lt, taus_out, p + 1)
+                with obs.named_span(f"red2band.step{p + 1:03d}.panel"):
+                    lt, taus_out, pq = factor_panel(lt, taus_out, p + 1)
                 if pq is not None:
                     cc.record_overlapped("red2band_dist", ROW_AXIS, 1)
                     cc.record_overlapped("red2band_dist", COL_AXIS, 1)
-            lt = apply_bulk(lt, ops)
+            with obs.named_span(f"red2band.step{p:03d}.bulk"):
+                lt = apply_bulk(lt, ops)
         return lt, taus_out
 
     def run(lt):
@@ -580,8 +593,12 @@ def _build_dist_red2band_scan(dist, mesh, dtype, band):
         taus = taus0
         for (lu_off, lc_off), p0, seg_len in telescope_windows(npan, window):
             sub = lt[lu_off:, lc_off:]
+            # index-free scope: one traced body per telescope segment —
+            # critpath reconstructs per-step timing by occurrence order
             (sub, taus), _ = jax.lax.scan(
-                make_step(lu_off, lc_off, ltr - lu_off, ltc - lc_off),
+                obs.scoped_step(
+                    "red2band.scanstep",
+                    make_step(lu_off, lc_off, ltr - lu_off, ltc - lc_off)),
                 (sub, taus), jnp.arange(p0, p0 + seg_len))
             lt = lt.at[lu_off:, lc_off:].set(sub)
         return lt, taus

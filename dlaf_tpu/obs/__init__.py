@@ -53,11 +53,12 @@ from .sinks import (SCHEMA_VERSION, JsonlSink,
                     expand_rank_template, read_history_records, read_records,
                     validate_file, validate_history_records, validate_records)
 from .trace import (NOOP_CTX, NOOP_SPAN, Span, current_span, entry_span,
-                    named_span, span, start_profiler, stop_profiler)
+                    named_span, scoped_step, span, start_profiler,
+                    stop_profiler)
 
 __all__ = [
     "configure", "enabled", "metrics_active", "span", "entry_span",
-    "named_span",
+    "named_span", "scoped_step",
     "current_span", "counter", "gauge", "histogram", "registry",
     "get_logger", "emit_event", "emit_metrics_snapshot", "flush",
     "prometheus_text", "prometheus_snapshot_text", "validate_file",

@@ -174,7 +174,8 @@ SCHEMA_VERSION = 1
 
 KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
                "accuracy", "serve", "resilience", "flight_trigger",
-               "devtrace", "measured_overlap", "autotune")
+               "devtrace", "measured_overlap", "autotune",
+               "schedule", "critpath", "whatif")
 
 #: Documented attribution-coverage floor of ``--require-devtrace``
 #: (docs/observability.md device-time attribution): a devtrace record
@@ -182,6 +183,23 @@ KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
 #: algorithm phases — below it, the per-phase walls describe a minority
 #: of the timeline and must not gate (or pass) anything.
 DEVTRACE_COVERAGE_FLOOR = 0.5
+
+#: Documented coverage floor of ``--require-critpath`` (docs/
+#: observability.md critical-path attribution): a critpath record must
+#: join at least this fraction of the scheduled programs' device busy
+#: time to per-step scopes — below it the per-step walls, gaps and bound
+#: classifications describe a minority of the step timeline and must not
+#: gate (or pass) anything.
+CRITPATH_COVERAGE_FLOOR = 0.5
+
+#: Bound vocabulary of critpath step/program classification
+#: (obs.critpath.BOUNDS, duplicated here so validation never imports the
+#: joiner).
+CRITPATH_BOUNDS = ("panel", "bulk", "comm", "copy", "gap")
+
+#: What-if scenario vocabulary (obs.critpath projections).
+WHATIF_SCENARIOS = ("collectives_free", "gaps_closed", "panel_free",
+                    "copies_free")
 
 #: The resilience record's event vocabulary (schema above).
 RESILIENCE_EVENTS = ("retry", "give_up", "deadline", "circuit_open",
@@ -533,6 +551,98 @@ def _validate_measured_overlap(r: dict, where: str, errors: list) -> None:
                       "object")
 
 
+def _validate_schedule(r: dict, where: str, errors: list) -> None:
+    for key in ("site", "module"):
+        if not isinstance(r.get(key), str) or not r.get(key):
+            errors.append(f"{where}: schedule record without a {key}")
+    ops = r.get("ops")
+    if not isinstance(ops, list) or not ops:
+        errors.append(f"{where}: schedule record without ops")
+        return
+    for j, entry in enumerate(ops):
+        if (not isinstance(entry, list) or len(entry) != 4
+                or not isinstance(entry[0], str)
+                or not isinstance(entry[1], str)
+                or not isinstance(entry[2], int)
+                or not isinstance(entry[3], str)):
+            errors.append(f"{where}: schedule ops[{j}] must be "
+                          "[instr, algo, step, phase]")
+            break
+    algos = r.get("algos")
+    if not isinstance(algos, dict) or not algos:
+        errors.append(f"{where}: schedule record without algos summary")
+
+
+def _validate_critpath(r: dict, where: str, errors: list) -> None:
+    if not isinstance(r.get("trace"), str) or not r.get("trace"):
+        errors.append(f"{where}: critpath record without a trace name")
+    if not isinstance(r.get("algo"), str) or not r.get("algo"):
+        errors.append(f"{where}: critpath record without an algo")
+    cov = r.get("coverage")
+    if not _finite(cov) or not 0.0 <= cov <= 1.0:
+        errors.append(f"{where}: critpath coverage must be finite in "
+                      f"[0, 1], got {cov!r}")
+    if r.get("join") not in ("annotation", "rebase"):
+        errors.append(f"{where}: critpath join must be "
+                      f"annotation|rebase, got {r.get('join')!r}")
+    for key in ("n_runs", "n_steps"):
+        if not isinstance(r.get(key), int) or isinstance(r.get(key), bool) \
+                or r.get(key, 0) < 1:
+            errors.append(f"{where}: critpath {key} must be a positive "
+                          "int")
+    for key in ("wall_s", "gap_total_s", "critical_path_s"):
+        if not _finite(r.get(key)) or r.get(key, -1) < 0:
+            errors.append(f"{where}: critpath {key} "
+                          "missing/non-finite/negative")
+    if r.get("bound") not in CRITPATH_BOUNDS:
+        errors.append(f"{where}: critpath bound must be one of "
+                      f"{CRITPATH_BOUNDS}, got {r.get('bound')!r}")
+    steps = r.get("steps")
+    if not isinstance(steps, list) or not steps:
+        errors.append(f"{where}: critpath record without steps")
+        return
+    for s in steps:
+        if not isinstance(s, dict):
+            errors.append(f"{where}: critpath step entries must be "
+                          "objects")
+            break
+        w = f"{where} step[{s.get('step')!r}]"
+        if not isinstance(s.get("step"), int):
+            errors.append(f"{w}: missing step index")
+        if s.get("empty"):
+            continue
+        # the "no NaN walls" leg: every per-step wall is finite
+        for key in ("wall_s", "panel_s", "bulk_s", "comm_s",
+                    "comm_exposed_s", "copy_s", "idle_s", "gap_after_s"):
+            if key == "gap_after_s" and key not in s:
+                continue  # the last step has no following boundary
+            if not _finite(s.get(key)) or s.get(key, -1) < 0:
+                errors.append(f"{w}: {key} missing/non-finite/negative")
+        if s.get("bound") not in CRITPATH_BOUNDS:
+            errors.append(f"{w}: bound must be one of "
+                          f"{CRITPATH_BOUNDS}, got {s.get('bound')!r}")
+
+
+def _validate_whatif(r: dict, where: str, errors: list) -> None:
+    if not isinstance(r.get("algo"), str) or not r.get("algo"):
+        errors.append(f"{where}: whatif record without an algo")
+    if r.get("scenario") not in WHATIF_SCENARIOS:
+        errors.append(f"{where}: whatif scenario must be one of "
+                      f"{WHATIF_SCENARIOS}, got {r.get('scenario')!r}")
+    for key in ("saved_s", "wall_s", "projected_wall_s"):
+        if not _finite(r.get(key)) or r.get(key, -1) < 0:
+            errors.append(f"{where}: whatif {key} "
+                          "missing/non-finite/negative")
+    if _finite(r.get("wall_s")) and _finite(r.get("projected_wall_s")) \
+            and r["projected_wall_s"] > r["wall_s"] + 1e-12:
+        errors.append(f"{where}: whatif projected_wall_s > wall_s "
+                      "(removing work cannot slow the run)")
+    pct = r.get("wall_pct")
+    if not _finite(pct) or not 0.0 <= pct <= 100.0:
+        errors.append(f"{where}: whatif wall_pct must be finite in "
+                      f"[0, 100], got {pct!r}")
+
+
 def _validate_autotune(r: dict, where: str, errors: list) -> None:
     for key in ("site", "op", "dtype", "platform"):
         if not isinstance(r.get(key), str) or not r.get(key):
@@ -638,7 +748,7 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_telemetry=False, require_accuracy=False,
                      require_serve=False, require_resilience=False,
                      require_flight=False, require_devtrace=False,
-                     require_autotune=False) -> list:
+                     require_autotune=False, require_critpath=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -696,7 +806,13 @@ def validate_records(records, require_spans=False, require_gflops=False,
     ``escalate`` or ``relax`` (the loop actually moved a route), and NO
     site whose LAST decision is ``exhausted`` (an artifact ending with
     the ladder pinned at its top under a breach is an open incident and
-    must be REJECTED, like an open breaker)."""
+    must be REJECTED, like an open breaker) — and (``require_critpath``)
+    the per-step critical-path attribution obligation (ISSUE 16,
+    docs/observability.md): >= 1 ``critpath`` record with >= 1 step and
+    join coverage >= :data:`CRITPATH_COVERAGE_FLOOR` (below the floor
+    the per-step walls/gaps/bounds describe a minority of the scheduled
+    timeline), and >= 1 ``whatif`` projection record (the headroom
+    ranking the attribution exists to produce)."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = n_accuracy = 0
@@ -707,8 +823,10 @@ def validate_records(records, require_spans=False, require_gflops=False,
     n_flight_triggers = n_flight_context = 0
     n_overlap_proof = n_devtrace_covered = 0
     n_autotune_moves = 0
+    n_critpath_covered = n_whatif = 0
     autotune_last = {}                # site -> last decision reason seen
     devtrace_coverages = []
+    critpath_coverages = []
     circuit_state = {}                # site -> latest gauge value seen
     serve_retrace_sites = {}          # serve.* site -> trace evidence count
     overlap_axes, byte_axes = set(), set()
@@ -750,6 +868,19 @@ def validate_records(records, require_spans=False, require_gflops=False,
                     and _finite(r.get("collective_s")) \
                     and r["collective_s"] > 0:
                 n_overlap_proof += 1
+        elif rtype == "schedule":
+            _validate_schedule(r, where, errors)
+        elif rtype == "critpath":
+            _validate_critpath(r, where, errors)
+            if _finite(r.get("coverage")):
+                critpath_coverages.append(float(r["coverage"]))
+                if r["coverage"] >= CRITPATH_COVERAGE_FLOOR \
+                        and isinstance(r.get("n_steps"), int) \
+                        and r["n_steps"] >= 1:
+                    n_critpath_covered += 1
+        elif rtype == "whatif":
+            _validate_whatif(r, where, errors)
+            n_whatif += 1
         elif rtype == "autotune":
             _validate_autotune(r, where, errors)
             if r.get("reason") in ("escalate", "relax"):
@@ -937,6 +1068,17 @@ def validate_records(records, require_spans=False, require_gflops=False,
             errors.append("artifact contains no devtrace record with "
                           "attribution coverage >= "
                           f"{DEVTRACE_COVERAGE_FLOOR}{got}")
+    if require_critpath:
+        if n_critpath_covered == 0:
+            got = (f" (got {['%.3f' % c for c in critpath_coverages]})"
+                   if critpath_coverages else "")
+            errors.append("artifact contains no critpath record with "
+                          ">= 1 step and join coverage >= "
+                          f"{CRITPATH_COVERAGE_FLOOR}{got}")
+        if n_whatif == 0:
+            errors.append("artifact contains no whatif projection record "
+                          "(critpath attribution produced no headroom "
+                          "ranking)")
     if require_autotune:
         if n_autotune_moves == 0:
             errors.append("artifact contains no autotune escalate/relax "

@@ -190,7 +190,28 @@ def aot_compile(site: str, jitted, *args, **kwargs) -> AotProgram:
     memory = memory_analysis_dict(compiled)
     count_retrace(site)
     record_compile(site, compile_s=t2 - t1, trace_s=t1 - t0, memory=memory)
+    record_schedule(site, compiled)
     return AotProgram(compiled, t1 - t0, t2 - t1, memory)
+
+
+def record_schedule(site: str, compiled) -> None:
+    """Record the per-step HLO schedule of a compiled program so the
+    critpath joiner (``obs.critpath``) can attribute device intervals to
+    ``<algo>.step<k>.<phase>`` scopes offline.  Emits one ``schedule``
+    record per program carrying step scopes; silent no-op when the sink
+    is off, the program has no step scopes, or the backend refuses to
+    render optimized HLO text."""
+    if not (STATE.telemetry_on and STATE.sink is not None):
+        return
+    try:
+        hlo_text = compiled.as_text()
+    except Exception:  # backend without text rendering — never fail the compile
+        return
+    from . import critpath
+
+    rec = critpath.schedule_record(site, hlo_text)
+    if rec is not None:
+        STATE.sink.write(rec)
 
 
 def _arg_key(x):

@@ -164,6 +164,24 @@ def named_span(name: str):
     return jax.named_scope(name)
 
 
+def scoped_step(name: str, fn):
+    """Wrap a ``lax.scan`` step body so every op it traces carries the
+    ``name`` scope. A scan body is traced ONCE for all iterations, so the
+    scope can carry no step index — the critpath joiner reconstructs the
+    per-iteration timeline from occurrence order instead (one execution
+    of the body's instruction set per iteration; the one-traced-body
+    limitation, docs/observability.md). Zero-cost pass-through when
+    observability is off (``named_span`` returns the no-op singleton)."""
+    if not (STATE.metrics_on or STATE.annotate):
+        return fn
+
+    def wrapped(*args):
+        with named_span(name):
+            return fn(*args)
+
+    return wrapped
+
+
 def current_span():
     """Innermost live Span of this thread, or None (attrs can be attached
     to it from helper layers without plumbing the object through)."""

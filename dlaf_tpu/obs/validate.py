@@ -77,6 +77,14 @@ Flags:
                             the documented floor
                             (sinks.DEVTRACE_COVERAGE_FLOOR); NaN phase
                             walls are schema errors regardless
+    --require-critpath      fail unless the artifact carries the
+                            per-step critical-path attribution trail
+                            (ISSUE 16, docs/observability.md): >= 1
+                            critpath record with >= 1 step and join
+                            coverage >= the documented floor
+                            (sinks.CRITPATH_COVERAGE_FLOOR), and >= 1
+                            whatif projection record; NaN step walls
+                            are schema errors regardless
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
@@ -115,7 +123,8 @@ def main(argv=None) -> int:
              "--require-bt-overlap", "--require-telemetry",
              "--require-accuracy", "--require-serve",
              "--require-resilience", "--require-flight",
-             "--require-devtrace", "--require-autotune", "--history",
+             "--require-devtrace", "--require-autotune",
+             "--require-critpath", "--history",
              "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
@@ -154,7 +163,8 @@ def main(argv=None) -> int:
         require_resilience="--require-resilience" in flags,
         require_flight="--require-flight" in flags,
         require_devtrace="--require-devtrace" in flags,
-        require_autotune="--require-autotune" in flags)
+        require_autotune="--require-autotune" in flags,
+        require_critpath="--require-critpath" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -169,6 +179,8 @@ def main(argv=None) -> int:
     n_devtrace = sum(r.get("type") in ("devtrace", "measured_overlap")
                      for r in records)
     n_autotune = sum(r.get("type") == "autotune" for r in records)
+    n_critpath = sum(r.get("type") in ("schedule", "critpath", "whatif")
+                     for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
@@ -178,6 +190,7 @@ def main(argv=None) -> int:
     extra += f", {n_flight} flight triggers" if n_flight else ""
     extra += f", {n_devtrace} devtrace records" if n_devtrace else ""
     extra += f", {n_autotune} autotune decisions" if n_autotune else ""
+    extra += f", {n_critpath} critpath records" if n_critpath else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

@@ -66,6 +66,32 @@ from dlaf_tpu.obs.sinks import (read_history_records, read_records,
                                 validate_history_line)
 
 
+def worst_step_category(paths) -> str | None:
+    """The largest per-step category wall (incl. step-boundary gaps)
+    summed across the fresh artifacts' ``critpath`` records, as a human
+    line, or None when no artifact carries them. Delegates the
+    ``<algo>.stepNNN <category>`` vocabulary to ``perf_diff.extract`` —
+    single owner — so the verdict and the explainer name steps
+    identically."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from perf_diff import extract
+    except ImportError:
+        return None
+    acc: dict = {}
+    for p in paths:
+        try:
+            facts = extract(read_records(p))
+        except (OSError, ValueError):
+            continue
+        for lbl, v in facts["step_cat"].items():
+            acc[lbl] = acc.get(lbl, 0.0) + v
+    if not acc:
+        return None
+    lbl, v = max(acc.items(), key=lambda kv: kv[1])
+    return f"{lbl} ({v * 1e3:.2f} ms)"
+
+
 def measurement_key(line: dict) -> tuple:
     """The baseline key: (variant, platform, n, nb, workload, dtype).
     The ISSUE-7 5-tuple plus dtype — a float32 arm must never gate a
@@ -301,13 +327,23 @@ def main(argv=None) -> int:
     if regressions:
         print(f"bench_gate: {regressions} regressed key(s)",
               file=sys.stderr)
+        # the per-step attribution is already in the fresh artifact
+        # (ISSUE 16 critpath records): name the dominant step category
+        # in the verdict itself, so the trip says WHERE before anyone
+        # runs the explainer
+        step = worst_step_category(args.fresh or [])
+        if step is not None:
+            print(f"bench_gate: dominant step category in fresh "
+                  f"artifact: {step}", file=sys.stderr)
         # the explainer is one command away (ISSUE 14): diff the fresh
         # obs artifact against a known-good merged artifact — per-phase
         # device walls, compile seconds, retraces, comm bytes, overlap
-        # fractions, accuracy — and the ranked report names the phase
+        # fractions, accuracy — and the ranked report names the phase;
+        # --json adds the per-step category deltas machine-readably
         fresh_art = args.fresh[0] if args.fresh else "<fresh.jsonl>"
         print("bench_gate: diagnose with: python scripts/perf_diff.py "
-              f"<baseline_merged.jsonl> {fresh_art}", file=sys.stderr)
+              f"<baseline_merged.jsonl> {fresh_art} [--json]",
+              file=sys.stderr)
         return 1
     print("bench_gate: no regressions")
     return 0

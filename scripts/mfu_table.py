@@ -66,14 +66,18 @@ Usage:
                                            # between the mfu-table markers
     python scripts/mfu_table.py --no-ici   # skip the traced ICI column
                                            # (fast; prints em-dashes)
-    python scripts/mfu_table.py --measured # fill the measured(dev)
-                                           # column from the committed
-                                           # devtrace fixture
+    python scripts/mfu_table.py --measured # fill the measured(dev) and
+                                           # measured-bound columns from
+                                           # the committed devtrace and
+                                           # critpath fixtures
     python scripts/mfu_table.py --reuse-ici  # reuse the ICI cells
                                            # already in BASELINE.md
                                            # instead of re-tracing
                                            # (hermetic regeneration)
-    python scripts/mfu_table.py --fixture DIR  # override the fixture dir
+    python scripts/mfu_table.py --fixture DIR  # override the devtrace
+                                           # fixture dir
+    python scripts/mfu_table.py --critpath-fixture DIR  # override the
+                                           # critpath fixture dir
 """
 
 from __future__ import annotations
@@ -345,6 +349,17 @@ def ici_ceiling(family: str, n: int, nb: int, grid: str, chip: str):
 #: attribution).
 FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "devtrace")
 
+#: critpath fixture for the measured-bound column (``--measured``): the
+#: ISSUE-16 per-step schedule join, committed with its schedule-bearing
+#: merged artifact (docs/observability.md critical-path attribution).
+CRITPATH_FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "critpath")
+
+#: critpath program (step-scope algo tag) -> table family.
+ALGO_FAMILIES = {
+    "cholesky": "cholesky", "trsm": "trsm", "hegst": "hegst",
+    "red2band": "red2band", "bt_r2b": "bt_r2b",
+}
+
 #: entry-span phase name -> table family (the devtrace phase join keys
 #: measured device GF/s by span name; the table rows key by family).
 ENTRY_PHASE_FAMILIES = {
@@ -398,6 +413,53 @@ def measured_device(fixture_dir: str = FIXTURE_DIR):
                  f"{a.get('n', '?')}/{a.get('nb', '?')} "
                  f"{a.get('grid', '1x1')})")
         out[family] = label
+    return out
+
+
+def measured_bound(fixture_dir: str = CRITPATH_FIXTURE_DIR):
+    """{family: "bound (platform n/nb grid)"} from the committed critpath
+    fixture — the per-step critical-path classification's dominant bound
+    (panel/bulk/comm/copy/gap), MEASURED from the schedule join instead of
+    modeled from the panel-chain latency. Labeled with platform/shape like
+    the measured(dev) column, for the same reason: a CPU-container
+    fixture's bound (spin-wait collectives classify as comm) must never
+    masquerade as a TPU datum. Empty dict when the fixture is
+    absent/unreadable (the column prints em-dashes)."""
+    sys.path.insert(0, REPO)
+    from dlaf_tpu.obs import critpath, devtrace
+    from dlaf_tpu.obs.aggregate import merge_artifacts
+
+    import glob as _glob
+
+    trace = os.path.join(fixture_dir, "trace.json.gz")
+    jsonls = sorted(_glob.glob(os.path.join(fixture_dir, "*.jsonl")))
+    if not os.path.exists(trace) or not jsonls:
+        return {}
+    try:
+        records = merge_artifacts(jsonls)
+        report = critpath.attribute(devtrace.load_trace(trace), records)
+    except (OSError, ValueError) as e:
+        print(f"mfu_table: critpath fixture unreadable: {e}",
+              file=sys.stderr)
+        return {}
+    platform = "cpu"
+    for r in records:
+        if r.get("type") == "accuracy" and r.get("platform"):
+            platform = r["platform"]
+            break
+    attrs_by_name = {}
+    for r in records:
+        if r.get("type") == "span" and r.get("name"):
+            attrs_by_name.setdefault(r["name"], r.get("attrs") or {})
+    out = {}
+    for algo, prog in report["programs"].items():
+        family = ALGO_FAMILIES.get(algo)
+        if family is None or not prog.get("bound"):
+            continue
+        a = attrs_by_name.get(algo, {})
+        out[family] = (f"{prog['bound']} ({platform} "
+                       f"{a.get('n', '?')}/{a.get('nb', '?')} "
+                       f"{a.get('grid', '1x1')})")
     return out
 
 
@@ -501,9 +563,10 @@ CONFIGS = [
 _MEAS_AT = {"#4 red2band d 16384/512 4x4": (8192, 512)}
 
 
-def build_rows(with_ici=True, reuse_ici=None, dev=None):
+def build_rows(with_ici=True, reuse_ici=None, dev=None, mb=None):
     rows = []
     dev = dev or {}
+    mb = mb or {}
     for label, family, n, nb, grid, chip, note in CONFIGS:
         comp = oz_compute_ceiling(chip)
         hbm = (chol_hbm_ceiling(chip, n, nb)
@@ -530,14 +593,14 @@ def build_rows(with_ici=True, reuse_ici=None, dev=None):
         mfu = f"{100.0 * got / ceil:.1f}%" if got else "—"
         rows.append((label, f"ozaki s={OZ_SLICES} (bf16 dots)",
                      f"{comp:.0f}", f"{hbm:.0f}" if hbm else "—",
-                     f"{ici:.0f}" if ici else "—",
-                     f"{panel:.0f}" if panel else "—", bound,
+                     f"{ici:.0f}" if ici else "—", bound,
                      f"{got:.1f}" if got else "pending",
-                     dev.get(family, "—"), mfu, note))
+                     dev.get(family, "—"), mb.get(family, "—"),
+                     mfu, note))
     return rows
 
 
-def render(with_ici=True, reuse_ici=None, dev=None) -> str:
+def render(with_ici=True, reuse_ici=None, dev=None, mb=None) -> str:
     head = (f"{BEGIN}\n"
             "## MFU / roofline table (scripts/mfu_table.py — regenerate "
             "with `--write`)\n\n"
@@ -561,14 +624,23 @@ def render(with_ici=True, reuse_ici=None, dev=None) -> str:
             "stage's own flop model and roofline (`dc_level_batch` / "
             "`bt_lookahead`, docs/eigensolver_perf.md), so config #5 "
             "reads per stage instead of through a red2band proxy. "
-            "`panel ceil` (step-chain families) = flops / (steps x "
-            "modeled per-step panel-chain latency, "
-            f"{PANEL_STEP_S * 1e3:.1f} ms from the 2026-08-01 probes) — "
-            "the serial panel floor NO overlap can beat; where it binds "
-            "(`bound=panel`), the fused Pallas panel kernels "
-            "(`panel_impl`, docs/pallas_panel.md) are the lever, modeled "
-            "~6x higher at 2 dispatches/step (A/B via the bench "
-            "`fpanel`/`fpanel+fp1` arms). "
+            "The panel-critical-path ceiling (step-chain families: flops "
+            "/ (steps x modeled per-step panel-chain latency, "
+            f"{PANEL_STEP_S * 1e3:.1f} ms from the 2026-08-01 probes)) "
+            "stays folded into the ceiling min — `ceil bound = panel` "
+            "still names it as the binding side, where the fused Pallas "
+            "panel kernels (`panel_impl`, docs/pallas_panel.md) are the "
+            "lever — but its displayed column is replaced by `measured "
+            "bound`: the ISSUE-16 per-step critical-path classification "
+            "(`dlaf_tpu.obs.critpath`, docs/observability.md), the "
+            "dominant per-step bound (panel/bulk/comm/copy/gap) measured "
+            "from the schedule join over the committed "
+            "`tests/fixtures/critpath/` fixture rather than modeled. "
+            "Like `measured(dev)` it is labeled with the platform/shape "
+            "it ran (the CI fixture is a CPU-container 2x2 run whose "
+            "spin-wait collectives classify as comm-bound, and it "
+            "carries the fixture's documented 2 ms synthetic step gap; "
+            "a TPU-captured fixture drops in unchanged). "
             "`measured(dev)` is the ISSUE-14 device-timeline path "
             "(`dlaf_tpu.obs.devtrace` + `--measured`): entry-span flop "
             "models over the phase's attributed DEVICE-busy wall from a "
@@ -580,11 +652,11 @@ def render(with_ici=True, reuse_ici=None, dev=None) -> str:
             "TPU ceilings; a TPU-captured fixture drops in unchanged — "
             "docs/observability.md device-time attribution).\n\n"
             "| config | route | compute ceil GF/s | HBM ceil GF/s "
-            "| ICI ceil GF/s | panel ceil GF/s | bound | measured GF/s "
-            "| measured(dev) GF/s | MFU | note |\n"
+            "| ICI ceil GF/s | ceil bound | measured GF/s "
+            "| measured(dev) GF/s | measured bound | MFU | note |\n"
             "|---|---|---|---|---|---|---|---|---|---|---|\n")
     body = "".join("| " + " | ".join(r) + " |\n"
-                   for r in build_rows(with_ici, reuse_ici, dev))
+                   for r in build_rows(with_ici, reuse_ici, dev, mb))
     return head + body + END
 
 
@@ -599,10 +671,20 @@ def main() -> None:
         if i >= len(sys.argv):
             raise SystemExit("mfu_table: --fixture needs a directory")
         fixture = sys.argv[i]
-    dev = measured_device(fixture) if "--measured" in sys.argv else None
+    cp_fixture = CRITPATH_FIXTURE_DIR
+    if "--critpath-fixture" in sys.argv:
+        i = sys.argv.index("--critpath-fixture") + 1
+        if i >= len(sys.argv):
+            raise SystemExit("mfu_table: --critpath-fixture needs a "
+                             "directory")
+        cp_fixture = sys.argv[i]
+    dev = mb = None
+    if "--measured" in sys.argv:
+        dev = measured_device(fixture)
+        mb = measured_bound(cp_fixture)
     reuse = parse_existing_ici() if "--reuse-ici" in sys.argv else None
     text = render(with_ici="--no-ici" not in sys.argv,
-                  reuse_ici=reuse, dev=dev)
+                  reuse_ici=reuse, dev=dev, mb=mb)
     if "--write" not in sys.argv:
         print(text)
         return

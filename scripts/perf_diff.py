@@ -22,7 +22,12 @@ extracts:
   summed);
 * **measured overlap fraction** per (algo, axis) (``measured_overlap``
   records, collective-time-weighted mean);
-* **worst accuracy bound_ratio** (``accuracy`` records).
+* **worst accuracy bound_ratio** (``accuracy`` records);
+* **per-step category walls** — ``critpath`` records' per-step
+  panel/bulk/exposed-comm/copy walls plus the step-boundary gap (keyed
+  at the boundary it precedes: the gap after step k is
+  ``<algo>.step<k+1> gap``), so a regression names not just the phase
+  but the STEP and CATEGORY that moved (ISSUE 16).
 
 The report is RANKED what-changed: every change sorted by severity
 (relative change weighted by absolute magnitude), worst first; changes
@@ -30,7 +35,17 @@ in the bad direction beyond ``--threshold`` are REGRESSION lines naming
 the phase/site/key. ``--inject-slowdown cholesky=0.5`` scales the FRESH
 artifact's matching device-phase walls (and its host span walls) by
 1.5x before diffing — the CI must-trip drill: the injected phase must
-top the ranking and exit 1.
+top the ranking and exit 1. ``--inject-slowdown`` specs matching a
+step-category label (``cholesky.step002 gap=0.5`` or a bare
+``cholesky.step002``-prefixed label) scale the matching step categories
+instead, so the step-level drill trips the step-level finding.
+
+``--json`` prints the full machine-readable report to stdout instead of
+the human ranking: ``{"findings": [...], "regressions": [...],
+"worst_step": {...}}`` where each finding carries
+kind/label/old/new/delta/rel/severity/regression and ``worst_step`` is
+the most severe step-category finding that got worse
+(``scripts/bench_gate.py`` splices it into its verdict).
 
 Exit status: 0 = no regression beyond threshold; 1 = >= 1 regression
 (each named); 2 = usage error.
@@ -39,6 +54,7 @@ Exit status: 0 = no regression beyond threshold; 1 = >= 1 regression
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import os
 import sys
@@ -64,6 +80,7 @@ def extract(records) -> dict:
         "overlap": {},          # (algo, axis) -> weighted overlap frac
         "worst_bound_ratio": None,
         "coverage": None,       # worst devtrace coverage
+        "step_cat": {},         # "<algo>.stepNNN <cat>" -> seconds
     }
     overlap_acc: dict = {}
     last_snap: dict = {}
@@ -100,6 +117,26 @@ def extract(records) -> dict:
                     facts["compile_s"].get(site, 0.0) + r["compile_s"]
             elif r.get("event") == "retrace":
                 facts["retraces"][site] = facts["retraces"].get(site, 0) + 1
+        elif rtype == "critpath":
+            algo = r.get("algo", "?")
+            for s in r.get("steps") or []:
+                if not isinstance(s, dict) or s.get("empty") \
+                        or not isinstance(s.get("step"), int):
+                    continue
+                k = s["step"]
+                for cat, key in (("panel", "panel_s"), ("bulk", "bulk_s"),
+                                 ("comm", "comm_exposed_s"),
+                                 ("copy", "copy_s")):
+                    if _finite(s.get(key)):
+                        lbl = f"{algo}.step{k:03d} {cat}"
+                        facts["step_cat"][lbl] = \
+                            facts["step_cat"].get(lbl, 0.0) + s[key]
+                # the gap after step k stalls the NEXT step's start:
+                # key it at the boundary it precedes
+                if _finite(s.get("gap_after_s")):
+                    lbl = f"{algo}.step{k + 1:03d} gap"
+                    facts["step_cat"][lbl] = \
+                        facts["step_cat"].get(lbl, 0.0) + s["gap_after_s"]
         elif rtype == "accuracy":
             br = r.get("bound_ratio")
             if r.get("nonfinite") is True:
@@ -141,9 +178,10 @@ def _rel(old: float, new: float) -> float:
 
 
 def diff(a: dict, b: dict, threshold: float) -> list:
-    """Ranked findings ``[(severity, is_regression, line), ...]`` worst
-    first. Direction conventions: walls/compile/retraces/bytes/
-    bound_ratio UP is bad; overlap fraction DOWN is bad."""
+    """Ranked findings (dicts with severity/regression/worse/kind/label/
+    old/new/delta/rel/line keys), worst first. Direction conventions:
+    walls/compile/retraces/bytes/bound_ratio UP is bad; overlap fraction
+    DOWN is bad."""
     findings = []
 
     def add(kind, label, old, new, *, unit="ms", scale=1e3, bad_up=True,
@@ -158,10 +196,12 @@ def diff(a: dict, b: dict, threshold: float) -> list:
             # contract must not trip on a better-instrumented fresh run
             side = "only in fresh" if old is None else "only in baseline"
             v = float(new if old is None else old)
-            findings.append((0.0, False, False,
-                             f"{kind:<14s} {label}: "
-                             + fmt.format(v * scale)
-                             + f" {unit} ({side}; not comparable)"))
+            findings.append({
+                "severity": 0.0, "regression": False, "worse": False,
+                "kind": kind, "label": label,
+                "old": old, "new": new, "delta": None, "rel": None,
+                "line": (f"{kind:<14s} {label}: " + fmt.format(v * scale)
+                         + f" {unit} ({side}; not comparable)")})
             return
         old_v, new_v = float(old), float(new)
         delta = new_v - old_v
@@ -175,10 +215,14 @@ def diff(a: dict, b: dict, threshold: float) -> list:
         sev = min(abs(rel), 10.0) * abs(delta) * scale
         arrow = "+" if delta >= 0 else ""
         rel_s = "new" if math.isinf(rel) else f"{arrow}{rel * 100:.1f}%"
-        line = (f"{kind:<14s} {label}: "
-                + fmt.format(old_v * scale) + f" -> "
-                + fmt.format(new_v * scale) + f" {unit} ({rel_s})")
-        findings.append((sev, is_reg, worse, line))
+        findings.append({
+            "severity": sev, "regression": is_reg, "worse": worse,
+            "kind": kind, "label": label,
+            "old": old_v, "new": new_v, "delta": delta,
+            "rel": None if math.isinf(rel) else rel,
+            "line": (f"{kind:<14s} {label}: "
+                     + fmt.format(old_v * scale) + " -> "
+                     + fmt.format(new_v * scale) + f" {unit} ({rel_s})")})
 
     for phase in sorted(set(a["phase_wall"]) | set(b["phase_wall"])):
         add("device-phase", phase, a["phase_wall"].get(phase),
@@ -202,10 +246,22 @@ def diff(a: dict, b: dict, threshold: float) -> list:
         add("overlap-frac", f"{key[0]}/{key[1]}", a["overlap"].get(key),
             b["overlap"].get(key), unit="%", scale=100.0, bad_up=False,
             fmt="{:.1f}")
+    for lbl in sorted(set(a["step_cat"]) | set(b["step_cat"])):
+        add("step-category", lbl, a["step_cat"].get(lbl),
+            b["step_cat"].get(lbl), min_abs=0.01)
     add("bound-ratio", "worst accuracy", a["worst_bound_ratio"],
         b["worst_bound_ratio"], unit="", scale=1.0, fmt="{:.3g}")
-    findings.sort(key=lambda f: -f[0])
+    findings.sort(key=lambda f: -f["severity"])
     return findings
+
+
+def worst_step(findings):
+    """The most severe step-category finding that got worse, or None —
+    the per-step verdict line ``bench_gate`` splices in."""
+    for f in findings:
+        if f["kind"] == "step-category" and f["worse"]:
+            return f
+    return None
 
 
 def parse_inject(spec: str):
@@ -220,11 +276,23 @@ def inject_slowdown(facts: dict, phase, factor: float) -> None:
     """Scale the fresh artifact's device-phase walls (and host span
     walls, so artifacts without devtrace records still drill) by
     ``1 + factor`` — matching ``phase`` only, or every phase when
-    None."""
+    None. A spec naming a step-category label (exactly, or as a
+    ``<algo>.stepNNN`` prefix) scales the matching step categories
+    instead — the step-level must-trip drill."""
+    step_hits = [lbl for lbl in facts["step_cat"]
+                 if phase is not None
+                 and (lbl == phase or lbl.startswith(phase + " "))]
+    if step_hits:
+        for lbl in step_hits:
+            facts["step_cat"][lbl] *= 1.0 + factor
+        return
     for table in ("phase_wall", "host_wall"):
         for name in facts[table]:
             if phase is None or name == phase:
                 facts[table][name] *= 1.0 + factor
+    if phase is None:
+        for lbl in facts["step_cat"]:
+            facts["step_cat"][lbl] *= 1.0 + factor
 
 
 def main(argv=None) -> int:
@@ -240,6 +308,10 @@ def main(argv=None) -> int:
                     help="scale the fresh artifact's matching phase "
                          "walls by 1+F before diffing (the CI "
                          "must-trip drill)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report to stdout "
+                         "instead of the human ranking (same exit "
+                         "codes)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -253,10 +325,11 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"perf_diff: {e}", file=sys.stderr)
         return 1
-    if not (a["phase_wall"] or a["host_wall"]) \
-            or not (b["phase_wall"] or b["host_wall"]):
-        print("perf_diff: an artifact carries neither devtrace phases "
-              "nor span records — nothing to attribute", file=sys.stderr)
+    if not (a["phase_wall"] or a["host_wall"] or a["step_cat"]) \
+            or not (b["phase_wall"] or b["host_wall"] or b["step_cat"]):
+        print("perf_diff: an artifact carries neither devtrace phases, "
+              "span records, nor critpath steps — nothing to attribute",
+              file=sys.stderr)
         return 1
     mode = ""
     if args.inject_slowdown:
@@ -269,27 +342,40 @@ def main(argv=None) -> int:
         inject_slowdown(b, phase, factor)
         mode = (f" [+{factor:.0%} injected slowdown on "
                 f"{phase or 'every phase'}]")
+    findings = diff(a, b, args.threshold)
+    regressions = [f["line"] for f in findings if f["regression"]]
+    ws = worst_step(findings)
+    if args.json:
+        print(json.dumps({
+            "baseline": args.baseline, "fresh": args.fresh,
+            "threshold": args.threshold,
+            "coverage": {"baseline": a["coverage"],
+                         "fresh": b["coverage"]},
+            "findings": findings,
+            "regressions": regressions,
+            "worst_step": ws,
+        }, indent=1, sort_keys=True))
+        return 1 if regressions else 0
     print(f"perf_diff: {args.baseline} -> {args.fresh}{mode}")
     if a["coverage"] is not None or b["coverage"] is not None:
         fmt = lambda c: "-" if c is None else f"{c * 100:.1f}%"  # noqa: E731
         print(f"  devtrace coverage: {fmt(a['coverage'])} -> "
               f"{fmt(b['coverage'])}")
-    findings = diff(a, b, args.threshold)
-    regressions = []
     shown = 0
-    for sev, is_reg, worse, line in findings:
-        verdict = "REGRESSION" if is_reg else \
-            ("  worse   " if worse else "  ok      ")
-        if is_reg:
-            regressions.append(line)
-        if shown < args.top or is_reg:
-            print(f"  {verdict} {line}")
+    for f in findings:
+        verdict = "REGRESSION" if f["regression"] else \
+            ("  worse   " if f["worse"] else "  ok      ")
+        if shown < args.top or f["regression"]:
+            print(f"  {verdict} {f['line']}")
             shown += 1
     if not findings:
         print("  (no measurable differences)")
     if regressions:
         print(f"perf_diff: {len(regressions)} regression(s); worst: "
               f"{regressions[0]}", file=sys.stderr)
+        if ws is not None:
+            print(f"perf_diff: worst step category: {ws['line'].strip()}",
+                  file=sys.stderr)
         return 1
     print("perf_diff: no regression beyond "
           f"{args.threshold:.0%}")

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Regenerate the committed device-trace fixtures in one command.
+
+    python scripts/refresh_devtrace_fixture.py [--only devtrace|critpath]
+                                               [--no-inject] [--keep-tmp]
+
+Two fixtures ship in the repo, both distilled from the same miniapp
+configuration (2x2 cholesky, n=128 nb=32, lookahead + comm-lookahead,
+XLA:CPU with 4 forced host devices):
+
+* ``tests/fixtures/devtrace/`` — the device-timeline attribution fixture
+  (``mfu_table.py --measured`` source, ISSUE 14).  Traced run without
+  program telemetry; distilled by ``obs.devtrace --distill``.
+* ``tests/fixtures/critpath/`` — the per-step critical-path fixture
+  (ISSUE 16).  Traced run WITH ``DLAF_PROGRAM_TELEMETRY=1`` so the
+  merged artifact carries the ``schedule`` records the joiner needs,
+  then a 2 ms synthetic gap is injected before ``cholesky.step002``
+  (``--no-inject`` skips it).  The injection is deliberate and
+  documented: XLA:CPU collectives spin-wait, so a CPU-container run has
+  genuinely ZERO device idle between steps — the committed fixture would
+  otherwise exercise the gap-accounting path only at 0.0, and the replay
+  tests could not pin "a known gap is recovered at the right boundary"
+  hermetically.  The injected size/step are asserted below, so a refresh
+  that drifts fails here, not in CI.
+
+Each leg ends with a hermetic self-check (replay the distilled fixture
+exactly the way the tests and ``mfu_table.py``/CI do; validate the
+record schema with the matching ``--require-*`` obligation) and only
+then replaces the committed fixture.  Exit 0 = all requested fixtures
+refreshed and verified.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+sys.path.insert(0, REPO)
+
+#: One shared miniapp shape: small enough to distill to ~100 KB, deep
+#: enough for a 4-step pipeline (nt = 128/32) on a 2x2 grid.
+MINIAPP = ["-m", "128", "-b", "32", "--grid-rows", "2", "--grid-cols", "2",
+           "--nruns", "2"]
+
+#: The critpath fixture's documented synthetic gap (see module docstring).
+INJECT_SPEC = "cholesky.step002=2.0"
+INJECT_STEP = 2
+INJECT_S = 2.0e-3
+
+BASE_ENV = {
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    "JAX_PLATFORMS": "cpu",
+    "DLAF_CHOLESKY_LOOKAHEAD": "1",
+    "DLAF_COMM_LOOKAHEAD": "1",
+}
+
+
+def run(cmd, env=None, **kw):
+    merged = dict(os.environ)
+    merged.update(env or {})
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.run(cmd, env=merged, cwd=REPO, check=True, **kw)
+
+
+def traced_miniapp(tmp: str, telemetry: bool) -> tuple[str, str]:
+    """Run the traced miniapp; return (trace_dir, merged_jsonl)."""
+    os.makedirs(tmp, exist_ok=True)
+    art = os.path.join(tmp, "art")
+    trace_dir = os.path.join(tmp, "trace")
+    merged = os.path.join(tmp, "merged.jsonl")
+    env = dict(BASE_ENV, DLAF_METRICS_PATH=art, DLAF_TRACE_DIR=trace_dir)
+    if telemetry:
+        env["DLAF_PROGRAM_TELEMETRY"] = "1"
+    run([sys.executable, "-m", "dlaf_tpu.miniapp.miniapp_cholesky",
+         *MINIAPP], env=env)
+    run([sys.executable, "-m", "dlaf_tpu.obs.aggregate", art, "-o", merged])
+    return trace_dir, merged
+
+
+def refresh_devtrace(tmp: str) -> None:
+    from dlaf_tpu.obs import devtrace
+    from dlaf_tpu.obs.aggregate import merge_artifacts
+    from dlaf_tpu.obs.sinks import DEVTRACE_COVERAGE_FLOOR, validate_records
+
+    trace_dir, merged = traced_miniapp(os.path.join(tmp, "dev"),
+                                       telemetry=False)
+    distilled = os.path.join(tmp, "dev", "trace.json.gz")
+    run([sys.executable, "-m", "dlaf_tpu.obs.devtrace", trace_dir, merged,
+         "--distill", distilled], stdout=subprocess.DEVNULL)
+    # hermetic self-check: exactly the replay the tests and mfu_table do
+    records = merge_artifacts([merged])
+    report = devtrace.attribute(devtrace.load_trace(distilled), records)
+    assert report["join"] == "annotation", report["join"]
+    assert report["coverage"] >= DEVTRACE_COVERAGE_FLOOR, report["coverage"]
+    assert report["overlap"], "no attributed collectives"
+    assert "cholesky" in report["phases"], sorted(report["phases"])
+    recs = devtrace.records_from_report(report, distilled)
+    errs = validate_records(records + recs, require_devtrace=True)
+    assert not errs, errs
+    dest = os.path.join(FIXTURES, "devtrace")
+    os.makedirs(dest, exist_ok=True)
+    shutil.copy(distilled, os.path.join(dest, "trace.json.gz"))
+    shutil.copy(merged, os.path.join(dest, "merged.jsonl"))
+    print(f"devtrace fixture refreshed -> {dest} "
+          f"(coverage {report['coverage']:.1%})")
+
+
+def refresh_critpath(tmp: str, inject: bool) -> None:
+    from dlaf_tpu.obs import critpath, devtrace
+    from dlaf_tpu.obs.aggregate import merge_artifacts
+    from dlaf_tpu.obs.sinks import CRITPATH_COVERAGE_FLOOR, validate_records
+
+    trace_dir, merged = traced_miniapp(os.path.join(tmp, "cp"),
+                                       telemetry=True)
+    records = merge_artifacts([merged])
+    events = devtrace.load_trace(trace_dir)
+    if inject:
+        algo, step, seconds = critpath.parse_inject(INJECT_SPEC)
+        n = critpath.inject_gap(events, records, algo, step, seconds)
+        assert n >= 1, "injection found no runs"
+        print(f"injected {seconds * 1e3:.1f} ms before "
+              f"{algo}.step{step:03d} in {n} runs (documented synthetic "
+              "gap: XLA:CPU spin-wait collectives leave zero real idle)")
+    kept = devtrace.distill(events, records)
+    distilled = os.path.join(tmp, "cp", "trace.json.gz")
+    with gzip.open(distilled, "wt", encoding="utf-8") as fh:
+        fh.write(json.dumps({"traceEvents": kept}))
+    # hermetic self-check: the replay CI and the tests perform
+    replay = critpath.attribute(devtrace.load_trace(distilled), records)
+    assert replay["coverage"] >= CRITPATH_COVERAGE_FLOOR, replay["coverage"]
+    prog = replay["programs"]["cholesky"]
+    assert prog["n_steps"] >= 2, prog["n_steps"]
+    assert all(s.get("bound") for s in prog["steps"]
+               if not s.get("empty")), "steps without bound class"
+    if inject:
+        gap = prog["steps"][INJECT_STEP - 1].get("gap_after_s", 0.0)
+        # lookahead overlap eats into the boundary; at least half the
+        # injected idle must be recovered at the RIGHT boundary
+        assert gap >= 0.5 * INJECT_S, (
+            f"injected gap not recovered: {gap * 1e3:.3f} ms before "
+            f"step{INJECT_STEP:03d}")
+        others = [s.get("gap_after_s", 0.0) for s in prog["steps"]
+                  if not s.get("empty") and s["step"] != INJECT_STEP - 1]
+        assert all(g < gap for g in others), (gap, others)
+    recs = critpath.records_from_report(replay, distilled)
+    errs = validate_records(records + recs, require_critpath=True)
+    assert not errs, errs
+    dest = os.path.join(FIXTURES, "critpath")
+    os.makedirs(dest, exist_ok=True)
+    shutil.copy(distilled, os.path.join(dest, "trace.json.gz"))
+    shutil.copy(merged, os.path.join(dest, "merged.jsonl"))
+    gap_ms = (prog["steps"][INJECT_STEP - 1].get("gap_after_s", 0.0) * 1e3
+              if inject else 0.0)
+    print(f"critpath fixture refreshed -> {dest} "
+          f"(coverage {replay['coverage']:.1%}, "
+          f"gap before step{INJECT_STEP:03d}: {gap_ms:.3f} ms)")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    only = None
+    inject = True
+    keep = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--only":
+            i += 1
+            only = argv[i]
+            if only not in ("devtrace", "critpath"):
+                print(f"--only must be devtrace|critpath, got {only!r}",
+                      file=sys.stderr)
+                return 2
+        elif a == "--no-inject":
+            inject = False
+        elif a == "--keep-tmp":
+            keep = True
+        else:
+            print(__doc__, file=sys.stderr)
+            return 2
+        i += 1
+    tmp = tempfile.mkdtemp(prefix="fixture_refresh_")
+    try:
+        if only in (None, "devtrace"):
+            refresh_devtrace(tmp)
+        if only in (None, "critpath"):
+            refresh_critpath(tmp, inject)
+    finally:
+        if keep:
+            print(f"scratch kept: {tmp}")
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

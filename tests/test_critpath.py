@@ -1,0 +1,570 @@
+"""Critical-path & stall attribution tests (ISSUE 16, dlaf_tpu.obs.critpath).
+
+Covers the HLO schedule parser (module-name pin, innermost-scope-wins
+for comm-lookahead-hoisted panels, scanstep scopes), the device-event
+join on hand-built synthetic timelines — where the EXACT contract can be
+pinned: a serial non-overlapping timeline with equal durations (trimming
+is a no-op) recovers an injected gap to the microsecond — plus boundary
+gap accounting, bound classification, what-if projections, the scan
+occurrence-order reconstruction, the CSE detangler, the rebase join
+fallback, single-step (n <= nb) programs, the schedule/critpath/whatif
+record schema + ``--require-critpath`` accept/reject legs (coverage
+below the floor must be REJECTED, with the measured coverages named),
+the hermetic replay of the committed ``tests/fixtures/critpath/``
+fixture (which carries a documented 2 ms synthetic gap — XLA:CPU's
+spin-wait collectives make real step-boundary gaps exactly zero, so the
+nonzero-gap leg needs a known injection), the CLI, the depgraph-side
+static step structure (lookahead pin: NO bulk_k -> panel_{k+1} edge),
+and the downstream consumers: ``mfu_table.measured_bound``,
+``perf_diff`` per-step category facts / ``--json`` / ``worst_step``,
+and ``bench_gate.worst_step_category``.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import dlaf_tpu.config as config
+from dlaf_tpu.analysis import depgraph
+from dlaf_tpu.obs import critpath
+from dlaf_tpu.obs.aggregate import merge_artifacts
+from dlaf_tpu.obs.devtrace import load_trace
+from dlaf_tpu.obs.sinks import (CRITPATH_BOUNDS, CRITPATH_COVERAGE_FLOOR,
+                                WHATIF_SCENARIOS, validate_records)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SCRIPTS = os.path.join(REPO, "scripts")
+FIXTURE = os.path.join(HERE, "fixtures", "critpath")
+FIXTURE_TRACE = os.path.join(FIXTURE, "trace.json.gz")
+FIXTURE_JSONL = os.path.join(FIXTURE, "merged.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# schedule extraction from optimized HLO
+# ---------------------------------------------------------------------------
+
+_HLO = """\
+HloModule jit_factorize, entry_computation_layout={(f64[4,4]{1,0})->f64[4,4]{1,0}}
+
+ENTRY main {
+  %p0 = f64[4,4] parameter(0)
+  %potrf.1 = f64[4,4] custom-call(%p0), op_name="jit(factorize)/cholesky.step000.panel/potrf"
+  %dot.1 = f64[4,4] dot(%potrf.1, %p0), op_name="jit(factorize)/cholesky.step000.bulk/dot_general"
+  %psum.1 = f64[4,4] all-reduce(%dot.1), op_name="jit(factorize)/cholesky.step000.bulk/cholesky.step001.panel/psum"
+  %solve.1 = f64[4,4] triangular-solve(%p0), op_name="jit(solve)/trsm.scanstep.panel/triangular_solve"
+  %bcast.1 = f64[4,4] broadcast(%p0), op_name="jit(factorize)/broadcast_in_dim"
+}
+"""
+
+
+def test_schedule_from_hlo():
+    sched = critpath.schedule_from_hlo(_HLO)
+    # the module regex must stop at the word: "HloModule name," carries a
+    # trailing comma that a greedy \S+ would capture
+    assert sched["module"] == "jit_factorize"
+    ops = sched["ops"]
+    assert ops["potrf.1"] == ["cholesky", 0, "panel"]
+    assert ops["dot.1"] == ["cholesky", 0, "bulk"]
+    # innermost scope wins: the comm-lookahead panel chain hoisted into
+    # step 0's bulk scope is attributed to step 1's panel
+    assert ops["psum.1"] == ["cholesky", 1, "panel"]
+    # scan bodies are traced once — index-free scope, step -1
+    assert ops["solve.1"] == ["trsm", -1, "panel"]
+    assert "bcast.1" not in ops            # unscoped ops are omitted
+
+
+def test_schedule_record_and_schema():
+    rec = critpath.schedule_record("cholesky.dist", _HLO)
+    assert rec["type"] == "schedule" and rec["module"] == "jit_factorize"
+    assert rec["n_ops"] == 4
+    assert rec["algos"] == {"cholesky": {"steps": 2, "scan": False},
+                            "trsm": {"steps": 0, "scan": True}}
+    assert not validate_records([rec])
+    # a program with no step scopes yields nothing to record
+    assert critpath.schedule_record("x", "HloModule m\n%a = add(b, c)") is None
+    # schema: a malformed ops entry is named by index
+    bad = copy.deepcopy(rec)
+    bad["ops"][0] = ["just-a-name"]
+    assert any("ops[0]" in e for e in validate_records([bad]))
+
+
+# ---------------------------------------------------------------------------
+# synthetic serial timeline: the exact-arithmetic contract
+# ---------------------------------------------------------------------------
+
+
+def _sched(ops, algos, module="jit_chol"):
+    return {"type": "schedule", "v": 1, "ts": 1.0, "site": "chol.test",
+            "module": module, "n_ops": len(ops), "algos": algos,
+            "ops": ops, "rank": 0}
+
+
+def _span(name="chol", dur_s=1e-3, ts=2.0, **attrs):
+    return {"v": 1, "type": "span", "ts": ts, "name": name, "dur_s": dur_s,
+            "depth": 0, "parent": None, "attrs": attrs, "rank": 0}
+
+
+def _meta_events():
+    return [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 9,
+         "args": {"name": "python"}},
+    ]
+
+
+def _dev(name, ts, dur, module="jit_chol"):
+    return {"ph": "X", "pid": 1, "tid": 1, "ts": float(ts),
+            "dur": float(dur), "name": name,
+            "args": {"hlo_op": name, "hlo_module": module}}
+
+
+def _host(name, ts, dur):
+    return {"ph": "X", "pid": 9, "tid": 1, "ts": float(ts),
+            "dur": float(dur), "name": name}
+
+
+def _serial_setup(n_steps=3, host_window=True):
+    """A serial NON-overlapping timeline with equal 100 us durations:
+    panel_k [200k, 200k+100], bulk_k [200k+100, 200k+200]. Equal
+    durations make the robust-window trimming a no-op, so every
+    derived number is exact arithmetic."""
+    ops, events = [], _meta_events()
+    if host_window:
+        events.append(_host("chol", 0.0, n_steps * 200.0 + 100.0))
+    for k in range(n_steps):
+        ops += [[f"p{k}", "chol", k, "panel"], [f"b{k}", "chol", k, "bulk"]]
+        events.append(_dev(f"p{k}", 200.0 * k, 100.0))
+        events.append(_dev(f"b{k}", 200.0 * k + 100.0, 100.0))
+    records = [_sched(ops, {"chol": {"steps": n_steps, "scan": False}}),
+               _span(flops=1e6, n=n_steps * 32, nb=32)]
+    records[-1]["flops"] = 1e6
+    return events, records
+
+
+def test_serial_timeline_attributes_exactly():
+    events, records = _serial_setup()
+    report = critpath.attribute(events, records)
+    assert report["join"] == "annotation"
+    assert report["coverage"] == pytest.approx(1.0)
+    prog = report["programs"]["chol"]
+    assert prog["n_runs"] == 1 and prog["n_steps"] == 3 and not prog["scan"]
+    assert prog["wall_s"] == pytest.approx(600e-6)
+    assert prog["gap_total_s"] == pytest.approx(0.0, abs=1e-12)
+    for s in prog["steps"]:
+        assert s["wall_s"] == pytest.approx(200e-6)
+        assert s["phases"]["panel"] == pytest.approx(100e-6)
+        assert s["phases"]["bulk"] == pytest.approx(100e-6)
+        assert s.get("gap_after_s", 0.0) == pytest.approx(0.0, abs=1e-12)
+        assert s["bound"] in CRITPATH_BOUNDS
+    assert prog["critical_path"] and prog["critical_path_s"] > 0
+    # flops from the entry span -> measured GF/s over the run wall
+    assert prog["gflops"] == pytest.approx(1e6 / 600e-6 / 1e9)
+    # what-ifs: gaps_closed saves nothing here; vocabulary is complete
+    wi = {w["scenario"]: w for w in prog["whatif"]}
+    assert set(wi) == set(WHATIF_SCENARIOS)
+    assert wi["gaps_closed"]["saved_s"] == pytest.approx(0.0, abs=1e-12)
+    assert wi["panel_free"]["saved_s"] == pytest.approx(300e-6)
+
+
+def test_inject_gap_recovers_exactly_on_serial_timeline():
+    """On the serial timeline the measured boundary gap grows by EXACTLY
+    the injected delta (no lookahead tail to absorb it) — the arithmetic
+    contract behind the CI drill and the fixture's documented 2 ms."""
+    events, records = _serial_setup()
+    n = critpath.inject_gap(events, records, "chol", 1, 5e-3)
+    assert n == 1
+    prog = critpath.attribute(events, records)["programs"]["chol"]
+    steps = prog["steps"]
+    assert steps[0]["gap_after_s"] == pytest.approx(5e-3, rel=1e-9)
+    assert steps[1]["gap_after_s"] == pytest.approx(0.0, abs=1e-12)
+    assert prog["gap_total_s"] == pytest.approx(5e-3, rel=1e-9)
+    # the stalled step is now gap-bound; the others untouched
+    assert steps[0]["bound"] == "gap"
+    assert steps[1]["wall_s"] == pytest.approx(200e-6)
+
+
+def test_parse_inject():
+    assert critpath.parse_inject("cholesky.step002=2.0") == \
+        ("cholesky", 2, pytest.approx(2e-3))
+    with pytest.raises(ValueError, match="inject-gap"):
+        critpath.parse_inject("cholesky.panel=2.0")
+
+
+def test_comm_bound_step_and_collectives_free_projection():
+    """An exposed collective (serial: nothing overlaps it) must dominate
+    its step's bound and the collectives_free projection exactly."""
+    ops = [["p0", "chol", 0, "panel"], ["c0", "chol", 0, "panel"],
+           ["p1", "chol", 1, "panel"]]
+    events = _meta_events() + [
+        _host("chol", 0.0, 500.0),
+        _dev("p0", 0.0, 50.0),
+        {"ph": "X", "pid": 1, "tid": 1, "ts": 50.0, "dur": 200.0,
+         "name": "all-reduce.7",
+         "args": {"hlo_op": "c0", "hlo_module": "jit_chol"}},
+        _dev("p1", 250.0, 50.0),
+    ]
+    records = [_sched(ops, {"chol": {"steps": 2, "scan": False}}), _span()]
+    prog = critpath.attribute(events, records)["programs"]["chol"]
+    s0 = prog["steps"][0]
+    assert s0["comm_s"] == pytest.approx(200e-6)
+    assert s0["comm_exposed_s"] == pytest.approx(200e-6)
+    assert s0["bound"] == "comm"
+    wi = {w["scenario"]: w for w in prog["whatif"]}
+    assert wi["collectives_free"]["saved_s"] == pytest.approx(200e-6)
+
+
+def test_single_step_program_has_no_gap_keys():
+    """n <= nb: one step, no boundaries — the joiner must not emit gap
+    keys, and the artifact still satisfies --require-critpath."""
+    events, records = _serial_setup(n_steps=1)
+    report = critpath.attribute(events, records)
+    prog = report["programs"]["chol"]
+    assert prog["n_steps"] == 1
+    (s0,) = prog["steps"]
+    assert "gap_after_s" not in s0
+    assert prog["gap_total_s"] == 0.0
+    assert prog["critical_path"] == ["step000.panel", "step000.bulk"]
+    recs = critpath.records_from_report(report, "t.json.gz")
+    assert not validate_records(recs, require_critpath=True)
+
+
+def test_cse_detangle_keeps_step_windows_tight():
+    """An op tagged step 0 but re-executed inside step 1's window (XLA
+    CSE shares fusions across steps; the shared instr keeps the FIRST
+    emitter's metadata) must be re-assigned, not stretch step 0."""
+    ops = [["u0", "chol", 0, "panel"], ["u1", "chol", 1, "panel"],
+           ["sh", "chol", 0, "bulk"]]
+    events = _meta_events() + [
+        _host("chol", 0.0, 400.0),
+        _dev("u0", 0.0, 100.0), _dev("u1", 200.0, 100.0),
+        _dev("sh", 50.0, 10.0), _dev("sh", 250.0, 10.0),
+    ]
+    records = [_sched(ops, {"chol": {"steps": 2, "scan": False}}), _span()]
+    prog = critpath.attribute(events, records)["programs"]["chol"]
+    assert prog["steps"][0]["wall_s"] == pytest.approx(100e-6)
+    assert prog["steps"][1]["wall_s"] == pytest.approx(100e-6)
+    assert prog["steps"][0]["gap_after_s"] == pytest.approx(100e-6)
+
+
+def test_scan_program_reconstructs_steps_from_occurrence_order():
+    """A scan body is traced once (step -1 in the schedule); iterations
+    are reconstructed from per-(op, device) occurrence order, with the
+    iteration total inferred from the entry span's (n, nb)."""
+    ops = [["sp", "chol", -1, "panel"], ["sb", "chol", -1, "bulk"]]
+    events = _meta_events() + [_host("chol", 0.0, 700.0)]
+    for k in range(3):
+        events.append(_dev("sp", 200.0 * k, 80.0))
+        events.append(_dev("sb", 200.0 * k + 80.0, 100.0))
+    records = [_sched(ops, {"chol": {"steps": 0, "scan": True}}),
+               _span(n=96, nb=32)]          # ceil(96/32) = 3 iterations
+    prog = critpath.attribute(events, records)["programs"]["chol"]
+    assert prog["scan"] and prog["n_steps"] == 3
+    for s in prog["steps"]:
+        assert s["phases"]["panel"] == pytest.approx(80e-6)
+        assert s["phases"]["bulk"] == pytest.approx(100e-6)
+    assert prog["steps"][0]["gap_after_s"] == pytest.approx(20e-6)
+
+
+def test_rebase_join_without_annotation_mirrors():
+    """A mirror-less trace (no host TraceAnnotation events) still joins:
+    the JSONL spans are rebased onto the device-time origin."""
+    events, records = _serial_setup(host_window=False)
+    report = critpath.attribute(events, records)
+    assert report["join"] == "rebase"
+    assert report["programs"]["chol"]["n_steps"] == 3
+
+
+def test_attribute_fails_loudly_without_schedule_or_devices():
+    events, records = _serial_setup()
+    with pytest.raises(ValueError, match="no schedule records"):
+        critpath.attribute(events, [_span()])
+    with pytest.raises(ValueError, match="no device events"):
+        critpath.attribute(_meta_events(), records)
+
+
+# ---------------------------------------------------------------------------
+# record schema + --require-critpath accept/reject
+# ---------------------------------------------------------------------------
+
+
+def _report_records():
+    events, records = _serial_setup()
+    report = critpath.attribute(events, records)
+    return critpath.records_from_report(report, "t.json.gz")
+
+
+def test_records_validate_and_require_critpath_accepts():
+    recs = _report_records()
+    assert not validate_records(recs)
+    assert not validate_records(recs, require_critpath=True)
+    types = [r["type"] for r in recs]
+    assert types.count("critpath") == 1
+    assert types.count("whatif") == len(WHATIF_SCENARIOS)
+
+
+def test_require_critpath_rejects_low_coverage_naming_it():
+    recs = _report_records()
+    (cp,) = [r for r in recs if r["type"] == "critpath"]
+    cp["coverage"] = CRITPATH_COVERAGE_FLOOR - 0.01
+    errors = validate_records(recs, require_critpath=True)
+    # the rejection names the measured coverages (the "(got [...])" idiom)
+    assert any("coverage" in e and "got" in e for e in errors)
+    # but the records stay schema-valid
+    assert not validate_records(recs)
+
+
+def test_require_critpath_rejects_missing_whatif():
+    recs = [r for r in _report_records() if r["type"] != "whatif"]
+    errors = validate_records(recs, require_critpath=True)
+    assert any("whatif" in e for e in errors)
+
+
+def test_critpath_schema_rejects_bad_vocabulary():
+    recs = _report_records()
+    bad = copy.deepcopy(recs)
+    (cp,) = [r for r in bad if r["type"] == "critpath"]
+    cp["bound"] = "mystery"
+    assert any("bound" in e for e in validate_records(bad))
+    bad = copy.deepcopy(recs)
+    (cp,) = [r for r in bad if r["type"] == "critpath"]
+    cp["steps"][0]["bound"] = "mystery"
+    assert any("bound" in e for e in validate_records(bad))
+    bad = copy.deepcopy(recs)
+    wi = [r for r in bad if r["type"] == "whatif"][0]
+    wi["scenario"] = "magic"
+    assert any("scenario" in e for e in validate_records(bad))
+    # a projection that makes things SLOWER is a computation bug
+    bad = copy.deepcopy(recs)
+    wi = [r for r in bad if r["type"] == "whatif"][0]
+    wi["projected_wall_s"] = wi["wall_s"] * 2
+    assert any("projected_wall_s" in e for e in validate_records(bad))
+
+
+# ---------------------------------------------------------------------------
+# the committed fixture: hermetic replay (the CI leg's contract)
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_replays_hermetically():
+    """The fixture must show per-step bound classification and a NONZERO
+    measured step-boundary gap: the documented 2 ms injection before
+    cholesky.step002 (XLA:CPU's spin-wait collectives make organic gaps
+    exactly zero), partially absorbed by lookahead overlap but well
+    above noise, at the right boundary and ONLY there."""
+    records = merge_artifacts([FIXTURE_JSONL])
+    report = critpath.attribute(load_trace(FIXTURE_TRACE), records)
+    assert report["join"] == "annotation"
+    assert report["coverage"] >= CRITPATH_COVERAGE_FLOOR
+    prog = report["programs"]["cholesky"]
+    assert not prog["scan"] and prog["n_steps"] == 4 and prog["n_runs"] >= 2
+    steps = prog["steps"]
+    gap = steps[1]["gap_after_s"]          # the gap BEFORE step 2
+    assert gap > 0.5e-3
+    for s in steps:
+        assert s["bound"] in CRITPATH_BOUNDS
+        if s["step"] != 1 and "gap_after_s" in s:
+            assert s["gap_after_s"] < gap
+    # the critical path walks the serial panel chain (docs/lookahead.md)
+    assert prog["critical_path"][:3] == [
+        "step000.panel", "step000.strip", "step001.panel"]
+    assert prog["gflops"] > 0
+    recs = critpath.records_from_report(report, FIXTURE_TRACE)
+    assert not validate_records(records + recs, require_critpath=True)
+
+
+def test_fixture_gap_injection_drill_names_the_boundary():
+    """Trace-level injection before step 3 must surface as that exact
+    boundary's gap — the CI must-trip drill's mechanism."""
+    records = merge_artifacts([FIXTURE_JSONL])
+    events = load_trace(FIXTURE_TRACE)
+    base = critpath.attribute(events, records)["programs"]["cholesky"]
+    n = critpath.inject_gap(events, records, "cholesky", 3, 5e-3)
+    assert n >= 2
+    prog = critpath.attribute(events, records)["programs"]["cholesky"]
+    grew = prog["steps"][2]["gap_after_s"] - \
+        base["steps"][2].get("gap_after_s", 0.0)
+    # lookahead tails absorb part of the delta, never most of it
+    assert grew > 2.5e-3
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_critpath_cli_reports_and_validates(tmp_path):
+    out = str(tmp_path / "cp.jsonl")
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.critpath", FIXTURE_TRACE,
+         FIXTURE_JSONL, "-o", out], capture_output=True, text=True,
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "critical path:" in r.stdout and "what-if:" in r.stdout
+    v = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.validate", out,
+         "--require-critpath"], capture_output=True, text=True, cwd=REPO)
+    assert v.returncode == 0, v.stderr
+
+
+def test_critpath_cli_exit_codes(tmp_path):
+    # usage errors -> 2
+    assert subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.critpath", FIXTURE_TRACE],
+        capture_output=True, cwd=REPO).returncode == 2
+    assert subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.critpath", FIXTURE_TRACE,
+         FIXTURE_JSONL, "--bogus"], capture_output=True,
+        cwd=REPO).returncode == 2
+    # an artifact without schedule records cannot join -> 1, loudly
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps(_span()) + "\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "dlaf_tpu.obs.critpath", FIXTURE_TRACE,
+         str(bare)], capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    assert "no schedule records" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# depgraph: the static step DAG (the critical-path model's skeleton)
+# ---------------------------------------------------------------------------
+
+
+def test_step_structure_pins_lookahead_edges(devices8):
+    """The traced unrolled dist Cholesky, annotated, must expose the
+    per-step phase groups, and under lookahead panel k+1 must NOT depend
+    on bulk k (the serial form must — stale-test guard)."""
+    from dlaf_tpu.algorithms.cholesky import _build_dist_cholesky
+    from dlaf_tpu.comm.grid import Grid
+    from dlaf_tpu.common.index2d import TileElementSize
+    from dlaf_tpu.matrix.matrix import Matrix
+    from dlaf_tpu.obs._state import STATE
+
+    config.initialize()
+    grid = Grid(2, 2)
+    mat = Matrix.from_global(np.eye(24), TileElementSize(4, 4), grid=grid)
+
+    def structure(lookahead):
+        old = STATE.annotate
+        STATE.annotate = True       # named_span scopes only emit when on
+        try:
+            fn = _build_dist_cholesky(mat.dist, grid.mesh, "L", False, True,
+                                      lookahead=lookahead,
+                                      comm_la=lookahead)
+            return depgraph.step_structure(
+                depgraph.shard_map_body(fn, mat.storage))
+        finally:
+            STATE.annotate = old
+
+    st = structure(lookahead=True)
+    assert st["algos"]["cholesky"] == {"steps": 6, "scan": False}
+    assert "cholesky.step000.panel" in st["groups"]
+    assert "cholesky.step000.bulk" in st["groups"]
+    serial_edges = {(f"cholesky.step{k:03d}.bulk",
+                     f"cholesky.step{k + 1:03d}.panel") for k in range(5)}
+    assert not serial_edges & set(map(tuple, st["edges"])), \
+        "pipelined panel still depends on the previous bulk product"
+    st = structure(lookahead=False)
+    assert serial_edges & set(map(tuple, st["edges"])), \
+        "serialized form lost its bulk->panel edge — test is stale"
+
+
+# ---------------------------------------------------------------------------
+# downstream consumers: mfu_table, perf_diff, bench_gate
+# ---------------------------------------------------------------------------
+
+
+def test_mfu_table_measured_bound_from_fixture():
+    sys.path.insert(0, SCRIPTS)
+    import mfu_table
+
+    mb = mfu_table.measured_bound(FIXTURE)
+    assert "cholesky" in mb
+    assert mb["cholesky"].startswith("comm")     # the fixture's verdict
+    assert "cpu" in mb["cholesky"]               # platform-labeled, always
+    text = mfu_table.render(with_ici=False, mb=mb)
+    assert "measured bound" in text
+    assert mb["cholesky"] in text
+
+
+def test_perf_diff_extracts_step_categories():
+    sys.path.insert(0, SCRIPTS)
+    from perf_diff import diff, extract, worst_step
+
+    def cp(gap):
+        return {"type": "critpath", "algo": "chol", "coverage": 0.9,
+                "steps": [
+                    {"step": 0, "panel_s": 1e-3, "bulk_s": 2e-3,
+                     "comm_exposed_s": 0.5e-3, "copy_s": 0.0,
+                     "gap_after_s": gap, "bound": "bulk"},
+                    {"step": 1, "empty": True},
+                ]}
+
+    facts = extract([cp(4e-3)])
+    assert facts["step_cat"]["chol.step000 panel"] == pytest.approx(1e-3)
+    assert facts["step_cat"]["chol.step000 comm"] == pytest.approx(0.5e-3)
+    # the gap after step 0 stalls step 1's start: keyed at the boundary
+    # it precedes, matching the --inject-gap spec vocabulary
+    assert facts["step_cat"]["chol.step001 gap"] == pytest.approx(4e-3)
+    assert not any("step001 panel" in k for k in facts["step_cat"])
+    findings = diff(extract([cp(4e-3)]), extract([cp(8e-3)]), 0.25)
+    ws = worst_step(findings)
+    assert ws and ws["label"] == "chol.step001 gap" and ws["regression"]
+    # identical artifacts -> no worse step
+    assert worst_step(diff(facts, extract([cp(4e-3)]), 0.25)) is None
+
+
+@pytest.fixture()
+def critpath_artifact(tmp_path):
+    records = merge_artifacts([FIXTURE_JSONL])
+    report = critpath.attribute(load_trace(FIXTURE_TRACE), records)
+    recs = critpath.records_from_report(report, FIXTURE_TRACE)
+    path = str(tmp_path / "cp_enriched.jsonl")
+    with open(path, "w") as f:
+        for r in records + recs:
+            f.write(json.dumps(r, default=str) + "\n")
+    return path
+
+
+def test_perf_diff_json_contract(critpath_artifact):
+    r = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "perf_diff.py"),
+         critpath_artifact, critpath_artifact, "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    assert {"findings", "regressions", "worst_step",
+            "coverage"} <= set(data)
+    assert data["regressions"] == [] and data["worst_step"] is None
+
+
+def test_perf_diff_step_gap_regression_names_the_step(critpath_artifact):
+    """An injected slowdown on one step-boundary gap must exit 1 with
+    that exact label — the verdict bench_gate splices in."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "perf_diff.py"),
+         critpath_artifact, critpath_artifact,
+         "--inject-slowdown", "cholesky.step002 gap=1.0", "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    assert data["worst_step"]["label"] == "cholesky.step002 gap"
+    # regressions are the human verdict lines, worst first
+    assert any("cholesky.step002 gap" in line
+               for line in data["regressions"])
+
+
+def test_bench_gate_worst_step_category(critpath_artifact):
+    sys.path.insert(0, SCRIPTS)
+    import bench_gate
+
+    line = bench_gate.worst_step_category([critpath_artifact])
+    assert line and line.startswith("cholesky.step") and "ms" in line
+    assert bench_gate.worst_step_category([]) is None
