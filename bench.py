@@ -133,8 +133,16 @@ def _cache_dir() -> str:
 #: scripts/bench_gate.py holds to the history-free
 #: --min-autotune-speedup floor; workload="autotune" keeps both numbers
 #: out of every headline. Sized via DLAF_BENCH_AUTOTUNE_N.
+#: "fleet" (ISSUE 18, docs/fleet.md): the multi-replica serve-tier arm —
+#: the same seeded mixed-bucket stream through a fleet Router over ONE
+#: real subprocess replica vs DLAF_BENCH_FLEET_WORKERS replicas; the
+#: N-vs-1 requests/s ratio rides as the "speedup" field
+#: scripts/bench_gate.py holds to the history-free --min-fleet-scaling
+#: floor, and a mid-stream SIGKILL leg reports the zero-loss failover
+#: cost as "recovery_s". workload="fleet" keeps every number out of the
+#: headlines. Sized via DLAF_BENCH_FLEET_N / DLAF_BENCH_FLEET_REQS.
 STAGE_BASES = ("tridiag", "btr2b", "btb2t", "fpanel", "serve", "overload",
-               "autotune")
+               "autotune", "fleet")
 
 
 def _run_fpanel_variant(variant: str, platform: str) -> None:
@@ -538,6 +546,157 @@ def _run_autotune_variant(variant: str, platform: str) -> None:
     print(json.dumps(line), flush=True)
 
 
+def _run_fleet_variant(variant: str, platform: str) -> None:
+    """Measure the fleet serve tier (ISSUE 18, docs/fleet.md): the SAME
+    seeded mixed-bucket cholesky/solve stream through a Router over ONE
+    real subprocess replica, then over ``DLAF_BENCH_FLEET_WORKERS``
+    replicas sharing the persistent compile cache — requests/s in the
+    ``gflops`` history slot, p99 latency seconds in ``t``, and the
+    N-vs-1 throughput ratio as the ``speedup`` field
+    scripts/bench_gate.py holds to the history-free
+    ``--min-fleet-scaling`` floor. The arm then re-runs the stream with
+    a mid-flight SIGKILL of the replica holding unacked tickets and
+    reports ``recovery_s`` (kill -> every ticket resolved, ZERO lost):
+    the replica-kill drill's cost, measured rather than asserted away.
+    workload="fleet" keeps all of it out of every headline."""
+    import signal
+    import subprocess
+
+    from dlaf_tpu import obs
+    from dlaf_tpu.fleet import Router
+    from dlaf_tpu.obs import quantile
+    from dlaf_tpu.serve import Request
+
+    bn = int(os.environ.get("DLAF_BENCH_FLEET_N", "64"))
+    n_reqs = int(os.environ.get("DLAF_BENCH_FLEET_REQS", "48"))
+    n_workers = int(os.environ.get("DLAF_BENCH_FLEET_WORKERS", "3"))
+    # the replica queues bucket by these knobs (children inherit the
+    # env); two n-buckets x two ops = four bucket programs, so the
+    # router's bucket co-location actually spreads across replicas
+    os.environ["DLAF_SERVE_BUCKETS"] = f"{max(bn // 2, 8)},{bn}"
+    os.environ["DLAF_SERVE_DEADLINE_MS"] = "60000"
+    rng = np.random.default_rng(bn * 7 + n_reqs)
+    problems = []
+    for i in range(n_reqs):
+        n = int(rng.integers(bn // 4 + 1, bn + 1))
+        if i % 3 == 2:
+            problems.append(dict(
+                op="solve",
+                a=np.tril(rng.standard_normal((n, n))) + 3 * np.eye(n),
+                b=rng.standard_normal((n, 4))))
+        else:
+            x = rng.standard_normal((n, n))
+            problems.append(dict(op="cholesky", a=x @ x.T + n * np.eye(n)))
+
+    router = Router(port=0)
+    wenv = dict(os.environ)
+    if wenv.get("DLAF_METRICS_PATH"):
+        # the replicas must not interleave writes into THIS child's
+        # artifact: each gets its own rank-templated shard next to it
+        wenv["DLAF_METRICS_PATH"] += ".fleet_w%r.jsonl"
+    procs: dict = {}
+
+    def spawn(k):
+        procs[k] = subprocess.Popen(
+            [sys.executable, "-m", "dlaf_tpu.fleet.worker",
+             "--connect", f"127.0.0.1:{router.port}", "--worker", str(k)],
+            env=wenv)
+
+    def wait_up(count, timeout_s=180.0):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            states = router.stats()["workers"]
+            if sum(1 for m in states.values()
+                   if m["state"] == "up") >= count:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"fleet replicas not up: {states}")
+            router.poll()
+            time.sleep(0.05)
+
+    def pass_once():
+        tickets = [router.submit(Request(**p)) for p in problems]
+        router.flush()
+        if not router.join(tickets, timeout_s=VARIANT_TIMEOUT_S):
+            raise RuntimeError("fleet stream timed out")
+        bad = [t for t in tickets if t.error is not None]
+        assert not bad, f"{len(bad)} fleet tickets failed: {bad[0].error}"
+        return tickets
+
+    def measure(tag):
+        pass_once()                  # warm: compile into the shared cache
+        best, p99 = float("inf"), float("nan")
+        for i in range(2):
+            t0 = time.perf_counter()
+            tickets = pass_once()
+            t = time.perf_counter() - t0
+            lat = [tk.total_s for tk in tickets
+                   if isinstance(tk.total_s, (int, float))]
+            log(f"[{variant}] {tag} pass {i}: {t:.4f}s "
+                f"{n_reqs / t:.1f} req/s")
+            if t < best:
+                best, p99 = t, float(quantile(lat, 0.99)) if lat \
+                    else float("nan")
+        return n_reqs / best, p99
+
+    spawn(0)
+    wait_up(1)
+    log(f"[{variant}] fleet arm on {platform}: bucket={bn} "
+        f"requests={n_reqs} replicas=1 then {n_workers}")
+    rps_1, _ = measure("1-replica")
+    for k in range(1, n_workers):
+        spawn(k)
+    wait_up(n_workers)
+    rps_n, p99_n = measure(f"{n_workers}-replica")
+    scaling = rps_n / rps_1
+
+    # the replica-kill recovery leg: strand a partial batch on one
+    # replica (no flush yet), SIGKILL it, and clock kill -> last ticket
+    tickets = [router.submit(Request(**p)) for p in problems]
+    router.poll()
+    pending = [t for t in tickets if not t.resolved()]
+    recovery_s = 0.0
+    if pending:
+        victim = pending[0].attempts[-1]
+        vpid = router.stats()["workers"][victim]["pid"]
+        t_kill = time.perf_counter()
+        os.kill(vpid, signal.SIGKILL)
+        procs[victim].wait(timeout=60)
+        router.flush()
+        if not router.join(tickets, timeout_s=VARIANT_TIMEOUT_S):
+            raise RuntimeError("fleet kill-recovery stream timed out")
+        recovery_s = time.perf_counter() - t_kill
+    st = router.stats()
+    assert st["lost"] == 0, f"replica kill lost tickets: {st}"
+    log(f"[{variant}] fleet {n_workers}x {rps_n:.1f} req/s vs 1x "
+        f"{rps_1:.1f} -> scaling {scaling:.2f}x; kill recovery "
+        f"{recovery_s:.3f}s ({st['redispatches']} redispatches, 0 lost)")
+    router.drain_fleet()
+    for p in procs.values():
+        if p.poll() is None:
+            p.terminate()
+            p.wait(timeout=30)
+    router.close()
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    from measure_common import append_history
+
+    line = append_history(platform, bn, bn, rps_n, p99_n,
+                          source="bench.py", variant=variant,
+                          dtype="float64", workload="fleet",
+                          extra={"speedup": round(float(scaling), 3),
+                                 "rps_1": round(float(rps_1), 2),
+                                 "rps_n": round(float(rps_n), 2),
+                                 "workers": n_workers,
+                                 "requests": n_reqs,
+                                 "recovery_s": round(float(recovery_s), 3),
+                                 "redispatches": st["redispatches"]})
+    obs.emit_event("bench_result", payload=line)
+    obs.flush()
+    print(json.dumps(line), flush=True)
+
+
 def _run_stage_variant(variant: str, base: str, mods: set) -> None:
     """Measure one eigensolver-stage arm; same artifact/stdout protocol as
     the cholesky arms (bench_result record + one JSON line)."""
@@ -567,6 +726,9 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
         return
     if base == "autotune":
         _run_autotune_variant(variant, platform)
+        return
+    if base == "fleet":
+        _run_fleet_variant(variant, platform)
         return
     # stage arms default to a smaller N off-TPU: the local red2band that
     # feeds the bt arm compiles per-panel, and the CPU fallback sweep's
@@ -977,7 +1139,8 @@ def sweep(platform: str) -> None:
     order = ["ozaki", "ozaki+la1", ab_arm, "xla", "scan", "scan+la1",
              "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm",
              "tridiag", "tridiag+dcb1", "btr2b", "btr2b+btla1", "btb2t",
-             "fpanel", "fpanel+fp1", "serve", "overload", "autotune"]
+             "fpanel", "fpanel+fp1", "serve", "overload", "autotune",
+             "fleet"]
 
     def _known(v):
         b = v[: -len("+la1")] if v.endswith("+la1") else v

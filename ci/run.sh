@@ -1082,6 +1082,184 @@ print(f"overload drill ok: shed={shed}, max depth {max_seen}/8, "
 obs.flush()
 EOF
     python -m dlaf_tpu.obs.validate "$RETRY_DIR/overload.jsonl"
+    echo "== smoke: chaos drill 4 — fleet replica kill, zero loss =="
+    # 3 REAL subprocess workers behind one fleet Router (docs/fleet.md):
+    # a mixed cholesky/solve stream is mid-flight when the replica
+    # holding unacked tickets dies by SIGKILL — every ticket must still
+    # resolve with a CORRECT answer, zero tickets lost, >= 1 observed
+    # redispatch, and the merged per-process artifact must PASS
+    # --require-fleet (trace-stamped route records, zero-loss contract).
+    # One driver script, three modes (FLEET_MODE): the kill drill, its
+    # graceful SIGTERM twin, and the failover-off must-trip leg
+    FLEET_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$FLEET_DIR")
+    cat > "$FLEET_DIR/drill.py" <<'EOF'
+"""Fleet chaos-drill driver (ci/run.sh smoke; mode from FLEET_MODE)."""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.fleet import Router
+from dlaf_tpu.serve import Request, cholesky_spec
+
+mode = os.environ["FLEET_MODE"]
+C.initialize()
+router = Router(port=0)
+env = dict(os.environ, DLAF_METRICS_PATH=os.environ["FLEET_WORKER_ART"])
+procs = [subprocess.Popen(
+    [sys.executable, "-m", "dlaf_tpu.fleet.worker",
+     "--connect", f"127.0.0.1:{router.port}", "--worker", str(k)],
+    env=env) for k in range(3)]
+deadline = time.monotonic() + 120
+while True:
+    states = router.stats()["workers"]
+    if sum(1 for m in states.values() if m["state"] == "up") == 3:
+        break
+    assert time.monotonic() < deadline, f"workers never joined: {states}"
+    router.poll()
+    time.sleep(0.05)
+router.warmup([cholesky_spec(batch=4, n=16, nb=16, dtype="float64")])
+
+rng = np.random.default_rng(0)
+
+
+def hpd(n):
+    x = rng.standard_normal((n, n))
+    return x @ x.T + n * np.eye(n)
+
+
+reqs = [Request(op="cholesky", a=hpd(int(rng.integers(10, 17))))
+        for _ in range(8)]
+for _ in range(4):
+    reqs.append(Request(op="solve",
+                        a=np.tril(rng.standard_normal((12, 12)))
+                        + 3 * np.eye(12),
+                        b=rng.standard_normal((12, 3))))
+tickets = [router.submit(r) for r in reqs[:6]]
+
+# the victim: whichever replica holds an unresolved ticket's unacked
+# dispatch — batch=4/huge-deadline guarantees a partial batch is still
+# queued there, so the kill strands real work, not an idle socket
+router.poll()
+pending = [t for t in tickets if not t.resolved()]
+assert pending, "no unacked tickets to strand (batch/deadline config?)"
+victim = pending[0].attempts[-1]
+vpid = router.stats()["workers"][victim]["pid"]
+os.kill(vpid, signal.SIGTERM if mode == "sigterm" else signal.SIGKILL)
+procs[victim].wait(timeout=60)
+
+tickets += [router.submit(r) for r in reqs[6:]]  # routed around the hole
+router.flush()
+ok = router.join(tickets, timeout_s=180.0)
+st = router.stats()
+if mode == "nofailover":
+    assert st["lost"] >= 1, st
+    lost = [t for t in tickets if t.error is not None]
+    assert lost, "failover off but no ticket was poisoned"
+    for t in lost:
+        try:
+            t.result()
+            raise SystemExit(3)  # a lost ticket must NOT answer
+        except RuntimeError:
+            pass
+    print(f"failover OFF: {st['lost']} ticket(s) stranded as designed")
+else:
+    assert ok, f"stream did not complete: {st}"
+    for t in tickets:
+        a = np.asarray(t.request.a)
+        if t.request.op == "cholesky":
+            fac = np.tril(t.result())
+            ref = np.tril(a) + np.tril(a, -1).T
+            assert np.allclose(fac @ fac.T, ref, atol=1e-8)
+        else:
+            x = t.result()
+            assert np.allclose(np.tril(a) @ x, np.asarray(t.request.b),
+                               atol=1e-8)
+    assert st["lost"] == 0, st
+    assert st["workers"][victim]["state"] == "dead", st
+    if mode == "sigkill":
+        assert st["redispatches"] >= 1, st
+        assert procs[victim].returncode != 0, "SIGKILL exited cleanly?"
+    else:                       # sigterm: drained handbacks, NO failover
+        assert st["redispatches"] == 0, st
+        assert st["handbacks"] >= 1, st
+        assert procs[victim].returncode == 0, procs[victim].returncode
+    print(f"fleet {mode} drill ok: {len(tickets)} tickets resolved, "
+          f"lost={st['lost']}, redispatches={st['redispatches']}, "
+          f"handbacks={st['handbacks']}")
+router.drain_fleet()
+obs.flush()
+for p in procs:
+    if p.poll() is None:
+        p.terminate()
+        p.wait(timeout=30)
+EOF
+    DLAF_METRICS_PATH="$FLEET_DIR/kill_router.jsonl" \
+      FLEET_WORKER_ART="$FLEET_DIR/kill_worker.r%r.jsonl" \
+      FLEET_MODE=sigkill DLAF_SERVE_BATCH=4 DLAF_SERVE_BUCKETS=16 \
+      DLAF_SERVE_DEADLINE_MS=60000 PYTHONPATH="$PWD" \
+      python "$FLEET_DIR/drill.py"
+    python -m dlaf_tpu.obs.aggregate "$FLEET_DIR"/kill_*.jsonl \
+      -o "$FLEET_DIR/kill_merged.jsonl"
+    python -m dlaf_tpu.obs.validate "$FLEET_DIR/kill_merged.jsonl" \
+      --require-fleet
+    # graceful twin: SIGTERM the same victim profile — the worker drains
+    # (absorbs + hands back its undispatched tickets, exit 0) and the
+    # router re-routes the handbacks with ZERO failover redispatches;
+    # the artifact still passes --require-fleet (worker_dead carries
+    # reason=drained, so no redispatch obligation applies)
+    DLAF_METRICS_PATH="$FLEET_DIR/drain_router.jsonl" \
+      FLEET_WORKER_ART="$FLEET_DIR/drain_worker.r%r.jsonl" \
+      FLEET_MODE=sigterm DLAF_SERVE_BATCH=4 DLAF_SERVE_BUCKETS=16 \
+      DLAF_SERVE_DEADLINE_MS=60000 PYTHONPATH="$PWD" \
+      python "$FLEET_DIR/drill.py"
+    python -m dlaf_tpu.obs.aggregate "$FLEET_DIR"/drain_*.jsonl \
+      -o "$FLEET_DIR/drain_merged.jsonl"
+    python -m dlaf_tpu.obs.validate "$FLEET_DIR/drain_merged.jsonl" \
+      --require-fleet
+    # must-trip: with failover OFF the same kill strands tickets — the
+    # artifact carries ticket_lost records and --require-fleet must
+    # REJECT it, proving the zero-loss contract has teeth
+    DLAF_METRICS_PATH="$FLEET_DIR/off_router.jsonl" \
+      FLEET_WORKER_ART="$FLEET_DIR/off_worker.r%r.jsonl" \
+      FLEET_MODE=nofailover DLAF_FLEET_FAILOVER=0 DLAF_SERVE_BATCH=4 \
+      DLAF_SERVE_BUCKETS=16 DLAF_SERVE_DEADLINE_MS=60000 \
+      PYTHONPATH="$PWD" python "$FLEET_DIR/drill.py"
+    python -m dlaf_tpu.obs.aggregate "$FLEET_DIR"/off_*.jsonl \
+      -o "$FLEET_DIR/off_merged.jsonl"
+    off_out=$(python -m dlaf_tpu.obs.validate \
+      "$FLEET_DIR/off_merged.jsonl" --require-fleet 2>&1) && {
+      echo "--require-fleet FAILED to reject the lost-ticket artifact" >&2
+      exit 1
+    }
+    echo "$off_out" | grep -q "ticket_lost" || {
+      echo "lost-ticket rejection did not name ticket_lost:" >&2
+      echo "$off_out" >&2; exit 1
+    }
+    echo "--require-fleet correctly rejected the failover-off artifact"
+    echo "== smoke: fleet bench arm + scaling gate =="
+    # the fleet workload arm (bench.py, workload=fleet): requests/s over
+    # N real subprocess replicas vs one through the same router, plus
+    # the mid-stream SIGKILL recovery_s leg — gated by bench_gate's
+    # history-free --min-fleet-scaling floor, whose must-trip is an
+    # absurd floor the measured ratio cannot clear
+    FLEET_BENCH_ART="$FLEET_DIR/fleet_bench.jsonl"
+    DLAF_BENCH_VARIANT=fleet DLAF_METRICS_PATH="$FLEET_BENCH_ART" \
+      DLAF_BENCH_HISTORY_PATH="$FLEET_DIR/bench_history.jsonl" \
+      python bench.py > /dev/null
+    python scripts/bench_gate.py --fresh "$FLEET_BENCH_ART"
+    if python scripts/bench_gate.py --fresh "$FLEET_BENCH_ART" \
+        --min-fleet-scaling 1000 > /dev/null 2>&1; then
+      echo "bench_gate FAILED to flag a sub-floor fleet scaling" >&2
+      exit 1
+    fi
+    echo "bench_gate fleet-scaling leg trips as required"
     echo "== smoke: eigensolver pipeline (batched D&C + pipelined bt) =="
     # distributed eigensolver on a 2x2 virtual-CPU grid with the two
     # ISSUE-6 knobs pinned ON (the CPU auto would resolve both off): the

@@ -308,11 +308,22 @@ class RouteTable:
 
     def load(self, path: Optional[str] = None) -> None:
         path = path or self.path
-        try:
-            with open(path) as f:
-                doc = json.load(f)
-        except ValueError as e:
-            raise ValueError(f"{path}: unparsable autotune table ({e})")
+        doc = None
+        for attempt in range(2):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                break
+            except ValueError as e:
+                # writers replace the table atomically (tmp + fsync +
+                # os.replace), but a reader that opened the OLD inode
+                # right as it was unlinked can still see a short read on
+                # some filesystems. One immediate re-open lands on the
+                # NEW complete inode; only a second failure means the
+                # file is genuinely corrupt — refuse the warm start then.
+                if attempt:
+                    raise ValueError(f"{path}: unparsable autotune "
+                                     f"table ({e})")
         self.load_dict(doc, where=path)
 
     # -- introspection ---------------------------------------------------

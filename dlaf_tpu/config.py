@@ -538,6 +538,43 @@ class Configuration:
     #: how long an open breaker rejects calls before letting ONE half-open
     #: probe through (success closes it, failure re-opens).
     circuit_cooldown_s: float = 30.0
+    #: Fleet size (``DLAF_FLEET_WORKERS``, docs/fleet.md): how many
+    #: serve worker replicas the launch helpers / CI drills / bench
+    #: fleet arm spawn behind one router. The router itself accepts any
+    #: number of ``hello`` connections — this knob sizes the launchers,
+    #: not the protocol.
+    fleet_workers: int = 3
+    #: Router heartbeat interval, milliseconds
+    #: (``DLAF_FLEET_HEARTBEAT_MS``): how often the router pings each
+    #: routable worker at its clock edges (docs/fleet.md liveness).
+    fleet_heartbeat_ms: float = 1000.0
+    #: Heartbeat silence budget, milliseconds
+    #: (``DLAF_FLEET_HEARTBEAT_TIMEOUT_MS``): an ``up`` worker with no
+    #: traffic for this long turns ``suspect`` at the next router clock
+    #: edge — its breaker is forced open, its unacknowledged tickets
+    #: re-dispatch to siblings, and re-admission follows the half-open
+    #: probe discipline. Evaluated against the router's injectable
+    #: clock, so timeout drills replay deterministically.
+    fleet_heartbeat_timeout_ms: float = 5000.0
+    #: Failover switch (``DLAF_FLEET_FAILOVER``): True (default)
+    #: re-dispatches a dead worker's unacknowledged tickets to siblings
+    #: (at-least-once, zero loss); False poisons them with structured
+    #: WorkerLostError + ``ticket_lost`` fleet records — which
+    #: ``--require-fleet`` REJECTS, so disabling failover is visible in
+    #: CI, never silent (the must-trip drill leg).
+    fleet_failover: bool = True
+    #: Router ticket-dispatch retry budget
+    #: (``DLAF_FLEET_RETRY_ATTEMPTS``): total attempts per dispatch
+    #: under the shared policy engine, with worker re-selection each
+    #: attempt. Must exceed ``circuit_threshold`` for a sustained
+    #: per-worker fault to open that worker's breaker mid-policy and
+    #: re-route the remaining attempts to a sibling (docs/fleet.md).
+    fleet_retry_attempts: int = 5
+    #: Base backoff between router dispatch retries, milliseconds
+    #: (``DLAF_FLEET_RETRY_BACKOFF_MS``; exponential + deterministic
+    #: seeded jitter). 0 (default) retries immediately — a fleet
+    #: re-route targets a DIFFERENT worker, so waiting buys nothing.
+    fleet_retry_backoff_ms: float = 0.0
     #: Stage-checkpoint directory for preemption-safe pipeline resume
     #: (``DLAF_RESUME_DIR``, docs/robustness.md §5): when non-empty, the
     #: eigensolver pipeline writes an atomic versioned checkpoint after
@@ -722,6 +759,24 @@ def _validate(cfg: Configuration) -> None:
     if not cfg.serve_retry_backoff_ms >= 0:
         raise ValueError(f"serve_retry_backoff_ms="
                          f"{cfg.serve_retry_backoff_ms}: must be >= 0")
+    if cfg.fleet_workers < 1:
+        raise ValueError(f"fleet_workers={cfg.fleet_workers}: must be "
+                         ">= 1 (replicas behind the fleet router)")
+    if not cfg.fleet_heartbeat_ms > 0:
+        raise ValueError(f"fleet_heartbeat_ms={cfg.fleet_heartbeat_ms}: "
+                         "must be > 0 (the router ping cadence)")
+    if not cfg.fleet_heartbeat_timeout_ms >= cfg.fleet_heartbeat_ms:
+        raise ValueError(
+            f"fleet_heartbeat_timeout_ms={cfg.fleet_heartbeat_timeout_ms}:"
+            f" must be >= fleet_heartbeat_ms={cfg.fleet_heartbeat_ms} "
+            "(a timeout shorter than one ping interval declares every "
+            "healthy worker suspect)")
+    if cfg.fleet_retry_attempts < 1:
+        raise ValueError(f"fleet_retry_attempts={cfg.fleet_retry_attempts}:"
+                         " must be >= 1 (1 = no dispatch retry)")
+    if not cfg.fleet_retry_backoff_ms >= 0:
+        raise ValueError(f"fleet_retry_backoff_ms="
+                         f"{cfg.fleet_retry_backoff_ms}: must be >= 0")
     if not 0 <= cfg.metrics_port <= 65535:
         raise ValueError(f"metrics_port={cfg.metrics_port}: must be in "
                          "[0, 65535] (0 = live exporter off)")

@@ -1,0 +1,88 @@
+"""Length-prefixed JSON framing over local sockets (docs/fleet.md).
+
+The fleet tier's only wire format: each message is a 4-byte big-endian
+unsigned length followed by that many bytes of UTF-8 JSON — the same
+zero-new-deps stdlib discipline as the ``obs/exporter.py`` HTTP
+endpoint, chosen over pickle (no cross-process code execution surface)
+and over a streaming parser (framing makes partial-read handling
+trivial and a torn message impossible: a frame either arrives whole or
+the connection is dead). Arrays ride INSIDE the JSON via the serve wire
+codec (:func:`dlaf_tpu.serve.queue.array_to_wire`) — this module only
+moves bytes.
+
+Failure vocabulary: EOF mid-frame or on a frame boundary raises
+:class:`TransportClosed` (the router's fast worker-death signal);
+a socket timeout BETWEEN frames raises :class:`TransportIdle` (the
+worker loop's "check the drain flag" tick) while a timeout mid-frame
+keeps reading — the peer writes frames atomically, so a half-received
+frame means bytes are in flight, not lost.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+#: Hard per-frame bound. A frame length above this is a protocol error
+#: (corrupt stream / wrong peer), not a big request — serve-regime
+#: requests are small by definition and even a 4096-lane f64 bucket of
+#: n=512 is ~8 GiB short of this.
+MAX_FRAME_BYTES = 256 << 20
+
+_LEN = struct.Struct(">I")
+
+
+class TransportClosed(ConnectionError):
+    """The peer closed the connection (EOF) — at a frame boundary or,
+    worse, mid-frame. The router treats either as worker death."""
+
+
+class TransportIdle(TimeoutError):
+    """No frame STARTED within the socket timeout. Nothing was consumed;
+    the stream is intact — poll your flags and call recv again."""
+
+
+def _recv_exact(sock: socket.socket, n: int, *, idle_ok: bool) -> bytes:
+    """Read exactly ``n`` bytes. ``idle_ok`` governs only the FIRST
+    byte: a timeout with zero bytes read raises :class:`TransportIdle`
+    (clean idle tick); once any byte arrived, timeouts keep reading —
+    abandoning a partial frame would desync the framing forever."""
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(n - got)
+        except socket.timeout:
+            if idle_ok and got == 0:
+                raise TransportIdle("no frame within the socket timeout")
+            continue
+        if not chunk:
+            raise TransportClosed(
+                f"peer closed the connection ({got}/{n} bytes of the "
+                "current read)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    """Frame and send one JSON message (atomic from the reader's view:
+    ``sendall`` of length+payload in one buffer)."""
+    payload = json.dumps(obj).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"fleet frame of {len(payload)} bytes exceeds "
+                         f"MAX_FRAME_BYTES={MAX_FRAME_BYTES}")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket, *, idle_ok: bool = False) -> dict:
+    """Receive one framed JSON message (see module docstring for the
+    :class:`TransportClosed` / :class:`TransportIdle` split)."""
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size, idle_ok=idle_ok))
+    if length > MAX_FRAME_BYTES:
+        raise TransportClosed(
+            f"frame length {length} exceeds MAX_FRAME_BYTES="
+            f"{MAX_FRAME_BYTES} — corrupt stream or wrong peer")
+    return json.loads(_recv_exact(sock, length, idle_ok=False)
+                      .decode("utf-8"))

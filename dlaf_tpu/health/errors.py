@@ -196,6 +196,73 @@ class AutotuneExhaustedError(HealthError):
             "see docs/autotune.md)")
 
 
+class DrainedError(HealthError):
+    """A queued request was drained undispatched (:meth:`dlaf_tpu.serve.
+    queue.Queue.drain` — graceful worker shutdown, docs/fleet.md). The
+    request was never started, so resubmitting it elsewhere is always
+    safe; the fleet router does exactly that with handed-back tickets.
+
+    Attributes:
+        site: the draining queue's site label.
+        rid: the drained request's id.
+        op / bucket_n: the bucket the request was pending in.
+    """
+
+    def __init__(self, site: str, rid: int, op: str = "",
+                 bucket_n: int = 0):
+        self.site = str(site)
+        self.rid = int(rid)
+        self.op = str(op)
+        self.bucket_n = int(bucket_n)
+        super().__init__(
+            f"request {self.rid} drained undispatched from {self.site!r} "
+            f"({self.op or '?'}(n<={self.bucket_n})) — never started; "
+            "safe to resubmit")
+
+
+class WorkerLostError(HealthError):
+    """A fleet worker died (socket EOF or heartbeat timeout) holding this
+    unacknowledged ticket, and failover is DISABLED
+    (``DLAF_FLEET_FAILOVER=0``) so the router cannot re-dispatch it to a
+    sibling (docs/fleet.md). With failover on this error never surfaces —
+    the ticket is re-dispatched instead.
+
+    Attributes:
+        worker: the dead worker's index.
+        seq: the router ticket sequence number.
+        reason: how the death was detected ("eof" | "heartbeat_timeout").
+    """
+
+    def __init__(self, worker: int, seq: int, reason: str):
+        self.worker = int(worker)
+        self.seq = int(seq)
+        self.reason = str(reason)
+        super().__init__(
+            f"fleet worker {self.worker} lost ticket {self.seq} "
+            f"({self.reason}) and DLAF_FLEET_FAILOVER=0 forbids "
+            "re-dispatch — the request did not complete")
+
+
+class FleetUnavailableError(HealthError):
+    """The fleet router has no routable worker: every member is dead,
+    draining, or behind an open breaker whose cooldown has not admitted
+    a half-open probe yet (docs/fleet.md). Fail-fast by design — queueing
+    against a fully-down fleet would hide the outage.
+
+    Attributes:
+        workers: total registered workers.
+        states: ``{worker: membership state}`` at the rejection.
+    """
+
+    def __init__(self, workers: int, states: dict):
+        self.workers = int(workers)
+        self.states = dict(states)
+        super().__init__(
+            f"fleet has no routable worker ({self.workers} registered: "
+            f"{self.states}) — every member is dead, draining, or "
+            "breaker-rejected")
+
+
 class CheckError(HealthError):
     """The opt-in finite guard (``DLAF_CHECK=1``) found non-finite values.
 

@@ -287,6 +287,63 @@ def fail_dispatch(nth: int = 0, count: int = 1,
         _reset_breakers("serve.")
 
 
+#: Armed fleet-dispatch fault (separate schedule from _FAIL_DISPATCH: the
+#: router and its in-process drill workers share one process, and a
+#: single global attempt counter would let worker-queue dispatches
+#: consume the router's faulted indices nondeterministically).
+_FAIL_FLEET: Optional[dict] = None
+
+
+def maybe_fail_fleet_dispatch() -> None:
+    """Hook consulted by the fleet router once per ticket-dispatch
+    ATTEMPT (after worker selection, so the fault is charged to the
+    routed worker's breaker): raises the armed exception when this
+    attempt falls on a faulted index."""
+    with _LOCK:
+        spec = _FAIL_FLEET
+        if spec is None:
+            return
+        idx = spec["seen"]
+        spec["seen"] += 1
+        if spec["every"] is not None:
+            hit = idx >= spec["nth"] and (idx - spec["nth"]) \
+                % spec["every"] == 0
+        else:
+            hit = spec["nth"] <= idx < spec["nth"] + spec["count"]
+    if hit:
+        raise spec["exc"](f"injected fleet dispatch fault (attempt {idx})")
+
+
+@contextlib.contextmanager
+def fail_fleet_dispatch(nth: int = 0, count: int = 1,
+                        every: Optional[int] = None,
+                        exc: type = RuntimeError):
+    """The fleet-layer twin of :func:`fail_dispatch` (docs/fleet.md drill
+    catalog): raises ``exc`` inside the router's ticket-dispatch attempt,
+    deterministically by FLEET attempt index — a schedule independent of
+    the serve-queue one, so a drill's router faults replay exactly even
+    while in-process workers dispatch concurrently. Not reentrant;
+    ``fleet.`` breakers are reset on exit so an injected storm never
+    leaves a worker's breaker failing fast into real routing."""
+    global _FAIL_FLEET
+    if count < 1:
+        raise ValueError(f"fail_fleet_dispatch: count={count} must be >= 1")
+    if every is not None and every < 1:
+        raise ValueError(f"fail_fleet_dispatch: every={every} must be >= 1")
+    with _LOCK:
+        if _FAIL_FLEET is not None:
+            raise RuntimeError("fail_fleet_dispatch is not reentrant")
+        _FAIL_FLEET = {"nth": int(nth), "count": int(count),
+                       "every": None if every is None else int(every),
+                       "exc": exc, "seen": 0}
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _FAIL_FLEET = None
+        _reset_breakers("fleet.")
+
+
 def hang_seconds(site: str) -> float:
     """Armed clock-aware stall for ``site`` (0.0 when unarmed) — the
     policy engine adds this to each attempt's measured elapsed time, so a

@@ -58,6 +58,10 @@ _started_at: Optional[float] = None
 _QUEUES: list = []
 _QUEUES_LOCK = threading.Lock()
 
+#: weakrefs to live fleet routers (docs/fleet.md: the router process's
+#: /healthz aggregates per-worker state through Router.fleet_view()).
+_FLEETS: list = []
+
 
 def register_queue(queue) -> None:
     """Expose ``queue`` on ``/healthz`` for its lifetime (weakref; called
@@ -72,6 +76,21 @@ def live_queues() -> list:
         alive = [(r, r()) for r in _QUEUES]
         _QUEUES[:] = [r for r, q in alive if q is not None]
         return [q for _, q in alive if q is not None]
+
+
+def register_fleet(router) -> None:
+    """Expose a fleet ``Router`` on ``/healthz`` for its lifetime
+    (weakref; called by ``fleet.Router.__init__``)."""
+    with _QUEUES_LOCK:
+        _FLEETS[:] = [r for r in _FLEETS if r() is not None]
+        _FLEETS.append(weakref.ref(router))
+
+
+def live_fleets() -> list:
+    with _QUEUES_LOCK:
+        alive = [(r, r()) for r in _FLEETS]
+        _FLEETS[:] = [r for r, f in alive if f is not None]
+        return [f for _, f in alive if f is not None]
 
 
 #: Content types the endpoint answers with (negotiated per request).
@@ -127,7 +146,7 @@ def healthz_payload() -> dict:
                 cell[q_keys[labels["q"]]] = safe(m.get("value"))
             elif name == BREACH_COUNTER:
                 breaches[labels.get("op", "")] = safe(m.get("value"))
-    return {
+    payload = {
         "status": "ok",
         "rank": current_rank(),
         "pid": os.getpid(),
@@ -139,6 +158,12 @@ def healthz_payload() -> dict:
         "slo": {"windows": [slo_rows[k] for k in sorted(slo_rows)],
                 "breaches": breaches},
     }
+    fleets = [f.fleet_view() for f in live_fleets()]
+    if fleets:
+        # router process only: cross-replica membership + ticket state
+        # (local, non-blocking — a wedged worker must not wedge /healthz)
+        payload["fleet"] = fleets
+    return payload
 
 
 def _make_handler():

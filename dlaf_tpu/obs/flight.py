@@ -28,7 +28,11 @@ schema owner) and their call sites:
   inside one rolling SLO window for one op (obs/slo.py, ISSUE 14);
 * ``autotune_exhausted`` — an accuracy probe breached the budget at the
   TOP rung of a precision ladder: no safer route exists
-  (autotune/controller.py, ISSUE 15; docs/autotune.md).
+  (autotune/controller.py, ISSUE 15; docs/autotune.md);
+* ``fleet_worker_down`` — the fleet router reaped a dead replica still
+  holding unacknowledged tickets (fleet/router.py, ISSUE 18;
+  docs/fleet.md) — the ring captures the routing decisions that led
+  into the failover.
 
 Per-reason cooldown (default 60 s, injectable clock): the FIRST shed of
 a burst dumps; the next thousand do not re-dump the same ring. Dumps

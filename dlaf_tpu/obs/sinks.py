@@ -175,7 +175,7 @@ SCHEMA_VERSION = 1
 KNOWN_TYPES = ("span", "metrics", "log", "bench_result", "program",
                "accuracy", "serve", "resilience", "flight_trigger",
                "devtrace", "measured_overlap", "autotune",
-               "schedule", "critpath", "whatif")
+               "schedule", "critpath", "whatif", "fleet")
 
 #: Documented attribution-coverage floor of ``--require-devtrace``
 #: (docs/observability.md device-time attribution): a devtrace record
@@ -204,14 +204,24 @@ WHATIF_SCENARIOS = ("collectives_free", "gaps_closed", "panel_free",
 #: The resilience record's event vocabulary (schema above).
 RESILIENCE_EVENTS = ("retry", "give_up", "deadline", "circuit_open",
                      "circuit_half_open", "circuit_close", "shed",
-                     "expired", "checkpoint", "preempt", "resume")
+                     "expired", "checkpoint", "preempt", "resume",
+                     "drain")
 
 #: The flight recorder's trigger vocabulary (docs/observability.md live
 #: operations; trigger sites in :mod:`dlaf_tpu.obs.flight`).
 FLIGHT_REASONS = ("breaker_open", "overload_shed",
                   "factorization_exhausted", "accuracy_breach",
                   "healthz_failure", "slo_breach_burst",
-                  "autotune_exhausted")
+                  "autotune_exhausted", "fleet_worker_down")
+
+#: The fleet record's event vocabulary (docs/fleet.md; emitted by
+#: :class:`dlaf_tpu.fleet.router.Router` — the router is the ONLY
+#: writer, so the fleet audit trail is a single ordered decision log).
+#: ``route``/``redispatch``/``handback`` are ticket-scoped (carry
+#: ``seq`` + the active trace context); the rest are membership-scoped.
+FLEET_EVENTS = ("route", "redispatch", "handback", "worker_up",
+                "worker_dead", "heartbeat_timeout", "draining",
+                "drained", "probe", "ticket_lost")
 
 #: The autotune decision vocabulary (docs/autotune.md; decision core in
 #: :func:`dlaf_tpu.autotune.table.decide`).
@@ -472,6 +482,32 @@ def _validate_resilience(r: dict, where: str, errors: list) -> None:
                       "delay_s >= 0 (the backoff actually applied)")
     if not isinstance(r.get("attrs", {}), dict):
         errors.append(f"{where}: resilience attrs must be an object")
+
+
+def _validate_fleet(r: dict, where: str, errors: list) -> None:
+    """Fleet decision record (docs/fleet.md): ``event`` from
+    :data:`FLEET_EVENTS`, ``worker`` a non-negative int (the replica the
+    decision is ABOUT), and for ticket-scoped events (route, redispatch,
+    handback, ticket_lost) the router ticket ``seq`` — those records are
+    also trace-stamped so a ticket's full journey joins on trace_id."""
+    event = r.get("event")
+    if event not in FLEET_EVENTS:
+        errors.append(f"{where}: fleet event must be one of "
+                      f"{FLEET_EVENTS}, got {event!r}")
+    worker = r.get("worker")
+    if not isinstance(worker, int) or isinstance(worker, bool) or worker < 0:
+        errors.append(f"{where}: fleet record needs a non-negative int "
+                      f"worker, got {worker!r}")
+    if event in ("route", "redispatch", "handback", "ticket_lost"):
+        seq = r.get("seq")
+        if not isinstance(seq, int) or isinstance(seq, bool) or seq < 0:
+            errors.append(f"{where}: fleet {event} record needs a "
+                          f"non-negative int seq, got {seq!r}")
+        if not isinstance(r.get("trace_id"), str) or not r.get("trace_id"):
+            errors.append(f"{where}: fleet {event} record must be "
+                          "trace-stamped (joinable to its request)")
+    if not isinstance(r.get("attrs", {}), dict):
+        errors.append(f"{where}: fleet attrs must be an object")
 
 
 def _validate_devtrace(r: dict, where: str, errors: list) -> None:
@@ -748,7 +784,8 @@ def validate_records(records, require_spans=False, require_gflops=False,
                      require_telemetry=False, require_accuracy=False,
                      require_serve=False, require_resilience=False,
                      require_flight=False, require_devtrace=False,
-                     require_autotune=False, require_critpath=False) -> list:
+                     require_autotune=False, require_critpath=False,
+                     require_fleet=False) -> list:
     """Validate parsed records; returns a list of error strings (empty =
     valid). ``require_*`` add the CI smoke-tier artifact obligations:
     at least one span, at least one span with finite derived gflops,
@@ -812,7 +849,15 @@ def validate_records(records, require_spans=False, require_gflops=False,
     join coverage >= :data:`CRITPATH_COVERAGE_FLOOR` (below the floor
     the per-step walls/gaps/bounds describe a minority of the scheduled
     timeline), and >= 1 ``whatif`` projection record (the headroom
-    ranking the attribution exists to produce)."""
+    ranking the attribution exists to produce) — and (``require_fleet``)
+    the multi-replica zero-loss obligation (docs/fleet.md): >= 1
+    ``fleet`` record with event ``route`` (the router actually routed),
+    ZERO ``ticket_lost`` records (a lost ticket is the exact failure the
+    fleet tier exists to prevent — any occurrence REJECTS the artifact),
+    and every ``worker_dead`` whose reason is not ``drained`` (an
+    ungraceful death) must be answered by >= 1 ``redispatch`` record
+    somewhere in the artifact — a crash with no failover is a silent
+    at-least-once violation."""
     errors = []
     n_spans = n_gflops = n_coll = n_retries = n_fallbacks = 0
     n_dc_batched = n_bt_overlap = n_accuracy = 0
@@ -824,6 +869,8 @@ def validate_records(records, require_spans=False, require_gflops=False,
     n_overlap_proof = n_devtrace_covered = 0
     n_autotune_moves = 0
     n_critpath_covered = n_whatif = 0
+    n_fleet_routes = n_fleet_redispatch = n_fleet_lost = 0
+    n_fleet_ungraceful_dead = 0
     autotune_last = {}                # site -> last decision reason seen
     devtrace_coverages = []
     critpath_coverages = []
@@ -881,6 +928,18 @@ def validate_records(records, require_spans=False, require_gflops=False,
         elif rtype == "whatif":
             _validate_whatif(r, where, errors)
             n_whatif += 1
+        elif rtype == "fleet":
+            _validate_fleet(r, where, errors)
+            event = r.get("event")
+            if event == "route":
+                n_fleet_routes += 1
+            elif event == "redispatch":
+                n_fleet_redispatch += 1
+            elif event == "ticket_lost":
+                n_fleet_lost += 1
+            elif event == "worker_dead" \
+                    and (r.get("attrs") or {}).get("reason") != "drained":
+                n_fleet_ungraceful_dead += 1
         elif rtype == "autotune":
             _validate_autotune(r, where, errors)
             if r.get("reason") in ("escalate", "relax"):
@@ -1089,6 +1148,18 @@ def validate_records(records, require_spans=False, require_gflops=False,
         if exhausted:
             errors.append("autotune ladder(s) left exhausted at artifact "
                           f"end (last decision 'exhausted'): {exhausted}")
+    if require_fleet:
+        if n_fleet_routes == 0:
+            errors.append("artifact contains no fleet route record (the "
+                          "router never dispatched anything)")
+        if n_fleet_lost > 0:
+            errors.append(f"artifact contains {n_fleet_lost} fleet "
+                          "ticket_lost record(s) — the zero-loss "
+                          "contract (docs/fleet.md) is violated")
+        if n_fleet_ungraceful_dead > 0 and n_fleet_redispatch == 0:
+            errors.append(f"artifact contains {n_fleet_ungraceful_dead} "
+                          "ungraceful fleet worker death(s) but no "
+                          "redispatch record — failover never ran")
     if require_comm_overlap:
         if not {"row", "col"} <= overlap_axes:
             errors.append("artifact lacks positive finite "

@@ -85,6 +85,16 @@ Flags:
                             (sinks.CRITPATH_COVERAGE_FLOOR), and >= 1
                             whatif projection record; NaN step walls
                             are schema errors regardless
+    --require-fleet         fail unless the artifact carries the
+                            multi-replica zero-loss trail (docs/
+                            fleet.md): >= 1 fleet record with event
+                            route (the router actually dispatched),
+                            ZERO ticket_lost records (any lost ticket
+                            REJECTS the artifact — the exact failure
+                            the fleet tier exists to prevent), and
+                            every ungraceful worker_dead (reason !=
+                            drained) answered by >= 1 redispatch
+                            record (failover actually ran)
     --history               validate the file as an append-only bench
                             history log (.bench_history.jsonl: bare
                             measurement lines — finite gflops/t/n/nb,
@@ -124,7 +134,7 @@ def main(argv=None) -> int:
              "--require-accuracy", "--require-serve",
              "--require-resilience", "--require-flight",
              "--require-devtrace", "--require-autotune",
-             "--require-critpath", "--history",
+             "--require-critpath", "--require-fleet", "--history",
              "--accuracy-history", "--prom"}
     requires = {f for f in flags if f.startswith("--require-")}
     history_modes = flags & {"--history", "--accuracy-history"}
@@ -164,7 +174,8 @@ def main(argv=None) -> int:
         require_flight="--require-flight" in flags,
         require_devtrace="--require-devtrace" in flags,
         require_autotune="--require-autotune" in flags,
-        require_critpath="--require-critpath" in flags)
+        require_critpath="--require-critpath" in flags,
+        require_fleet="--require-fleet" in flags)
     if errors:
         for e in errors:
             print(f"INVALID {path}: {e}", file=sys.stderr)
@@ -181,6 +192,7 @@ def main(argv=None) -> int:
     n_autotune = sum(r.get("type") == "autotune" for r in records)
     n_critpath = sum(r.get("type") in ("schedule", "critpath", "whatif")
                      for r in records)
+    n_fleet = sum(r.get("type") == "fleet" for r in records)
     snaps = [r for r in records if r.get("type") == "metrics"]
     ranks = sorted({r["rank"] for r in records if "rank" in r})
     extra = f", {n_progs} program events" if n_progs else ""
@@ -191,6 +203,7 @@ def main(argv=None) -> int:
     extra += f", {n_devtrace} devtrace records" if n_devtrace else ""
     extra += f", {n_autotune} autotune decisions" if n_autotune else ""
     extra += f", {n_critpath} critpath records" if n_critpath else ""
+    extra += f", {n_fleet} fleet records" if n_fleet else ""
     extra += f", ranks {ranks}" if ranks else ""
     print(f"VALID {path}: {len(records)} records ({n_spans} spans, "
           f"{len(snaps)} metrics snapshots, {n_logs} logs{extra})")

@@ -84,6 +84,23 @@ class ProgramSpec:
                 + (".info" if self.with_info else "")
                 + (".don" if self.donate else ""))
 
+    def to_wire(self) -> dict:
+        """JSON-safe form (fleet warmup handoff, docs/fleet.md): every
+        field is already a JSON scalar except ``route``, whose
+        key/value pairs survive the list round-trip."""
+        doc = dataclasses.asdict(self)
+        doc["route"] = [list(pair) for pair in self.route]
+        return doc
+
+    @classmethod
+    def from_wire(cls, doc: dict) -> "ProgramSpec":
+        """Inverse of :meth:`to_wire` — restores the route pairs to the
+        tuples the (frozen, hashed) spec is keyed by, so a wire-round-
+        tripped spec is ``==`` to the original."""
+        doc = dict(doc)
+        doc["route"] = tuple(tuple(pair) for pair in doc.get("route", ()))
+        return cls(**doc)
+
 
 def cholesky_spec(*, batch: int, n: int, nb: int, dtype: str,
                   uplo: str = "L", with_info: bool = True,

@@ -82,6 +82,7 @@ Resilience (PR 12, docs/robustness.md):
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import functools
 import itertools
@@ -99,7 +100,8 @@ from ..common.asserts import dlaf_assert
 from ..config import (get_configuration, parse_serve_buckets,
                       register_program_cache)
 from ..health import circuit as _circuit
-from ..health.errors import DeadlineExceededError, OverloadError
+from ..health.errors import (DeadlineExceededError, DrainedError,
+                             OverloadError)
 from ..health.policy import RetryPolicy, with_policy
 from .programs import (ProgramService, cholesky_spec, eigh_spec,
                        get_service, solve_spec)
@@ -141,6 +143,30 @@ def rhs_ceiling(free: int) -> int:
     return 1 << (free - 1).bit_length()
 
 
+# ---------------------------------------------------------------------------
+# Wire codec (fleet ticket handoff, docs/fleet.md): requests must cross a
+# process boundary as JSON — the fleet transport is length-prefixed JSON
+# over local sockets, zero new deps — so arrays ride as base64(raw bytes)
+# + dtype + shape. Defined HERE (not in dlaf_tpu.fleet) because the
+# request owns its serialization and serve must not import fleet.
+# ---------------------------------------------------------------------------
+
+def array_to_wire(a) -> dict:
+    """One ndarray as a JSON-safe dict (dtype name + shape + base64 of
+    the C-contiguous raw bytes — exact, no text round-trip loss)."""
+    a = np.ascontiguousarray(np.asarray(a))
+    return {"dtype": a.dtype.name, "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def array_from_wire(doc: dict) -> np.ndarray:
+    """Inverse of :func:`array_to_wire` (a writable copy — frombuffer
+    views are read-only and serve results are caller-owned)."""
+    flat = np.frombuffer(base64.b64decode(doc["data"]),
+                         dtype=np.dtype(doc["dtype"]))
+    return flat.reshape(tuple(int(s) for s in doc["shape"])).copy()
+
+
 @dataclasses.dataclass
 class Request:
     """One serving request: ``op`` in :data:`OPS`, ``a`` the ``(n, n)``
@@ -163,6 +189,30 @@ class Request:
     rid: Optional[int] = None
     deadline_s: Optional[float] = None
 
+    def to_wire(self) -> dict:
+        """JSON-safe form for the fleet ticket handoff (docs/fleet.md):
+        arrays via :func:`array_to_wire`, scalars as-is. Round-trips
+        exactly through :meth:`from_wire`."""
+        return {"op": self.op, "a": array_to_wire(self.a),
+                "b": None if self.b is None else array_to_wire(self.b),
+                "uplo": self.uplo, "side": self.side,
+                "transa": self.transa, "diag": self.diag,
+                "alpha": float(self.alpha), "rid": self.rid,
+                "deadline_s": self.deadline_s}
+
+    @classmethod
+    def from_wire(cls, doc: dict) -> "Request":
+        return cls(op=str(doc["op"]), a=array_from_wire(doc["a"]),
+                   b=(None if doc.get("b") is None
+                      else array_from_wire(doc["b"])),
+                   uplo=str(doc.get("uplo", "L")),
+                   side=str(doc.get("side", "L")),
+                   transa=str(doc.get("transa", "N")),
+                   diag=str(doc.get("diag", "N")),
+                   alpha=float(doc.get("alpha", 1.0)),
+                   rid=doc.get("rid"),
+                   deadline_s=doc.get("deadline_s"))
+
 
 class Ticket:
     """Handle returned by :meth:`Queue.submit`. ``done`` flips when the
@@ -172,7 +222,8 @@ class Ticket:
     raises RuntimeError while still queued. ``info`` is the per-element
     info value (int) once done."""
 
-    def __init__(self, request: Request, submitted: float):
+    def __init__(self, request: Request, submitted: float,
+                 trace_id: Optional[str] = None):
         self.request = request
         self.submitted = submitted
         self.done = False
@@ -183,8 +234,10 @@ class Ticket:
         # request-scoped trace correlation (ISSUE 13): one ID per
         # request, stamped by obs.trace_context onto every record the
         # request's causal chain emits — `obs.aggregate --trace <id>`
-        # joins them back together
-        self.trace_id = obs.new_trace_id()
+        # joins them back together. An adopted trace_id (the fleet
+        # worker passing through its router ticket's ID) keeps the
+        # cross-process chain joinable from either side.
+        self.trace_id = trace_id or obs.new_trace_id()
         self._result = None
 
     def result(self):
@@ -194,6 +247,8 @@ class Ticket:
             # open breaker, ...) — surface the cause instead of "queued"
             what = ("expired before dispatch"
                     if isinstance(self.error, DeadlineExceededError)
+                    else "drained undispatched"
+                    if isinstance(self.error, DrainedError)
                     else "batch dispatch failed")
             raise RuntimeError(
                 f"request {self.request.rid}: {what} "
@@ -431,7 +486,8 @@ class Queue:
 
     def _bucket_counts(self, key: _BucketKey) -> dict:
         return self._counts.setdefault(
-            key, {"shed": 0, "expired": 0, "dispatches": 0, "failures": 0})
+            key, {"shed": 0, "expired": 0, "dispatches": 0, "failures": 0,
+                  "drained": 0})
 
     def _admit(self, key: _BucketKey) -> None:
         """Admission control (lock held): at the ``max_depth`` bound,
@@ -477,21 +533,25 @@ class Queue:
                 # not to THIS submit, which must still be admitted
                 pass
 
-    def submit(self, req: Request) -> Ticket:
+    def submit(self, req: Request,
+               trace_id: Optional[str] = None) -> Ticket:
         """Enqueue one request; dispatches its bucket immediately when
         the batch fills, and sweeps OTHER buckets' expired deadlines
         (the no-background-thread discipline: submission is the clock
         edge). At the ``max_depth`` admission bound the submit sheds
         (:class:`~dlaf_tpu.health.errors.OverloadError`, no ticket
         created — a shed request is never stranded) or applies
-        backpressure, per the ``shed`` knob."""
+        backpressure, per the ``shed`` knob. ``trace_id`` (optional)
+        makes the ticket adopt an existing trace — the fleet worker
+        passes its router ticket's ID through so the whole
+        cross-process chain joins on one ID."""
         with self._lock:
             now = self.clock()
             key = self._key(req)          # validate BEFORE admission
             self._admit(key)
             if req.rid is None:
                 req.rid = next(self._rid)
-            ticket = Ticket(req, now)
+            ticket = Ticket(req, now, trace_id)
             lanes = self._pending.setdefault(key, [])
             lanes.append((req, ticket))
             self.requests += 1
@@ -527,6 +587,46 @@ class Queue:
                 n += 1
             return n
 
+    def drain(self) -> list:
+        """Cancel every UNDISPATCHED pending request (graceful shutdown:
+        stop serving without running partial batches nobody will wait
+        for) and return the ``(request, ticket)`` pairs, in submission
+        order per bucket. The explicit API the fleet worker's drain path
+        uses instead of reaching into ``_pending`` (docs/fleet.md) —
+        drained requests were never started, so handing them back to the
+        router for resubmission elsewhere is always safe.
+
+        Each drained ticket is poisoned with a structured
+        :class:`~dlaf_tpu.health.errors.DrainedError` (``result()``
+        names the cause instead of claiming "still queued"), counted
+        per bucket (``stats()['drained']``,
+        ``dlaf_serve_drained_total{op}``), and emits one ``resilience``
+        ``drain`` record under the ticket's trace ID — stats, records,
+        and metrics stay in exact agreement (pinned in
+        tests/test_serve.py)."""
+        with self._lock:
+            drained = []
+            for key in [k for k, lanes in self._pending.items() if lanes]:
+                lanes = self._pending.pop(key)
+                counts = self._bucket_counts(key)
+                if obs.metrics_active():
+                    obs.gauge("dlaf_serve_depth", op=key.op,
+                              bucket_n=key.n).set(0.0)
+                for req, ticket in lanes:
+                    ticket.error = DrainedError("serve.queue", req.rid,
+                                                op=key.op, bucket_n=key.n)
+                    counts["drained"] += 1
+                    if obs.metrics_active():
+                        obs.counter("dlaf_serve_drained_total",
+                                    op=key.op).inc()
+                    with obs.trace_context(trace_id=ticket.trace_id):
+                        obs.emit_event(
+                            "resilience", site="serve.queue", event="drain",
+                            attrs={"rid": req.rid, "op": key.op,
+                                   "bucket_n": key.n})
+                    drained.append((req, ticket))
+            return drained
+
     def pending(self) -> int:
         return sum(len(v) for v in self._pending.values())
 
@@ -552,6 +652,7 @@ class Queue:
                     "expired": counts.get("expired", 0),
                     "dispatches": counts.get("dispatches", 0),
                     "failures": counts.get("failures", 0),
+                    "drained": counts.get("drained", 0),
                     "breaker": _circuit.peek(site),
                 }
             return {
@@ -561,6 +662,7 @@ class Queue:
                 "dispatches": self.dispatches,
                 "shed": sum(b["shed"] for b in buckets.values()),
                 "expired": sum(b["expired"] for b in buckets.values()),
+                "drained": sum(b["drained"] for b in buckets.values()),
                 "max_depth": self.max_depth,
                 "shed_policy": "shed" if self.shed else "backpressure",
                 "buckets": buckets,

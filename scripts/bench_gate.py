@@ -48,6 +48,13 @@ be >= ``--min-autotune-speedup`` (default 0.5 — parity minus
 probe-per-call overhead on platforms where the ladder is inert; on TPU
 the learned routes sit well above 1).
 
+``workload="fleet"`` lines (bench.py's multi-replica serve-tier arm,
+ISSUE 18, docs/fleet.md) carry the third history-free leg: their
+N-replica vs 1-replica requests/s ``speedup`` field must be >=
+``--min-fleet-scaling`` (default 0.8 — the single-threaded router's
+wire serialization bounds toy-size CPU scaling at parity-ish; the
+floor trips routing collapse, not transport physics).
+
 Exit status: 0 = no regression; 1 = regression (or invalid history /
 no usable fresh measurements); 2 = usage error.
 """
@@ -152,6 +159,18 @@ DEFAULT_MIN_SERVE_SPEEDUP = 3.0
 #: whole point and sit well above 1.
 DEFAULT_MIN_AUTOTUNE_SPEEDUP = 0.5
 
+#: History-free floor on the fleet arm's N-replica vs 1-replica
+#: requests/s ratio (ISSUE 18, docs/fleet.md). The single-threaded
+#: router serializes every request onto the wire, so at the arm's toy
+#: CPU sizes the bound is protocol cost, not compute — the honest
+#: expectation there is parity-ish (measured 1.03-1.09x at n=64-128,
+#: 3 replicas). 0.8 trips the real failure modes — every bucket
+#: hash-colliding onto one replica, failover thrash re-dispatching the
+#: steady state — without demanding scaling the transport can't give;
+#: on TPU-class program runtimes the replicas' parallel compute is the
+#: point and the ratio sits well above 1.
+DEFAULT_MIN_FLEET_SCALING = 0.8
+
 
 def _best_speedup_per_key(fresh, workload: str) -> dict:
     """Best finite ``speedup`` field per key among ``workload`` lines —
@@ -175,7 +194,8 @@ def run_gate(history, fresh, *, tolerance: float, min_history: int,
              best_k: int, log=print,
              min_serve_speedup: float = DEFAULT_MIN_SERVE_SPEEDUP,
              min_autotune_speedup: float
-             = DEFAULT_MIN_AUTOTUNE_SPEEDUP) -> int:
+             = DEFAULT_MIN_AUTOTUNE_SPEEDUP,
+             min_fleet_scaling: float = DEFAULT_MIN_FLEET_SCALING) -> int:
     """Compare fresh bests against history baselines; returns the number
     of regressed keys. Keys without fresh measurements are skipped (the
     gate judges what this run measured, not what it skipped — bench.py's
@@ -241,6 +261,19 @@ def run_gate(history, fresh, *, tolerance: float, min_history: int,
         else:
             log(f"OK         {fmt_key(key)}: learned-vs-pinned-worst "
                 f"speedup {s:.2f}x >= {min_autotune_speedup:.2f}x")
+    # fleet-scaling floor (ISSUE 18, docs/fleet.md): N replicas vs one
+    # through the same router — history-free like the serve/autotune
+    # legs, so a first-round fleet measurement already gates
+    for key, s in sorted(_best_speedup_per_key(fresh, "fleet").items(),
+                         key=lambda kv: fmt_key(kv[0])):
+        if s < min_fleet_scaling:
+            regressions += 1
+            log(f"REGRESSION {fmt_key(key)}: fleet N-vs-1 scaling "
+                f"{s:.2f}x < {min_fleet_scaling:.2f}x "
+                "(ISSUE-18 fleet floor; history-free leg)")
+        else:
+            log(f"OK         {fmt_key(key)}: fleet N-vs-1 scaling "
+                f"{s:.2f}x >= {min_fleet_scaling:.2f}x")
     return regressions
 
 
@@ -273,6 +306,11 @@ def main(argv=None) -> int:
                     help="history-free floor on the autotune arm's "
                          "learned-table vs pinned-worst-case-route "
                          "speedup field (ISSUE 15; docs/autotune.md)")
+    ap.add_argument("--min-fleet-scaling", type=float,
+                    default=DEFAULT_MIN_FLEET_SCALING,
+                    help="history-free floor on the fleet arm's "
+                         "N-replica vs 1-replica requests/s ratio "
+                         "(ISSUE 18; docs/fleet.md)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -323,7 +361,8 @@ def main(argv=None) -> int:
                            min_history=args.min_history,
                            best_k=args.best_k,
                            min_serve_speedup=args.min_serve_speedup,
-                           min_autotune_speedup=args.min_autotune_speedup)
+                           min_autotune_speedup=args.min_autotune_speedup,
+                           min_fleet_scaling=args.min_fleet_scaling)
     if regressions:
         print(f"bench_gate: {regressions} regressed key(s)",
               file=sys.stderr)

@@ -17,6 +17,7 @@ bench-gate autotune speedup leg.
 
 import json
 import math
+import multiprocessing
 import os
 import subprocess
 import sys
@@ -182,6 +183,19 @@ class TestDecisionCore:
 # Table persistence: round-trip + loud refusal
 # ---------------------------------------------------------------------------
 
+def _hammer_table(path, n_saves):
+    """Fork-child body of the write-rename race drill: repeatedly
+    replace the table at ``path`` through the atomic save discipline.
+    Touches only pure-python table code (fork-safe under a jax-hosting
+    parent)."""
+    table = at.RouteTable()
+    for ratio in (3.0, 0.01, 0.01, 0.01):
+        table.observe(KEY, F64, ratio, margin=0.25, relax_after=3,
+                      budget=0)
+    for _ in range(n_saves):
+        table.save(path)
+
+
 class TestTablePersistence:
     def _learned(self):
         table = at.RouteTable()
@@ -266,6 +280,67 @@ class TestTablePersistence:
         # the committed steady state: every entry fully relaxed (rung 0)
         # so the CI warm-start leg holds with ZERO route changes
         assert all(e["rung"] == 0 for e in table.snapshot().values())
+
+    def test_load_retries_once_on_a_mid_replace_read(self, tmp_path,
+                                                     monkeypatch):
+        """A reader whose first open lands mid-replace (transient short
+        read on the dying inode) must retry once and succeed — fleet
+        workers warm-start from one shared committed table while the
+        autotune loop may still be persisting to it."""
+        from dlaf_tpu.autotune import table as table_mod
+        path = str(tmp_path / "table.json")
+        self._learned().save(path)
+        calls = {"n": 0}
+        real = table_mod.json.load
+
+        def flaky(f, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("Expecting value: line 1 column 1")
+            return real(f, *args, **kwargs)
+
+        monkeypatch.setattr(table_mod.json, "load", flaky)
+        loaded = at.RouteTable()
+        loaded.load(path)
+        assert calls["n"] == 2
+        assert loaded.rung_of(KEY) is not None
+
+    def test_load_still_refuses_a_genuinely_corrupt_table(self, tmp_path):
+        path = tmp_path / "table.json"
+        path.write_text('{"version": 3, "entr')    # truncated for real
+        with pytest.raises(ValueError, match="unparsable autotune table"):
+            at.RouteTable().load(str(path))
+
+    @pytest.mark.skipif(
+        "fork" not in multiprocessing.get_all_start_methods(),
+        reason="needs the fork start method")
+    def test_concurrent_writers_never_corrupt_a_reader(self, tmp_path):
+        """N processes hammering one table path through the atomic
+        write-rename (tmp + fsync + os.replace) while a reader loads in
+        a loop: every load sees a COMPLETE table (old or new, never a
+        torn one), and no .tmp litter survives."""
+        path = str(tmp_path / "table.json")
+        self._learned().save(path)      # the reader always has a table
+        ctx = multiprocessing.get_context("fork")
+        procs = [ctx.Process(target=_hammer_table, args=(path, 30))
+                 for _ in range(3)]
+        for p in procs:
+            p.start()
+        reads = 0
+        try:
+            while any(p.is_alive() for p in procs) or reads < 20:
+                loaded = at.RouteTable()
+                loaded.load(path)
+                assert loaded.snapshot(), "reader saw an empty table"
+                reads += 1
+        finally:
+            for p in procs:
+                p.join(timeout=60)
+        assert all(p.exitcode == 0 for p in procs), \
+            [p.exitcode for p in procs]
+        assert reads >= 20
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p]
+        assert leftovers == []
 
 
 # ---------------------------------------------------------------------------

@@ -615,6 +615,99 @@ def test_queue_threaded_submits_race_free():
 
 
 # ---------------------------------------------------------------------------
+# Queue.drain() — the explicit graceful-shutdown API (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+def test_queue_drain_returns_undispatched_and_poisons_tickets():
+    """drain() hands back every UNDISPATCHED (request, ticket) pair in
+    submission order, empties the queue, and poisons each ticket with a
+    structured DrainedError — result() names the cause instead of
+    claiming "still queued"."""
+    from dlaf_tpu.health.errors import DrainedError
+
+    svc = ProgramService()
+    q = Queue(svc, batch=4, deadline_s=1e9, buckets=(16,),
+              clock=_FakeClock())
+    done = q.submit(Request(op="cholesky", a=_hpd(12, seed=9)))
+    q.flush()                          # dispatched: NOT drainable
+    assert done.done
+    reqs = [Request(op="cholesky", a=_hpd(12, seed=i)) for i in range(3)]
+    reqs.append(Request(op="eigh", a=_sym(12)))
+    tickets = [q.submit(r) for r in reqs]
+    assert q.pending() == 4
+
+    drained = q.drain()
+    assert q.pending() == 0
+    assert [r.rid for r, _ in drained] == [r.rid for r in reqs]
+    assert [t for _, t in drained] == tickets
+    assert done not in [t for _, t in drained]
+    for req, t in drained:
+        assert not t.done and isinstance(t.error, DrainedError)
+        assert t.error.rid == req.rid and t.error.site == "serve.queue"
+        assert t.error.bucket_n == 16
+        with pytest.raises(RuntimeError,
+                           match="drained undispatched") as ei:
+            t.result()
+        assert ei.value.__cause__ is t.error
+    assert {t.error.op for _, t in drained} == {"cholesky", "eigh"}
+    # drained tickets never resurface on later clock edges
+    assert q.poll(now=1e12) == 0 and q.flush() == 0
+    assert q.drain() == []             # idempotent on an empty queue
+    # and the queue still serves fresh work afterwards
+    t2 = q.submit(Request(op="cholesky", a=_hpd(12, seed=77)))
+    q.flush()
+    assert t2.done and np.tril(t2.result()).shape == (12, 12)
+
+
+def test_queue_drain_stats_records_metrics_agree(tmp_path):
+    """One drain, three observers — stats()['drained'], the resilience
+    ``drain`` records, and ``dlaf_serve_drained_total{op}`` — must all
+    report the SAME counts, joinable per request by trace ID."""
+    path = str(tmp_path / "drain.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, log="off"))
+    svc = ProgramService()
+    q = Queue(svc, batch=8, deadline_s=1e9, buckets=(16,),
+              clock=_FakeClock())
+    tickets = [q.submit(Request(op="cholesky", a=_hpd(12, seed=i)))
+               for i in range(3)]
+    tickets += [q.submit(Request(op="eigh", a=_sym(12, seed=i)))
+                for i in range(2)]
+
+    drained = q.drain()
+    assert len(drained) == 5
+    st = q.stats()
+    assert st["pending"] == 0 and st["drained"] == 5
+    by_site = {site: b["drained"] for site, b in st["buckets"].items()
+               if b["drained"]}
+    assert sorted(by_site.values()) == [2, 3]
+    assert all(b["depth"] == 0 for b in st["buckets"].values())
+
+    reg = obs.registry()
+    assert reg.counter("dlaf_serve_drained_total",
+                       op="cholesky").snapshot()["value"] == 3
+    assert reg.counter("dlaf_serve_drained_total",
+                       op="eigh").snapshot()["value"] == 2
+    depth = [m for m in reg.snapshot()
+             if m["name"] == "dlaf_serve_depth"]
+    assert depth and all(m["value"] == 0.0 for m in depth)
+
+    obs.flush()
+    recs = [r for r in obs.read_records(path)
+            if r.get("type") == "resilience" and r.get("event") == "drain"]
+    assert len(recs) == 5
+    assert all(r["site"] == "serve.queue" for r in recs)
+    # records ↔ tickets joined by trace ID, one each, attrs name the rid
+    assert ({r["trace_id"] for r in recs}
+            == {t.trace_id for _, t in drained})
+    by_trace = {r["trace_id"]: r for r in recs}
+    for req, t in drained:
+        attrs = by_trace[t.trace_id]["attrs"]
+        assert attrs == {"rid": req.rid, "op": req.op, "bucket_n": 16}
+    assert obs.validate_file(path) == []
+    assert len({t.trace_id for _, t in drained}) == 5
+
+
+# ---------------------------------------------------------------------------
 # Records, accuracy, and --require-serve
 # ---------------------------------------------------------------------------
 
