@@ -108,6 +108,12 @@ def _cache_dir() -> str:
 #: auto can't blur the comparison, "fpanel+fp1" pins fused; same
 #: discipline as the "+la1"/comm arms). Sized off-TPU via
 #: DLAF_BENCH_FPANEL_N (the fused kernels run in interpret mode there).
+#: "fstep" (ISSUE 19): the fused-STEP A/B arm — the same f32 local
+#: cholesky pair with "fstep" pinning DLAF_STEP_IMPL=xla (composed
+#: per-op chain) and "fstep+fs1" pinning the one-pallas_call-per-step
+#: fused kernel (docs/pallas_panel.md "Fused step kernel"); paired
+#: accuracy records ride both arms, and bench_gate holds the pair's
+#: presence as a must-trip leg. Sized off-TPU via DLAF_BENCH_FSTEP_N.
 #: "serve" (ISSUE 11): the batched serving-layer arm — requests/s and
 #: p99 latency of a seeded mixed-shape request stream through
 #: serve.Queue over a WARM bucket set, vs a loop of singleton cholesky()
@@ -141,17 +147,19 @@ def _cache_dir() -> str:
 #: floor, and a mid-stream SIGKILL leg reports the zero-loss failover
 #: cost as "recovery_s". workload="fleet" keeps every number out of the
 #: headlines. Sized via DLAF_BENCH_FLEET_N / DLAF_BENCH_FLEET_REQS.
-STAGE_BASES = ("tridiag", "btr2b", "btb2t", "fpanel", "serve", "overload",
-               "autotune", "fleet")
+STAGE_BASES = ("tridiag", "btr2b", "btb2t", "fpanel", "fstep", "serve",
+               "overload", "autotune", "fleet")
 
 
-def _run_fpanel_variant(variant: str, platform: str) -> None:
-    """Measure one fused-panel A/B arm (f32 local cholesky; the knob was
+def _run_fpanel_variant(variant: str, platform: str,
+                        workload: str = "fpanel") -> None:
+    """Measure one fused-panel ("fpanel", ISSUE 10) or fused-step
+    ("fstep", ISSUE 19) A/B arm (f32 local cholesky; the knob was
     pinned by the caller): same artifact/stdout protocol as the other
-    arms, ``workload="fpanel"`` so the cholesky headline (a different
-    dtype + flop tier) never picks it up. Off-TPU the fused route runs
-    the kernels in interpret mode — tiny N keeps that inside the sweep
-    budget while still exercising the full routed program."""
+    arms, a dedicated ``workload`` label so the cholesky headline (a
+    different dtype + flop tier) never picks it up. Off-TPU the fused
+    route runs the kernels in interpret mode — tiny N keeps that inside
+    the sweep budget while still exercising the full routed program."""
     import dlaf_tpu.config as config
     from dlaf_tpu.algorithms.cholesky import cholesky
     from dlaf_tpu.common.index2d import GlobalElementSize, TileElementSize
@@ -159,13 +167,15 @@ def _run_fpanel_variant(variant: str, platform: str) -> None:
     from dlaf_tpu.miniapp.generators import hpd_element_fn
     from dlaf_tpu.types import total_ops
 
-    n = int(os.environ.get("DLAF_BENCH_FPANEL_N") or
+    n = int(os.environ.get(f"DLAF_BENCH_{workload.upper()}_N") or
             (os.environ.get("DLAF_BENCH_N", "4096")
              if platform == "tpu" else "256"))
     nb = min(int(os.environ.get("DLAF_BENCH_NB", "256")),
              max(n // 4, 32))    # keep a real multi-step panel chain
-    log(f"[{variant}] fused-panel arm on {platform}: n={n} nb={nb} "
-        f"panel_impl={config.get_configuration().panel_impl}")
+    cfg = config.get_configuration()
+    log(f"[{variant}] fused-{'step' if workload == 'fstep' else 'panel'} "
+        f"arm on {platform}: n={n} nb={nb} "
+        f"panel_impl={cfg.panel_impl} step_impl={cfg.step_impl}")
     ref = Matrix.from_element_fn(hpd_element_fn(n, np.float32),
                                  GlobalElementSize(n, n),
                                  TileElementSize(nb, nb), dtype=np.float32)
@@ -184,7 +194,7 @@ def _run_fpanel_variant(variant: str, platform: str) -> None:
     log(f"[{variant}] best of 3: {best_t:.4f}s {best_g:.1f} GFlop/s")
     line = append_history(platform, n, nb, best_g, best_t,
                           source="bench.py", variant=variant,
-                          dtype="float32", donate=True, workload="fpanel")
+                          dtype="float32", donate=True, workload=workload)
     from dlaf_tpu import obs
     from dlaf_tpu.obs import accuracy
 
@@ -713,10 +723,18 @@ def _run_stage_variant(variant: str, base: str, mods: set) -> None:
     if base == "fpanel":
         os.environ.setdefault("DLAF_PANEL_IMPL",
                               "fused" if "fp1" in mods else "xla")
+    if base == "fstep":
+        # plain arm pins the composed chain so TPU "auto" cannot blur
+        # the A/B; "+fs1" pins the fused step kernel (ISSUE 19)
+        os.environ.setdefault("DLAF_STEP_IMPL",
+                              "fused" if "fs1" in mods else "xla")
     config.initialize()
     platform = jax.devices()[0].platform
     if base == "fpanel":
         _run_fpanel_variant(variant, platform)
+        return
+    if base == "fstep":
+        _run_fpanel_variant(variant, platform, workload="fstep")
         return
     if base == "serve":
         _run_serve_variant(variant, platform)
@@ -1139,8 +1157,8 @@ def sweep(platform: str) -> None:
     order = ["ozaki", "ozaki+la1", ab_arm, "xla", "scan", "scan+la1",
              "loop", "loop+la1", "biggemm", "biggemm+la1", "invgemm",
              "tridiag", "tridiag+dcb1", "btr2b", "btr2b+btla1", "btb2t",
-             "fpanel", "fpanel+fp1", "serve", "overload", "autotune",
-             "fleet"]
+             "fpanel", "fpanel+fp1", "fstep", "fstep+fs1", "serve",
+             "overload", "autotune", "fleet"]
 
     def _known(v):
         b = v[: -len("+la1")] if v.endswith("+la1") else v

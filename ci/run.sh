@@ -411,6 +411,176 @@ EOF
       cat "$PANEL_DRILL_LOG" >&2; exit 1
     fi
     echo "disable_pallas panel drill tripped as required (DegradationError)"
+    echo "== smoke: fused step kernel route (step_impl=fused, ISSUE 19) =="
+    # tiny local + 2x2-distributed f32 cholesky on the FUSED STEP route
+    # (one pallas_call per strip-bearing blocked step: panel potrf +
+    # strip solve + adjacent trailing slab, docs/pallas_panel.md "Fused
+    # step kernel"; off-TPU the kernel runs in interpret mode); the
+    # artifact must carry the trace-time
+    # dlaf_step_kernel_total{impl="fused"} counters AND a finite
+    # accuracy record next to them
+    STEP_DIR=$(mktemp -d)
+    SMOKE_KEEP+=("$STEP_DIR")
+    STEP_ART="$STEP_DIR/step_metrics.jsonl"
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=4" \
+      DLAF_METRICS_PATH="$STEP_ART" DLAF_STEP_IMPL=fused DLAF_ACCURACY=1 \
+      python - <<'EOF'
+import numpy as np
+import scipy.linalg as sla
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.comm.grid import Grid
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.matrix.matrix import Matrix
+from dlaf_tpu.obs import accuracy
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((64, 64)).astype(np.float32)
+a = x @ x.T + 64 * np.eye(64, dtype=np.float32)
+ref = sla.cholesky(a, lower=True)
+for grid_shape in (None, (2, 2)):
+    grid = Grid(*grid_shape) if grid_shape else None
+    mat = Matrix.from_global(a, TileElementSize(16, 16), grid=grid)
+    fac = cholesky("L", mat)
+    rel = abs(np.tril(fac.to_numpy()) - ref).max() / abs(ref).max()
+    assert rel < 1e-5, rel
+    accuracy.emit("ci_step", "cholesky_residual",
+                  accuracy.cholesky_residual(
+                      "L", Matrix.from_global(a, TileElementSize(16, 16),
+                                              grid=grid), fac),
+                  n=64, nb=16, c=60.0, dtype=np.float32, of=fac.storage)
+fused = obs.registry().counter("dlaf_step_kernel_total",
+                               impl="fused").snapshot()
+assert fused["value"] >= 6, fused   # 3 strip-bearing steps x (local + dist)
+print("fused step smoke ok:", fused)
+obs.flush()
+EOF
+    python -m dlaf_tpu.obs.validate "$STEP_ART" --require-accuracy
+    python - "$STEP_ART" <<'EOF'
+import json, sys
+recs = [json.loads(line) for line in open(sys.argv[1])]
+mets = [m for r in recs if r.get("type") == "metrics"
+        for m in r["metrics"]]
+fused = [m for m in mets if m["name"] == "dlaf_step_kernel_total"
+         and m["labels"].get("impl") == "fused"]
+assert fused and all(m["value"] > 0 for m in fused), fused
+print(f"step artifact ok: {len(fused)} fused step counter series")
+EOF
+    echo "== smoke: fused step degrade must-trip drill (VMEM budget) =="
+    # the ladder's automatic-degrade contract, drilled end to end: a
+    # starved DLAF_STEP_VMEM_LIMIT must land the explicitly-requested
+    # fused step route on the composed per-op chain, COUNTING the
+    # fallback at site=step reason=vmem_budget and once-announcing it;
+    # the injected route-off must count reason=injected_off the same
+    # way; and the same starvation under DLAF_STRICT=1 must exit
+    # SPECIFICALLY 1 naming DegradationError (any other exit = a crash
+    # masquerading as detection — PR 8/9 drill contract)
+    STEP_DRILL_LOG=$(mktemp)
+    sdrill0_rc=0
+    DLAF_STEP_IMPL=fused DLAF_STEP_VMEM_LIMIT=1024 \
+      DLAF_METRICS_PATH=$(mktemp -d)/step_drill.jsonl \
+      python - > "$STEP_DRILL_LOG" 2>&1 <<'EOF' || sdrill0_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.health import inject
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((32, 32)).astype(np.float32)
+a = x @ x.T + 32 * np.eye(32, dtype=np.float32)
+cholesky("L", Matrix.from_global(a, TileElementSize(8, 8)))
+c = obs.registry().counter("dlaf_fallback_total", site="step",
+                           reason="vmem_budget").snapshot()
+assert c["value"] >= 1, c
+print("step vmem fallback counted:", c)
+EOF
+    if [ "$sdrill0_rc" -ne 0 ] \
+        || ! grep -q "step vmem fallback counted" "$STEP_DRILL_LOG"; then
+      echo "step vmem fallback counter leg failed (rc=$sdrill0_rc)" >&2
+      cat "$STEP_DRILL_LOG" >&2; exit 1
+    fi
+    grep -q "degraded path at 'step'" "$STEP_DRILL_LOG" || {
+      echo "step degradation was not once-announced" >&2
+      cat "$STEP_DRILL_LOG" >&2; exit 1; }
+    sdrill1_rc=0
+    DLAF_STEP_IMPL=fused DLAF_METRICS_PATH=$(mktemp -d)/step_drill2.jsonl \
+      python - > "$STEP_DRILL_LOG" 2>&1 <<'EOF' || sdrill1_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu import obs
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.health import inject
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((32, 32)).astype(np.float32)
+a = x @ x.T + 32 * np.eye(32, dtype=np.float32)
+with inject.disable_route("pallas"):
+    cholesky("L", Matrix.from_global(a, TileElementSize(8, 8)))
+c = obs.registry().counter("dlaf_fallback_total", site="step",
+                           reason="injected_off").snapshot()
+assert c["value"] >= 1, c
+print("step injected_off fallback counted:", c)
+EOF
+    if [ "$sdrill1_rc" -ne 0 ] \
+        || ! grep -q "step injected_off fallback counted" "$STEP_DRILL_LOG"
+    then
+      echo "step disable_route counter leg failed (rc=$sdrill1_rc)" >&2
+      cat "$STEP_DRILL_LOG" >&2; exit 1
+    fi
+    sdrill_rc=0
+    DLAF_STEP_IMPL=fused DLAF_STEP_VMEM_LIMIT=1024 DLAF_STRICT=1 \
+      python - > "$STEP_DRILL_LOG" 2>&1 <<'EOF' || sdrill_rc=$?
+import numpy as np
+import dlaf_tpu.config as C
+from dlaf_tpu.algorithms.cholesky import cholesky
+from dlaf_tpu.common.index2d import TileElementSize
+from dlaf_tpu.matrix.matrix import Matrix
+
+C.initialize()
+rng = np.random.default_rng(0)
+x = rng.standard_normal((32, 32)).astype(np.float32)
+a = x @ x.T + 32 * np.eye(32, dtype=np.float32)
+cholesky("L", Matrix.from_global(a, TileElementSize(8, 8)))
+raise SystemExit(3)   # reaching here = the strict raise never fired
+EOF
+    if [ "$sdrill_rc" -ne 1 ] \
+        || ! grep -q "DegradationError" "$STEP_DRILL_LOG"; then
+      echo "step vmem-budget drill did not trip cleanly" \
+           "(rc=$sdrill_rc, wanted rc=1 + DegradationError)" >&2
+      cat "$STEP_DRILL_LOG" >&2; exit 1
+    fi
+    echo "fused step degrade drill tripped as required (DegradationError)"
+    echo "== smoke: fstep bench A/B pair + completeness gate (ISSUE 19) =="
+    # the fused-step A/B bench arms (plain fstep pins step_impl=xla,
+    # fstep+fs1 pins fused) must land paired records in one artifact
+    # that clears bench_gate --fresh; a HALF-pair artifact must trip
+    # the gate's history-free completeness leg — the pair IS the claim
+    FSTEP_BENCH_ART="$STEP_DIR/fstep_bench.jsonl"
+    for v in fstep fstep+fs1; do
+      DLAF_BENCH_VARIANT="$v" DLAF_METRICS_PATH="$FSTEP_BENCH_ART" \
+        DLAF_BENCH_HISTORY_PATH="$STEP_DIR/bench_history.jsonl" \
+        DLAF_BENCH_FSTEP_N=64 DLAF_ACCURACY=1 python bench.py > /dev/null
+    done
+    python scripts/bench_gate.py --fresh "$FSTEP_BENCH_ART"
+    FSTEP_HALF_ART="$STEP_DIR/fstep_half.jsonl"
+    DLAF_BENCH_VARIANT=fstep+fs1 DLAF_METRICS_PATH="$FSTEP_HALF_ART" \
+      DLAF_BENCH_HISTORY_PATH="$STEP_DIR/bench_history.jsonl" \
+      DLAF_BENCH_FSTEP_N=64 python bench.py > /dev/null
+    if python scripts/bench_gate.py --fresh "$FSTEP_HALF_ART" \
+        > /dev/null 2>&1; then
+      echo "bench_gate FAILED to flag a half fstep A/B pair" >&2
+      exit 1
+    fi
+    echo "bench_gate fstep completeness leg trips as required"
     echo "== smoke: batched serving layer (warm queue stream, ISSUE 11) =="
     # drive serve.Queue end-to-end (docs/serving.md): warmup a bucket
     # set, then a seeded mixed-shape cholesky/solve/eigh request stream

@@ -99,12 +99,13 @@ def _count_step_modes(algo: str, overlapped: int, serialized: int) -> None:
 @register_program_cache
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "trailing",
                                              "lookahead", "with_info",
-                                             "panel_fused",
+                                             "panel_fused", "step_fused",
                                              "panel_interpret", "route"),
                    donate_argnums=0)
 def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
                     lookahead: bool = False, with_info: bool = False,
-                    panel_fused: bool = False, panel_interpret: bool = False,
+                    panel_fused: bool = False, step_fused: bool = False,
+                    panel_interpret: bool = False,
                     route: tuple = ()):
     # ``route`` is the active autotune route's cache-key component
     # (docs/autotune.md): the builders read route-sensitive knobs at
@@ -161,6 +162,74 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
                                             else (0, 1)))
         k0, k1 = k * nb, min((k + 1) * nb, n)
         blk = a[k0:k1, k0:k1] if la is None else la[0]
+        if step_fused and k1 < n:
+            # step_impl route (docs/pallas_panel.md "Fused step kernel"):
+            # ONE pallas_call per blocked step — potrf ladder + whole
+            # strip solve + the adjacent trailing column/row strip, with
+            # the factor, its inverse, and the solved leading strip
+            # block VMEM-resident between the three ops. The remaining
+            # trailing update is the row/column-trimmed rest-herk of the
+            # lookahead split (same dots, same per-cell application
+            # order), so the la on/off contract stays bitwise on this
+            # route regardless of the lookahead knob.
+            m = n - k1
+            w = min(nb, m)
+            ppan.count_step_kernel("fused")
+            if uplo == "L":
+                colsrc = a[k1:, k0:k1] if la is None else la[1]
+                diag, panel, new_col = ppan.fused_step(
+                    "L", blk, colsrc, a[k1:, k1:k1 + w],
+                    interpret=panel_interpret)
+                a = a.at[k0:k1, k0:k1].set(diag)
+                a = a.at[k1:, k0:k1].set(panel)
+                a = a.at[k1:, k1:k1 + w].set(new_col)
+                la = ((new_col[:w], new_col[w:] if k1 + w < n else None)
+                      if lookahead else None)
+                if trailing == "loop":
+                    for j in range(k + 2, nt):
+                        j0, j1 = j * nb, min((j + 1) * nb, n)
+                        pj = panel[j0 - k1: j1 - k1]
+                        dj = tb.herk("L", "N", pj, a[j0:j1, j0:j1],
+                                     alpha=-1.0)
+                        a = a.at[j0:j1, j0:j1].set(dj)
+                        if j1 < n:
+                            below = tb.gemm(panel[j1 - k1:], pj,
+                                            a[j1:, j0:j1], alpha=-1.0,
+                                            beta=1.0, op_b="C")
+                            a = a.at[j1:, j0:j1].set(below)
+                elif m > w:
+                    pr = panel[w:]
+                    upd = pr @ jnp.conj(pr).T
+                    mask = jnp.tril(jnp.ones((m - w, m - w), dtype=bool))
+                    a = a.at[k1 + w:, k1 + w:].add(jnp.where(mask, -upd, 0))
+            else:
+                rowsrc = a[k0:k1, k1:] if la is None else la[1]
+                diag, panel, new_row = ppan.fused_step(
+                    "U", blk, rowsrc, a[k1:k1 + w, k1:],
+                    interpret=panel_interpret)
+                a = a.at[k0:k1, k0:k1].set(diag)
+                a = a.at[k0:k1, k1:].set(panel)
+                a = a.at[k1:k1 + w, k1:].set(new_row)
+                la = ((new_row[:, :w], new_row[:, w:]
+                       if k1 + w < n else None) if lookahead else None)
+                if trailing == "loop":
+                    for j in range(k + 2, nt):
+                        j0, j1 = j * nb, min((j + 1) * nb, n)
+                        pj = panel[:, j0 - k1: j1 - k1]
+                        dj = tb.herk("U", "C", pj, a[j0:j1, j0:j1],
+                                     alpha=-1.0)
+                        a = a.at[j0:j1, j0:j1].set(dj)
+                        if j1 < n:
+                            right = tb.gemm(pj, panel[:, j1 - k1:],
+                                            a[j0:j1, j1:], alpha=-1.0,
+                                            beta=1.0, op_a="C")
+                            a = a.at[j0:j1, j1:].set(right)
+                elif m > w:
+                    pr = panel[:, w:]
+                    upd = jnp.conj(pr).T @ pr
+                    mask = jnp.triu(jnp.ones((m - w, m - w), dtype=bool))
+                    a = a.at[k1 + w:, k1 + w:].add(jnp.where(mask, -upd, 0))
+            continue
         if use_oz:
             # latency-bound panel ops in mixed precision (f32 seed + Newton,
             # tile_ops.mixed): emulated-f64 potrf/trsm are the wall-clock
@@ -184,6 +253,9 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
         if k1 == n:
             break
         m = n - k1
+        # strip-bearing step on the composed-op chain (step_impl route
+        # accounting — the fused branch above counts impl="fused")
+        ppan.count_step_kernel("xla")
         if uplo == "L":
             # panel: A[k1:, k] <- A[k1:, k] Lkk^-H   (tile::trsm, high-prio
             # in the reference impl.h:147-156; here XLA schedules it) —
@@ -338,11 +410,13 @@ def _cholesky_local(a, *, uplo: str, nb: int, trailing: str = "loop",
 @functools.partial(jax.jit, static_argnames=("uplo", "nb", "use_mxu",
                                              "use_mixed", "lookahead",
                                              "with_info", "panel_fused",
+                                             "step_fused",
                                              "panel_interpret", "route"),
                    donate_argnums=0)
 def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                          use_mixed: bool = False, lookahead: bool = False,
                          with_info: bool = False, panel_fused: bool = False,
+                         step_fused: bool = False,
                          panel_interpret: bool = False, route: tuple = ()):
     """``lax.scan`` formulation of the local factorization: ONE compiled
     step body, looped ``nt`` times with uniform full-size shapes.
@@ -386,15 +460,23 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
         def step(acc, k):
             k0 = k * nb
             blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
+            ppan.count_step_kernel("fused" if step_fused else "xla")
             if use_mixed:
                 ppan.count_panel_kernel("xla", "potrf")
                 fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
                 diag = fac + tb.tri_mask(blk, other, k=-1)
+            elif step_fused:
+                # step_impl route, scan form: the potrf is DEFERRED into
+                # the fused factor+solve kernel below (the trailing
+                # update's traced-index masks keep it outside the
+                # kernel, so the scan forms fuse the 2-op panel chain)
+                fac_inv = diag = None
             else:
                 fac_inv = None
                 diag = ppan.panel_potrf(uplo, blk, fused=panel_fused,
                                       interpret=panel_interpret)
-            acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
+            if diag is not None:
+                acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
             below = rows >= k0 + nb      # (m,) rows/cols past the pivot
             if uplo == "L":
                 col = jax.lax.dynamic_slice(acc, (0, k0), (m, nb))
@@ -402,6 +484,12 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                     ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(col, inv_t) if use_mxu else col @ inv_t
+                elif step_fused:
+                    # col's pivot rows hold the unfactored blk; the
+                    # write-back + explicit diag update below restore
+                    # the factored tile
+                    diag, pfull = ppan.fused_factor_solve(
+                        "L", blk, col, interpret=panel_interpret)
                 elif panel_fused:
                     pfull = ppan.panel_solve("R", "L", "C", "N", diag, col,
                                            fused=True,
@@ -412,6 +500,8 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                 panel = jnp.where(below[:, None], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
                     acc, jnp.where(below[:, None], pfull, col), (0, k0))
+                if step_fused:
+                    acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
                 if use_mxu:
                     upd = (oz.herk_c128(panel, slices=tb._oz_slices())
                            if jnp.iscomplexobj(panel)
@@ -428,6 +518,9 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                     ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(inv_t, row) if use_mxu else inv_t @ row
+                elif step_fused:
+                    diag, pfull = ppan.fused_factor_solve(
+                        "U", blk, row, interpret=panel_interpret)
                 elif panel_fused:
                     pfull = ppan.panel_solve("L", "U", "C", "N", diag, row,
                                            fused=True,
@@ -438,6 +531,8 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                 panel = jnp.where(below[None, :], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
                     acc, jnp.where(below[None, :], pfull, row), (k0, 0))
+                if step_fused:
+                    acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
                 pt = jnp.conj(jnp.swapaxes(panel, -1, -2))
                 if use_mxu:
                     upd = (oz.herk_c128(pt, slices=tb._oz_slices())
@@ -476,15 +571,20 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
             acc, pp = carry      # pp: previous step's masked panel
             k0 = k * nb
             blk = jax.lax.dynamic_slice(acc, (k0, k0), (nb, nb))
+            ppan.count_step_kernel("fused" if step_fused else "xla")
             if use_mixed:
                 ppan.count_panel_kernel("xla", "potrf")
                 fac, fac_inv = mx.potrf_inv_refined(uplo, blk)
                 diag = fac + tb.tri_mask(blk, other, k=-1)
+            elif step_fused:
+                # potrf deferred into the fused factor+solve kernel
+                fac_inv = diag = None
             else:
                 fac_inv = None
                 diag = ppan.panel_potrf(uplo, blk, fused=panel_fused,
                                       interpret=panel_interpret)
-            acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
+            if diag is not None:
+                acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
             below = rows >= k0 + nb
             tri = (rows[:, None] >= rows[None, :] if uplo == "L"
                    else rows[:, None] <= rows[None, :])
@@ -495,6 +595,9 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                     ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(col, inv_t) if use_mxu else col @ inv_t
+                elif step_fused:
+                    diag, pfull = ppan.fused_factor_solve(
+                        "L", blk, col, interpret=panel_interpret)
                 elif panel_fused:
                     pfull = ppan.panel_solve("R", "L", "C", "N", diag, col,
                                            fused=True,
@@ -505,6 +608,8 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                 panel = jnp.where(below[:, None], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
                     acc, jnp.where(below[:, None], pfull, col), (0, k0))
+                if step_fused:
+                    acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
                 # deferred bulk of step k-1: its next-col (block col k)
                 # was applied in body k-1, the rest lands here
                 pupd = syrk_like(pp)
@@ -526,6 +631,9 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                     ppan.count_panel_kernel("xla", "solve")
                     inv_t = jnp.conj(fac_inv).T
                     pfull = tb.mm_mxu(inv_t, row) if use_mxu else inv_t @ row
+                elif step_fused:
+                    diag, pfull = ppan.fused_factor_solve(
+                        "U", blk, row, interpret=panel_interpret)
                 elif panel_fused:
                     pfull = ppan.panel_solve("L", "U", "C", "N", diag, row,
                                            fused=True,
@@ -536,6 +644,8 @@ def _cholesky_local_scan(a, *, uplo: str, nb: int, use_mxu: bool = False,
                 panel = jnp.where(below[None, :], pfull, 0)
                 acc = jax.lax.dynamic_update_slice(
                     acc, jnp.where(below[None, :], pfull, row), (k0, 0))
+                if step_fused:
+                    acc = jax.lax.dynamic_update_slice(acc, diag, (k0, k0))
                 ppt = jnp.conj(jnp.swapaxes(pp, -1, -2))
                 pupd = syrk_like(ppt)
                 pmask = tri & (rows[:, None] >= k0 + nb)
@@ -621,7 +731,8 @@ def _masked_oz_update(afl, bfl, pairmask, nrows, ncols, mb, interpret):
 def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                          use_mxu=False, use_mixed=False, cplx=False,
                          use_oz_pallas=False, lookahead=False,
-                         comm_la=False, with_info=False, panel_fused=False):
+                         comm_la=False, with_info=False, panel_fused=False,
+                         step_fused=False):
     """Build the shard_map'd factorization program for one (dist, mesh, uplo).
 
     ``use_mxu`` routes the trailing tile-pair contraction through the
@@ -715,11 +826,20 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
         # (fused with the explicit inverse the panel solve consumes, so
         # each step pays one f32 cholesky + ONE f32 solve, not two)
         lkk_inv = None
+        # step_impl route, distributed form: potrf + whole-strip solve as
+        # ONE fused pallas_call (the trailing slab stays outside — it
+        # needs the POST-collective transposed panel, so only the 2-op
+        # chain can fuse here). Deferred past the early-outs: the final
+        # step and strip-less shards keep the plain potrf.
+        fuse_step = step_fused and not use_mixed and k < nt - 1 and (
+            (ltr - lu_r) if uplo == "L" else (ltc - lu_c)) > 0
         if use_mixed:
             ppan.count_panel_kernel("xla", "potrf")
             other = "U" if uplo == "L" else "L"
             fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
             lkk = fac + tb.tri_mask(diag, other, k=-1)
+        elif fuse_step:
+            lkk = None   # factored inside the fused kernel below
         else:
             # panel_impl route (docs/pallas_panel.md): fused VMEM potrf
             # kernel or XLA's blocked-cholesky thunk chain
@@ -734,6 +854,7 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
                 return lkk, None, None, None
             g_rows = local_rows_global(lu_r, rr, nrows)
             row_valid = (g_rows > k) & (g_rows < nt)
+            ppan.count_step_kernel("fused" if fuse_step else "xla")
             # trsm_panel: native batched solve, or (f64_trsm="mixed")
             # refined inverse + matmul that follows the f64_gemm routing
             # (inverse precomputed by the fused potrf step); the panel
@@ -741,10 +862,14 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             # ranks' carried tiles are stale pre-bulk values, but every
             # use of `pan` is gated by the owner-column keep/bcast masks)
             colsrc = lt[lu_r:, kc] if la is None else la[0][lu_r - la[1]:]
-            pan = ppan.panel_solve("R", "L", "C", "N", lkk, colsrc,
-                                 fused=panel_fused,
-                                 interpret=pallas_interpret,
-                                 inv_a=lkk_inv)
+            if fuse_step:
+                lkk, pan = ppan.fused_factor_solve(
+                    "L", diag, colsrc, interpret=pallas_interpret)
+            else:
+                pan = ppan.panel_solve("R", "L", "C", "N", lkk, colsrc,
+                                     fused=panel_fused,
+                                     interpret=pallas_interpret,
+                                     inv_a=lkk_inv)
             pan = jnp.where(row_valid[:, None, None], pan,
                             jnp.zeros_like(pan))
             # -- panel broadcast (reference broadcast_panel.h:101-193) ---
@@ -767,10 +892,16 @@ def _build_dist_cholesky(dist, mesh, uplo, use_pallas, pallas_interpret,
             return lkk, None, None, None
         g_cols = local_cols_global(lu_c, rc, ncols)
         col_valid = (g_cols > k) & (g_cols < nt)
+        ppan.count_step_kernel("fused" if fuse_step else "xla")
         rowsrc = lt[kr, lu_c:] if la is None else la[0][lu_c - la[1]:]
-        pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowsrc,
-                             fused=panel_fused, interpret=pallas_interpret,
-                             inv_a=lkk_inv)
+        if fuse_step:
+            lkk, pan = ppan.fused_factor_solve(
+                "U", diag, rowsrc, interpret=pallas_interpret)
+        else:
+            pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowsrc,
+                                 fused=panel_fused,
+                                 interpret=pallas_interpret,
+                                 inv_a=lkk_inv)
         pan = jnp.where(col_valid[:, None, None], pan, jnp.zeros_like(pan))
         # col-wise down the mesh, then all_gather along the column axis
         # to index the transposed panel by local rows
@@ -1078,7 +1209,7 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                               use_mixed=False, cplx=False,
                               use_oz_pallas=False, pallas_interpret=False,
                               lookahead=False, with_info=False,
-                              panel_fused=False):
+                              panel_fused=False, step_fused=False):
     """``lax.scan`` form of the distributed factorization: ONE compiled
     step body looped ``nt`` times inside the ``shard_map``.
 
@@ -1126,20 +1257,42 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             ts = jnp.minimum(mb, n - k * mb)
             pad = jnp.arange(mb) >= ts   # short-edge mask
             diag = pad_diag_identity_dyn(diag, ts)
+            # step_impl route, scan form: potrf deferred into the fused
+            # factor+solve kernel at the panel-solve site (the diag
+            # write-back then trails the column/row write)
+            fuse_step = step_fused and not use_mixed
+            ppan.count_step_kernel("fused" if fuse_step else "xla")
             if use_mixed:
                 ppan.count_panel_kernel("xla", "potrf")
                 other = "U" if uplo == "L" else "L"
                 fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
                 lkk = fac + tb.tri_mask(diag, other, k=-1)
+            elif fuse_step:
+                lkk_inv = lkk = None
             else:
                 lkk_inv = None
                 lkk = ppan.panel_potrf(uplo, diag, fused=panel_fused,
                                      interpret=pallas_interpret)
-            # un-pad: the written diagonal tile keeps stored edge zeros
-            lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
-            upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
-            lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
-                                              (kr, kc, 0, 0))
+
+            def write_diag(lt, lkk, fallback=None):
+                # un-pad: the written diagonal tile keeps stored edge
+                # zeros. ``fallback`` is the non-owner tile value —
+                # ``cand`` before the column/row write, the CURRENT tile
+                # after it (the write-back may have put a solved panel
+                # tile into the pivot slot on owner-column ranks that
+                # are not the pivot-row owner)
+                lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
+                upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w,
+                                     cand if fallback is None else fallback)
+                return jax.lax.dynamic_update_slice(
+                    lt, upd_tile[None, None], (kr, kc, 0, 0))
+
+            def pivot_tile(lt):
+                return jax.lax.dynamic_slice(
+                    lt, (kr, kc, 0, 0), (1, 1, mb, mb))[0, 0]
+
+            if lkk is not None:
+                lt = write_diag(lt, lkk)
 
             g_rows = ctx.g_rows(lu_r0, ltr_s)
             g_cols = ctx.g_cols(lu_c0, ltc_s)
@@ -1150,14 +1303,21 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                 # -- panel trsm over the segment's local row slots -------
                 colk = jax.lax.dynamic_slice(
                     lt, (0, kc, 0, 0), (ltr_s, 1, mb, mb))[:, 0]
-                pan = ppan.panel_solve("R", "L", "C", "N", lkk, colk,
-                                     fused=panel_fused,
-                                     interpret=pallas_interpret,
-                                     inv_a=lkk_inv)
+                if fuse_step:
+                    lkk, pan = ppan.fused_factor_solve(
+                        "L", diag, colk, interpret=pallas_interpret)
+                else:
+                    pan = ppan.panel_solve("R", "L", "C", "N", lkk, colk,
+                                         fused=panel_fused,
+                                         interpret=pallas_interpret,
+                                         inv_a=lkk_inv)
                 pan = jnp.where(row_valid[:, None, None], pan, 0)
                 keep = (is_owner_c & row_valid)[:, None, None]
                 lt = jax.lax.dynamic_update_slice(
                     lt, jnp.where(keep, pan, colk)[:, None], (0, kc, 0, 0))
+                if fuse_step:
+                    # colk predates the factor; fix the pivot tile now
+                    lt = write_diag(lt, lkk, fallback=pivot_tile(lt))
 
                 # -- panel broadcast + transposed panel ------------------
                 vr = cc.bcast(pan, COL_AXIS, owner_c)
@@ -1189,14 +1349,20 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
                 # -- mirrored sweep: panel is block row kr ---------------
                 rowk = jax.lax.dynamic_slice(
                     lt, (kr, 0, 0, 0), (1, ltc_s, mb, mb))[0]
-                pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowk,
-                                     fused=panel_fused,
-                                     interpret=pallas_interpret,
-                                     inv_a=lkk_inv)
+                if fuse_step:
+                    lkk, pan = ppan.fused_factor_solve(
+                        "U", diag, rowk, interpret=pallas_interpret)
+                else:
+                    pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowk,
+                                         fused=panel_fused,
+                                         interpret=pallas_interpret,
+                                         inv_a=lkk_inv)
                 pan = jnp.where(col_valid[:, None, None], pan, 0)
                 keep = (is_owner_r & col_valid)[:, None, None]
                 lt = jax.lax.dynamic_update_slice(
                     lt, jnp.where(keep, pan, rowk)[None], (kr, 0, 0, 0))
+                if fuse_step:
+                    lt = write_diag(lt, lkk, fallback=pivot_tile(lt))
 
                 vcp = cc.bcast(pan, ROW_AXIS, owner_r)
                 vrp = transpose_row_to_cols(DistContext(dist), vcp, lu_c0,
@@ -1279,19 +1445,34 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             ts = jnp.minimum(mb, n - k * mb)
             pad = jnp.arange(mb) >= ts
             diag = pad_diag_identity_dyn(diag, ts)
+            # step_impl route: potrf fused with the strip solve below
+            fuse_step = step_fused and not use_mixed
+            ppan.count_step_kernel("fused" if fuse_step else "xla")
             if use_mixed:
                 ppan.count_panel_kernel("xla", "potrf")
                 other = "U" if uplo == "L" else "L"
                 fac, lkk_inv = mx.potrf_inv_refined(uplo, diag)
                 lkk = fac + tb.tri_mask(diag, other, k=-1)
+            elif fuse_step:
+                lkk_inv = lkk = None
             else:
                 lkk_inv = None
                 lkk = ppan.panel_potrf(uplo, diag, fused=panel_fused,
                                      interpret=pallas_interpret)
-            lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
-            upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w, cand)
-            lt = jax.lax.dynamic_update_slice(lt, upd_tile[None, None],
-                                              (kr, kc, 0, 0))
+
+            def write_diag(lt, lkk, fallback=None):
+                lkk_w = jnp.where(pad[:, None] | pad[None, :], cand, lkk)
+                upd_tile = jnp.where(is_owner_r & is_owner_c, lkk_w,
+                                     cand if fallback is None else fallback)
+                return jax.lax.dynamic_update_slice(
+                    lt, upd_tile[None, None], (kr, kc, 0, 0))
+
+            def pivot_tile(lt):
+                return jax.lax.dynamic_slice(
+                    lt, (kr, kc, 0, 0), (1, 1, mb, mb))[0, 0]
+
+            if lkk is not None:
+                lt = write_diag(lt, lkk)
 
             g_rows = ctx.g_rows(lu_r0, ltr_s)
             g_cols = ctx.g_cols(lu_c0, ltc_s)
@@ -1302,14 +1483,20 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             if uplo == "L":
                 colk = jax.lax.dynamic_slice(
                     lt, (0, kc, 0, 0), (ltr_s, 1, mb, mb))[:, 0]
-                pan = ppan.panel_solve("R", "L", "C", "N", lkk, colk,
-                                     fused=panel_fused,
-                                     interpret=pallas_interpret,
-                                     inv_a=lkk_inv)
+                if fuse_step:
+                    lkk, pan = ppan.fused_factor_solve(
+                        "L", diag, colk, interpret=pallas_interpret)
+                else:
+                    pan = ppan.panel_solve("R", "L", "C", "N", lkk, colk,
+                                         fused=panel_fused,
+                                         interpret=pallas_interpret,
+                                         inv_a=lkk_inv)
                 pan = jnp.where(row_valid[:, None, None], pan, 0)
                 keep = (is_owner_c & row_valid)[:, None, None]
                 lt = jax.lax.dynamic_update_slice(
                     lt, jnp.where(keep, pan, colk)[:, None], (0, kc, 0, 0))
+                if fuse_step:
+                    lt = write_diag(lt, lkk, fallback=pivot_tile(lt))
                 vr = cc.bcast(pan, COL_AXIS, owner_c)
                 vc = transpose_col_to_rows(DistContext(dist), vr, lu_r0,
                                            g_cols)
@@ -1364,14 +1551,20 @@ def _build_dist_cholesky_scan(dist, mesh, uplo, use_mxu=False,
             # -- mirrored sweep (uplo='U') ------------------------------
             rowk = jax.lax.dynamic_slice(
                 lt, (kr, 0, 0, 0), (1, ltc_s, mb, mb))[0]
-            pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowk,
-                                 fused=panel_fused,
-                                 interpret=pallas_interpret,
-                                 inv_a=lkk_inv)
+            if fuse_step:
+                lkk, pan = ppan.fused_factor_solve(
+                    "U", diag, rowk, interpret=pallas_interpret)
+            else:
+                pan = ppan.panel_solve("L", "U", "C", "N", lkk, rowk,
+                                     fused=panel_fused,
+                                     interpret=pallas_interpret,
+                                     inv_a=lkk_inv)
             pan = jnp.where(col_valid[:, None, None], pan, 0)
             keep = (is_owner_r & col_valid)[:, None, None]
             lt = jax.lax.dynamic_update_slice(
                 lt, jnp.where(keep, pan, rowk)[None], (kr, 0, 0, 0))
+            if fuse_step:
+                lt = write_diag(lt, lkk, fallback=pivot_tile(lt))
             vcp = cc.bcast(pan, ROW_AXIS, owner_r)
             vrp = transpose_row_to_cols(DistContext(dist), vcp, lu_c0,
                                         g_rows)
@@ -1499,7 +1692,7 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                           pallas_interpret, use_mxu, use_mixed,
                           use_oz_pallas=False, scan=False, donate=False,
                           lookahead=False, comm_la=False, with_info=False,
-                          panel_fused=False, route=()):
+                          panel_fused=False, step_fused=False, route=()):
     # dtype stays in the cache key: storage dtype changes retrace the jit
     # anyway, but distinct keys keep program caches per element type.
     # ``route`` (the active autotune route, docs/autotune.md) is a pure
@@ -1517,7 +1710,7 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
             use_oz_pallas=use_oz_pallas,
             pallas_interpret=pallas_interpret,
             lookahead=lookahead, with_info=with_info,
-            panel_fused=panel_fused), **donate_kw)
+            panel_fused=panel_fused, step_fused=step_fused), **donate_kw)
     return jax.jit(_build_dist_cholesky(dist, mesh, uplo, use_pallas,
                                         pallas_interpret, use_mxu=use_mxu,
                                         use_mixed=use_mixed,
@@ -1526,7 +1719,8 @@ def _dist_cholesky_cached(dist, mesh, dtype, uplo, use_pallas,
                                         lookahead=lookahead,
                                         comm_la=comm_la,
                                         with_info=with_info,
-                                        panel_fused=panel_fused),
+                                        panel_fused=panel_fused,
+                                        step_fused=step_fused),
                    **donate_kw)
 
 
@@ -1635,6 +1829,13 @@ def _cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
     # whole-matrix "xla" trailing delegation has no panel chain to route
     panel_fused = trailing != "xla" and ppan.panel_uses_fused(
         dt, mat.block_size.row)
+    # fused STEP route (step_impl knob, docs/pallas_panel.md "Fused step
+    # kernel"): one pallas_call per blocked step — resolved once here
+    # (single owner pallas_panel.step_uses_fused: dtype/block/VMEM
+    # policy + injection gate + site="step" fallback accounting) and
+    # threaded into every builder as a static/cache-key argument
+    step_fused = trailing != "xla" and ppan.step_uses_fused(
+        dt, mat.block_size.row)
     # entry span: host wall around trace+dispatch, unfenced (device
     # completion is the caller's fence — the miniapp span carries the
     # honest GFlop/s); attrs and the reference flop model build lazily
@@ -1644,6 +1845,7 @@ def _cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
         trailing=trailing, lookahead=int(lookahead),
         comm_lookahead=int(comm_la),
         panel_impl="fused" if panel_fused else "xla",
+        step_impl="fused" if step_fused else "xla",
         **({"autotune_route": dict(route)} if route else {}),
         grid=f"{grid_shape[0]}x{grid_shape[1]}"))
     # the scan formulations follow the f64_gemm/f64_trsm knobs (identical
@@ -1666,15 +1868,18 @@ def _cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                     uplo=uplo, nb=mat.block_size.row, use_mxu=use_mxu,
                     use_mixed=use_mixed, lookahead=lookahead,
                     with_info=with_info, panel_fused=panel_fused,
-                    panel_interpret=panel_fused and panel_interp,
+                    step_fused=step_fused,
+                    panel_interpret=(panel_fused or step_fused)
+                    and panel_interp,
                     route=route)
             else:
                 out = obs.telemetry.call(
                     "cholesky.local", _cholesky_local, a, uplo=uplo,
                     nb=mat.block_size.row, trailing=trailing,
                     lookahead=lookahead, with_info=with_info,
-                    panel_fused=panel_fused,
-                    panel_interpret=panel_fused and panel_interp,
+                    panel_fused=panel_fused, step_fused=step_fused,
+                    panel_interpret=(panel_fused or step_fused)
+                    and panel_interp,
                     route=route)
             info = None
             if with_info:
@@ -1726,7 +1931,8 @@ def _cholesky(uplo: str, mat: Matrix, *, donate: bool = False,
                                # hoist (and cache key) is unrolled-only
                                comm_la=comm_la and not scan_mode,
                                with_info=with_info,
-                               panel_fused=panel_fused, route=route)
+                               panel_fused=panel_fused,
+                               step_fused=step_fused, route=route)
     with entry_span, quiet_donation():
         if with_info:
             storage, info = obs.telemetry.call("cholesky.dist", fn,

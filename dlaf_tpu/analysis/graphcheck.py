@@ -222,6 +222,35 @@ def program_specs(rows: int = 2, cols: int = 2, n: int = 24, nb: int = 4,
                                            pallas_interpret=True,
                                            panel_fused=True), (st32,)))
 
+    # ---- fused STEP route (step_impl="fused"; f32 — ONE pallas_call
+    # per strip-bearing blocked step, docs/pallas_panel.md "Fused step
+    # kernel"). Built with an EXPLICIT step_fused=True like the fpanel
+    # specs above: the pinned native config keeps the knob itself on
+    # "xla", these audit the fused-step programs the TPU auto
+    # resolution emits (tests/test_fused_step.py pins the per-step
+    # kernel count and the comm-overlap independence on this route). ----
+    for uplo in ("L", "U"):
+        add(f"cholesky.local.fstep.{uplo}.la1",
+            lambda uplo=uplo: (
+                lambda x: _cholesky_local.__wrapped__(
+                    x, uplo=uplo, nb=nb, trailing="loop", lookahead=True,
+                    step_fused=True, panel_interpret=True), (loc32,)))
+        add(f"cholesky.dist.fstep.{uplo}.la1.comm1",
+            lambda uplo=uplo: (
+                _build_dist_cholesky(dist, grid.mesh, uplo, False, True,
+                                     lookahead=True, comm_la=True,
+                                     step_fused=True), (st32,)))
+    add("cholesky.local_scan.fstep.L.la1",
+        lambda: (
+            lambda x: _cholesky_local_scan.__wrapped__(
+                x, uplo="L", nb=nb, lookahead=True, step_fused=True,
+                panel_interpret=True), (loc32,)))
+    add("cholesky.dist_scan.fstep.L.la1",
+        lambda: (_build_dist_cholesky_scan(dist, grid.mesh, "L",
+                                           lookahead=True,
+                                           pallas_interpret=True,
+                                           step_fused=True), (st32,)))
+
     # ---- autotune-routed programs (ISSUE 15, docs/autotune.md): the
     # re-routed programs the steered entries dispatch — a fast rung
     # (s=5 + the fused ozaki reduction) and the safety-top rung traced

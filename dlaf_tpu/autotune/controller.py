@@ -105,6 +105,7 @@ _KNOB_VALUES = {
     "f64_trsm": {"mixed": 0.0, "native": 1.0},
     "panel_impl": {"fused": 0.0, "xla": 1.0},
     "ozaki_impl": {"pallas": 0.0, "jnp": 1.0},
+    "step_impl": {"fused": 0.0, "xla": 1.0},
 }
 
 

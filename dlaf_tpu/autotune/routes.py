@@ -46,7 +46,8 @@ import numpy as np
 
 #: Fields a route may override, in serialization order. Each is the name
 #: of the config knob it shadows.
-ROUTE_FIELDS = ("f64_gemm_slices", "f64_trsm", "panel_impl", "ozaki_impl")
+ROUTE_FIELDS = ("f64_gemm_slices", "f64_trsm", "panel_impl", "ozaki_impl",
+                "step_impl")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,6 +59,7 @@ class Route:
     f64_trsm: Optional[str] = None        # "mixed" | "native"
     panel_impl: Optional[str] = None      # "fused" | "xla"
     ozaki_impl: Optional[str] = None      # "jnp" | "pallas"
+    step_impl: Optional[str] = None       # "fused" | "xla"
 
     def key(self) -> tuple:
         """Hashable cache-key component for the program caches: a route
@@ -80,6 +82,8 @@ class Route:
             parts.append(f"panel_{self.panel_impl}")
         if self.ozaki_impl is not None:
             parts.append(f"oz{self.ozaki_impl}")
+        if self.step_impl is not None:
+            parts.append(f"step_{self.step_impl}")
         return ".".join(parts) or "default"
 
     def as_dict(self) -> dict:
@@ -119,11 +123,16 @@ class Ladder:
 #: solves (``f64_trsm="native"``) as the safety top. Rung 3 (s=7, the
 #: TPU auto default) is the start. Every override only binds inside the
 #: mxu gemm route, so the whole ladder is inert where f64_gemm resolves
-#: "native" (CPU) — see the module docstring's ladder discipline.
+#: "native" (CPU) — see the module docstring's ladder discipline. Rung 0
+#: additionally arms ``step_impl="fused"``: dormant today (the fused
+#: step kernel is f32/bf16-only, and a route override never counts a
+#: fallback — :func:`~dlaf_tpu.tile_ops.pallas_panel.step_uses_fused`),
+#: it pre-registers the fastest step route on the fastest rung for when
+#: the emulated-f64 panel chain learns to ride it.
 LADDER_F64 = Ladder(
     name="f64",
     rungs=(
-        Route(f64_gemm_slices=5, ozaki_impl="pallas"),
+        Route(f64_gemm_slices=5, ozaki_impl="pallas", step_impl="fused"),
         Route(f64_gemm_slices=5),
         Route(f64_gemm_slices=6),
         Route(f64_gemm_slices=7),
@@ -133,18 +142,25 @@ LADDER_F64 = Ladder(
     start=3,
 )
 
-#: f32/bf16 ladder: the fused Pallas panel kernels (the TPU default,
-#: rung 0 = empty route) vs the generic XLA panel chain as the
-#: conservative escape (docs/pallas_panel.md documents the two impls as
-#: ulp-distinct at equal analytic budget; the generic route is the
-#: reference arbiter when a probe breaches).
+#: f32/bf16 ladder: the fused step kernel (one pallas_call per blocked
+#: step — the fastest, least conservative rung) above the platform
+#: default (start; on TPU the auto knobs already resolve both fusions
+#: on), degrading first to the composed per-op chain with only the
+#: panel kernels fused (``step_impl="xla"``) and finally to the generic
+#: XLA chain (docs/pallas_panel.md documents the impls as ulp-distinct
+#: at equal analytic budget; the generic route is the reference arbiter
+#: when a probe breaches). The ``step_impl="fused"`` override binds only
+#: on TPU (:func:`dlaf_tpu.tile_ops.pallas_panel.step_uses_fused`), so
+#: the ladder stays behavior-inert on CPU.
 LADDER_F32 = Ladder(
     name="f32",
     rungs=(
+        Route(step_impl="fused"),
         Route(),
-        Route(panel_impl="xla"),
+        Route(step_impl="xla"),
+        Route(step_impl="xla", panel_impl="xla"),
     ),
-    start=0,
+    start=1,
 )
 
 _LADDERS = {

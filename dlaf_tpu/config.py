@@ -258,6 +258,34 @@ class Configuration:
     #: knob contracts (lookahead/comm_lookahead/with_info) stay bitwise
     #: WITHIN each impl (tests/test_pallas_panel.py).
     panel_impl: str = "auto"
+    #: Fused STEP kernel route for the blocked Cholesky builders
+    #: (tile_ops/pallas_panel.py ``fused_step``/``fused_factor_solve``,
+    #: docs/pallas_panel.md "Fused step kernel"): "xla" (the panel chain
+    #: stays composed ops — ``panel_impl`` decides potrf/solve
+    #: individually), "fused" (ONE ``pallas_call`` per blocked step:
+    #: potrf ladder + whole-strip solve — and, on the local unrolled
+    #: builders, the adjacent trailing-update slab — with the factor,
+    #: its triangular inverse, and the solved leading strip block all
+    #: VMEM-resident between the ops; removes the per-step
+    #: kernel-launch + HBM round-trip that the MFU table pins as the
+    #: panel-bound floor, ROADMAP item 4), or "auto" (default): fused
+    #: on TPU for f32/bf16 within the ``step_vmem_limit`` budget, xla
+    #: elsewhere. Explicit "fused" with an unsupported dtype/block or a
+    #: VMEM-budget overflow registers at
+    #: ``dlaf_fallback_total{site="step"}`` (DLAF_STRICT raises);
+    #: off-TPU explicit "fused" runs in interpret mode (CI/parity).
+    #: Results are ulp-close, not bitwise, vs the composed chain; all
+    #: knob contracts (lookahead/comm_lookahead/with_info) stay bitwise
+    #: WITHIN the fused-step route (tests/test_fused_step.py).
+    step_impl: str = "auto"
+    #: VMEM budget (bytes) for the fused step kernel's modeled live set
+    #: (``pallas_panel.step_vmem_bytes``): block sizes whose kernel
+    #: would exceed it degrade to the composed-op step route (counted
+    #: under explicit "fused", silent policy under "auto"). The default
+    #: caps the kernel at 10 MiB, leaving ~6 MiB of a v5e core's
+    #: ~16 MiB VMEM for the compiler's own buffers; the autotune ladder
+    #: and ``health.inject`` drills exercise the degrade path.
+    step_vmem_limit: int = 10 * 2 ** 20
     #: Panel-level factor/solve ops (real f64): "native" (XLA — latency-bound
     #: under TPU f64 emulation), "mixed" (f32 seed + Newton refinement,
     #: tile_ops/mixed.py: refined explicit inverse + matmul for per-tile
@@ -708,6 +736,7 @@ _VALID_CHOICES = {
     "f64_gemm": ("native", "mxu", "auto"),
     "f64_trsm": ("native", "mixed", "auto"),
     "panel_impl": ("fused", "xla", "auto"),
+    "step_impl": ("fused", "xla", "auto"),
     "ozaki_impl": ("jnp", "pallas"),
     "ozaki_dot": ("int8", "bf16", "auto"),
     "ozaki_group": ("dots", "concat", "auto"),
@@ -738,6 +767,9 @@ def _validate(cfg: Configuration) -> None:
     if not 0 <= cfg.f64_gemm_slices <= 9:
         raise ValueError(f"f64_gemm_slices={cfg.f64_gemm_slices}: must be in "
                          "[1, 9], or 0 for the platform-adaptive default")
+    if cfg.step_vmem_limit < 1:
+        raise ValueError(f"step_vmem_limit={cfg.step_vmem_limit}: must be "
+                         ">= 1 byte (the fused step kernel's VMEM budget)")
     if cfg.mixed_seed_base < 1:
         raise ValueError(f"mixed_seed_base={cfg.mixed_seed_base}: must be >= 1"
                          " (the recursive seed's leaf size)")
@@ -1000,6 +1032,24 @@ def resolved_panel_impl() -> str:
                "(MFU table: 1.9-7.3% with neither roofline binding); the "
                "fused Pallas panel kernels collapse it to one dispatch "
                "per step (docs/pallas_panel.md)")
+
+
+def resolved_step_impl() -> str:
+    """``step_impl`` with "auto" resolved: fused on TPU, xla elsewhere
+    (platform leg only — the dtype/block/VMEM-budget legs live in
+    ``tile_ops.pallas_panel.step_uses_fused``, the route's single
+    owner). An active autotune route (docs/autotune.md) overrides the
+    resolution."""
+    routed = _route_override("step_impl")
+    if routed is not None:
+        return routed
+    return resolve_platform_auto(
+        get_configuration().step_impl, knob="step_impl",
+        tpu_choice="fused", other_choice="xla",
+        detail="the remaining panel-bound floor is the kernel-launch + "
+               "HBM round-trip between panel factorization and trailing "
+               "update at every blocked step (ROADMAP item 4); the fused "
+               "step kernel removes the boundary (docs/pallas_panel.md)")
 
 
 def resolved_cholesky_lookahead() -> bool:

@@ -347,6 +347,223 @@ def fused_panel_solve(side: str, uplo: str, op: str, diag: str, a, b, *,
 
 
 # ---------------------------------------------------------------------------
+# Fused STEP kernels (step_impl route, docs/pallas_panel.md)
+# ---------------------------------------------------------------------------
+
+def _factor_into(a_ref, fac_ref, inv_ref, s: int):
+    """Grid-step-0 shared prologue of the fused step kernels: run the
+    micro-block potrf ladder on the identity-padded diagonal tile, write
+    the factor out with the LAPACK pass-through triangle, and build the
+    factor's triangular inverse into VMEM scratch for the strip solve
+    (the sequential TPU grid keeps both resident across grid steps)."""
+    a = a_ref[...].astype(jnp.float32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    tril = rows >= cols
+    f = _potrf_ladder(jnp.where(tril, a, 0.0), s)
+    fac_ref[...] = jnp.where(tril, f, a).astype(fac_ref.dtype)
+    inv_ref[...] = _tri_inv_lower(jnp.where(tril, f, 0.0), s)
+
+
+def _make_factor_solve_kernel(s: int):
+    """2-op step kernel (canonical lower/right form): grid step 0
+    factors the diagonal tile and derives its inverse into scratch;
+    every grid step then applies the inverse to its strip block as ONE
+    MXU gemm — potrf + whole-strip solve in a single ``pallas_call``,
+    the factor never round-tripping to HBM between the two ops."""
+
+    def kernel(a_ref, b_ref, fac_ref, out_ref, inv_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            _factor_into(a_ref, fac_ref, inv_ref, s)
+
+        b = b_ref[...].astype(jnp.float32)
+        out = jax.lax.dot_general(
+            b, inv_ref[...], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[...] = out.astype(out_ref.dtype)
+
+    return kernel
+
+
+def _make_step_kernel(s: int, w: int):
+    """3-op step kernel (canonical lower form): the factor+solve
+    prologue of :func:`_make_factor_solve_kernel` plus the ADJACENT
+    trailing-update slab consumed in the same kernel. Block 0's solved
+    strip rows (the rows aligned with the slab's columns) persist in a
+    second VMEM scratch square across the sequential grid, and every
+    grid step subtracts its ``p_i p_0^H`` outer product from its slab
+    block under the trailing lower-triangle mask (``w`` = the slab's
+    true column count). The solved strip never leaves VMEM between the
+    solve and the slab gemm that consumes it."""
+
+    def kernel(a_ref, b_ref, c_ref, fac_ref, p_ref, nc_ref, inv_ref,
+               p0_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            _factor_into(a_ref, fac_ref, inv_ref, s)
+
+        b = b_ref[...].astype(jnp.float32)
+        p = jax.lax.dot_general(
+            b, inv_ref[...], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p_ref[...] = p.astype(p_ref.dtype)
+
+        @pl.when(i == 0)
+        def _():
+            p0_ref[...] = p
+
+        upd = jax.lax.dot_general(
+            p, p0_ref[...], dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # strip row (global) vs slab column mask: strictly-below rows
+        # take the full update, the leading block its lower triangle;
+        # pad columns (>= w) pass the slab through untouched
+        grow = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0) + i * s
+        col = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        mask = (grow >= col) & (col < w)
+        c = c_ref[...].astype(jnp.float32)
+        nc_ref[...] = (c + jnp.where(mask, -upd, 0.0)).astype(nc_ref.dtype)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_factor_solve_rows(diag, b, *, interpret: bool = False):
+    """Canonical lower 2-op step over the rows of the 2D strip ``b``."""
+    d = diag.shape[-1]
+    f = b.shape[0]
+    s = _pad_size(d, interpret)
+    ap = _identity_pad(diag, s)
+    fp = -(-max(f, 1) // s) * s
+    bp = jnp.zeros((fp, s), b.dtype).at[:f, :d].set(b)
+    fac, out = pl.pallas_call(
+        _make_factor_solve_kernel(s),
+        grid=(fp // s,),
+        in_specs=[
+            pl.BlockSpec((s, s), lambda i: (0, 0)),
+            pl.BlockSpec((s, s), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((s, s), lambda i: (0, 0)),
+            pl.BlockSpec((s, s), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, s), diag.dtype),
+            jax.ShapeDtypeStruct((fp, s), b.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((s, s), jnp.float32)],
+        interpret=interpret,
+    )(ap, bp)
+    return fac[:d, :d], out[:f, :d]
+
+
+def fused_factor_solve(uplo: str, diag, strip, *, interpret: bool = False):
+    """Fused panel CHAIN: potrf of the diagonal tile + the whole panel
+    strip solve in ONE ``pallas_call`` (the 2-op step kernel — the
+    scan/distributed builders' step form, where the trailing slab is
+    separated from the panel chain by collectives or traced-index
+    masking and cannot join the kernel).
+
+    uplo='L': ``fac = chol(tril(diag))`` (upper triangle passes
+    through) and each strip row block solves ``X fac^H = strip`` — the
+    ``("R", "L", "C", "N")`` panel convention. uplo='U' is the mirrored
+    sweep (``fac^H X = strip``), mapped onto the canonical lower kernel
+    through cheap transposes outside the single kernel. ``strip`` is 2D
+    (rows, d) or a stacked (R, d, d) tile batch. f32/bf16 only
+    (computed in f32)."""
+    assert diag.ndim == 2 and jnp.dtype(diag.dtype) in _SUPPORTED, (
+        diag.shape, diag.dtype)
+    if uplo == "U":
+        st = jnp.swapaxes(strip, -1, -2)
+        fac, pan = fused_factor_solve("L", diag.T, st, interpret=interpret)
+        return fac.T, jnp.swapaxes(pan, -1, -2)
+    shape = strip.shape
+    b2 = strip.reshape(-1, shape[-1])
+    kw = dict(interpret=interpret)
+    if not _tracing(diag, b2):
+        fac, out = obs.telemetry.call("pallas_panel.factor_solve",
+                                      _fused_factor_solve_rows, diag, b2,
+                                      **kw)
+    else:
+        fac, out = _fused_factor_solve_rows(diag, b2, **kw)
+    return fac, out.reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "interpret"))
+def _fused_step_lower(diag, strip, slab, *, w: int,
+                      interpret: bool = False):
+    """Canonical lower 3-op step: pad, grid over the strip's row blocks,
+    slice the three outputs back."""
+    d = diag.shape[-1]
+    m = strip.shape[0]
+    s = _pad_size(d, interpret)
+    ap = _identity_pad(diag, s)
+    r = -(-max(m, 1) // s)
+    mp = r * s
+    bp = jnp.zeros((mp, s), strip.dtype).at[:m, :d].set(strip)
+    cp = jnp.zeros((mp, s), slab.dtype).at[:m, :w].set(slab)
+    fac, pan, nc = pl.pallas_call(
+        _make_step_kernel(s, w),
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((s, s), lambda i: (0, 0)),
+            pl.BlockSpec((s, s), lambda i: (i, 0)),
+            pl.BlockSpec((s, s), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((s, s), lambda i: (0, 0)),
+            pl.BlockSpec((s, s), lambda i: (i, 0)),
+            pl.BlockSpec((s, s), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, s), diag.dtype),
+            jax.ShapeDtypeStruct((mp, s), strip.dtype),
+            jax.ShapeDtypeStruct((mp, s), slab.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((s, s), jnp.float32),
+                        pltpu.VMEM((s, s), jnp.float32)],
+        interpret=interpret,
+    )(ap, bp, cp)
+    return fac[:d, :d], pan[:m, :d], nc[:m, :w]
+
+
+def fused_step(uplo: str, diag, strip, slab, *, interpret: bool = False):
+    """One fused Cholesky STEP — panel potrf + panel strip solve + the
+    ADJACENT trailing-update slab — as ONE ``pallas_call``: the factor,
+    its triangular inverse, and block 0 of the solved strip all stay
+    resident in VMEM between the three ops (the ROADMAP item-4 kernel;
+    docs/pallas_panel.md "Fused step kernel").
+
+    uplo='L': ``diag`` (d, d) lower-stored, ``strip`` (m, d) the rows
+    below the diagonal, ``slab`` (m, w) the first ``w = min(d, m)``
+    trailing columns. Returns ``(fac, panel, new_slab)`` where ``fac``
+    is the factored tile (opposite triangle passed through), ``panel``
+    the solved strip, and ``new_slab = slab - mask(panel panel[:w]^H)``
+    with the trailing lower-triangle mask — exactly the builders'
+    lookahead-split column strip, so the SSA carry can consume it
+    directly. uplo='U' is the mirrored sweep (``strip`` (d, m), ``slab``
+    (w, m)), mapped onto the canonical lower kernel through transposes
+    outside the single kernel. f32/bf16 only (computed in f32); the
+    NaN-prefix ``potrf_info`` failure contract propagates through the
+    solve and slab like the composed ops."""
+    assert diag.ndim == 2 and jnp.dtype(diag.dtype) in _SUPPORTED, (
+        diag.shape, diag.dtype)
+    if uplo == "U":
+        fac, pan, ns = fused_step("L", diag.T, strip.T, slab.T,
+                                  interpret=interpret)
+        return fac.T, pan.T, ns.T
+    w = slab.shape[-1]
+    kw = dict(w=w, interpret=interpret)
+    if not _tracing(diag, strip, slab):
+        return obs.telemetry.call("pallas_panel.step", _fused_step_lower,
+                                  diag, strip, slab, **kw)
+    return _fused_step_lower(diag, strip, slab, **kw)
+
+
+# ---------------------------------------------------------------------------
 # Routing — the panel_impl knob's single owner
 # ---------------------------------------------------------------------------
 
@@ -393,6 +610,84 @@ def panel_uses_fused(dtype, nb: int, platform=None) -> bool:
                        f"needs f32/bf16, nb<={PANEL_MB_MAX})")
         return False
     return route_available("pallas", "panel")
+
+
+def step_vmem_bytes(nb: int, dtype, interpret: bool = False) -> int:
+    """Modeled VMEM live set of the fused 3-op STEP kernel at block edge
+    ``nb``: the resident diagonal tile + factor output (single-buffered
+    by their constant index maps), double-buffered strip/slab/panel/
+    new-slab grid blocks, and the two f32 scratch squares (triangular
+    inverse + leading solved strip block). docs/pallas_panel.md walks
+    the arithmetic."""
+    s = _pad_size(nb, interpret)
+    db = jnp.dtype(dtype).itemsize
+    return s * s * (2 * db + 8 * db + 2 * 4)
+
+
+def step_uses_fused(dtype, nb: int) -> bool:
+    """Will the blocked-Cholesky STEP route through the fused step
+    kernels under the current config? Single owner of the ``step_impl``
+    route decision (mirrors :func:`panel_uses_fused`); callers resolve
+    it ONCE per entry and thread it into the builders as a static
+    cache-key argument.
+
+    * ``"xla"`` — never (the panel chain stays composed ops; the
+      ``panel_impl`` route still decides potrf/solve individually).
+    * ``"auto"`` — fused on TPU for f32/bf16 within
+      :data:`PANEL_MB_MAX` and the ``step_vmem_limit`` budget;
+      everything else is route POLICY (uncounted).
+    * ``"fused"`` (explicit) — wherever supported (off-TPU the call
+      sites run the kernel in interpret mode); an unsupported
+      dtype/block or a VMEM-budget overflow registers through
+      ``report_fallback(site="step")`` (counted, strict raises).
+
+    An autotune ROUTE override to "fused" binds only on TPU — the
+    ladder rung stays behavior-inert on CPU per the docs/autotune.md
+    ladder discipline — while explicit config ``step_impl=fused`` binds
+    everywhere (tests/CI use it in interpret mode).
+    ``health.inject.disable_route("pallas")`` forces the gate closed;
+    when that flips a would-be-True answer the degradation is counted
+    at ``site="step"`` like every pallas route.
+    """
+    from ..config import get_configuration, resolved_step_impl
+    from ..health.registry import report_fallback, route_available
+
+    impl = resolved_step_impl()
+    if impl != "fused":
+        return False
+    cfg = get_configuration()
+    explicit = cfg.step_impl == "fused"
+    if not explicit and jax.default_backend() != "tpu":
+        # route-override rung relaxing onto "fused" off-TPU: stay inert
+        return False
+    supported = jnp.dtype(dtype) in _SUPPORTED and nb <= PANEL_MB_MAX
+    need = step_vmem_bytes(nb, dtype)
+    if not supported or need > cfg.step_vmem_limit:
+        if explicit:
+            # the user explicitly asked for the fused step: landing on
+            # XLA is a degradation, not policy — counted, strict raises
+            if not supported:
+                reason = ("unsupported_dtype"
+                          if jnp.dtype(dtype) not in _SUPPORTED
+                          else "block_too_large")
+                detail = (f"dtype={np.dtype(dtype).name} nb={nb} (fused "
+                          f"step needs f32/bf16, nb<={PANEL_MB_MAX})")
+            else:
+                reason = "vmem_budget"
+                detail = (f"nb={nb}: fused step kernel models ~{need} B "
+                          f"VMEM > step_vmem_limit={cfg.step_vmem_limit}")
+            report_fallback("step", reason, detail=detail)
+        return False
+    return route_available("pallas", "step")
+
+
+def count_step_kernel(impl: str) -> None:
+    """Trace-time step-route accounting (once per emitted strip-bearing
+    step in the compiled program): how many blocked-factorization steps
+    run their panel chain through a fused step kernel vs the composed
+    XLA/op chain — ``dlaf_step_kernel_total{impl}``."""
+    if obs.metrics_active():
+        obs.counter("dlaf_step_kernel_total", impl=impl).inc()
 
 
 def count_panel_kernel(impl: str, op: str) -> None:

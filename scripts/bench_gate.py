@@ -48,6 +48,12 @@ be >= ``--min-autotune-speedup`` (default 0.5 — parity minus
 probe-per-call overhead on platforms where the ladder is inert; on TPU
 the learned routes sit well above 1).
 
+``workload="fstep"`` lines (bench.py's fused-step A/B arm, ISSUE 19,
+docs/pallas_panel.md "Fused step kernel") face a history-free
+COMPLETENESS leg: the pair is the claim — when any fstep line is fresh,
+both the pinned composed-chain arm (``fstep``) and the fused-step arm
+(``fstep+fs1``) must be present, so a half-pair cannot pass as an A/B.
+
 ``workload="fleet"`` lines (bench.py's multi-replica serve-tier arm,
 ISSUE 18, docs/fleet.md) carry the third history-free leg: their
 N-replica vs 1-replica requests/s ``speedup`` field must be >=
@@ -274,6 +280,23 @@ def run_gate(history, fresh, *, tolerance: float, min_history: int,
         else:
             log(f"OK         {fmt_key(key)}: fleet N-vs-1 scaling "
                 f"{s:.2f}x >= {min_fleet_scaling:.2f}x")
+    # fused-step A/B completeness (ISSUE 19, docs/pallas_panel.md
+    # "Fused step kernel"): the fstep workload is a PAIRED claim — a
+    # fused-step measurement without its pinned composed-chain partner
+    # (or vice versa) cannot support the step-gap story, so the gate
+    # fails the half-pair loudly. History-free like the floors above.
+    fstep_variants = {line.get("variant") for line in fresh
+                      if line.get("workload") == "fstep"}
+    if fstep_variants:
+        missing = {"fstep", "fstep+fs1"} - fstep_variants
+        if missing:
+            regressions += 1
+            log(f"REGRESSION fstep A/B pair incomplete: missing "
+                f"{sorted(missing)} (ISSUE-19 fused-step leg; "
+                "history-free)")
+        else:
+            log(f"OK         fstep A/B pair complete "
+                f"({sorted(fstep_variants)})")
     return regressions
 
 

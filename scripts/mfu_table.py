@@ -161,6 +161,17 @@ def oz_compute_ceiling(chip: str, dot: str = "bf16") -> float:
 #: the ``fpanel`` / ``fpanel+fp1`` bench arms exist to measure.
 PANEL_STEP_S = 0.6e-3
 
+#: Modeled per-step latency of the FUSED STEP route (``step_impl``,
+#: docs/pallas_panel.md): ONE pallas_call per blocked step — the panel
+#: potrf, the strip solve, and the adjacent trailing slab never leave
+#: VMEM between them, so the per-step floor collapses to a single kernel
+#: dispatch + the strip's HBM streaming. Modeled ~0.05 ms/step pending
+#: silicon (half the fused-panel chain's two dispatches) — the ``fstep``
+#: bench arm and the committed critpath fixture pair
+#: (tests/fixtures/critpath{,_prestep}/) are the measured instruments
+#: that replace this model.
+FUSED_STEP_S = 0.05e-3
+
 #: Families whose per-step panel chain serializes across steps (step
 #: k+1's panel consumes step k's strip): the chain is a WALL-CLOCK FLOOR
 #: of nt * PANEL_STEP_S even under perfect lookahead/comm overlap, so
@@ -168,14 +179,15 @@ PANEL_STEP_S = 0.6e-3
 _PANEL_CHAIN_FAMILIES = ("cholesky", "trsm", "hegst")
 
 
-def panel_ceiling(family: str, n: int, nb: int):
+def panel_ceiling(family: str, n: int, nb: int,
+                  step_s: float = PANEL_STEP_S):
     """Panel-critical-path ceiling in GF/s (steps x modeled panel
     latency), or None for families without a serialized per-step panel
     chain."""
     if family not in _PANEL_CHAIN_FAMILIES:
         return None
     nt = -(-n // nb)
-    return _FLOPS_MODEL[family](n) / (nt * PANEL_STEP_S) / 1e9
+    return _FLOPS_MODEL[family](n) / (nt * step_s) / 1e9
 
 
 def chol_hbm_ceiling(chip: str, n: int, nb: int) -> float:
@@ -529,6 +541,10 @@ def measured(family: str, n: int, nb: int, path: str = HISTORY):
 #: override where the recorded number ran a rehearsal config.
 CONFIGS = [
     ("#1 cholesky d 4096/256 1x1", "cholesky", 4096, 256, "1x1", "v5e", ""),
+    ("#1 fused-step ceil 4096/256 1x1", "cholesky", 4096, 256, "1x1",
+     "v5e", "panel ceiling at the fused STEP route's one-dispatch/step "
+     "model (step_impl=fused, docs/pallas_panel.md) — the `fstep` bench "
+     "arm + critpath fixture pair measure what this models"),
     ("#1 ladder 8192/256 1x1", "cholesky", 8192, 256, "1x1", "v5e", ""),
     ("#1 ladder 12288/256 1x1", "cholesky", 12288, 256, "1x1", "v5e", ""),
     ("#1 ladder 16384/256 1x1", "cholesky", 16384, 256, "1x1", "v5e", ""),
@@ -562,6 +578,10 @@ CONFIGS = [
 #: where the recorded datum ran a different (n, nb) than the config asks
 _MEAS_AT = {"#4 red2band d 16384/512 4x4": (8192, 512)}
 
+#: rows whose panel-critical-path ceiling uses a different modeled
+#: per-step latency than the product default (the fused-step ceiling row)
+_STEP_S = {"#1 fused-step ceil 4096/256 1x1": FUSED_STEP_S}
+
 
 def build_rows(with_ici=True, reuse_ici=None, dev=None, mb=None):
     rows = []
@@ -581,7 +601,8 @@ def build_rows(with_ici=True, reuse_ici=None, dev=None, mb=None):
             ici = ici_ceiling(family, n, nb, grid, chip)
         else:
             ici = None
-        panel = panel_ceiling(family, n, nb)
+        panel = panel_ceiling(family, n, nb,
+                              step_s=_STEP_S.get(label, PANEL_STEP_S))
         candidates = [comp] + [x for x in (hbm, ici, panel)
                                if x is not None]
         ceil = min(candidates)
@@ -630,7 +651,12 @@ def render(with_ici=True, reuse_ici=None, dev=None, mb=None) -> str:
             "stays folded into the ceiling min — `ceil bound = panel` "
             "still names it as the binding side, where the fused Pallas "
             "panel kernels (`panel_impl`, docs/pallas_panel.md) are the "
-            "lever — but its displayed column is replaced by `measured "
+            "lever; the `#1 fused-step ceil` row re-prices that ceiling "
+            "at the fused STEP route's one-dispatch-per-step model "
+            f"({FUSED_STEP_S * 1e3:.2f} ms, `step_impl=fused` — the "
+            "panel/strip/slab never round-trip HBM within a step), the "
+            "headroom the `fstep` bench arm exists to claim — but its "
+            "displayed column is replaced by `measured "
             "bound`: the ISSUE-16 per-step critical-path classification "
             "(`dlaf_tpu.obs.critpath`, docs/observability.md), the "
             "dominant per-step bound (panel/bulk/comm/copy/gap) measured "

@@ -574,6 +574,17 @@ def test_strict_panel_site_raises(tmp_path):
     assert ei.value.site == "panel"
 
 
+def test_strict_step_site_raises(tmp_path):
+    path = str(tmp_path / "strict_step.jsonl")
+    C.initialize(C.Configuration(metrics_path=path, strict=True,
+                                 step_impl="fused", step_vmem_limit=1024))
+    a = hpd_matrix(16, np.float32)
+    with pytest.raises(health.DegradationError) as ei:
+        cholesky("L", Matrix_from(a, 4))
+    assert ei.value.site == "step"
+    assert ei.value.reason == "vmem_budget"
+
+
 def test_strict_coverage_audit_no_unlisted_site():
     """The audit itself: every ``report_fallback``/``route_available``
     site literal in dlaf_tpu/ must be in the strict-covered list below
@@ -582,7 +593,7 @@ def test_strict_coverage_audit_no_unlisted_site():
     import re
 
     covered = {"secular", "deflate", "band_to_tridiag", "pallas_update",
-               "ozaki_gemm", "ozaki_pallas", "panel"}
+               "ozaki_gemm", "ozaki_pallas", "panel", "step"}
     root = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "dlaf_tpu")
     found = set()
